@@ -1,0 +1,43 @@
+"""horovod_tpu: a TPU-native distributed training framework with the
+capability surface of Horovod (reference: aaron276h/horovod).
+
+Data-parallel collectives (allreduce / grouped_allreduce / allgather /
+broadcast / alltoall / reducescatter / join / barrier) behind
+``init``/``rank``/``size`` and ``DistributedOptimizer``-style adapters,
+executed as XLA collectives over ICI/DCN via PJRT instead of
+NCCL/MPI/Gloo.  Usage mirrors the reference::
+
+    import horovod_tpu as hvd           # or: import horovod_tpu.jax as hvd
+    hvd.init()
+    avg = hvd.allreduce(grads, op=hvd.Average)
+
+See SURVEY.md for the architecture map against the reference tree.
+"""
+
+from .common.basics import (init, shutdown, is_initialized, rank, size,
+                            local_rank, local_size, cross_rank, cross_size,
+                            is_homogeneous, topology, start_timeline,
+                            stop_timeline, xla_built, tcp_built, gloo_built,
+                            mpi_built, nccl_built, ccl_built, ddl_built,
+                            cuda_built, rocm_built, mpi_enabled,
+                            mpi_threads_supported)
+from .common.process_sets import (ProcessSet, global_process_set,
+                                  add_process_set, remove_process_set,
+                                  process_set_by_id, process_set_ids)
+from .ops.api import (SUM, AVERAGE, MIN, MAX, PRODUCT, ADASUM,
+                      allreduce, allreduce_async, grouped_allreduce,
+                      grouped_allreduce_async, allgather, allgather_async,
+                      broadcast, broadcast_async, alltoall, alltoall_async,
+                      reducescatter, reducescatter_async, barrier, join,
+                      synchronize, poll)
+from .ops.engine import CollectiveHandle, HorovodInternalError
+
+# Reference-style aliases (horovod exposes mpi_ops.Sum etc. as hvd.Sum).
+Sum = SUM
+Average = AVERAGE
+Min = MIN
+Max = MAX
+Product = PRODUCT
+Adasum = ADASUM
+
+__version__ = "0.1.0"

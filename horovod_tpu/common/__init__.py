@@ -1,0 +1,2 @@
+"""Common layer: lifecycle, config, topology, process sets (reference:
+horovod/common/ Python side)."""

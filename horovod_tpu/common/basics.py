@@ -1,0 +1,273 @@
+"""Core lifecycle + identity API: init / shutdown / rank / size / ...
+
+Equivalent of the reference's ``horovod/common/basics.py``
+(``HorovodBasics``) plus the init path of ``horovod/common/operations.cc``
+(``InitializeHorovodOnce``): reads env config once, discovers topology
+(TPU coords / launcher env instead of MPI), builds the process-set table
+and the background collective engine, and exposes the identity calls every
+adapter re-exports.
+
+Controller modes (reference: MPI vs Gloo controller selection):
+
+* ``inprocess`` — single-controller SPMD: ranks are mesh devices, the
+  engine executes XLA collectives directly.  Default when no launcher env
+  is present.  This is the TPU-idiomatic mode.
+* ``tcp``       — one process per slot, rank-0 negotiation + host-side
+  collectives over TCP through the native C++ core
+  (``horovod_tpu/core``), bootstrap via the rendezvous KV server.  The
+  Gloo-equivalent.  Selected automatically when the launcher exported
+  ``HOROVOD_RANK``/``HOROVOD_SIZE``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import List, Optional, Sequence
+
+from . import process_sets as _ps
+from .config import Config
+from .topology import Topology, inprocess_topology, multiprocess_topology
+from ..utils.timeline import get_timeline
+
+LOG = logging.getLogger("horovod_tpu")
+
+_LOG_LEVELS = {"trace": logging.DEBUG, "debug": logging.DEBUG,
+               "info": logging.INFO, "warning": logging.WARNING,
+               "error": logging.ERROR, "fatal": logging.CRITICAL,
+               "off": logging.CRITICAL + 10}
+
+
+class _GlobalState:
+    """Singleton runtime state (reference: HorovodGlobalState)."""
+
+    def __init__(self):
+        self.initialized = False
+        self.config: Optional[Config] = None
+        self.topology: Optional[Topology] = None
+        self.engine = None          # CollectiveEngine (inprocess mode)
+        self.tcp_core = None        # native core handle (tcp mode)
+        self.controller_mode = "inprocess"
+        self.lock = threading.Lock()
+
+
+_state = _GlobalState()
+
+
+def _resolve_process_set_ranks(process_set_id: int) -> Optional[List[int]]:
+    ps = _ps.process_set_by_id(process_set_id)
+    return ps.ranks
+
+
+def init(devices: Optional[Sequence] = None,
+         process_sets: Optional[Sequence] = None,
+         controller: Optional[str] = None,
+         comm=None):
+    """Initialize the runtime.  ``comm`` is accepted for reference API
+    compatibility (an MPI communicator there) and must be None here.
+
+    ``devices``: explicit jax device list for the world (defaults to all
+    addressable devices).  ``process_sets``: ProcessSets (or rank lists) to
+    register at init, like the reference's ``hvd.init(process_sets=...)``.
+    """
+    if comm is not None:
+        raise ValueError(
+            "MPI communicators do not exist on TPU; use process_sets or "
+            "the launcher instead")
+    with _state.lock:
+        if _state.initialized:
+            return
+        config = Config.from_env()
+        logging.basicConfig()
+        LOG.setLevel(_LOG_LEVELS.get(config.log_level, logging.WARNING))
+        mode = (controller or config.controller or "auto").lower()
+        if mode == "auto":
+            mode = "tcp" if config.rank is not None else "inprocess"
+        _state.config = config
+        _state.controller_mode = mode
+
+        timeline = get_timeline()
+        if config.timeline:
+            timeline.initialize(config.timeline, config.timeline_mark_cycles)
+
+        if mode == "inprocess":
+            import jax
+            from ..ops.engine import CollectiveEngine
+            devs = list(devices) if devices is not None else list(jax.devices())
+            _state.topology = inprocess_topology(devs)
+            _state.engine = CollectiveEngine(
+                devs, config, timeline, _resolve_process_set_ranks)
+            if config.autotune:
+                from ..utils.autotune import ParameterManager
+                _state.engine.parameter_manager = ParameterManager(
+                    config.fusion_threshold_bytes, config.cycle_time_ms,
+                    log_path=config.autotune_log,
+                    warmup=config.autotune_warmup_samples,
+                    steps_per_sample=config.autotune_steps_per_sample)
+        elif mode == "tcp":
+            from ..core.client import TcpCore
+            _state.topology = multiprocess_topology(
+                config.rank or 0, config.size or 1,
+                config.local_rank, config.local_size,
+                config.cross_rank, config.cross_size)
+            _state.tcp_core = TcpCore(_state.topology, config)
+            _state.tcp_core.initialize()
+        else:
+            raise ValueError("unknown controller mode %r" % mode)
+
+        _ps.reset_registry()
+        if process_sets:
+            for ps in process_sets:
+                _ps.add_process_set(ps)
+        _state.initialized = True
+        atexit.register(shutdown)
+
+
+def shutdown():
+    """Tear down the background engine / native core (``hvd.shutdown``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.shutdown()
+            _state.engine = None
+        if _state.tcp_core is not None:
+            _state.tcp_core.shutdown()
+            _state.tcp_core = None
+        get_timeline().shutdown()
+        _ps.reset_registry()
+        _state.initialized = False
+        _state.topology = None
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init():
+    if not _state.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init() first")
+
+
+def _controller_is_spmd() -> bool:
+    return _state.controller_mode == "inprocess"
+
+
+def _get_engine():
+    _require_init()
+    if _state.engine is None:
+        raise RuntimeError(
+            "eager collectives in tcp mode go through the native core")
+    return _state.engine
+
+
+def _get_tcp_core():
+    _require_init()
+    return _state.tcp_core
+
+
+def _get_config() -> Config:
+    _require_init()
+    return _state.config
+
+
+def rank() -> int:
+    _require_init()
+    return _state.topology.rank
+
+
+def size() -> int:
+    _require_init()
+    return _state.topology.size
+
+
+def local_rank() -> int:
+    _require_init()
+    return _state.topology.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _state.topology.local_size
+
+
+def cross_rank() -> int:
+    _require_init()
+    return _state.topology.cross_rank
+
+
+def cross_size() -> int:
+    _require_init()
+    return _state.topology.cross_size
+
+
+def is_homogeneous() -> bool:
+    _require_init()
+    return _state.topology.is_homogeneous()
+
+
+def topology() -> Topology:
+    _require_init()
+    return _state.topology
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False):
+    """Begin writing the chrome-trace timeline (``hvd.start_timeline``)."""
+    get_timeline().initialize(file_path, mark_cycles)
+
+
+def stop_timeline():
+    get_timeline().shutdown()
+
+
+# -- capability probes (reference: *_built()/*_enabled() in basics.py) ----
+
+def xla_built() -> bool:
+    return True
+
+
+def tcp_built() -> bool:
+    try:
+        from ..core.client import core_library_available
+        return core_library_available()
+    except Exception:
+        return False
+
+
+def gloo_built() -> bool:
+    # The TCP core is this framework's Gloo-equivalent CPU path.
+    return tcp_built()
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

@@ -1,0 +1,145 @@
+"""Runtime configuration parsed from environment variables.
+
+TPU-native equivalent of the reference's env parsing
+(``horovod/common/utils/env_parser.cc`` + the ``HOROVOD_*`` reads in
+``horovod/common/operations.cc``).  The same variable names are honored so
+reference users can switch without changing their job env; ``HVD_TPU_*``
+aliases are also accepted and win when both are set.
+
+No config files exist, mirroring the reference: env vars are the single
+source of runtime configuration, and the launcher (horovod_tpu.runner)
+forwards CLI flags by exporting these same variables to workers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Defaults mirror the reference's (fusion 64 MiB, cycle 1 ms lower bound /
+# 5 ms typical, cache capacity 1024, stall warning 60 s, shutdown 5 s).
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 5.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECS = 60.0
+DEFAULT_STALL_SHUTDOWN_SECS = 0.0  # 0 = never abort, warn only
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Read ``HVD_TPU_<name>`` falling back to ``HOROVOD_<name>``."""
+    v = os.environ.get("HVD_TPU_" + name)
+    if v is None:
+        v = os.environ.get("HOROVOD_" + name)
+    return default if v is None else v
+
+
+def _env_int(name: str, default: int) -> int:
+    v = _env(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = _env(name)
+    try:
+        return float(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = _env(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Config:
+    """Typed snapshot of all runtime knobs, read once at ``hvd.init()``."""
+
+    # --- fusion / cycle (parameter_manager-tunable) ---
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+
+    # --- response / executable cache ---
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+
+    # --- autotune ---
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+
+    # --- timeline (chrome trace) ---
+    timeline: Optional[str] = None
+    timeline_mark_cycles: bool = False
+
+    # --- stall inspector ---
+    stall_warning_secs: float = DEFAULT_STALL_WARNING_SECS
+    stall_shutdown_secs: float = DEFAULT_STALL_SHUTDOWN_SECS
+    stall_check_disable: bool = False
+
+    # --- logging ---
+    log_level: str = "warning"
+    log_timestamp: bool = True
+
+    # --- distributed / controller selection ---
+    controller: str = "auto"  # auto | inprocess | tcp
+    rank: Optional[int] = None
+    size: Optional[int] = None
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
+    cross_rank: Optional[int] = None
+    cross_size: Optional[int] = None
+    rendezvous_addr: Optional[str] = None  # host:port of the KV server
+    secret_key: Optional[str] = None
+
+    # --- misc parity knobs ---
+    dynamic_process_sets: bool = False
+    num_streams: int = 1  # HOROVOD_NUM_NCCL_STREAMS analog: engine executors
+    batch_d2d_memcopies: bool = True
+    elastic_timeout_secs: float = 600.0
+
+    @staticmethod
+    def from_env() -> "Config":
+        def opt_int(name):
+            v = _env(name)
+            return int(v) if v not in (None, "") else None
+
+        return Config(
+            fusion_threshold_bytes=_env_int(
+                "FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD),
+            cycle_time_ms=_env_float("CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_env_int("CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY),
+            autotune=_env_bool("AUTOTUNE", False),
+            autotune_log=_env("AUTOTUNE_LOG"),
+            autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int(
+                "AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            timeline=_env("TIMELINE"),
+            timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            stall_warning_secs=_env_float(
+                "STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_WARNING_SECS),
+            stall_shutdown_secs=_env_float(
+                "STALL_SHUTDOWN_TIME_SECONDS", DEFAULT_STALL_SHUTDOWN_SECS),
+            stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
+            log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
+            log_timestamp=_env_bool("LOG_TIMESTAMP", True),
+            controller=(_env("CONTROLLER", "auto") or "auto").lower(),
+            rank=opt_int("RANK"),
+            size=opt_int("SIZE"),
+            local_rank=opt_int("LOCAL_RANK"),
+            local_size=opt_int("LOCAL_SIZE"),
+            cross_rank=opt_int("CROSS_RANK"),
+            cross_size=opt_int("CROSS_SIZE"),
+            rendezvous_addr=_env("RENDEZVOUS_ADDR"),
+            secret_key=_env("SECRET_KEY"),
+            dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
+            num_streams=_env_int("NUM_STREAMS", 1),
+            batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            elastic_timeout_secs=_env_float("ELASTIC_TIMEOUT", 600.0),
+        )

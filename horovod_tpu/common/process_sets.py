@@ -1,0 +1,156 @@
+"""Process sets: named subsets of ranks that collectives can run on.
+
+Equivalent of the reference's ``horovod/common/process_set.cc`` +
+``horovod/common/process_sets.py`` (``ProcessSetTable``, ``hvd.ProcessSet``,
+``hvd.add_process_set``/``remove_process_set``).  In the TPU-native design a
+process set maps onto a sub-mesh of devices (in-process mode) or a subset of
+TCP peers (multi-process mode); each registered set gets its own executable
+cache partition so compiled collectives are keyed per set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+GLOBAL_PROCESS_SET_ID = 0
+
+
+class ProcessSet:
+    """A named subset of ranks.
+
+    ``ProcessSet([0, 1])`` restricts collectives to ranks 0 and 1.  The
+    global set (all ranks) always exists with id 0.
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = (
+            sorted(set(int(r) for r in ranks)) if ranks is not None else None)
+        self.process_set_id: Optional[int] = None
+
+    def included(self) -> bool:
+        """Whether the calling rank belongs to this set."""
+        from . import basics
+        if self.ranks is None:
+            return True
+        if basics.is_initialized() and basics._controller_is_spmd():
+            # Single controller acts for every device-rank.
+            return True
+        return basics.rank() in self.ranks
+
+    def rank(self) -> int:
+        """Rank of the caller within this set."""
+        from . import basics
+        if self.ranks is None:
+            return basics.rank()
+        if basics.rank() not in self.ranks:
+            raise ValueError(
+                "rank %d is not part of this process set" % basics.rank())
+        return self.ranks.index(basics.rank())
+
+    def size(self) -> int:
+        from . import basics
+        if self.ranks is None:
+            return basics.size()
+        return len(self.ranks)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessSet)
+                and self.ranks == other.ranks)
+
+    def __hash__(self):
+        return hash(tuple(self.ranks) if self.ranks is not None else None)
+
+    def __repr__(self):
+        return "ProcessSet(id=%s, ranks=%s)" % (
+            self.process_set_id,
+            "ALL" if self.ranks is None else self.ranks)
+
+
+global_process_set = ProcessSet(None)
+global_process_set.process_set_id = GLOBAL_PROCESS_SET_ID
+
+
+class ProcessSetTable:
+    """Registry mapping ids -> ProcessSet (``ProcessSetTable`` parity)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_id: Dict[int, ProcessSet] = {
+            GLOBAL_PROCESS_SET_ID: global_process_set}
+        self._next_id = 1
+
+    def reset(self, world_size: Optional[int] = None):
+        with self._lock:
+            self._by_id = {GLOBAL_PROCESS_SET_ID: global_process_set}
+            self._next_id = 1
+
+    def add(self, ps: ProcessSet) -> int:
+        from . import basics
+        with self._lock:
+            for existing in self._by_id.values():
+                if existing == ps:
+                    raise ValueError(
+                        "A process set with the same ranks already exists: %r"
+                        % existing)
+            if ps.ranks is not None and basics.is_initialized():
+                world = basics.size()
+                bad = [r for r in ps.ranks if r < 0 or r >= world]
+                if bad:
+                    raise ValueError(
+                        "Process set ranks %s out of range for world size %d"
+                        % (bad, world))
+            ps.process_set_id = self._next_id
+            self._by_id[ps.process_set_id] = ps
+            self._next_id += 1
+            return ps.process_set_id
+
+    def remove(self, ps: ProcessSet):
+        with self._lock:
+            if ps.process_set_id in (None, GLOBAL_PROCESS_SET_ID):
+                raise ValueError("Cannot remove the global process set")
+            self._by_id.pop(ps.process_set_id, None)
+            ps.process_set_id = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            return self._by_id[process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._by_id)
+
+
+_table = ProcessSetTable()
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a new process set (``hvd.add_process_set`` parity).
+
+    Accepts a ``ProcessSet`` or a list of ranks.
+    """
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    _table.add(process_set)
+    return process_set
+
+
+def remove_process_set(process_set: ProcessSet) -> bool:
+    """Deregister (``hvd.remove_process_set`` parity). Returns success."""
+    try:
+        _table.remove(process_set)
+        return True
+    except (ValueError, KeyError):
+        return False
+
+
+def process_set_by_id(process_set_id: int) -> ProcessSet:
+    return _table.get(process_set_id)
+
+
+def process_set_ids() -> List[int]:
+    return _table.ids()
+
+
+def reset_registry():
+    _table.reset()

@@ -1,0 +1,92 @@
+"""TPU topology discovery.
+
+Replaces the reference's MPI rank assignment (``MPIContext::Initialize`` in
+``horovod/common/mpi/mpi_context.cc``): on TPU, rank/size/local_rank derive
+from the TPU pod topology visible to the runtime (device coords, process
+index) rather than from ``MPI_Comm_rank``.
+
+Two worlds are supported:
+
+* **in-process SPMD** (single controller): every addressable device is a
+  "rank"; `local` = devices on this host; `cross` = slices.  This is the
+  idiomatic-JAX world where collectives are XLA ops over a Mesh.
+* **multi-process** (one process per host/slot, launched by
+  ``horovod_tpu.runner``): rank/size come from the launcher's env
+  (``HOROVOD_RANK``/``HOROVOD_SIZE``...), matching the reference's
+  Gloo-bootstrap path (``horovod/common/gloo/gloo_context.cc``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """World description: who am I, how many of us, how are we laid out."""
+
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    # Device coords (TPU: (x, y, z[, core])) indexed by global rank, when
+    # the runtime exposes them; None on CPU test worlds.
+    coords: Optional[List[tuple]] = None
+
+    def is_homogeneous(self) -> bool:
+        return self.size % max(self.cross_size, 1) == 0
+
+
+def _device_coords(devices: Sequence) -> Optional[List[tuple]]:
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return None
+        core = getattr(d, "core_on_chip", 0)
+        coords.append(tuple(c) + (core,))
+    return coords
+
+
+def inprocess_topology(devices: Sequence) -> Topology:
+    """Topology for the single-controller world: rank-per-device.
+
+    ``local`` covers devices owned by this process; with a single process
+    that is all of them, so local == world and cross_size == 1 (one host).
+    On a real multi-host JAX runtime (jax.distributed), local is
+    ``jax.local_devices()`` and cross is the process grid.
+    """
+    import jax
+
+    n = len(devices)
+    local = [d for d in devices if d.process_index == jax.process_index()]
+    n_local = len(local) or n
+    return Topology(
+        rank=0,
+        size=n,
+        local_rank=0,
+        local_size=n_local,
+        cross_rank=jax.process_index(),
+        cross_size=max(jax.process_count(), 1),
+        coords=_device_coords(devices),
+    )
+
+
+def multiprocess_topology(rank: int, size: int,
+                          local_rank: Optional[int] = None,
+                          local_size: Optional[int] = None,
+                          cross_rank: Optional[int] = None,
+                          cross_size: Optional[int] = None) -> Topology:
+    """Topology injected by the launcher for the one-process-per-slot world."""
+    local_size = local_size if local_size is not None else 1
+    local_rank = local_rank if local_rank is not None else 0
+    if cross_size is None:
+        cross_size = max(size // max(local_size, 1), 1)
+    if cross_rank is None:
+        cross_rank = rank // max(local_size, 1)
+    return Topology(rank=rank, size=size, local_rank=local_rank,
+                    local_size=local_size, cross_rank=cross_rank,
+                    cross_size=cross_size, coords=None)

@@ -1,0 +1,2 @@
+"""Collective op layer: XLA executables + async fusion engine (reference:
+horovod/common/ops/)."""

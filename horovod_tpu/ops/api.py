@@ -1,0 +1,254 @@
+"""Eager (host-side) collective API — the 8-op surface.
+
+Reference parity: ``hvd.allreduce / grouped_allreduce / allgather /
+broadcast / alltoall / reducescatter / join / barrier`` plus their
+``*_async`` variants and ``synchronize``/``poll`` (reference:
+``horovod/torch/mpi_ops.py`` + ``horovod/tensorflow/mpi_ops.py`` surfaces,
+backed by ``EnqueueTensor*`` in ``horovod/common/operations.cc``).
+
+In the in-process SPMD world, a collective's input is "rank-major
+stacked": ``x[r]`` is rank r's contribution (a list of per-rank tensors is
+also accepted; allgather may be ragged in dim 0).  Ops are enqueued to the
+background engine, fused, and executed as compiled XLA collectives; the
+``*_async`` forms return handles resolved by the cycle thread.
+
+In the multi-process (tcp) world the same calls route through the native
+C++ core, which negotiates readiness across ranks before executing.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from ..common.process_sets import ProcessSet, global_process_set
+from . import xla_ops
+from .engine import CollectiveHandle
+from .xla_ops import (ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM,
+                      handle_average_backwards_compatibility)
+
+__all__ = [
+    "SUM", "AVERAGE", "MIN", "MAX", "PRODUCT", "ADASUM",
+    "allreduce", "allreduce_async", "grouped_allreduce",
+    "grouped_allreduce_async", "allgather", "allgather_async",
+    "broadcast", "broadcast_async", "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "barrier", "join",
+    "synchronize", "poll",
+]
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    return name if name else "%s.noname.%s" % (prefix, uuid.uuid4().hex[:8])
+
+
+def _ps_id(process_set: Optional[ProcessSet]) -> int:
+    ps = process_set or global_process_set
+    if ps.process_set_id is None:
+        raise ValueError("process set %r is not registered" % ps)
+    return ps.process_set_id
+
+
+def _stack(tensor, ps_size: int):
+    """Accept a rank-major stacked array or a list of per-rank tensors."""
+    if isinstance(tensor, (list, tuple)):
+        arr = jnp.stack([jnp.asarray(t) for t in tensor])
+    else:
+        arr = jnp.asarray(tensor)
+    if arr.shape[0] != ps_size:
+        raise ValueError(
+            "expected rank-major stacked input with leading dim %d (one "
+            "slice per rank), got shape %s" % (ps_size, arr.shape))
+    return arr
+
+
+def _engine():
+    return basics._get_engine()
+
+
+# -- allreduce -------------------------------------------------------------
+
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None
+                    ) -> CollectiveHandle:
+    red_op = handle_average_backwards_compatibility(op, average)
+    ps = process_set or global_process_set
+    if red_op == ADASUM:
+        from ..utils.adasum import adasum_reduce_stacked
+        stacked = _stack(tensor, ps.size())
+        h = CollectiveHandle(_auto_name("allreduce", name))
+        try:
+            h._set_result(adasum_reduce_stacked(stacked))
+        except Exception as exc:  # noqa: BLE001
+            h._set_error(exc)
+        return h
+    stacked = _stack(tensor, ps.size())
+    return _engine().enqueue_allreduce(
+        _auto_name("allreduce", name), stacked, red_op,
+        prescale_factor, postscale_factor, _ps_id(process_set))
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None):
+    """Reduce across ranks; returns the reduced tensor (replicated)."""
+    return allreduce_async(tensor, average, name, op, prescale_factor,
+                           postscale_factor, process_set).wait()
+
+
+# -- grouped allreduce -----------------------------------------------------
+
+def grouped_allreduce_async(tensors: Sequence, average=None,
+                            name: Optional[str] = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: Optional[ProcessSet] = None
+                            ) -> List[CollectiveHandle]:
+    """Enqueue a group atomically so fusion packs them together
+    (reference: group_table.cc / hvd.grouped_allreduce)."""
+    red_op = handle_average_backwards_compatibility(op, average)
+    ps_id = _ps_id(process_set)
+    ps = process_set or global_process_set
+    base = _auto_name("grouped_allreduce", name)
+    handles = []
+    for i, t in enumerate(tensors):
+        handles.append(_engine().enqueue_allreduce(
+            "%s.%d" % (base, i), _stack(t, ps.size()), red_op,
+            prescale_factor, postscale_factor, ps_id))
+    return handles
+
+
+def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set: Optional[ProcessSet] = None):
+    return [h.wait() for h in grouped_allreduce_async(
+        tensors, average, name, op, prescale_factor, postscale_factor,
+        process_set)]
+
+
+# -- allgather -------------------------------------------------------------
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None
+                    ) -> CollectiveHandle:
+    ps = process_set or global_process_set
+    if isinstance(tensor, (list, tuple)):
+        per_rank = [jnp.asarray(t) for t in tensor]
+        if len(per_rank) != ps.size():
+            raise ValueError("need one tensor per rank")
+    else:
+        arr = jnp.asarray(tensor)
+        per_rank = [arr[r] for r in range(ps.size())]
+    return _engine().enqueue_allgather(
+        _auto_name("allgather", name), per_rank, _ps_id(process_set))
+
+
+def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
+    """Gather per-rank tensors, concatenated on dim 0 (ragged dim-0 ok)."""
+    return allgather_async(tensor, name, process_set).wait()
+
+
+# -- broadcast -------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None
+                    ) -> CollectiveHandle:
+    ps = process_set or global_process_set
+    return _engine().enqueue_broadcast(
+        _auto_name("broadcast", name), _stack(tensor, ps.size()),
+        root_rank, _ps_id(process_set))
+
+
+def broadcast(tensor, root_rank: int, name=None,
+              process_set: Optional[ProcessSet] = None):
+    """Every rank receives rank ``root_rank``'s tensor."""
+    return broadcast_async(tensor, root_rank, name, process_set).wait()
+
+
+# -- alltoall --------------------------------------------------------------
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None
+                   ) -> CollectiveHandle:
+    ps = process_set or global_process_set
+    if isinstance(tensor, (list, tuple)):
+        tensor = jnp.stack([jnp.asarray(t) for t in tensor]) \
+            if splits is None else [jnp.asarray(t) for t in tensor]
+    if splits is not None:
+        splits = np.asarray(splits)
+        if isinstance(tensor, list):
+            tensor = jnp.stack(tensor) if len(
+                {t.shape for t in tensor}) == 1 else tensor
+    return _engine().enqueue_alltoall(
+        _auto_name("alltoall", name), tensor, splits, _ps_id(process_set))
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set: Optional[ProcessSet] = None):
+    """Exchange: rank r sends slice j of its tensor to rank j.
+
+    Returns the stacked received tensors; with ``splits`` also returns the
+    received splits (reference AlltoallOp semantics).
+    """
+    out, recv_splits = alltoall_async(tensor, splits, name,
+                                      process_set).wait()
+    return out if splits is None else (out, recv_splits)
+
+
+# -- reducescatter ---------------------------------------------------------
+
+def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
+                        process_set: Optional[ProcessSet] = None
+                        ) -> CollectiveHandle:
+    ps = process_set or global_process_set
+    return _engine().enqueue_reducescatter(
+        _auto_name("reducescatter", name), _stack(tensor, ps.size()),
+        op, _ps_id(process_set))
+
+
+def reducescatter(tensor, op=SUM, name=None,
+                  process_set: Optional[ProcessSet] = None):
+    """Reduce then scatter dim-0 shards; row r of the result is rank r's."""
+    return reducescatter_async(tensor, op, name, process_set).wait()
+
+
+# -- barrier / join --------------------------------------------------------
+
+def barrier(process_set: Optional[ProcessSet] = None):
+    """Block until all ranks (and all previously enqueued collectives on
+    this process set) have arrived (reference BarrierOp)."""
+    return _engine().enqueue_barrier(
+        _auto_name("barrier", None), _ps_id(process_set)).wait()
+
+
+def join(device=None) -> int:
+    """Signal this rank is out of data (reference JoinOp, ``hvd.join``).
+
+    Returns the last rank that joined.  In the in-process SPMD world all
+    device-ranks share one data stream, so join degenerates to a barrier
+    and returns size-1; the TCP multi-process core implements the full
+    zero-contribution protocol for uneven data.
+    """
+    if not basics._controller_is_spmd():
+        return basics._get_tcp_core().join()
+    barrier()
+    return basics.size() - 1
+
+
+# -- handle helpers --------------------------------------------------------
+
+def synchronize(handle: CollectiveHandle):
+    """Wait on an async handle and return its output (reference
+    ``hvd.synchronize``)."""
+    return handle.wait()
+
+
+def poll(handle: CollectiveHandle) -> bool:
+    """True if the async op has completed (reference ``hvd.poll``)."""
+    return handle.poll()

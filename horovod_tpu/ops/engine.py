@@ -1,0 +1,338 @@
+"""Asynchronous collective engine: enqueue -> fuse -> execute.
+
+TPU-native re-design of the reference's C++ coordination core hot path
+(``horovod/common/operations.cc`` ``BackgroundThreadLoop``/``RunLoopOnce``,
+``tensor_queue.cc``, ``fusion_buffer_manager.cc``): callers enqueue named
+tensors and get an async handle; a background cycle thread wakes every
+``HOROVOD_CYCLE_TIME`` ms, drains the queue, *fuses* small same-typed
+allreduces into one flat buffer (up to ``HOROVOD_FUSION_THRESHOLD`` bytes,
+padded to power-of-two buckets so the compiled-executable cache hits), runs
+one XLA collective per fused group, scatters results back, and resolves the
+handles.
+
+In the single-controller SPMD world "negotiation" is trivial (one process
+knows all readiness), so the controller concern collapses into this engine;
+the full rank-0 negotiation protocol lives in the C++ TCP core
+(``horovod_tpu/core``) used by the multi-process mode.  The engine still
+records NEGOTIATE/QUEUE/FUSE/EXEC phases in the timeline so traces read
+like the reference's.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.config import Config
+from ..utils.stall_inspector import StallInspector
+from ..utils.timeline import Timeline
+from . import xla_ops
+from .executable_cache import ExecutableCache
+from .xla_ops import MeshCollectives
+
+LOG = logging.getLogger("horovod_tpu")
+
+_OP_ALLREDUCE = "allreduce"
+_OP_ALLGATHER = "allgather"
+_OP_BROADCAST = "broadcast"
+_OP_ALLTOALL = "alltoall"
+_OP_REDUCESCATTER = "reducescatter"
+_OP_BARRIER = "barrier"
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed (reference parity: surfaces to elastic mode)."""
+
+
+class CollectiveHandle:
+    """Async completion handle (reference: torch handle_manager.cc idea)."""
+
+    __slots__ = ("_event", "_result", "_error", "name")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def _set_error(self, exc: BaseException):
+        self._error = exc
+        self._event.set()
+
+    def poll(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                "collective %r did not complete in %s s" % (self.name, timeout))
+        if self._error is not None:
+            raise HorovodInternalError(str(self._error)) from self._error
+        return self._result
+
+
+class _Entry:
+    __slots__ = ("name", "op_type", "payload", "red_op", "prescale",
+                 "postscale", "root_rank", "splits", "process_set_id",
+                 "handle", "enqueue_t", "nbytes")
+
+    def __init__(self, name, op_type, payload, red_op, prescale, postscale,
+                 root_rank, splits, process_set_id, handle, nbytes):
+        self.name = name
+        self.op_type = op_type
+        self.payload = payload
+        self.red_op = red_op
+        self.prescale = prescale
+        self.postscale = postscale
+        self.root_rank = root_rank
+        self.splits = splits
+        self.process_set_id = process_set_id
+        self.handle = handle
+        self.enqueue_t = time.monotonic()
+        self.nbytes = nbytes
+
+
+def _bucket(n: int) -> int:
+    """Pad fused flat length to a power-of-two bucket (>=1024) so compiled
+    executables are reused across steps with slightly different groupings —
+    static shapes are what keep XLA/MXU happy."""
+    b = 1024
+    while b < n:
+        b <<= 1
+    return b
+
+
+class CollectiveEngine:
+    """Background-cycle fusion engine over one device list."""
+
+    def __init__(self, devices, config: Config, timeline: Timeline,
+                 process_set_resolver: Callable[[int], List[int]]):
+        self.devices = list(devices)
+        self.size = len(self.devices)
+        self.config = config
+        self.timeline = timeline
+        self._resolve_process_set = process_set_resolver
+        self.cache = ExecutableCache(config.cache_capacity)
+        self._collectives: Dict[int, MeshCollectives] = {}
+        self._queue: List[_Entry] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._shutdown = False
+        self._cycle_count = 0
+        self.stall_inspector = StallInspector(
+            warning_secs=config.stall_warning_secs,
+            shutdown_secs=config.stall_shutdown_secs,
+            enabled=not config.stall_check_disable)
+        self.parameter_manager = None  # installed by basics when autotuning
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-tpu-cycle", daemon=True)
+        self._thread.start()
+
+    # -- process-set meshes ------------------------------------------------
+
+    def collectives_for(self, process_set_id: int) -> MeshCollectives:
+        mc = self._collectives.get(process_set_id)
+        if mc is None:
+            ranks = self._resolve_process_set(process_set_id)
+            devs = (self.devices if ranks is None
+                    else [self.devices[r] for r in ranks])
+            mc = MeshCollectives(devs, cache=self.cache,
+                                 name="ps%d" % process_set_id)
+            self._collectives[process_set_id] = mc
+        return mc
+
+    def invalidate_process_set(self, process_set_id: int):
+        self._collectives.pop(process_set_id, None)
+
+    # -- enqueue API -------------------------------------------------------
+
+    def _enqueue(self, name, op_type, payload, red_op=xla_ops.SUM,
+                 prescale=1.0, postscale=1.0, root_rank=0, splits=None,
+                 process_set_id=0, nbytes=0) -> CollectiveHandle:
+        if self._shutdown:
+            raise HorovodInternalError("engine is shut down")
+        handle = CollectiveHandle(name)
+        e = _Entry(name, op_type, payload, red_op, prescale, postscale,
+                   root_rank, splits, process_set_id, handle, nbytes)
+        self.timeline.negotiate_start(name, op_type)
+        self.stall_inspector.record_enqueue(name)
+        with self._wake:
+            self._queue.append(e)
+            self._wake.notify()
+        return handle
+
+    def enqueue_allreduce(self, name, stacked, red_op, prescale, postscale,
+                          process_set_id) -> CollectiveHandle:
+        arr = jnp.asarray(stacked)
+        return self._enqueue(name, _OP_ALLREDUCE, arr, red_op=red_op,
+                             prescale=prescale, postscale=postscale,
+                             process_set_id=process_set_id,
+                             nbytes=arr.nbytes // max(arr.shape[0], 1))
+
+    def enqueue_allgather(self, name, per_rank, process_set_id):
+        return self._enqueue(name, _OP_ALLGATHER, per_rank,
+                             process_set_id=process_set_id)
+
+    def enqueue_broadcast(self, name, stacked, root_rank, process_set_id):
+        return self._enqueue(name, _OP_BROADCAST, jnp.asarray(stacked),
+                             root_rank=root_rank,
+                             process_set_id=process_set_id)
+
+    def enqueue_alltoall(self, name, stacked, splits, process_set_id):
+        return self._enqueue(name, _OP_ALLTOALL, stacked, splits=splits,
+                             process_set_id=process_set_id)
+
+    def enqueue_reducescatter(self, name, stacked, red_op, process_set_id):
+        return self._enqueue(name, _OP_REDUCESCATTER, jnp.asarray(stacked),
+                             red_op=red_op, process_set_id=process_set_id)
+
+    def enqueue_barrier(self, name, process_set_id):
+        return self._enqueue(name, _OP_BARRIER, None,
+                             process_set_id=process_set_id)
+
+    # -- background loop ---------------------------------------------------
+
+    def _loop(self):
+        while True:
+            with self._wake:
+                if not self._queue and not self._shutdown:
+                    self._wake.wait(timeout=self.config.cycle_time_ms / 1e3)
+                if self._shutdown and not self._queue:
+                    return
+                batch, self._queue = self._queue, []
+            self._cycle_count += 1
+            self.timeline.mark_cycle(self._cycle_count)
+            if batch:
+                t0 = time.monotonic()
+                nbytes = sum(e.nbytes for e in batch)
+                self._run_cycle(batch)
+                if self.parameter_manager is not None:
+                    self.parameter_manager.observe(
+                        nbytes, time.monotonic() - t0)
+                    self.config.fusion_threshold_bytes = (
+                        self.parameter_manager.fusion_threshold)
+                    self.config.cycle_time_ms = (
+                        self.parameter_manager.cycle_time_ms)
+            try:
+                self.stall_inspector.check()
+            except Exception as exc:  # StallError -> fail outstanding ops
+                with self._wake:
+                    pending, self._queue = self._queue, []
+                for e in pending:
+                    e.handle._set_error(exc)
+
+    def _run_cycle(self, batch: List[_Entry]):
+        # Group allreduces for fusion: (process set, dtype, red_op, scales).
+        fuse_groups: Dict[tuple, List[_Entry]] = {}
+        singles: List[_Entry] = []
+        for e in batch:
+            self.timeline.negotiate_end(e.name)
+            if e.op_type == _OP_ALLREDUCE:
+                k = (e.process_set_id, str(e.payload.dtype), e.red_op,
+                     float(e.prescale), float(e.postscale))
+                fuse_groups.setdefault(k, []).append(e)
+            else:
+                singles.append(e)
+        for key, group in fuse_groups.items():
+            # Respect the fusion threshold: chunk greedy-first-fit in order.
+            chunk: List[_Entry] = []
+            chunk_bytes = 0
+            for e in group:
+                if chunk and chunk_bytes + e.nbytes > \
+                        self.config.fusion_threshold_bytes:
+                    self._execute_fused_allreduce(chunk)
+                    chunk, chunk_bytes = [], 0
+                chunk.append(e)
+                chunk_bytes += e.nbytes
+            if chunk:
+                self._execute_fused_allreduce(chunk)
+        for e in singles:
+            self._execute_single(e)
+
+    def _execute_fused_allreduce(self, entries: List[_Entry]):
+        names = [e.name for e in entries]
+        try:
+            mc = self.collectives_for(entries[0].process_set_id)
+            size = mc.size
+            if len(entries) == 1 and entries[0].payload.ndim >= 1:
+                e = entries[0]
+                self.timeline.activity_start(e.name, "EXEC_ALLREDUCE")
+                out = mc.allreduce(e.payload, e.red_op,
+                                   float(e.prescale), float(e.postscale))
+                self.timeline.activity_end(e.name)
+                self.stall_inspector.record_done(e.name)
+                e.handle._set_result(out)
+                return
+            self.timeline.activity_start_all(names, "MEMCPY_IN_FUSION_BUFFER")
+            flats, lengths = [], []
+            for e in entries:
+                f = e.payload.reshape(size, -1)
+                lengths.append(f.shape[1])
+                flats.append(f)
+            total = sum(lengths)
+            padded = _bucket(total)
+            fused = jnp.concatenate(
+                flats + [jnp.zeros((size, padded - total),
+                                   dtype=flats[0].dtype)], axis=1)
+            self.timeline.activity_end_all(names)
+            self.timeline.activity_start_all(names, "EXEC_FUSED_ALLREDUCE")
+            e0 = entries[0]
+            out = mc.allreduce(fused, e0.red_op, float(e0.prescale),
+                               float(e0.postscale))
+            self.timeline.activity_end_all(names)
+            self.timeline.activity_start_all(
+                names, "MEMCPY_OUT_FUSION_BUFFER")
+            off = 0
+            for e, ln in zip(entries, lengths):
+                shard = out[off:off + ln].reshape(e.payload.shape[1:])
+                off += ln
+                self.stall_inspector.record_done(e.name)
+                e.handle._set_result(shard)
+            self.timeline.activity_end_all(names)
+        except Exception as exc:  # noqa: BLE001 - propagate to handles
+            LOG.error("fused allreduce failed: %s", exc)
+            for e in entries:
+                self.stall_inspector.record_done(e.name)
+                e.handle._set_error(exc)
+
+    def _execute_single(self, e: _Entry):
+        try:
+            mc = self.collectives_for(e.process_set_id)
+            self.timeline.activity_start(e.name, "EXEC_" + e.op_type.upper())
+            if e.op_type == _OP_ALLGATHER:
+                out = mc.allgather(e.payload)
+            elif e.op_type == _OP_BROADCAST:
+                out = mc.broadcast(e.payload, e.root_rank)
+            elif e.op_type == _OP_ALLTOALL:
+                out = mc.alltoall(e.payload, e.splits)
+            elif e.op_type == _OP_REDUCESCATTER:
+                out = mc.reducescatter(e.payload, e.red_op)
+            elif e.op_type == _OP_BARRIER:
+                out = mc.barrier()
+            else:
+                raise NotImplementedError(e.op_type)
+            self.timeline.activity_end(e.name)
+            self.stall_inspector.record_done(e.name)
+            e.handle._set_result(out)
+        except Exception as exc:  # noqa: BLE001
+            LOG.error("%s %r failed: %s", e.op_type, e.name, exc)
+            self.stall_inspector.record_done(e.name)
+            e.handle._set_error(exc)
+
+    # -- shutdown ----------------------------------------------------------
+
+    def shutdown(self):
+        with self._wake:
+            self._shutdown = True
+            self._wake.notify()
+        self._thread.join(timeout=10.0)

@@ -1,0 +1,1 @@
+"""Utility subsystems: timeline, stall inspector, autotuner, adasum."""

@@ -1,0 +1,177 @@
+"""Online autotuner for fusion threshold and cycle time.
+
+Equivalent of the reference's ``horovod/common/parameter_manager.cc`` +
+``horovod/common/optim/bayesian_optimization.cc`` / ``gaussian_process.cc``:
+when ``HOROVOD_AUTOTUNE=1``, the engine scores each sample of
+(fusion_threshold, cycle_time) by observed throughput (bytes reduced per
+second), and a Gaussian-process surrogate with an expected-improvement
+acquisition proposes the next sample.  After convergence (or
+``HOROVOD_AUTOTUNE_STEPS`` samples) the best point is pinned.
+
+The search space mirrors the reference: fusion threshold over
+{0..64} MiB-scale powers of two, cycle time over 1..25 ms.  Scores and
+samples are appended to ``HOROVOD_AUTOTUNE_LOG`` as CSV when set.
+
+A native C++ implementation with the same algorithm lives in
+``horovod_tpu/core`` for the TCP world; this module drives the in-process
+engine and is also importable for tests of the math itself.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# Search space (log2 bytes, ms).
+_FUSION_CHOICES = [1 << p for p in range(20, 28)]  # 1 MiB .. 128 MiB
+_CYCLE_CHOICES = [1.0, 2.5, 5.0, 10.0, 25.0]
+
+
+class GaussianProcess:
+    """Minimal RBF-kernel GP regressor (reference: gaussian_process.cc)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-6,
+                 alpha: float = 1e-10):
+        self.length_scale = length_scale
+        self.noise = noise
+        self.alpha = alpha
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._l: Optional[np.ndarray] = None
+        self._a: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d / (self.length_scale ** 2))
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        self._x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        self._y = np.asarray(y, dtype=np.float64)
+        k = self._kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.noise + self.alpha
+        self._l = np.linalg.cholesky(k)
+        self._a = np.linalg.solve(
+            self._l.T, np.linalg.solve(self._l, self._y))
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._a
+        v = np.linalg.solve(self._l, ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var)
+
+
+def expected_improvement(mu: np.ndarray, sigma: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    """EI acquisition (reference: bayesian_optimization.cc)."""
+    z = (mu - best - xi) / sigma
+    cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+    pdf = np.exp(-0.5 * z ** 2) / math.sqrt(2.0 * math.pi)
+    return (mu - best - xi) * cdf + sigma * pdf
+
+
+class BayesianOptimizer:
+    """GP + EI over the discrete (fusion, cycle) grid."""
+
+    def __init__(self):
+        self.grid = np.array(
+            [[math.log2(f), math.log2(c + 1.0)]
+             for f in _FUSION_CHOICES for c in _CYCLE_CHOICES])
+        self.points: List[np.ndarray] = []
+        self.scores: List[float] = []
+        self.gp = GaussianProcess(length_scale=1.5)
+
+    def _normalize(self):
+        y = np.asarray(self.scores)
+        s = y.std()
+        return (y - y.mean()) / (s if s > 0 else 1.0)
+
+    def record(self, point_idx: int, score: float):
+        self.points.append(self.grid[point_idx])
+        self.scores.append(score)
+
+    def next_index(self) -> int:
+        if len(self.scores) < 2:
+            # Bootstrap with spread-out samples.
+            return [0, len(self.grid) - 1][len(self.scores)]
+        self.gp.fit(np.stack(self.points), self._normalize())
+        mu, sigma = self.gp.predict(self.grid)
+        ei = expected_improvement(mu, sigma, float(self._normalize().max()))
+        return int(np.argmax(ei))
+
+    def best_index(self) -> int:
+        by_point = {}
+        for p, s in zip(self.points, self.scores):
+            by_point.setdefault(tuple(p), []).append(s)
+        best_p = max(by_point, key=lambda p: np.mean(by_point[p]))
+        return int(np.argmin(((self.grid - np.array(best_p)) ** 2).sum(1)))
+
+
+class ParameterManager:
+    """Drives sampling from the engine's cycle loop (parameter_manager.cc).
+
+    ``observe(bytes, secs)`` is called once per non-empty cycle; samples are
+    scored by aggregate throughput over ``steps_per_sample`` cycles.
+    """
+
+    def __init__(self, fusion_threshold: int, cycle_time_ms: float,
+                 log_path: Optional[str] = None, warmup: int = 3,
+                 steps_per_sample: int = 10, max_samples: int = 30):
+        self.bo = BayesianOptimizer()
+        self.fusion_threshold = fusion_threshold
+        self.cycle_time_ms = cycle_time_ms
+        self.warmup = warmup
+        self.steps_per_sample = steps_per_sample
+        self.max_samples = max_samples
+        self._log = open(log_path, "w") if log_path else None
+        if self._log:
+            self._log.write("sample,fusion_bytes,cycle_ms,score_bytes_per_s\n")
+        self._cycle_bytes = 0.0
+        self._cycle_secs = 0.0
+        self._cycles_seen = 0
+        self._samples_done = 0
+        self._current_idx: Optional[int] = None
+        self.frozen = False
+
+    def _apply(self, idx: int):
+        f_log, c_log = self.bo.grid[idx]
+        self.fusion_threshold = int(2 ** f_log)
+        self.cycle_time_ms = float(2 ** c_log - 1.0)
+        self._current_idx = idx
+
+    def observe(self, nbytes: int, secs: float):
+        if self.frozen:
+            return
+        if self.warmup > 0:
+            self.warmup -= 1
+            return
+        if self._current_idx is None:
+            self._apply(self.bo.next_index())
+        self._cycle_bytes += nbytes
+        self._cycle_secs += max(secs, 1e-9)
+        self._cycles_seen += 1
+        if self._cycles_seen < self.steps_per_sample:
+            return
+        score = self._cycle_bytes / self._cycle_secs
+        self.bo.record(self._current_idx, score)
+        self._samples_done += 1
+        if self._log:
+            self._log.write("%d,%d,%.3f,%.1f\n" % (
+                self._samples_done, self.fusion_threshold,
+                self.cycle_time_ms, score))
+            self._log.flush()
+        self._cycle_bytes = self._cycle_secs = 0.0
+        self._cycles_seen = 0
+        if self._samples_done >= self.max_samples:
+            self._apply(self.bo.best_index())
+            self.frozen = True
+            if self._log:
+                self._log.write("# converged: fusion=%d cycle=%.3f\n" % (
+                    self.fusion_threshold, self.cycle_time_ms))
+                self._log.flush()
+        else:
+            self._apply(self.bo.next_index())

@@ -1,0 +1,94 @@
+"""Stall inspector: the runtime deadlock/mismatch diagnosis tool.
+
+Equivalent of the reference's ``horovod/common/stall_inspector.cc``: if a
+collective has been submitted but not completed for longer than the warning
+threshold (``HOROVOD_STALL_CHECK_TIME_SECONDS``, default 60 s), log which
+tensors are stuck — in multi-process mode, also which ranks are missing
+them.  Optionally aborts after a shutdown threshold
+(``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``).
+
+This is the most-loved debugging feature of the reference (it turns a hang
+into an actionable message like "ranks 1,3 have not submitted tensor X"),
+so it is kept as a first-class component.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+LOG = logging.getLogger("horovod_tpu")
+
+
+class StallError(RuntimeError):
+    """Raised when a stall exceeds the shutdown threshold."""
+
+
+class StallInspector:
+    def __init__(self, warning_secs: float = 60.0,
+                 shutdown_secs: float = 0.0,
+                 enabled: bool = True,
+                 reporter: Optional[Callable[[str], None]] = None):
+        self.warning_secs = warning_secs
+        self.shutdown_secs = shutdown_secs
+        self.enabled = enabled and warning_secs > 0
+        self._reporter = reporter or (lambda msg: LOG.warning(msg))
+        # tensor name -> (enqueue time, optional "who's missing" info)
+        self._pending: Dict[str, Tuple[float, Optional[List[int]]]] = {}
+        self._warned: Dict[str, float] = {}
+        self._last_check = time.monotonic()
+
+    # -- bookkeeping (called by the engine/controller) ---------------------
+
+    def record_enqueue(self, tensor_name: str,
+                       missing_ranks: Optional[List[int]] = None):
+        self._pending[tensor_name] = (time.monotonic(), missing_ranks)
+
+    def record_update_missing(self, tensor_name: str,
+                              missing_ranks: List[int]):
+        if tensor_name in self._pending:
+            t, _ = self._pending[tensor_name]
+            self._pending[tensor_name] = (t, missing_ranks)
+
+    def record_done(self, tensor_name: str):
+        self._pending.pop(tensor_name, None)
+        self._warned.pop(tensor_name, None)
+
+    # -- checking (called once per background cycle) -----------------------
+
+    def check(self) -> List[str]:
+        """Returns names of currently-stalled tensors; emits warnings."""
+        if not self.enabled:
+            return []
+        now = time.monotonic()
+        # The reference rate-limits checks to the warning interval itself.
+        if now - self._last_check < min(self.warning_secs, 1.0):
+            return []
+        self._last_check = now
+        stalled = []
+        for name, (t0, missing) in list(self._pending.items()):
+            age = now - t0
+            if age < self.warning_secs:
+                continue
+            stalled.append(name)
+            last_warn = self._warned.get(name, 0.0)
+            if now - last_warn >= self.warning_secs:
+                self._warned[name] = now
+                if missing:
+                    self._reporter(
+                        "Stalled collective: tensor %r has waited %.0f s; "
+                        "ranks %s have not submitted it. One or more ranks "
+                        "may have died or diverged in their collective call "
+                        "order." % (name, age, missing))
+                else:
+                    self._reporter(
+                        "Stalled collective: tensor %r has waited %.0f s "
+                        "without completing. Possible causes: a rank died, "
+                        "or ranks issued collectives in different orders."
+                        % (name, age))
+            if self.shutdown_secs > 0 and age >= self.shutdown_secs:
+                raise StallError(
+                    "Collective %r stalled beyond the shutdown threshold "
+                    "(%.0f s); aborting." % (name, self.shutdown_secs))
+        return stalled

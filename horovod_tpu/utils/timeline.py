@@ -1,0 +1,117 @@
+"""Chrome-trace timeline for per-tensor collective lifecycles.
+
+Equivalent of the reference's ``horovod/common/timeline.cc``: every tensor's
+journey (NEGOTIATE -> QUEUE -> FUSE -> EXEC -> DONE) is appended to a
+``chrome://tracing``-loadable JSON array when a timeline file is configured
+(``HOROVOD_TIMELINE=/path.json`` or ``hvd.start_timeline(path)``).
+``HOROVOD_TIMELINE_MARK_CYCLES`` adds an instant event per background-loop
+cycle, like the reference's cycle markers.
+
+On TPU the XLA/PJRT profiler (xprof) covers device-side detail; this
+timeline covers the host-side scheduling story, which is what the
+reference's timeline was for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Thread-safe incremental chrome-trace writer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = None
+        self._path: Optional[str] = None
+        self._first = True
+        self._start_ts = time.monotonic()
+        self._pending_negotiation = {}
+        self.mark_cycles = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, path: Optional[str], mark_cycles: bool = False):
+        if not path:
+            return
+        with self._lock:
+            if self._fh is not None:
+                return
+            self._path = path
+            self.mark_cycles = mark_cycles
+            self._fh = open(path, "w")
+            self._fh.write("[\n")
+            self._first = True
+
+    def active(self) -> bool:
+        return self._fh is not None
+
+    def shutdown(self):
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.write("\n]\n")
+                self._fh.close()
+            except Exception:
+                pass
+            self._fh = None
+
+    # -- low-level emit ----------------------------------------------------
+
+    def _us(self) -> int:
+        return int((time.monotonic() - self._start_ts) * 1e6)
+
+    def _emit(self, record: dict):
+        with self._lock:
+            if self._fh is None:
+                return
+            if not self._first:
+                self._fh.write(",\n")
+            self._first = False
+            self._fh.write(json.dumps(record))
+            self._fh.flush()
+
+    # -- reference-parity API ---------------------------------------------
+
+    def activity_start(self, tensor_name: str, activity: str, rank: int = 0):
+        """Begin a phase for one tensor (``Timeline::ActivityStart``)."""
+        self._emit({"name": activity, "ph": "B", "ts": self._us(),
+                    "pid": rank, "tid": tensor_name})
+
+    def activity_end(self, tensor_name: str, rank: int = 0):
+        """End the innermost phase (``Timeline::ActivityEnd``)."""
+        self._emit({"ph": "E", "ts": self._us(),
+                    "pid": rank, "tid": tensor_name})
+
+    def activity_start_all(self, tensor_names, activity: str, rank: int = 0):
+        for n in tensor_names:
+            self.activity_start(n, activity, rank)
+
+    def activity_end_all(self, tensor_names, rank: int = 0):
+        for n in tensor_names:
+            self.activity_end(n, rank)
+
+    def negotiate_start(self, tensor_name: str, op_name: str, rank: int = 0):
+        self.activity_start(tensor_name, "NEGOTIATE_" + op_name.upper(), rank)
+
+    def negotiate_end(self, tensor_name: str, rank: int = 0):
+        self.activity_end(tensor_name, rank)
+
+    def mark_cycle(self, cycle_index: int, rank: int = 0):
+        """Instant event per background-loop cycle (mark-cycles parity)."""
+        if self.mark_cycles:
+            self._emit({"name": "CYCLE_START", "ph": "i", "ts": self._us(),
+                        "pid": rank, "tid": "cycle", "s": "g",
+                        "args": {"cycle": cycle_index}})
+
+
+_global_timeline = Timeline()
+
+
+def get_timeline() -> Timeline:
+    return _global_timeline

@@ -1,0 +1,38 @@
+"""Test world setup: 8 virtual CPU devices.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the cheap real-wire
+test backend there is Gloo-on-localhost; ours is JAX CPU with
+``--xla_force_host_platform_device_count=8`` — a real 8-"chip" world where
+XLA collectives actually execute, no mocks.
+
+Must run before any test imports initialize a JAX backend.  The axon TPU
+plugin (when present) pins ``JAX_PLATFORMS=axon`` from sitecustomize, so we
+override through jax.config, which wins as long as no backend has been
+created yet.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def hvd_world():
+    """Initialized in-process world over the 8 CPU devices; torn down after."""
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

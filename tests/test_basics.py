@@ -1,0 +1,81 @@
+"""Lifecycle + identity API tests (reference: test/parallel/test_*.py
+init/rank/size cases and test/single basics)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_init_rank_size(hvd_world):
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_double_init_is_noop(hvd_world):
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_shutdown_and_reinit():
+    hvd.init()
+    assert hvd.is_initialized()
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init()
+    assert hvd.size() == 8
+    hvd.shutdown()
+
+
+def test_uninitialized_raises():
+    hvd.shutdown()
+    with pytest.raises(RuntimeError):
+        hvd.rank()
+
+
+def test_built_probes(hvd_world):
+    assert hvd.xla_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_built()
+    assert not hvd.cuda_built()
+    assert not hvd.mpi_enabled()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_process_set_registry(hvd_world):
+    ps = hvd.add_process_set([0, 1, 2])
+    assert ps.process_set_id is not None
+    assert ps.size() == 3
+    assert ps.process_set_id in hvd.process_set_ids()
+    # Duplicate registration rejected.
+    with pytest.raises(ValueError):
+        hvd.add_process_set(hvd.ProcessSet([0, 1, 2]))
+    # Out-of-range ranks rejected.
+    with pytest.raises(ValueError):
+        hvd.add_process_set([0, 99])
+    assert hvd.remove_process_set(ps)
+    assert not hvd.remove_process_set(ps)  # already gone
+
+
+def test_config_env_parsing(monkeypatch):
+    from horovod_tpu.common.config import Config
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "99")
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "INFO")
+    monkeypatch.setenv("HOROVOD_TIMELINE", "/tmp/tl.json")
+    c = Config.from_env()
+    assert c.fusion_threshold_bytes == 1 << 20
+    assert c.cycle_time_ms == 2.5
+    assert c.cache_capacity == 99
+    assert c.log_level == "info"
+    assert c.timeline == "/tmp/tl.json"
+    # HVD_TPU_* alias wins over HOROVOD_*.
+    monkeypatch.setenv("HVD_TPU_CYCLE_TIME", "7")
+    assert Config.from_env().cycle_time_ms == 7.0

@@ -1,0 +1,190 @@
+"""Collective matrix tests: every op x dtype x process-set, async handles,
+fusion, error propagation (reference: test/parallel/test_torch.py /
+test_tensorflow.py collective cases)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+SIZE = 8
+DTYPES = [np.float32, np.int32, "bfloat16"]
+
+
+def _stacked(shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(SIZE, *shape)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.asarray(x, dtype=jnp.bfloat16)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return (x * 10).astype(dtype)
+    return x.astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum(hvd_world, dtype):
+    x = _stacked((4, 3), dtype)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    expected = np.sum(np.asarray(x, dtype=np.float64), axis=0)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float64), expected,
+                               rtol=1e-2 if dtype == "bfloat16" else 1e-5)
+
+
+def test_allreduce_average(hvd_world):
+    x = _stacked((5,))
+    out = hvd.allreduce(x, op=hvd.Average)
+    np.testing.assert_allclose(out, x.mean(axis=0), rtol=1e-5)
+
+
+def test_allreduce_average_legacy_kwarg(hvd_world):
+    x = _stacked((5,))
+    out = hvd.allreduce(x, average=True)
+    np.testing.assert_allclose(out, x.mean(axis=0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        hvd.allreduce(x, average=True, op=hvd.Sum)
+
+
+@pytest.mark.parametrize("op,npfn", [(hvd.Min, np.min), (hvd.Max, np.max),
+                                     (hvd.Product, np.prod)])
+def test_allreduce_min_max_product(hvd_world, op, npfn):
+    x = _stacked((3, 2))
+    out = hvd.allreduce(x, op=op)
+    np.testing.assert_allclose(out, npfn(x, axis=0), rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale(hvd_world):
+    x = _stacked((4,))
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                        postscale_factor=2.0)
+    np.testing.assert_allclose(out, x.sum(axis=0), rtol=1e-5)
+
+
+def test_allreduce_adasum(hvd_world):
+    x = _stacked((16,))
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    assert out.shape == (16,)
+    assert np.all(np.isfinite(out))
+    # Adasum of identical tensors collapses toward a single copy:
+    same = np.tile(np.arange(8.0, dtype=np.float32), (SIZE, 1))
+    merged = np.asarray(hvd.allreduce(same, op=hvd.Adasum))
+    np.testing.assert_allclose(merged, same[0], rtol=1e-4)
+
+
+def test_allreduce_async_poll_synchronize(hvd_world):
+    x = _stacked((1000,))
+    h = hvd.allreduce_async(x, op=hvd.Sum, name="big")
+    out = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(out, x.sum(axis=0), rtol=1e-4)
+
+
+def test_grouped_allreduce_fusion(hvd_world):
+    tensors = [_stacked((n,), seed=n) for n in (3, 5, 7, 1024)]
+    outs = hvd.grouped_allreduce(tensors, op=hvd.Sum, name="grp")
+    for t, o in zip(tensors, outs):
+        np.testing.assert_allclose(o, t.sum(axis=0), rtol=1e-4)
+
+
+def test_allgather_uniform(hvd_world):
+    x = _stacked((2, 3))
+    out = hvd.allgather(x)
+    np.testing.assert_allclose(out, x.reshape(SIZE * 2, 3), rtol=1e-6)
+
+
+def test_allgather_ragged(hvd_world):
+    per_rank = [np.full((r + 1, 2), r, dtype=np.float32) for r in range(SIZE)]
+    out = np.asarray(hvd.allgather(per_rank))
+    assert out.shape == (sum(r + 1 for r in range(SIZE)), 2)
+    np.testing.assert_allclose(out, np.concatenate(per_rank, axis=0))
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(hvd_world, root):
+    x = _stacked((4, 2))
+    out = hvd.broadcast(x, root_rank=root)
+    np.testing.assert_allclose(out, x[root], rtol=1e-6)
+
+
+def test_alltoall_uniform(hvd_world):
+    # rank r sends value r*SIZE+j to rank j.
+    x = np.arange(SIZE * SIZE, dtype=np.float32).reshape(SIZE, SIZE)
+    out = np.asarray(hvd.alltoall(x))
+    expected = x.T.reshape(SIZE, SIZE)
+    np.testing.assert_allclose(out, expected)
+
+
+def test_alltoall_ragged_splits(hvd_world):
+    # rank r sends (j+1) rows to rank j.
+    splits = np.tile(np.arange(1, SIZE + 1), (SIZE, 1))
+    rows = splits[0].sum()
+    x = np.stack([np.full((rows, 2), r, dtype=np.float32)
+                  for r in range(SIZE)])
+    out, recv_splits = hvd.alltoall(x, splits=splits)
+    np.testing.assert_array_equal(recv_splits, splits.T)
+    # rank j receives (j+1) rows from each rank, in rank order.
+    for j in range(SIZE):
+        got = np.asarray(out[j])
+        assert got.shape == ((j + 1) * SIZE, 2)
+        expected = np.repeat(np.arange(SIZE, dtype=np.float32), j + 1)
+        np.testing.assert_allclose(got[:, 0], expected)
+
+
+def test_reducescatter(hvd_world):
+    x = _stacked((SIZE * 3, 2))
+    out = np.asarray(hvd.reducescatter(x, op=hvd.Sum))
+    full = x.sum(axis=0)
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], full[r * 3:(r + 1) * 3],
+                                   rtol=1e-4)
+
+
+def test_barrier_and_join(hvd_world):
+    hvd.barrier()
+    assert hvd.join() == SIZE - 1
+
+
+def test_process_set_collective(hvd_world):
+    ps = hvd.add_process_set([0, 2, 4])
+    x = np.ones((3, 5), dtype=np.float32) * np.arange(3)[:, None]
+    out = hvd.allreduce(x, op=hvd.Sum, process_set=ps)
+    np.testing.assert_allclose(out, x.sum(axis=0), rtol=1e-5)
+    g = hvd.allgather([x[i] for i in range(3)], process_set=ps)
+    assert np.asarray(g).shape == (15,)
+    hvd.remove_process_set(ps)
+
+
+def test_shape_mismatch_error_propagates(hvd_world):
+    with pytest.raises(ValueError):
+        hvd.allreduce(np.ones((3, 2), dtype=np.float32))  # wrong world dim
+
+
+def test_executable_cache_hits(hvd_world):
+    from horovod_tpu.common import basics
+    eng = basics._get_engine()
+    x = _stacked((64,))
+    hvd.allreduce(x, op=hvd.Sum, name="c1")
+    misses = eng.cache.misses
+    for _ in range(3):
+        hvd.allreduce(x, op=hvd.Sum, name="c1")
+    assert eng.cache.misses == misses  # steady state: no recompiles
+    assert eng.cache.hits > 0
+
+
+def test_timeline_written(tmp_path):
+    import json
+    hvd.shutdown()
+    path = str(tmp_path / "tl.json")
+    import os
+    os.environ["HOROVOD_TIMELINE"] = path
+    try:
+        hvd.init()
+        hvd.allreduce(_stacked((8,)), name="tltensor")
+        hvd.shutdown()
+    finally:
+        os.environ.pop("HOROVOD_TIMELINE", None)
+    events = json.load(open(path))
+    names = {e.get("name") for e in events}
+    assert any(n and n.startswith("NEGOTIATE") for n in names)
+    assert any(n and n.startswith("EXEC") for n in names)
+    assert all("ts" in e for e in events)
