@@ -1,0 +1,85 @@
+"""Parameter/object broadcast + state sync helpers.
+
+Reference parity: ``hvd.broadcast_parameters``,
+``hvd.broadcast_optimizer_state``, ``hvd.broadcast_object`` (
+``horovod/torch/functions.py`` and ``horovod/tensorflow/functions.py``
+``broadcast_variables`` / ``broadcast_object``).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from ..ops import api as eager
+
+
+def _replicate(tree):
+    """Place every leaf replicated over the world mesh (in-process mode)."""
+    eng = basics._get_engine()
+    mc = eng.collectives_for(0)
+    sharding = mc._replicated_sharding
+    return jax.tree.map(
+        lambda x: jax.device_put(jnp.asarray(x), sharding), tree)
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None):
+    """Make every rank hold root's parameter pytree.
+
+    In-process SPMD world: the single controller owns one logical copy, so
+    broadcast = replicate that copy across the mesh devices (an XLA
+    broadcast transfer over ICI).  Multi-process world: per-leaf engine
+    broadcast from ``root_rank``.
+    """
+    if basics._controller_is_spmd():
+        return _replicate(params)
+    leaves, treedef = jax.tree.flatten(params)
+    handles = [eager.broadcast_async(
+        g, root_rank, name="broadcast_parameters/%d" % i,
+        process_set=process_set) for i, g in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, [h.wait() for h in handles])
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None):
+    """Broadcast optax optimizer state (reference
+    ``broadcast_optimizer_state``); same mechanics as parameters since
+    optax state is a pytree."""
+    return broadcast_parameters(opt_state, root_rank, process_set)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Pickle-broadcast an arbitrary python object from root to all ranks
+    (reference ``hvd.broadcast_object``): the payload travels as a uint8
+    tensor through the same collective path as tensors do."""
+    if basics._controller_is_spmd():
+        # Single controller: root's object IS the object; round-trip the
+        # bytes through a device broadcast for wire parity.
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        size = basics.size()
+        stacked = np.tile(payload, (size, 1))
+        out = eager.broadcast(stacked, root_rank,
+                              name=name or "broadcast_object",
+                              process_set=process_set)
+        return pickle.loads(np.asarray(out).tobytes())
+    core = basics._get_tcp_core()
+    return core.broadcast_object(obj, root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None):
+    """Gather one python object per rank into a list (reference
+    ``hvd.allgather_object``)."""
+    if basics._controller_is_spmd():
+        return [obj] * basics.size()
+    core = basics._get_tcp_core()
+    return core.allgather_object(obj, name=name)

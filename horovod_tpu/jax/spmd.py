@@ -1,0 +1,132 @@
+"""In-program (SPMD) collectives: the performance path.
+
+These are the collectives you call *inside* a jitted, mesh-sharded train
+step (``jax.shard_map`` / pjit).  XLA lowers them to ICI/DCN collective HLO
+and fuses them with surrounding compute — the TPU equivalent of the
+reference's NCCL-on-stream hot path (``ops/nccl_operations.cc``), with the
+compiler doing the overlap that Horovod did with stream events.
+
+The op surface mirrors the eager API (Sum/Average/Min/Max, prescale/
+postscale, compression) so a reference user can move a call inside jit
+without relearning semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.xla_ops import AVERAGE, MAX, MIN, PRODUCT, SUM
+from .compression import Compression
+
+DEFAULT_AXIS = "hvd"
+
+
+def size(axis_name: str = DEFAULT_AXIS):
+    """World size along the DP axis (usable inside jit)."""
+    return lax.axis_size(axis_name)
+
+
+def rank(axis_name: str = DEFAULT_AXIS):
+    """This shard's index along the DP axis (usable inside jit)."""
+    return lax.axis_index(axis_name)
+
+
+def allreduce(x, op: str = AVERAGE, axis_name: str = DEFAULT_AXIS,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=Compression.none):
+    """Cross-replica reduce inside an SPMD program."""
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    wire, ctx = compression.compress(x)
+    if op in (SUM, AVERAGE):
+        red = lax.psum(wire, axis_name)
+        if op == AVERAGE:
+            n = lax.axis_size(axis_name)
+            red = (red / n).astype(wire.dtype)
+    elif op == MIN:
+        red = lax.pmin(wire, axis_name)
+    elif op == MAX:
+        red = lax.pmax(wire, axis_name)
+    elif op == PRODUCT:
+        red = jnp.prod(lax.all_gather(wire, axis_name), axis=0)
+    else:
+        raise NotImplementedError(op)
+    out = compression.decompress(red, ctx)
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return out
+
+
+def grouped_allreduce(xs: Sequence, op: str = AVERAGE,
+                      axis_name: str = DEFAULT_AXIS,
+                      compression=Compression.none):
+    """Reduce a list of tensors as one fused payload.
+
+    In-program fusion: flatten-concat-reduce-split, which XLA lowers to a
+    single large all-reduce — the explicit analog of the engine's fusion
+    buffer for hand-written SPMD steps.
+    """
+    flats = [jnp.ravel(x) for x in xs]
+    sizes = [f.shape[0] for f in flats]
+    fused = jnp.concatenate(flats)
+    red = allreduce(fused, op=op, axis_name=axis_name,
+                    compression=compression)
+    outs, off = [], 0
+    for x, n in zip(xs, sizes):
+        outs.append(red[off:off + n].reshape(x.shape).astype(x.dtype))
+        off += n
+    return outs
+
+
+def allreduce_pytree(tree, op: str = AVERAGE, axis_name: str = DEFAULT_AXIS,
+                     compression=Compression.none):
+    """Fused reduce of every leaf of a pytree (gradients, metrics...)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return jax.tree.unflatten(
+        treedef, grouped_allreduce(leaves, op=op, axis_name=axis_name,
+                                   compression=compression))
+
+
+def allgather(x, axis_name: str = DEFAULT_AXIS, tiled: bool = True):
+    """Gather shards along dim 0 (reference allgather semantics)."""
+    return lax.all_gather(x, axis_name, tiled=tiled)
+
+
+def broadcast(x, root_rank: int = 0, axis_name: str = DEFAULT_AXIS):
+    """Replace every shard's value with ``root_rank``'s."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def alltoall(x, axis_name: str = DEFAULT_AXIS, split_axis: int = 0,
+             concat_axis: int = 0):
+    """Exchange: chunk j along ``split_axis`` goes to rank j."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def reducescatter(x, op: str = SUM, axis_name: str = DEFAULT_AXIS,
+                  scatter_axis: int = 0):
+    """Reduce then keep this rank's dim-0 shard."""
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                           tiled=True)
+    if op == AVERAGE:
+        out = (out / lax.axis_size(axis_name)).astype(out.dtype)
+    return out
+
+
+def ppermute(x, perm, axis_name: str = DEFAULT_AXIS):
+    """Neighbor exchange (``collective-permute``): the ring primitive used
+    by ring attention / pipeline parallelism.  Not in the reference's op
+    set — exposed because on TPU it is THE ICI-topology-native collective."""
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def barrier(axis_name: str = DEFAULT_AXIS):
+    """In-program barrier: a 1-element psum data dependency."""
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)
