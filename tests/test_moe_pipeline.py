@@ -1,0 +1,131 @@
+"""Expert-parallel MoE and pipeline parallelism tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.parallel.moe import MoeConfig, init_moe_params, moe_ffn
+from horovod_tpu.parallel.pipeline import pipeline_apply, split_microbatches
+
+
+def test_moe_local_vs_expert_parallel(hvd_world):
+    """Same experts, ep=1 vs ep=8: outputs must match."""
+    cfg = MoeConfig(n_experts=8, d_model=16, d_ff=32, top_k=2,
+                    capacity_factor=8.0)  # capacity high: no drops
+    key = jax.random.PRNGKey(0)
+    full = init_moe_params(key, cfg, experts_per_shard=8)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8 * 4, 16).astype(np.float32))
+
+    y_local, aux_local = moe_ffn(full, x, cfg, axis_name=None)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("ep",))
+    # Shard experts across ep; tokens across ep too; router replicated.
+    shard_params = {
+        "router": full["router"],
+        "w1": full["w1"], "w3": full["w3"], "w2": full["w2"],
+    }
+    f = jax.jit(jax.shard_map(
+        lambda p, t: moe_ffn(p, t, cfg, axis_name="ep"),
+        mesh=mesh,
+        in_specs=({"router": P(), "w1": P("ep"), "w3": P("ep"),
+                   "w2": P("ep")}, P("ep")),
+        out_specs=(P("ep"), P()), check_vma=False))
+    y_ep, aux_ep = f(shard_params, x)
+    # Note: token sharding changes per-shard capacity accounting; with a
+    # generous capacity factor both paths route every token.
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_local),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_moe_capacity_drops_tokens(hvd_world):
+    cfg = MoeConfig(n_experts=4, d_model=8, d_ff=16, top_k=1,
+                    capacity_factor=0.25)  # tight capacity -> drops
+    params = init_moe_params(jax.random.PRNGKey(1), cfg, experts_per_shard=4)
+    x = jnp.asarray(np.random.RandomState(1).randn(32, 8).astype(np.float32))
+    y, aux = moe_ffn(params, x, cfg, axis_name=None)
+    # Dropped tokens produce zero output rows; some must survive.
+    norms = np.linalg.norm(np.asarray(y), axis=1)
+    assert (norms > 1e-6).any()
+    assert float(aux) > 0
+
+
+def test_moe_gradients_flow(hvd_world):
+    cfg = MoeConfig(n_experts=4, d_model=8, d_ff=16, top_k=2,
+                    capacity_factor=2.0)
+    params = init_moe_params(jax.random.PRNGKey(2), cfg, experts_per_shard=4)
+    x = jnp.asarray(np.random.RandomState(2).randn(16, 8).astype(np.float32))
+
+    def loss(p):
+        y, aux = moe_ffn(p, x, cfg, axis_name=None)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+    assert float(jnp.abs(g["router"]).sum()) > 0
+
+
+def test_pipeline_matches_sequential(hvd_world):
+    """8-stage pipeline == running all layers sequentially."""
+    n_layers, d = 8, 6
+    rng = np.random.RandomState(0)
+    ws = jnp.asarray(rng.randn(n_layers, d, d).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(16, d).astype(np.float32))
+
+    def layer(w, h):
+        return jnp.tanh(h @ w)
+
+    # Sequential reference.
+    ref = x
+    for i in range(n_layers):
+        ref = layer(ws[i], ref)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+
+    def stage_fn(stage_ws, h):
+        # One layer per stage here (8 stages x 1 layer).
+        return layer(stage_ws[0], h)
+
+    mbs = split_microbatches(x, 4)
+    f = jax.jit(jax.shard_map(
+        lambda w, m: pipeline_apply(w, m, stage_fn, axis_name="pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))
+    out = f(ws, mbs)
+    got = out.reshape(16, d)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential(hvd_world):
+    n_layers, d = 8, 4
+    rng = np.random.RandomState(1)
+    ws = jnp.asarray(rng.randn(n_layers, d, d).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(8, d).astype(np.float32))
+
+    def layer(w, h):
+        return jnp.tanh(h @ w)
+
+    def seq_loss(ws):
+        h = x
+        for i in range(n_layers):
+            h = layer(ws[i], h)
+        return jnp.mean(h ** 2)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+
+    def pp_loss(ws):
+        def inner(w, m):
+            out = pipeline_apply(
+                w, m, lambda sw, h: layer(sw[0], h), axis_name="pp")
+            return jnp.mean(out ** 2)
+        f = jax.shard_map(inner, mesh=mesh, in_specs=(P("pp"), P()),
+                          out_specs=P(), check_vma=False)
+        return f(ws, split_microbatches(x, 2))
+
+    g_ref = jax.grad(seq_loss)(ws)
+    g_pp = jax.jit(jax.grad(pp_loss))(ws)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-4)
