@@ -1,0 +1,104 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Mirrors the reference's synthetic benchmark harness
+(``examples/pytorch/pytorch_synthetic_benchmark.py``: synthetic ImageNet
+batches, timed train steps, img/sec printed) — BASELINE.md's tracked
+metric.  ``vs_baseline`` compares against the reference's published
+per-accelerator ResNet-50 throughput on the hardware its benchmarks used
+(~225 img/s on a P100 with fp32 torch; Horovod paper / docs-era numbers),
+i.e. "how much faster is one TPU chip under this framework than one GPU
+under the reference".
+
+Prints exactly one JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_P100_IMG_PER_SEC = 225.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    # CPU fallback keeps the harness runnable in dev; real numbers come
+    # from the TPU chip.
+    batch = 64 if on_accel else 8
+    image = 224 if on_accel else 64
+    steps = 30 if on_accel else 3
+    warmup = 5 if on_accel else 1
+
+    from horovod_tpu.models.resnet import create_resnet50, resnet_loss_fn
+    import horovod_tpu.jax as hvd
+
+    hvd.init(devices=jax.devices()[:1])
+
+    model = create_resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, image, image, 3), dtype=jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, size=(batch,)), dtype=jnp.int32)
+    batch_data = {"x": x, "y": y}
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, image, image, 3), np.float32),
+                           train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, batch):
+        def loss(p):
+            nll, new_state = resnet_loss_fn(
+                model, {"params": p, "batch_stats": batch_stats}, batch)
+            return nll, new_state.get("batch_stats", batch_stats)
+
+        (nll, new_stats), grads = jax.value_and_grad(
+            loss, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, nll
+
+    fetch = jax.jit(lambda v: v.astype(jnp.float32))
+
+    def run(n, p, bs, os_):
+        """n train steps + one forced scalar round-trip."""
+        t0 = time.perf_counter()
+        nll = None
+        for _ in range(n):
+            p, bs, os_, nll = train_step(p, bs, os_, batch_data)
+        float(np.asarray(fetch(nll)))
+        return time.perf_counter() - t0, p, bs, os_
+
+    # Warmup (compile everything, incl. the fetch path).
+    _, params, batch_stats, opt_state = run(warmup, params, batch_stats,
+                                            opt_state)
+
+    # Differential timing: (2N steps) - (N steps) cancels the dispatch/
+    # fetch overhead of the runtime tunnel, where block_until_ready alone
+    # is not a reliable completion barrier.
+    t1, params, batch_stats, opt_state = run(steps, params, batch_stats,
+                                             opt_state)
+    t2, params, batch_stats, opt_state = run(2 * steps, params,
+                                             batch_stats, opt_state)
+    dt = max(t2 - t1, 1e-9)
+
+    img_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / REFERENCE_P100_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""MNIST-scale MLP: the minimal end-to-end DP workload.
+
+Parity with the reference's canonical example
+(``examples/pytorch/pytorch_mnist.py``, the BASELINE.json CPU config):
+a small classifier trained data-parallel through
+``hvd.make_data_parallel_step`` + ``DistributedOptimizer``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes: Sequence[int] = (784, 128, 64, 10),
+             dtype=jnp.float32):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, fan_in, fan_out in zip(keys, sizes[:-1], sizes[1:]):
+        params.append({
+            "w": (jax.random.normal(k, (fan_in, fan_out))
+                  / math.sqrt(fan_in)).astype(dtype),
+            "b": jnp.zeros((fan_out,), dtype),
+        })
+    return params
+
+
+def mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, batch):
+    logits = mlp_apply(params, batch["x"])
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    return nll
+
+
+def accuracy(params, batch):
+    logits = mlp_apply(params, batch["x"])
+    return jnp.mean(jnp.argmax(logits, -1) == batch["y"])
+
+
+def synthetic_mnist(rng, n: int):
+    """Deterministic MNIST stand-in (zero-egress environment: no dataset
+    downloads): 10 gaussian class prototypes + noise."""
+    protos = rng.randn(10, 784).astype("float32")
+    y = rng.randint(0, 10, size=n)
+    x = protos[y] + 0.5 * rng.randn(n, 784).astype("float32")
+    return {"x": x.astype("float32"), "y": y.astype("int32")}

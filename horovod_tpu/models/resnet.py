@@ -1,0 +1,125 @@
+"""ResNet-v1.5 family (ResNet-50 flagship) in flax.
+
+Parity with the reference's headline benchmark workload
+(``examples/pytorch/pytorch_imagenet_resnet50.py`` +
+``pytorch_synthetic_benchmark.py``; BASELINE.md metric
+"ResNet-50 images/sec/chip").  TPU-first choices: NHWC layout (XLA's
+native conv layout on TPU), bf16 activations on the MXU, optional
+cross-replica SyncBatchNorm via the framework's DP axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+STAGE_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    norm: Callable
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    norm: Callable
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    depth: int = 50
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    sync_batch_norm: bool = False
+    axis_name: Optional[str] = "hvd"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+            axis_name=self.axis_name if (self.sync_batch_norm and train)
+            else None)
+        block = BottleneckBlock if self.depth >= 50 else BasicBlock
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(STAGE_SIZES[self.depth]):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(64 * 2 ** i, strides, norm, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def create_resnet50(num_classes: int = 1000, dtype=jnp.bfloat16,
+                    sync_batch_norm: bool = False):
+    return ResNet(depth=50, num_classes=num_classes, dtype=dtype,
+                  sync_batch_norm=sync_batch_norm)
+
+
+def resnet_loss_fn(model: ResNet, variables, batch, train: bool = True):
+    """Cross-entropy + batch-stat update handling for flax BatchNorm."""
+    if train:
+        logits, new_state = model.apply(
+            variables, batch["x"], train=True, mutable=["batch_stats"])
+    else:
+        logits = model.apply(variables, batch["x"], train=False)
+        new_state = {}
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["y"][:, None], axis=1).mean()
+    return nll, new_state

@@ -1,0 +1,113 @@
+"""Flagship transformer tests: dense dp/sp/tp training, MoE variant,
+single-device equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from horovod_tpu.models.transformer import (TransformerConfig, forward,
+                                            init_params, loss_fn,
+                                            make_train_step)
+
+VOCAB = 64
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=64, max_seq=64, dtype="float32")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _batch(rng, b, s):
+    tokens = rng.randint(0, VOCAB, size=(b, s)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1)
+    return {"tokens": tokens, "targets": targets}
+
+
+def test_dense_transformer_trains_dp_sp_tp(hvd_world):
+    cfg = _cfg()
+    mesh = _mesh((2, 2, 2), ("dp", "sp", "tp"))
+    build, shard_batch = make_train_step(cfg, mesh, optax.adam(1e-2))
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    step, params, opt_state = build(params)
+    rng = np.random.RandomState(0)
+    batch = shard_batch(_batch(rng, 4, 32))
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7  # memorizing a fixed batch
+
+
+def test_moe_transformer_trains(hvd_world):
+    cfg = _cfg(n_experts=4, top_k=2, capacity_factor=2.0, d_ff=32)
+    mesh = _mesh((2, 2, 2), ("dp", "sp", "tp"))
+    build, shard_batch = make_train_step(cfg, mesh, optax.adam(1e-2))
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    step, params, opt_state = build(params)
+    rng = np.random.RandomState(1)
+    batch = shard_batch(_batch(rng, 4, 32))
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_sharded_loss_matches_single_device(hvd_world):
+    """Same params/batch: (2,2,2) mesh loss == (1,1,1) mesh loss."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    rng = np.random.RandomState(2)
+    batch = _batch(rng, 4, 32)
+
+    def run(mesh_shape, names, devices):
+        mesh = Mesh(np.asarray(devices).reshape(mesh_shape), names)
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.models.transformer import param_specs
+        import jax as _jax
+        f = _jax.jit(_jax.shard_map(
+            lambda p, b: loss_fn(p, b, cfg), mesh=mesh,
+            in_specs=(param_specs(cfg),
+                      {"tokens": P("dp", "sp"), "targets": P("dp", "sp")}),
+            out_specs=P(), check_vma=False))
+        return float(f(params, batch))
+
+    l_multi = run((2, 2, 2), ("dp", "sp", "tp"), jax.devices())
+    l_single = run((1, 1, 1), ("dp", "sp", "tp"), jax.devices()[:1])
+    assert l_multi == pytest.approx(l_single, rel=2e-4)
+
+
+def test_remat_matches_no_remat(hvd_world):
+    cfg = _cfg(remat=True)
+    cfg_plain = _cfg(remat=False)
+    params = init_params(jax.random.PRNGKey(3), cfg_plain)
+    rng = np.random.RandomState(3)
+    batch = _batch(rng, 2, 16)
+    mesh = _mesh((2, 2, 2), ("dp", "sp", "tp"))
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.models.transformer import param_specs
+
+    def gradnorm(c):
+        f = jax.jit(jax.shard_map(
+            jax.grad(lambda p, b: loss_fn(p, b, c)), mesh=mesh,
+            in_specs=(param_specs(c),
+                      {"tokens": P("dp", "sp"), "targets": P("dp", "sp")}),
+            out_specs=param_specs(c), check_vma=False))
+        g = f(params, batch)
+        return float(optax.global_norm(
+            jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), g)))
+
+    np.testing.assert_allclose(gradnorm(cfg), gradnorm(cfg_plain),
+                               rtol=1e-4)
