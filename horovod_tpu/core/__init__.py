@@ -1,0 +1,3 @@
+"""Native C++ coordination core (reference: horovod/common/ C++ tree):
+TCP negotiation + host-side collectives, built as libhvdtpu_core.so and
+driven through ctypes (client.py)."""

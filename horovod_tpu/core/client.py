@@ -1,0 +1,325 @@
+"""ctypes client for the native coordination core.
+
+Counterpart of the reference's ``horovod/common/basics.py`` loading the
+compiled shared library: builds ``libhvdtpu_core.so`` on demand (plain
+``make``, no third-party deps), then drives the C API
+(``hvd_tcp_init`` / ``hvd_tcp_enqueue`` / handle polling) for the
+multi-process (one process per slot) world.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_CORE_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_CORE_DIR, "libhvdtpu_core.so")
+
+# Enum values must match src/common.h.
+_DTYPES = {
+    np.dtype("uint8"): 0, np.dtype("int8"): 1, np.dtype("uint16"): 2,
+    np.dtype("int16"): 3, np.dtype("int32"): 4, np.dtype("int64"): 5,
+    np.dtype("float16"): 6, np.dtype("float32"): 7,
+    np.dtype("float64"): 8, np.dtype("bool"): 9,
+}
+_OP_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "alltoall": 3,
+             "reducescatter": 4, "barrier": 5, "join": 6}
+_RED_OPS = {"Sum": 0, "Average": 1, "Min": 2, "Max": 3, "Product": 4,
+            "Adasum": 5}
+
+_build_lock = threading.Lock()
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the core if the .so is missing or stale."""
+    with _build_lock:
+        src_dir = os.path.join(_CORE_DIR, "src")
+        if not force and os.path.exists(_LIB_PATH):
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            stale = any(
+                os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime
+                for f in os.listdir(src_dir))
+            if not stale:
+                return _LIB_PATH
+        subprocess.run(["make", "-j", "-s"], cwd=_CORE_DIR, check=True,
+                       capture_output=True)
+        return _LIB_PATH
+
+
+def core_library_available() -> bool:
+    try:
+        build_library()
+        return True
+    except Exception:
+        return False
+
+
+_lib = None
+
+
+def load_library():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_library())
+    lib.hvd_tcp_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_char_p]
+    lib.hvd_tcp_init.restype = ctypes.c_int
+    lib.hvd_tcp_enqueue.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint, ctypes.c_double,
+        ctypes.c_double, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+    lib.hvd_tcp_enqueue.restype = ctypes.c_int
+    lib.hvd_tcp_poll.argtypes = [ctypes.c_int]
+    lib.hvd_tcp_poll.restype = ctypes.c_int
+    lib.hvd_tcp_result_nbytes.argtypes = [ctypes.c_int]
+    lib.hvd_tcp_result_nbytes.restype = ctypes.c_longlong
+    lib.hvd_tcp_result_ndim.argtypes = [ctypes.c_int]
+    lib.hvd_tcp_result_ndim.restype = ctypes.c_int
+    lib.hvd_tcp_result_dims.argtypes = [ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvd_tcp_recv_splits.argtypes = [ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvd_tcp_recv_splits.restype = ctypes.c_int
+    lib.hvd_tcp_copy_result.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.hvd_tcp_copy_result.restype = ctypes.c_int
+    lib.hvd_tcp_error_string.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                         ctypes.c_int]
+    lib.hvd_tcp_error_string.restype = ctypes.c_int
+    lib.hvd_tcp_release.argtypes = [ctypes.c_int]
+    lib.hvd_tcp_add_process_set.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_tcp_add_process_set.restype = ctypes.c_uint
+    lib.hvd_tcp_remove_process_set.argtypes = [ctypes.c_uint]
+    lib.hvd_tcp_register_group.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.hvd_tcp_register_group.restype = ctypes.c_int
+    lib.hvd_tcp_join.restype = ctypes.c_int
+    lib.hvd_tcp_cache_hits.restype = ctypes.c_longlong
+    lib.hvd_tcp_cache_misses.restype = ctypes.c_longlong
+    _lib = lib
+    return lib
+
+
+class TcpHandle:
+    """Async handle over the native core (mirrors CollectiveHandle)."""
+
+    def __init__(self, lib, handle: int, dtype, name: str):
+        self._lib = lib
+        self._h = handle
+        self._dtype = dtype
+        self.name = name
+
+    def poll(self) -> bool:
+        return self._lib.hvd_tcp_poll(self._h) != 0
+
+    def wait(self, timeout: Optional[float] = None):
+        deadline = time.monotonic() + (timeout or 3600.0)
+        while True:
+            st = self._lib.hvd_tcp_poll(self._h)
+            if st == 1:
+                return self._fetch()
+            if st == 2:
+                buf = ctypes.create_string_buffer(4096)
+                self._lib.hvd_tcp_error_string(self._h, buf, 4096)
+                self._lib.hvd_tcp_release(self._h)
+                from ..ops.engine import HorovodInternalError
+                raise HorovodInternalError(buf.value.decode())
+            if time.monotonic() > deadline:
+                raise TimeoutError("collective %r timed out" % self.name)
+            time.sleep(0.0005)
+
+    def _fetch(self):
+        lib = self._lib
+        ndim = lib.hvd_tcp_result_ndim(self._h)
+        dims = (ctypes.c_longlong * max(ndim, 1))()
+        if ndim > 0:
+            lib.hvd_tcp_result_dims(self._h, dims)
+        shape = tuple(dims[i] for i in range(ndim))
+        out = np.empty(shape, dtype=self._dtype)
+        if out.size:
+            rc = lib.hvd_tcp_copy_result(
+                self._h, out.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                from ..ops.engine import HorovodInternalError
+                raise HorovodInternalError("result copy failed")
+        splits = (ctypes.c_longlong * 1024)()
+        nsp = lib.hvd_tcp_recv_splits(self._h, splits)
+        recv_splits = [int(splits[i]) for i in range(max(nsp, 0))]
+        lib.hvd_tcp_release(self._h)
+        return (out, recv_splits) if recv_splits else out
+
+
+class TcpCore:
+    """Multi-process backend bound to the launcher's env (HOROVOD_RANK /
+    HOROVOD_SIZE / rendezvous address table)."""
+
+    def __init__(self, topology, config):
+        self.topology = topology
+        self.config = config
+        self._lib = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self):
+        self._lib = load_library()
+        addrs = self._resolve_addrs()
+        rc = self._lib.hvd_tcp_init(
+            self.topology.rank, self.topology.size,
+            ";".join(addrs).encode())
+        if rc != 0:
+            raise RuntimeError("native core init failed (rank %d)"
+                               % self.topology.rank)
+
+    def _resolve_addrs(self) -> List[str]:
+        """Address table: direct env (HOROVOD_ADDRS) or rendezvous KV."""
+        direct = os.environ.get("HOROVOD_ADDRS")
+        if direct:
+            return direct.split(";")
+        addr = self.config.rendezvous_addr
+        if not addr:
+            # Single host default: sequential ports from a base.
+            base = int(os.environ.get("HOROVOD_PORT_BASE", "29600"))
+            return ["127.0.0.1:%d" % (base + r)
+                    for r in range(self.topology.size)]
+        from ..runner.http_client import RendezvousClient
+        client = RendezvousClient(addr, secret=self.config.secret_key)
+        port = int(os.environ.get("HOROVOD_PORT_BASE", "29600")) + \
+            self.topology.rank
+        my = "%s:%d" % (os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1"),
+                        port)
+        client.put("addr/%d" % self.topology.rank, my)
+        addrs = []
+        for r in range(self.topology.size):
+            addrs.append(client.get_blocking("addr/%d" % r, timeout=60.0))
+        return addrs
+
+    def shutdown(self):
+        if self._lib is None:
+            return
+        self._lib.hvd_tcp_request_shutdown()
+        self._lib.hvd_tcp_wait_shutdown()
+
+    # -- collectives -------------------------------------------------------
+
+    def _enqueue(self, name, op_type, arr: Optional[np.ndarray],
+                 red_op="Sum", root_rank=0, process_set_id=0,
+                 prescale=1.0, postscale=1.0, splits=None) -> TcpHandle:
+        if arr is not None:
+            arr = np.ascontiguousarray(arr)
+            dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+            ndim = arr.ndim
+            data = arr.ctypes.data_as(ctypes.c_void_p)
+            dtype_id = _DTYPES[arr.dtype]
+            dtype = arr.dtype
+        else:
+            dims = (ctypes.c_longlong * 1)(0)
+            ndim = 0
+            data = None
+            dtype_id = 0
+            dtype = np.dtype("uint8")
+        if splits is not None:
+            sp = (ctypes.c_longlong * len(splits))(*[int(s)
+                                                     for s in splits])
+            nsp = len(splits)
+        else:
+            sp = None
+            nsp = 0
+        h = self._lib.hvd_tcp_enqueue(
+            name.encode(), _OP_TYPES[op_type], data, dims, ndim, dtype_id,
+            _RED_OPS[red_op], root_rank, process_set_id, prescale,
+            postscale, sp, nsp)
+        if h < 0:
+            raise RuntimeError("enqueue failed for %r" % name)
+        return TcpHandle(self._lib, h, dtype, name)
+
+    def allreduce_async(self, arr, name, op="Sum", prescale=1.0,
+                        postscale=1.0, process_set_id=0):
+        return self._enqueue(name, "allreduce", arr, red_op=op,
+                             prescale=prescale, postscale=postscale,
+                             process_set_id=process_set_id)
+
+    def allgather_async(self, arr, name, process_set_id=0):
+        return self._enqueue(name, "allgather", arr,
+                             process_set_id=process_set_id)
+
+    def broadcast_async(self, arr, name, root_rank=0, process_set_id=0):
+        return self._enqueue(name, "broadcast", arr, root_rank=root_rank,
+                             process_set_id=process_set_id)
+
+    def alltoall_async(self, arr, name, splits=None, process_set_id=0):
+        if splits is None:
+            n = self.topology.size
+            if arr.shape[0] % n:
+                raise ValueError("uniform alltoall needs dim0 % size == 0")
+            splits = [arr.shape[0] // n] * n
+        return self._enqueue(name, "alltoall", arr, splits=splits,
+                             process_set_id=process_set_id)
+
+    def reducescatter_async(self, arr, name, op="Sum", process_set_id=0):
+        return self._enqueue(name, "reducescatter", arr, red_op=op,
+                             process_set_id=process_set_id)
+
+    def barrier(self, name=None, process_set_id=0):
+        h = self._enqueue(name or "barrier.%f" % time.monotonic(),
+                          "barrier",
+                          np.zeros((1,), np.uint8),
+                          process_set_id=process_set_id)
+        h.wait()
+
+    def join(self) -> int:
+        lib = self._lib
+        h = lib.hvd_tcp_join()
+        handle = TcpHandle(lib, h, np.dtype("int64"), "__join__")
+        out = handle.wait()
+        return int(np.asarray(out).reshape(-1)[0]) if np.size(out) else -1
+
+    # -- object helpers ----------------------------------------------------
+
+    def broadcast_object(self, obj, root_rank=0, name=None):
+        name = name or "broadcast_object"
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        size_arr = np.array([payload.size], dtype=np.int64)
+        sz = self.broadcast_async(size_arr, name + ".size",
+                                  root_rank=root_rank).wait()
+        n = int(np.asarray(sz).reshape(-1)[0])
+        if self.topology.rank != root_rank:
+            payload = np.zeros((n,), dtype=np.uint8)
+        out = self.broadcast_async(payload, name + ".data",
+                                   root_rank=root_rank).wait()
+        return pickle.loads(np.asarray(out).tobytes())
+
+    def allgather_object(self, obj, name=None):
+        name = name or "allgather_object"
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        sizes = self.allgather_async(
+            np.array([payload.size], dtype=np.int64),
+            name + ".sizes").wait()
+        blob = self.allgather_async(payload, name + ".data").wait()
+        blob = np.asarray(blob)
+        out, off = [], 0
+        for s in np.asarray(sizes).reshape(-1):
+            out.append(pickle.loads(blob[off:off + int(s)].tobytes()))
+            off += int(s)
+        return out
+
+    def add_process_set(self, ranks: Sequence[int]) -> int:
+        arr = (ctypes.c_int * len(ranks))(*[int(r) for r in ranks])
+        return int(self._lib.hvd_tcp_add_process_set(arr, len(ranks)))
+
+    def register_group(self, names: Sequence[str]) -> int:
+        arr = (ctypes.c_char_p * len(names))(
+            *[n.encode() for n in names])
+        return int(self._lib.hvd_tcp_register_group(arr, len(names)))
+
+    def cache_stats(self):
+        return (int(self._lib.hvd_tcp_cache_hits()),
+                int(self._lib.hvd_tcp_cache_misses()))

@@ -1,0 +1,133 @@
+// C-linkage API consumed by the Python client via ctypes (reference:
+// the C API block of horovod/common/operations.cc — horovod_init,
+// horovod_rank, EnqueueTensorAllreduce, ... — loaded there through
+// horovod/common/basics.py).
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "operations.h"
+
+using namespace hvdtpu;
+
+extern "C" {
+
+// addrs: semicolon-separated "host:port" per rank.
+int hvd_tcp_init(int rank, int size, const char* addrs) {
+  std::vector<std::string> list;
+  std::string s(addrs ? addrs : "");
+  size_t pos = 0;
+  while (pos != std::string::npos && !s.empty()) {
+    size_t next = s.find(';', pos);
+    list.push_back(s.substr(pos, next == std::string::npos ? next
+                                                           : next - pos));
+    pos = next == std::string::npos ? next : next + 1;
+  }
+  Status st = CoreState::Get().Initialize(rank, size, list);
+  return st.ok() ? 0 : -1;
+}
+
+int hvd_tcp_rank() { return CoreState::Get().rank(); }
+int hvd_tcp_size() { return CoreState::Get().size(); }
+int hvd_tcp_is_initialized() {
+  return CoreState::Get().initialized() ? 1 : 0;
+}
+
+void hvd_tcp_request_shutdown() { CoreState::Get().RequestShutdown(); }
+void hvd_tcp_wait_shutdown() { CoreState::Get().WaitShutdown(); }
+
+// op_type/dtype/red_op: enum ints matching common.h.
+int hvd_tcp_enqueue(const char* name, int op_type, const void* data,
+                    const long long* dims, int ndim, int dtype, int red_op,
+                    int root_rank, unsigned int process_set_id,
+                    double prescale, double postscale,
+                    const long long* splits, int nsplits) {
+  Request q;
+  q.op_type = static_cast<OpType>(op_type);
+  q.dtype = static_cast<DataType>(dtype);
+  q.red_op = static_cast<ReduceOp>(red_op);
+  q.root_rank = root_rank;
+  q.process_set_id = process_set_id;
+  q.prescale = prescale;
+  q.postscale = postscale;
+  q.name = name ? name : "";
+  for (int i = 0; i < ndim; ++i) q.shape.dims.push_back(dims[i]);
+  for (int i = 0; i < nsplits; ++i) q.splits.push_back(splits[i]);
+  int64_t nbytes = q.shape.num_elements() *
+                   static_cast<int64_t>(DataTypeSize(q.dtype));
+  return CoreState::Get().Enqueue(std::move(q), data, nbytes);
+}
+
+int hvd_tcp_join() { return CoreState::Get().EnqueueJoin(); }
+
+int hvd_tcp_poll(int handle) { return CoreState::Get().Poll(handle); }
+
+long long hvd_tcp_result_nbytes(int handle) {
+  auto e = CoreState::Get().GetEntry(handle);
+  return e ? static_cast<long long>(e->output.size()) : -1;
+}
+
+int hvd_tcp_result_ndim(int handle) {
+  auto e = CoreState::Get().GetEntry(handle);
+  return e ? static_cast<int>(e->output_dims.size()) : -1;
+}
+
+void hvd_tcp_result_dims(int handle, long long* dims) {
+  auto e = CoreState::Get().GetEntry(handle);
+  if (!e) return;
+  for (size_t i = 0; i < e->output_dims.size(); ++i)
+    dims[i] = e->output_dims[i];
+}
+
+int hvd_tcp_recv_splits(int handle, long long* splits) {
+  auto e = CoreState::Get().GetEntry(handle);
+  if (!e) return -1;
+  for (size_t i = 0; i < e->recv_splits.size(); ++i)
+    splits[i] = e->recv_splits[i];
+  return static_cast<int>(e->recv_splits.size());
+}
+
+int hvd_tcp_copy_result(int handle, void* dst) {
+  auto e = CoreState::Get().GetEntry(handle);
+  if (!e || !e->done) return -1;
+  if (!e->status.ok()) return -2;
+  std::memcpy(dst, e->output.data(), e->output.size());
+  return 0;
+}
+
+// Returns bytes written (excl. NUL).
+int hvd_tcp_error_string(int handle, char* buf, int buflen) {
+  auto e = CoreState::Get().GetEntry(handle);
+  std::string msg = e ? e->status.reason() : "unknown handle";
+  int n = static_cast<int>(msg.size());
+  if (n >= buflen) n = buflen - 1;
+  std::memcpy(buf, msg.data(), static_cast<size_t>(n));
+  buf[n] = 0;
+  return n;
+}
+
+void hvd_tcp_release(int handle) { CoreState::Get().Release(handle); }
+
+unsigned int hvd_tcp_add_process_set(const int* ranks, int n) {
+  std::vector<int32_t> v(ranks, ranks + n);
+  return CoreState::Get().RegisterProcessSet(v);
+}
+
+int hvd_tcp_remove_process_set(unsigned int id) {
+  return CoreState::Get().RemoveProcessSet(id) ? 0 : -1;
+}
+
+int hvd_tcp_register_group(const char** names, int n) {
+  std::vector<std::string> v;
+  for (int i = 0; i < n; ++i) v.emplace_back(names[i]);
+  return CoreState::Get().RegisterGroup(v);
+}
+
+long long hvd_tcp_cache_hits() {
+  return static_cast<long long>(CoreState::Get().cache().hits);
+}
+long long hvd_tcp_cache_misses() {
+  return static_cast<long long>(CoreState::Get().cache().misses);
+}
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+// Core shared types: Status, DataType, op enums, shapes.
+// TPU-native counterpart of the reference's horovod/common/common.h
+// (Status/StatusType/DataType/Framework enums, TensorShape).
+#ifndef HVD_TPU_COMMON_H
+#define HVD_TPU_COMMON_H
+
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class StatusType : int32_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+class Status {
+ public:
+  Status() : type_(StatusType::OK) {}
+  static Status OK() { return Status(); }
+  static Status UnknownError(const std::string& msg) {
+    return Status(StatusType::UNKNOWN_ERROR, msg);
+  }
+  static Status PreconditionError(const std::string& msg) {
+    return Status(StatusType::PRECONDITION_ERROR, msg);
+  }
+  static Status Aborted(const std::string& msg) {
+    return Status(StatusType::ABORTED, msg);
+  }
+  static Status InvalidArgument(const std::string& msg) {
+    return Status(StatusType::INVALID_ARGUMENT, msg);
+  }
+  static Status InProgress() { return Status(StatusType::IN_PROGRESS, ""); }
+  bool ok() const { return type_ == StatusType::OK; }
+  bool in_progress() const { return type_ == StatusType::IN_PROGRESS; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  Status(StatusType type, std::string reason)
+      : type_(type), reason_(std::move(reason)) {}
+  StatusType type_;
+  std::string reason_;
+};
+
+enum class DataType : uint8_t {
+  U8 = 0, I8 = 1, U16 = 2, I16 = 3, I32 = 4, I64 = 5,
+  F16 = 6, F32 = 7, F64 = 8, BOOL = 9, BF16 = 10,
+};
+
+inline size_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::U8: case DataType::I8: case DataType::BOOL: return 1;
+    case DataType::U16: case DataType::I16: case DataType::F16:
+    case DataType::BF16: return 2;
+    case DataType::I32: case DataType::F32: return 4;
+    case DataType::I64: case DataType::F64: return 8;
+  }
+  return 1;
+}
+
+const char* DataTypeName(DataType dt);
+
+enum class ReduceOp : uint8_t { SUM = 0, AVERAGE = 1, MIN = 2, MAX = 3,
+                                PRODUCT = 4, ADASUM = 5 };
+
+enum class OpType : uint8_t {
+  ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2, ALLTOALL = 3,
+  REDUCESCATTER = 4, BARRIER = 5, JOIN = 6,
+};
+
+const char* OpTypeName(OpType t);
+
+struct TensorShape {
+  std::vector<int64_t> dims;
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+  bool operator==(const TensorShape& o) const { return dims == o.dims; }
+  std::string DebugString() const;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_COMMON_H

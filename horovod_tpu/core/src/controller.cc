@@ -1,0 +1,292 @@
+#include "controller.h"
+
+#include <algorithm>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+void Controller::Initialize(int rank, int size, TcpMesh* mesh,
+                            ResponseCache* cache,
+                            ProcessSetTable* process_sets,
+                            GroupTable* groups, StallInspector* stall,
+                            ParameterManager* params,
+                            uint64_t fusion_threshold) {
+  rank_ = rank;
+  size_ = size;
+  mesh_ = mesh;
+  cache_ = cache;
+  process_sets_ = process_sets;
+  groups_ = groups;
+  stall_ = stall;
+  params_ = params;
+  fusion_threshold_ = fusion_threshold;
+}
+
+Status Controller::RunCycle(const CycleRequest& mine, CycleResponse* out) {
+  ++cycle_count_;
+  if (size_ == 1) {
+    // Single process: negotiation is trivially local.
+    Absorb(mine);
+    *out = ComputeResponseList();
+    return Status::OK();
+  }
+  if (is_coordinator()) {
+    Absorb(mine);
+    // Gather one cycle message from every worker (lockstep round).
+    for (int r = 1; r < size_; ++r) {
+      std::vector<uint8_t> buf;
+      Status s = mesh_->RecvFrame(r, &buf);
+      if (!s.ok()) return s;
+      Absorb(CycleRequest::Deserialize(buf.data(), buf.size()));
+    }
+    *out = ComputeResponseList();
+    auto payload = out->Serialize();
+    for (int r = 1; r < size_; ++r) {
+      Status s = mesh_->SendFrame(r, payload.data(), payload.size());
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  // Worker: send mine, await the coordinator's decisions.
+  auto payload = mine.Serialize();
+  Status s = mesh_->SendFrame(0, payload.data(), payload.size());
+  if (!s.ok()) return s;
+  std::vector<uint8_t> buf;
+  s = mesh_->RecvFrame(0, &buf);
+  if (!s.ok()) return s;
+  *out = CycleResponse::Deserialize(buf.data(), buf.size());
+  if (out->fusion_threshold) fusion_threshold_ = out->fusion_threshold;
+  return Status::OK();
+}
+
+void Controller::Absorb(const CycleRequest& req) {
+  if (req.shutdown) shutdown_requested_.insert(req.rank);
+  if (req.joined && !joined_.count(req.rank)) {
+    joined_.insert(req.rank);
+    last_joined_ = req.rank;
+  }
+  // Bitvector fast path: newly-ready cached tensors.
+  auto bits = UnpackBits(req.cache_bits,
+                         static_cast<size_t>(cache_->size()));
+  for (size_t id = 0; id < bits.size(); ++id)
+    if (bits[id]) {
+      cache_ready_[static_cast<int32_t>(id)].insert(req.rank);
+      Request q;
+      if (cache_->GetById(static_cast<int32_t>(id), nullptr, &q))
+        stall_->RecordRankReady(q.name, req.rank, size_);
+    }
+  // Full requests (first negotiation for these tensors).
+  for (const auto& q : req.requests) {
+    auto it = pending_.find(q.name);
+    if (it == pending_.end()) {
+      Pending p;
+      p.request = q;
+      it = pending_.emplace(q.name, std::move(p)).first;
+    }
+    Pending& p = it->second;
+    p.ranks.insert(req.rank);
+    p.shapes[req.rank] = q.shape;
+    if (!q.splits.empty()) p.splits[req.rank] = q.splits;
+    tensor_bytes_[q.name] = static_cast<uint64_t>(
+        q.shape.num_elements()) * DataTypeSize(q.dtype);
+    stall_->RecordRankReady(q.name, req.rank, size_);
+    // Validate cross-rank agreement (reference: controller error joins).
+    const Request& c = p.request;
+    if (q.op_type != c.op_type || q.dtype != c.dtype ||
+        q.red_op != c.red_op || q.process_set_id != c.process_set_id ||
+        q.root_rank != c.root_rank) {
+      p.error = true;
+      p.error_message =
+          "Mismatched collective for tensor '" + q.name +
+          "': ranks disagree on op/dtype/reduce-op/process-set/root.";
+    } else if (q.op_type == OpType::ALLREDUCE ||
+               q.op_type == OpType::REDUCESCATTER ||
+               q.op_type == OpType::BROADCAST) {
+      if (!(q.shape == c.shape)) {
+        p.error = true;
+        p.error_message = "Mismatched shape for tensor '" + q.name +
+                          "': " + q.shape.DebugString() + " vs " +
+                          c.shape.DebugString() + ".";
+      }
+    } else if (q.op_type == OpType::ALLGATHER) {
+      // First dim may differ; trailing dims must match.
+      auto a = q.shape.dims, b = c.shape.dims;
+      if (a.size() != b.size() ||
+          !std::equal(a.begin() + (a.empty() ? 0 : 1), a.end(),
+                      b.begin() + (b.empty() ? 0 : 1))) {
+        p.error = true;
+        p.error_message = "Mismatched allgather trailing dims for '" +
+                          q.name + "'.";
+      }
+    }
+  }
+}
+
+Response Controller::BuildResponse(const Request& q) {
+  Response r;
+  r.op_type = q.op_type;
+  r.process_set_id = q.process_set_id;
+  r.dtype = q.dtype;
+  r.red_op = q.red_op;
+  r.root_rank = q.root_rank;
+  r.prescale = q.prescale;
+  r.postscale = q.postscale;
+  r.tensor_names = {q.name};
+  if (q.op_type == OpType::ALLREDUCE)
+    r.aux_sizes = {q.shape.num_elements()};
+  return r;
+}
+
+CycleResponse Controller::ComputeResponseList() {
+  CycleResponse out;
+  const bool all_shutdown =
+      static_cast<int>(shutdown_requested_.size()) == size_;
+  out.shutdown = all_shutdown;
+
+  // Cached-path responses: a cache id is ready when every member of its
+  // process set (minus joined ranks) has flipped its bit.
+  std::vector<int32_t> ready_cached;
+  for (auto& kv : cache_ready_) {
+    Request q;
+    Response resp;
+    if (!cache_->GetById(kv.first, &resp, &q)) continue;
+    const ProcessSet* ps = process_sets_->Get(q.process_set_id);
+    if (!ps) continue;
+    size_t needed = 0;
+    for (auto m : ps->Members(size_))
+      if (!joined_.count(m)) ++needed;
+    if (kv.second.size() >= needed && needed > 0) {
+      cache_->hits++;
+      tensor_bytes_[q.name] = static_cast<uint64_t>(
+          q.shape.num_elements()) * DataTypeSize(q.dtype);
+      out.responses.push_back(resp);
+      stall_->RecordDone(q.name);
+      ready_cached.push_back(kv.first);
+    }
+  }
+  for (auto id : ready_cached) cache_ready_.erase(id);
+
+  // Full-negotiation responses.
+  std::vector<std::string> done;
+  for (auto& kv : pending_) {
+    Pending& p = kv.second;
+    const Request& q = p.request;
+    const ProcessSet* ps = process_sets_->Get(q.process_set_id);
+    if (!ps) {
+      p.error = true;
+      p.error_message = "Unknown process set " +
+                        std::to_string(q.process_set_id);
+    }
+    size_t needed = 0;
+    if (ps)
+      for (auto m : ps->Members(size_))
+        if (!joined_.count(m)) ++needed;
+    if (!p.error && (p.ranks.size() < needed || needed == 0)) continue;
+    // Grouped tensors (grouped_allreduce) move atomically: wait until
+    // every member of the group is individually ready.
+    int32_t gid = groups_->GroupOf(kv.first);
+    if (!p.error && gid >= 0) {
+      int32_t have = 0;
+      for (auto& kv2 : pending_)
+        if (groups_->GroupOf(kv2.first) == gid &&
+            static_cast<int>(kv2.second.ranks.size()) >=
+                static_cast<int>(needed))
+          ++have;
+      if (have < groups_->GroupSize(gid)) continue;
+    }
+    Response r = BuildResponse(q);
+    if (p.error) {
+      r.error = true;
+      r.error_message = p.error_message;
+    } else if (q.op_type == OpType::ALLGATHER) {
+      // aux = first dims in member order.
+      for (auto m : ps->Members(size_)) {
+        auto it = p.shapes.find(m);
+        r.aux_sizes.push_back(
+            it == p.shapes.end() || it->second.dims.empty()
+                ? 0 : it->second.dims[0]);
+      }
+    } else if (q.op_type == OpType::ALLTOALL) {
+      // aux = full splits matrix, member-major.
+      auto members = ps->Members(size_);
+      for (auto m : members) {
+        auto it = p.splits.find(m);
+        for (size_t j = 0; j < members.size(); ++j)
+          r.aux_sizes.push_back(
+              it == p.splits.end() || j >= it->second.size()
+                  ? 0 : it->second[j]);
+      }
+    } else if (q.op_type == OpType::JOIN) {
+      r.last_joined = last_joined_;
+    }
+    // NOTE: the cache Put happens on EVERY rank while processing the
+    // broadcast response list (operations.cc), so ids stay identical
+    // across ranks by construction; the coordinator does not pre-insert.
+    cache_->misses++;
+    stall_->RecordDone(kv.first);
+    out.responses.push_back(r);
+    done.push_back(kv.first);
+  }
+  for (auto& n : done) pending_.erase(n);
+
+  // JOIN completes when every rank has joined.
+  if (static_cast<int>(joined_.size()) == size_ && size_ > 0 &&
+      !joined_.empty()) {
+    Response r;
+    r.op_type = OpType::JOIN;
+    r.last_joined = last_joined_;
+    r.tensor_names = {"__join__"};
+    out.responses.push_back(r);
+    joined_.clear();
+    last_joined_ = -1;
+  }
+
+  FuseResponses(&out.responses);
+
+  if (params_) {
+    out.fusion_threshold = params_->fusion_threshold();
+    out.cycle_time_ms = params_->cycle_time_ms();
+    fusion_threshold_ = params_->fusion_threshold();
+  }
+  return out;
+}
+
+void Controller::FuseResponses(std::vector<Response>* responses) {
+  // Pack same-typed ready allreduces into fused responses up to the
+  // threshold (reference: Controller::FuseResponses).
+  std::vector<Response> fused;
+  std::map<std::string, Response> open;  // fuse key -> accumulating resp
+  std::map<std::string, uint64_t> open_bytes;
+  for (auto& r : *responses) {
+    if (r.op_type != OpType::ALLREDUCE || r.error ||
+        r.red_op == ReduceOp::ADASUM) {
+      fused.push_back(r);
+      continue;
+    }
+    std::string key = std::to_string(r.process_set_id) + "|" +
+                      std::to_string(static_cast<int>(r.dtype)) + "|" +
+                      std::to_string(static_cast<int>(r.red_op)) + "|" +
+                      std::to_string(r.prescale) + "|" +
+                      std::to_string(r.postscale);
+    uint64_t bytes = 0;
+    auto sit = tensor_bytes_.find(r.tensor_names[0]);
+    if (sit != tensor_bytes_.end()) bytes = sit->second;
+    auto it = open.find(key);
+    if (it != open.end() &&
+        open_bytes[key] + bytes <= fusion_threshold_) {
+      it->second.tensor_names.push_back(r.tensor_names[0]);
+      it->second.aux_sizes.push_back(
+          r.aux_sizes.empty() ? 0 : r.aux_sizes[0]);
+      open_bytes[key] += bytes;
+    } else {
+      if (it != open.end()) fused.push_back(it->second);
+      open[key] = r;
+      open_bytes[key] = bytes;
+    }
+  }
+  for (auto& kv : open) fused.push_back(kv.second);
+  *responses = std::move(fused);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,78 @@
+// Negotiation controller (reference: horovod/common/controller.cc +
+// gloo_controller.cc): rank 0 coordinates.  Every cycle each worker sends
+// a CycleRequest (bitvector of newly-ready cached tensors + full Requests
+// for uncached ones + join/shutdown flags); the coordinator joins
+// readiness across ranks, validates shape/dtype agreement, fuses ready
+// allreduces up to the fusion threshold, and broadcasts a CycleResponse.
+// The cache path reproduces the reference's steady-state fast path: after
+// first negotiation a tensor costs one bit on the wire.
+#ifndef HVD_TPU_CONTROLLER_H
+#define HVD_TPU_CONTROLLER_H
+
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "net.h"
+#include "parameter_manager.h"
+#include "process_set.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+
+namespace hvdtpu {
+
+class Controller {
+ public:
+  void Initialize(int rank, int size, TcpMesh* mesh,
+                  ResponseCache* cache, ProcessSetTable* process_sets,
+                  GroupTable* groups, StallInspector* stall,
+                  ParameterManager* params, uint64_t fusion_threshold);
+
+  bool is_coordinator() const { return rank_ == 0; }
+  uint64_t fusion_threshold() const { return fusion_threshold_; }
+
+  // One synchronous negotiation round.  ``mine`` is this rank's cycle
+  // message; ``out`` receives the coordinator's decisions.
+  Status RunCycle(const CycleRequest& mine, CycleResponse* out);
+
+ private:
+  // Coordinator-side: fold one rank's cycle message into pending state.
+  void Absorb(const CycleRequest& req);
+  // Coordinator-side: emit every response whose readiness is complete.
+  CycleResponse ComputeResponseList();
+  Response BuildResponse(const Request& q);
+  void FuseResponses(std::vector<Response>* responses);
+
+  int rank_ = 0, size_ = 1;
+  TcpMesh* mesh_ = nullptr;
+  ResponseCache* cache_ = nullptr;
+  ProcessSetTable* process_sets_ = nullptr;
+  GroupTable* groups_ = nullptr;
+  StallInspector* stall_ = nullptr;
+  ParameterManager* params_ = nullptr;
+  uint64_t fusion_threshold_ = 64ull << 20;
+
+  // Pending negotiation state (coordinator only).
+  struct Pending {
+    Request request;        // canonical (first reporter's) metadata
+    std::set<int32_t> ranks;
+    std::map<int32_t, TensorShape> shapes;   // allgather first dims
+    std::map<int32_t, std::vector<int64_t>> splits;  // alltoall
+    bool error = false;
+    std::string error_message;
+  };
+  std::map<std::string, Pending> pending_;
+  std::map<std::string, uint64_t> tensor_bytes_;
+  std::map<int32_t, std::set<int32_t>> cache_ready_;  // cache id -> ranks
+  std::set<int32_t> joined_;
+  int32_t last_joined_ = -1;
+  std::set<int32_t> shutdown_requested_;
+  uint64_t cycle_count_ = 0;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_CONTROLLER_H

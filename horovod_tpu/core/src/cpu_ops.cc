@@ -1,0 +1,440 @@
+#include "cpu_ops.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// f16/bf16 <-> f32 conversion for arithmetic on 2-byte float formats.
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {
+      exp = 127 - 15 + 1;
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        --exp;
+      }
+      man &= 0x3ffu;
+      bits = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp + 127 - 15) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (exp <= 0) return static_cast<uint16_t>(sign);
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u);
+  return static_cast<uint16_t>(sign | (static_cast<uint32_t>(exp) << 10) |
+                               (man >> 13));
+}
+
+inline float Bf16ToFloat(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even
+  uint32_t rounding = 0x7fffu + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+template <typename T>
+void ReduceT(T* dst, const T* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:  // divide happens at the end, caller-side
+    case ReduceOp::ADASUM:   // adasum uses SUM for partial dots
+      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] + src[i];
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] * src[i];
+      break;
+  }
+}
+
+void ReduceHalfLike(uint8_t* dst, const uint8_t* src, int64_t n,
+                    ReduceOp op, bool bf16) {
+  auto* d = reinterpret_cast<uint16_t*>(dst);
+  auto* s = reinterpret_cast<const uint16_t*>(src);
+  for (int64_t i = 0; i < n; ++i) {
+    float a = bf16 ? Bf16ToFloat(d[i]) : HalfToFloat(d[i]);
+    float b = bf16 ? Bf16ToFloat(s[i]) : HalfToFloat(s[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::MIN: r = std::min(a, b); break;
+      case ReduceOp::MAX: r = std::max(a, b); break;
+      case ReduceOp::PRODUCT: r = a * b; break;
+      default: r = a + b; break;
+    }
+    d[i] = bf16 ? FloatToBf16(r) : FloatToHalf(r);
+  }
+}
+
+}  // namespace
+
+void ReduceBytes(uint8_t* dst, const uint8_t* src, int64_t count,
+                 DataType dtype, ReduceOp op) {
+  switch (dtype) {
+    case DataType::F32:
+      ReduceT(reinterpret_cast<float*>(dst),
+              reinterpret_cast<const float*>(src), count, op);
+      break;
+    case DataType::F64:
+      ReduceT(reinterpret_cast<double*>(dst),
+              reinterpret_cast<const double*>(src), count, op);
+      break;
+    case DataType::I32:
+      ReduceT(reinterpret_cast<int32_t*>(dst),
+              reinterpret_cast<const int32_t*>(src), count, op);
+      break;
+    case DataType::I64:
+      ReduceT(reinterpret_cast<int64_t*>(dst),
+              reinterpret_cast<const int64_t*>(src), count, op);
+      break;
+    case DataType::U8:
+    case DataType::BOOL:
+      ReduceT(dst, src, count, op);
+      break;
+    case DataType::I8:
+      ReduceT(reinterpret_cast<int8_t*>(dst),
+              reinterpret_cast<const int8_t*>(src), count, op);
+      break;
+    case DataType::U16:
+    case DataType::I16:
+      ReduceT(reinterpret_cast<int16_t*>(dst),
+              reinterpret_cast<const int16_t*>(src), count, op);
+      break;
+    case DataType::F16:
+      ReduceHalfLike(dst, src, count, op, false);
+      break;
+    case DataType::BF16:
+      ReduceHalfLike(dst, src, count, op, true);
+      break;
+  }
+}
+
+void ScaleBytes(uint8_t* buf, int64_t count, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::F32: {
+      auto* p = reinterpret_cast<float*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<float>(p[i] * factor);
+      break;
+    }
+    case DataType::F64: {
+      auto* p = reinterpret_cast<double*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::I32: {
+      auto* p = reinterpret_cast<int32_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int32_t>(p[i] * factor);
+      break;
+    }
+    case DataType::I64: {
+      auto* p = reinterpret_cast<int64_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int64_t>(p[i] * factor);
+      break;
+    }
+    case DataType::F16: {
+      auto* p = reinterpret_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToHalf(static_cast<float>(HalfToFloat(p[i]) * factor));
+      break;
+    }
+    case DataType::BF16: {
+      auto* p = reinterpret_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBf16(static_cast<float>(Bf16ToFloat(p[i]) * factor));
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+static int IndexIn(const std::vector<int32_t>& members, int me) {
+  for (size_t i = 0; i < members.size(); ++i)
+    if (members[i] == me) return static_cast<int>(i);
+  return -1;
+}
+
+Status RingAllreduce(TcpMesh& mesh, const std::vector<int32_t>& members,
+                     int me, uint8_t* buffer, int64_t count,
+                     DataType dtype, ReduceOp op) {
+  int n = static_cast<int>(members.size());
+  int i = IndexIn(members, me);
+  if (i < 0) return Status::InvalidArgument("rank not in process set");
+  if (n == 1 || count == 0) {
+    if (op == ReduceOp::AVERAGE) { /* single rank: avg == identity */ }
+    return Status::OK();
+  }
+  size_t esize = DataTypeSize(dtype);
+  // Chunk layout: first `rem` chunks get base+1 elements.
+  int64_t base = count / n, rem = count % n;
+  auto chunk_off = [&](int c) {
+    return c * base + std::min<int64_t>(c, rem);
+  };
+  auto chunk_len = [&](int c) { return base + (c < rem ? 1 : 0); };
+  int next = members[static_cast<size_t>((i + 1) % n)];
+  int prev = members[static_cast<size_t>((i - 1 + n) % n)];
+  std::vector<uint8_t> tmp(static_cast<size_t>((base + 1) * esize));
+
+  // Reduce-scatter phase: after n-1 steps chunk (i+1)%n is complete here.
+  for (int step = 0; step < n - 1; ++step) {
+    int send_c = ((i - step) % n + n) % n;
+    int recv_c = ((i - step - 1) % n + n) % n;
+    Status s = mesh.SendRaw(next, buffer + chunk_off(send_c) * esize,
+                            static_cast<size_t>(chunk_len(send_c)) * esize);
+    if (!s.ok()) return s;
+    s = mesh.RecvRaw(prev, tmp.data(),
+                     static_cast<size_t>(chunk_len(recv_c)) * esize);
+    if (!s.ok()) return s;
+    ReduceBytes(buffer + chunk_off(recv_c) * esize, tmp.data(),
+                chunk_len(recv_c), dtype, op);
+  }
+  // Allgather phase.
+  for (int step = 0; step < n - 1; ++step) {
+    int send_c = ((i + 1 - step) % n + n) % n;
+    int recv_c = ((i - step) % n + n) % n;
+    Status s = mesh.SendRaw(next, buffer + chunk_off(send_c) * esize,
+                            static_cast<size_t>(chunk_len(send_c)) * esize);
+    if (!s.ok()) return s;
+    s = mesh.RecvRaw(prev, buffer + chunk_off(recv_c) * esize,
+                     static_cast<size_t>(chunk_len(recv_c)) * esize);
+    if (!s.ok()) return s;
+  }
+  if (op == ReduceOp::AVERAGE)
+    ScaleBytes(buffer, count, dtype, 1.0 / n);
+  return Status::OK();
+}
+
+namespace {
+void AdasumCombine(float* a, const float* b, int64_t n) {
+  double dot = 0, na = 0, nb = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    dot += static_cast<double>(a[i]) * b[i];
+    na += static_cast<double>(a[i]) * a[i];
+    nb += static_cast<double>(b[i]) * b[i];
+  }
+  double ca = na > 0 ? 1.0 - dot / (2.0 * na) : 1.0;
+  double cb = nb > 0 ? 1.0 - dot / (2.0 * nb) : 1.0;
+  for (int64_t i = 0; i < n; ++i)
+    a[i] = static_cast<float>(ca * a[i] + cb * b[i]);
+}
+}  // namespace
+
+Status TreeAdasum(TcpMesh& mesh, const std::vector<int32_t>& members,
+                  int me, uint8_t* buffer, int64_t count, DataType dtype) {
+  int n = static_cast<int>(members.size());
+  int i = IndexIn(members, me);
+  if (i < 0) return Status::InvalidArgument("rank not in process set");
+  if (n & (n - 1))
+    return Status::InvalidArgument(
+        "Adasum requires a power-of-two world (reference parity)");
+  if (dtype != DataType::F32)
+    return Status::InvalidArgument("CPU Adasum supports float32");
+  auto* mine = reinterpret_cast<float*>(buffer);
+  std::vector<float> other(static_cast<size_t>(count));
+  // Distance-doubling binary tree: each round pairs ranks idx^d; both
+  // exchange their full current vectors and apply the Adasum combine
+  // (reference: ops/adasum/adasum_mpi.cc recursive exchange).
+  for (int d = 1; d < n; d <<= 1) {
+    int partner = members[static_cast<size_t>(i ^ d)];
+    Status s = mesh.SendRecv(partner, mine,
+                             static_cast<size_t>(count) * 4, other.data(),
+                             static_cast<size_t>(count) * 4);
+    if (!s.ok()) return s;
+    if (i & d) {
+      // Keep symmetry: both sides compute the same combined vector.
+      AdasumCombine(other.data(), mine, count);
+      std::memcpy(mine, other.data(), static_cast<size_t>(count) * 4);
+    } else {
+      AdasumCombine(mine, other.data(), count);
+    }
+  }
+  return Status::OK();
+}
+
+Status RingAllgatherV(TcpMesh& mesh, const std::vector<int32_t>& members,
+                      int me, const uint8_t* in, uint8_t* out,
+                      const std::vector<int64_t>& block_bytes) {
+  int n = static_cast<int>(members.size());
+  int i = IndexIn(members, me);
+  if (i < 0) return Status::InvalidArgument("rank not in process set");
+  std::vector<int64_t> offs(static_cast<size_t>(n) + 1, 0);
+  for (int j = 0; j < n; ++j) offs[j + 1] = offs[j] + block_bytes[j];
+  std::memcpy(out + offs[i], in, static_cast<size_t>(block_bytes[i]));
+  if (n == 1) return Status::OK();
+  int next = members[static_cast<size_t>((i + 1) % n)];
+  int prev = members[static_cast<size_t>((i - 1 + n) % n)];
+  for (int step = 0; step < n - 1; ++step) {
+    int send_b = ((i - step) % n + n) % n;
+    int recv_b = ((i - step - 1) % n + n) % n;
+    Status s = mesh.SendRaw(next, out + offs[send_b],
+                            static_cast<size_t>(block_bytes[send_b]));
+    if (!s.ok()) return s;
+    s = mesh.RecvRaw(prev, out + offs[recv_b],
+                     static_cast<size_t>(block_bytes[recv_b]));
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status StarBroadcast(TcpMesh& mesh, const std::vector<int32_t>& members,
+                     int me, int root_world_rank, uint8_t* buffer,
+                     int64_t nbytes) {
+  int n = static_cast<int>(members.size());
+  if (n == 1) return Status::OK();
+  if (me == root_world_rank) {
+    for (auto r : members) {
+      if (r == me) continue;
+      Status s = mesh.SendRaw(r, buffer, static_cast<size_t>(nbytes));
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  return mesh.RecvRaw(root_world_rank, buffer,
+                      static_cast<size_t>(nbytes));
+}
+
+Status PairwiseAlltoallV(TcpMesh& mesh, const std::vector<int32_t>& members,
+                         int me, const uint8_t* send, uint8_t* recv,
+                         const std::vector<int64_t>& send_bytes,
+                         const std::vector<int64_t>& recv_bytes) {
+  int n = static_cast<int>(members.size());
+  int i = IndexIn(members, me);
+  if (i < 0) return Status::InvalidArgument("rank not in process set");
+  std::vector<int64_t> soff(static_cast<size_t>(n) + 1, 0),
+      roff(static_cast<size_t>(n) + 1, 0);
+  for (int j = 0; j < n; ++j) {
+    soff[j + 1] = soff[j] + send_bytes[j];
+    roff[j + 1] = roff[j] + recv_bytes[j];
+  }
+  std::memcpy(recv + roff[i], send + soff[i],
+              static_cast<size_t>(send_bytes[i]));
+  for (int step = 1; step < n; ++step) {
+    int to = (i + step) % n;
+    int from = ((i - step) % n + n) % n;
+    int to_rank = members[static_cast<size_t>(to)];
+    int from_rank = members[static_cast<size_t>(from)];
+    Status s = mesh.SendRaw(to_rank, send + soff[to],
+                            static_cast<size_t>(send_bytes[to]));
+    if (!s.ok()) return s;
+    s = mesh.RecvRaw(from_rank, recv + roff[from],
+                     static_cast<size_t>(recv_bytes[from]));
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status RingReducescatter(TcpMesh& mesh, const std::vector<int32_t>& members,
+                         int me, const uint8_t* in, uint8_t* out,
+                         int64_t total_elems,
+                         const std::vector<int64_t>& chunk_elems,
+                         DataType dtype, ReduceOp op) {
+  int n = static_cast<int>(members.size());
+  int i = IndexIn(members, me);
+  if (i < 0) return Status::InvalidArgument("rank not in process set");
+  size_t esize = DataTypeSize(dtype);
+  // Work in a scratch copy of the full input, ring-reduce-scatter with
+  // the member-defined chunking, then emit this rank's chunk.
+  std::vector<uint8_t> work(in, in + total_elems * esize);
+  std::vector<int64_t> offs(static_cast<size_t>(n) + 1, 0);
+  for (int j = 0; j < n; ++j) offs[j + 1] = offs[j] + chunk_elems[j];
+  if (n > 1) {
+    int next = members[static_cast<size_t>((i + 1) % n)];
+    int prev = members[static_cast<size_t>((i - 1 + n) % n)];
+    int64_t maxc = 0;
+    for (auto c : chunk_elems) maxc = std::max(maxc, c);
+    std::vector<uint8_t> tmp(static_cast<size_t>(maxc) * esize);
+    for (int step = 0; step < n - 1; ++step) {
+      int send_c = ((i - step) % n + n) % n;
+      int recv_c = ((i - step - 1) % n + n) % n;
+      Status s = mesh.SendRaw(next, work.data() + offs[send_c] * esize,
+                              static_cast<size_t>(chunk_elems[send_c]) *
+                                  esize);
+      if (!s.ok()) return s;
+      s = mesh.RecvRaw(prev, tmp.data(),
+                       static_cast<size_t>(chunk_elems[recv_c]) * esize);
+      if (!s.ok()) return s;
+      ReduceBytes(work.data() + offs[recv_c] * esize, tmp.data(),
+                  chunk_elems[recv_c], dtype, op);
+    }
+  }
+  // After reduce-scatter, chunk (i+1)%n is the one completed on rank i —
+  // but Horovod semantics give rank i chunk i, so rotate it into place:
+  // simplest correct approach for the CPU path is one more exchange.
+  int done_c = (n == 1) ? 0 : (i + 1) % n;
+  if (done_c != i) {
+    // Send my completed chunk to its owner; receive mine from its holder.
+    int owner = members[static_cast<size_t>(done_c)];
+    int holder = members[static_cast<size_t>((i - 1 + n) % n)];
+    Status s;
+    std::vector<uint8_t> mine(static_cast<size_t>(chunk_elems[i]) * esize);
+    if (owner == holder) {
+      s = mesh.SendRecv(owner, work.data() + offs[done_c] * esize,
+                        static_cast<size_t>(chunk_elems[done_c]) * esize,
+                        mine.data(), mine.size());
+      if (!s.ok()) return s;
+    } else {
+      s = mesh.SendRaw(owner, work.data() + offs[done_c] * esize,
+                       static_cast<size_t>(chunk_elems[done_c]) * esize);
+      if (!s.ok()) return s;
+      s = mesh.RecvRaw(holder, mine.data(), mine.size());
+      if (!s.ok()) return s;
+    }
+    std::memcpy(out, mine.data(), mine.size());
+  } else {
+    std::memcpy(out, work.data() + offs[i] * esize,
+                static_cast<size_t>(chunk_elems[i]) * esize);
+  }
+  if (op == ReduceOp::AVERAGE)
+    ScaleBytes(out, chunk_elems[i], dtype, 1.0 / n);
+  return Status::OK();
+}
+
+Status MeshBarrier(TcpMesh& mesh, const std::vector<int32_t>& members,
+                   int me) {
+  uint8_t one = 1;
+  return RingAllreduce(mesh, members, me, &one, 1, DataType::U8,
+                       ReduceOp::MAX);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,70 @@
+// Host-side collective algorithms over the TCP mesh: the CPU/control-NIC
+// data plane.  Counterpart of the reference's Gloo/MPI op backends
+// (horovod/common/ops/gloo_operations.cc, mpi_operations.cc): ring
+// allreduce (reduce-scatter + allgather, bandwidth-optimal), ragged
+// allgather by ring rotation, star broadcast, pairwise alltoallv, ring
+// reducescatter, tree Adasum, barrier.  On TPU pods this path carries
+// small host tensors and the negotiation plane, while big payloads ride
+// ICI through the XLA executor — mirroring the reference's
+// MPI-control/NCCL-payload split.
+#ifndef HVD_TPU_CPU_OPS_H
+#define HVD_TPU_CPU_OPS_H
+
+#include <cstdint>
+#include <vector>
+
+#include "common.h"
+#include "net.h"
+
+namespace hvdtpu {
+
+// All calls take `members` = world-rank list of the process set (sorted)
+// and operate collectively; `me` is this process's world rank and must be
+// in members.  Buffers are raw bytes of `dtype` elements.
+
+Status RingAllreduce(TcpMesh& mesh, const std::vector<int32_t>& members,
+                     int me, uint8_t* buffer, int64_t count,
+                     DataType dtype, ReduceOp op);
+
+Status TreeAdasum(TcpMesh& mesh, const std::vector<int32_t>& members,
+                  int me, uint8_t* buffer, int64_t count, DataType dtype);
+
+// in: this rank's block (bytes); block_bytes[i] = rank i's block size.
+// out must hold sum(block_bytes), blocks concatenated in member order.
+Status RingAllgatherV(TcpMesh& mesh, const std::vector<int32_t>& members,
+                      int me, const uint8_t* in, uint8_t* out,
+                      const std::vector<int64_t>& block_bytes);
+
+Status StarBroadcast(TcpMesh& mesh, const std::vector<int32_t>& members,
+                     int me, int root_world_rank, uint8_t* buffer,
+                     int64_t nbytes);
+
+// send_bytes[j] = bytes this rank sends to member j (send buffer is the
+// concatenation in member order); recv_bytes[j] = bytes received from
+// member j (recv buffer likewise).
+Status PairwiseAlltoallV(TcpMesh& mesh, const std::vector<int32_t>& members,
+                         int me, const uint8_t* send, uint8_t* recv,
+                         const std::vector<int64_t>& send_bytes,
+                         const std::vector<int64_t>& recv_bytes);
+
+// Reduce full input then keep this rank's first-dim chunk; chunk_elems[i]
+// gives each member's chunk length (earlier ranks get the remainder, as
+// in the reference's ReducescatterOp).
+Status RingReducescatter(TcpMesh& mesh, const std::vector<int32_t>& members,
+                         int me, const uint8_t* in, uint8_t* out,
+                         int64_t total_elems,
+                         const std::vector<int64_t>& chunk_elems,
+                         DataType dtype, ReduceOp op);
+
+Status MeshBarrier(TcpMesh& mesh, const std::vector<int32_t>& members,
+                   int me);
+
+// Elementwise reduce src into dst (exposed for fusion-buffer scatter and
+// tests).
+void ReduceBytes(uint8_t* dst, const uint8_t* src, int64_t count,
+                 DataType dtype, ReduceOp op);
+void ScaleBytes(uint8_t* buf, int64_t count, DataType dtype, double factor);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_CPU_OPS_H

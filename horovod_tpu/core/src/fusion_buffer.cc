@@ -1,0 +1,15 @@
+#include "fusion_buffer.h"
+
+namespace hvdtpu {
+
+std::vector<uint8_t>& FusionBufferManager::GetBuffer(
+    uint32_t process_set_id, size_t nbytes) {
+  auto& buf = buffers_[process_set_id];
+  if (buf.size() < nbytes) {
+    total_ += nbytes - buf.size();
+    buf.resize(nbytes);
+  }
+  return buf;
+}
+
+}  // namespace hvdtpu

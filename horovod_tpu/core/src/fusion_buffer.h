@@ -1,0 +1,32 @@
+// Persistent fusion scratch buffers (reference:
+// horovod/common/fusion_buffer_manager.cc): small same-typed tensors are
+// packed into one contiguous buffer, reduced with a single collective,
+// then scattered back out — keeping per-collective overhead flat as the
+// tensor count grows.
+#ifndef HVD_TPU_FUSION_BUFFER_H
+#define HVD_TPU_FUSION_BUFFER_H
+
+#include <cstdint>
+#include <map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class FusionBufferManager {
+ public:
+  // One persistent buffer per (process set, dtype-size class), grown to
+  // the configured threshold on first use and reused forever after.
+  std::vector<uint8_t>& GetBuffer(uint32_t process_set_id, size_t nbytes);
+
+  size_t total_allocated() const { return total_; }
+
+ private:
+  std::map<uint32_t, std::vector<uint8_t>> buffers_;
+  size_t total_ = 0;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_FUSION_BUFFER_H

@@ -1,0 +1,80 @@
+#include "gaussian_process.h"
+
+#include <cmath>
+
+namespace hvdtpu {
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-0.5 * d2 / (length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  size_t n = x.size();
+  x_ = x;
+  // K + noise I
+  std::vector<std::vector<double>> k(n, std::vector<double>(n, 0));
+  for (size_t i = 0; i < n; ++i)
+    for (size_t j = 0; j < n; ++j)
+      k[i][j] = Kernel(x[i], x[j]) + (i == j ? noise_ + 1e-10 : 0.0);
+  // Cholesky: K = L L^T
+  l_.assign(n, std::vector<double>(n, 0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double s = k[i][j];
+      for (size_t m = 0; m < j; ++m) s -= l_[i][m] * l_[j][m];
+      if (i == j) {
+        l_[i][i] = std::sqrt(s > 1e-12 ? s : 1e-12);
+      } else {
+        l_[i][j] = s / l_[j][j];
+      }
+    }
+  }
+  // alpha = L^-T (L^-1 y)
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = y[i];
+    for (size_t m = 0; m < i; ++m) s -= l_[i][m] * z[m];
+    z[i] = s / l_[i][i];
+  }
+  alpha_.assign(n, 0);
+  for (size_t ii = n; ii-- > 0;) {
+    double s = z[ii];
+    for (size_t m = ii + 1; m < n; ++m) s -= l_[m][ii] * alpha_[m];
+    alpha_[ii] = s / l_[ii][ii];
+  }
+  fitted_ = true;
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mu,
+                              double* sigma) const {
+  size_t n = x_.size();
+  if (!fitted_ || n == 0) {
+    *mu = 0;
+    *sigma = 1;
+    return;
+  }
+  std::vector<double> ks(n);
+  for (size_t i = 0; i < n; ++i) ks[i] = Kernel(x, x_[i]);
+  double m = 0;
+  for (size_t i = 0; i < n; ++i) m += ks[i] * alpha_[i];
+  *mu = m;
+  // v = L^-1 ks; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = ks[i];
+    for (size_t mm = 0; mm < i; ++mm) s -= l_[i][mm] * v[mm];
+    v[i] = s / l_[i][i];
+  }
+  double var = 1.0;
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  *sigma = std::sqrt(var > 1e-12 ? var : 1e-12);
+}
+
+}  // namespace hvdtpu

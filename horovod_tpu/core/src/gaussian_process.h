@@ -1,0 +1,40 @@
+// RBF-kernel Gaussian-process regressor (reference:
+// horovod/common/optim/gaussian_process.cc, which used Eigen; this is a
+// dependency-free implementation with a dense Cholesky solve — the
+// autotuner's search space is tiny, so O(n^3) on dozens of samples is
+// nothing).
+#ifndef HVD_TPU_GAUSSIAN_PROCESS_H
+#define HVD_TPU_GAUSSIAN_PROCESS_H
+
+#include <cstddef>
+#include <vector>
+
+namespace hvdtpu {
+
+class GaussianProcess {
+ public:
+  explicit GaussianProcess(double length_scale = 1.0, double noise = 1e-6)
+      : length_scale_(length_scale), noise_(noise) {}
+
+  // x: n samples of dim d (row-major), y: n scores.
+  void Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+  // Posterior mean and stddev at one point.
+  void Predict(const std::vector<double>& x, double* mu,
+               double* sigma) const;
+  bool fitted() const { return fitted_; }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double length_scale_, noise_;
+  bool fitted_ = false;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> alpha_;           // K^-1 y
+  std::vector<std::vector<double>> l_;  // Cholesky factor of K
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_GAUSSIAN_PROCESS_H

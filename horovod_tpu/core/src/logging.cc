@@ -1,0 +1,106 @@
+#include "logging.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::U8: return "uint8";
+    case DataType::I8: return "int8";
+    case DataType::U16: return "uint16";
+    case DataType::I16: return "int16";
+    case DataType::I32: return "int32";
+    case DataType::I64: return "int64";
+    case DataType::F16: return "float16";
+    case DataType::F32: return "float32";
+    case DataType::F64: return "float64";
+    case DataType::BOOL: return "bool";
+    case DataType::BF16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+const char* OpTypeName(OpType t) {
+  switch (t) {
+    case OpType::ALLREDUCE: return "allreduce";
+    case OpType::ALLGATHER: return "allgather";
+    case OpType::BROADCAST: return "broadcast";
+    case OpType::ALLTOALL: return "alltoall";
+    case OpType::REDUCESCATTER: return "reducescatter";
+    case OpType::BARRIER: return "barrier";
+    case OpType::JOIN: return "join";
+  }
+  return "unknown";
+}
+
+std::string TensorShape::DebugString() const {
+  std::string s = "[";
+  for (size_t i = 0; i < dims.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(dims[i]);
+  }
+  return s + "]";
+}
+
+LogLevel MinLogLevelFromEnv() {
+  static LogLevel cached = [] {
+    const char* v = std::getenv("HVD_TPU_LOG_LEVEL");
+    if (!v) v = std::getenv("HOROVOD_LOG_LEVEL");
+    if (!v) return LogLevel::WARNING;
+    std::string s(v);
+    for (auto& c : s) c = static_cast<char>(::tolower(c));
+    if (s == "trace") return LogLevel::TRACE;
+    if (s == "debug") return LogLevel::DEBUG;
+    if (s == "info") return LogLevel::INFO;
+    if (s == "warning") return LogLevel::WARNING;
+    if (s == "error") return LogLevel::ERROR;
+    if (s == "fatal") return LogLevel::FATAL;
+    return LogLevel::WARNING;
+  }();
+  return cached;
+}
+
+bool LogTimestampFromEnv() {
+  static bool cached = [] {
+    const char* v = std::getenv("HVD_TPU_LOG_TIMESTAMP");
+    if (!v) v = std::getenv("HOROVOD_LOG_TIMESTAMP");
+    return !v || std::strcmp(v, "0") != 0;
+  }();
+  return cached;
+}
+
+static const char* kLevelNames[] = {"TRACE", "DEBUG", "INFO", "WARNING",
+                                    "ERROR", "FATAL"};
+
+LogMessage::LogMessage(const char* fname, int line, LogLevel level)
+    : fname_(fname), line_(line), level_(level) {}
+
+LogMessage::~LogMessage() {
+  char ts[64] = "";
+  if (LogTimestampFromEnv()) {
+    using namespace std::chrono;
+    auto now = system_clock::now();
+    auto t = system_clock::to_time_t(now);
+    auto us = duration_cast<microseconds>(now.time_since_epoch()).count()
+              % 1000000;
+    struct tm tmv;
+    localtime_r(&t, &tmv);
+    snprintf(ts, sizeof(ts), "%02d:%02d:%02d.%06d ", tmv.tm_hour,
+             tmv.tm_min, tmv.tm_sec, static_cast<int>(us));
+  }
+  const char* base = std::strrchr(fname_, '/');
+  base = base ? base + 1 : fname_;
+  std::fprintf(stderr, "[%s%s %s:%d] %s\n", ts,
+               kLevelNames[static_cast<int>(level_)], base, line_,
+               str().c_str());
+  if (level_ == LogLevel::FATAL) std::abort();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,41 @@
+// Leveled logging macros (reference: horovod/common/logging.h glog-style
+// LOG(level) with HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP env control).
+#ifndef HVD_TPU_LOGGING_H
+#define HVD_TPU_LOGGING_H
+
+#include <sstream>
+#include <string>
+
+namespace hvdtpu {
+
+enum class LogLevel : int { TRACE = 0, DEBUG = 1, INFO = 2, WARNING = 3,
+                            ERROR = 4, FATAL = 5 };
+
+LogLevel MinLogLevelFromEnv();
+bool LogTimestampFromEnv();
+
+class LogMessage : public std::basic_ostringstream<char> {
+ public:
+  LogMessage(const char* fname, int line, LogLevel level);
+  ~LogMessage();
+
+ private:
+  const char* fname_;
+  int line_;
+  LogLevel level_;
+};
+
+#define HVD_LOG_AT(level) \
+  if (static_cast<int>(level) >= \
+      static_cast<int>(::hvdtpu::MinLogLevelFromEnv())) \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, level)
+
+#define LOG_TRACE HVD_LOG_AT(::hvdtpu::LogLevel::TRACE)
+#define LOG_DEBUG HVD_LOG_AT(::hvdtpu::LogLevel::DEBUG)
+#define LOG_INFO HVD_LOG_AT(::hvdtpu::LogLevel::INFO)
+#define LOG_WARNING HVD_LOG_AT(::hvdtpu::LogLevel::WARNING)
+#define LOG_ERROR HVD_LOG_AT(::hvdtpu::LogLevel::ERROR)
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_LOGGING_H

@@ -1,0 +1,179 @@
+#include "message.h"
+
+#include <cstring>
+
+namespace hvdtpu {
+
+void Writer::u32(uint32_t v) {
+  for (int i = 0; i < 4; ++i) buf.push_back((v >> (8 * i)) & 0xff);
+}
+void Writer::i64(int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int i = 0; i < 8; ++i) buf.push_back((u >> (8 * i)) & 0xff);
+}
+void Writer::f64(double v) {
+  uint64_t u;
+  std::memcpy(&u, &v, 8);
+  for (int i = 0; i < 8; ++i) buf.push_back((u >> (8 * i)) & 0xff);
+}
+void Writer::str(const std::string& s) {
+  u32(static_cast<uint32_t>(s.size()));
+  buf.insert(buf.end(), s.begin(), s.end());
+}
+void Writer::bytes(const std::vector<uint8_t>& b) {
+  u32(static_cast<uint32_t>(b.size()));
+  buf.insert(buf.end(), b.begin(), b.end());
+}
+
+uint8_t Reader::u8() {
+  if (p_ + 1 > end_) { failed_ = true; return 0; }
+  return *p_++;
+}
+uint32_t Reader::u32() {
+  if (p_ + 4 > end_) { failed_ = true; return 0; }
+  uint32_t v = 0;
+  for (int i = 0; i < 4; ++i) v |= static_cast<uint32_t>(*p_++) << (8 * i);
+  return v;
+}
+int64_t Reader::i64() {
+  if (p_ + 8 > end_) { failed_ = true; return 0; }
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v |= static_cast<uint64_t>(*p_++) << (8 * i);
+  return static_cast<int64_t>(v);
+}
+double Reader::f64() {
+  uint64_t u = static_cast<uint64_t>(i64());
+  double v;
+  std::memcpy(&v, &u, 8);
+  return v;
+}
+std::string Reader::str() {
+  uint32_t n = u32();
+  if (p_ + n > end_) { failed_ = true; return ""; }
+  std::string s(reinterpret_cast<const char*>(p_), n);
+  p_ += n;
+  return s;
+}
+std::vector<uint8_t> Reader::bytes() {
+  uint32_t n = u32();
+  if (p_ + n > end_) { failed_ = true; return {}; }
+  std::vector<uint8_t> b(p_, p_ + n);
+  p_ += n;
+  return b;
+}
+
+void Request::Serialize(Writer& w) const {
+  w.u8(static_cast<uint8_t>(op_type));
+  w.u8(static_cast<uint8_t>(dtype));
+  w.u8(static_cast<uint8_t>(red_op));
+  w.u32(process_set_id);
+  w.u32(static_cast<uint32_t>(root_rank));
+  w.f64(prescale);
+  w.f64(postscale);
+  w.str(name);
+  w.u8(static_cast<uint8_t>(shape.dims.size()));
+  for (auto d : shape.dims) w.i64(d);
+  w.u32(static_cast<uint32_t>(splits.size()));
+  for (auto s : splits) w.i64(s);
+}
+
+Request Request::Deserialize(Reader& r) {
+  Request q;
+  q.op_type = static_cast<OpType>(r.u8());
+  q.dtype = static_cast<DataType>(r.u8());
+  q.red_op = static_cast<ReduceOp>(r.u8());
+  q.process_set_id = r.u32();
+  q.root_rank = static_cast<int32_t>(r.u32());
+  q.prescale = r.f64();
+  q.postscale = r.f64();
+  q.name = r.str();
+  uint8_t nd = r.u8();
+  for (int i = 0; i < nd; ++i) q.shape.dims.push_back(r.i64());
+  uint32_t ns = r.u32();
+  for (uint32_t i = 0; i < ns; ++i) q.splits.push_back(r.i64());
+  return q;
+}
+
+void Response::Serialize(Writer& w) const {
+  w.u8(static_cast<uint8_t>(op_type));
+  w.u8(error ? 1 : 0);
+  w.str(error_message);
+  w.u32(process_set_id);
+  w.u8(static_cast<uint8_t>(dtype));
+  w.u8(static_cast<uint8_t>(red_op));
+  w.u32(static_cast<uint32_t>(root_rank));
+  w.f64(prescale);
+  w.f64(postscale);
+  w.u32(static_cast<uint32_t>(tensor_names.size()));
+  for (auto& n : tensor_names) w.str(n);
+  w.u32(static_cast<uint32_t>(aux_sizes.size()));
+  for (auto v : aux_sizes) w.i64(v);
+  w.u32(static_cast<uint32_t>(last_joined));
+}
+
+Response Response::Deserialize(Reader& r) {
+  Response p;
+  p.op_type = static_cast<OpType>(r.u8());
+  p.error = r.u8() != 0;
+  p.error_message = r.str();
+  p.process_set_id = r.u32();
+  p.dtype = static_cast<DataType>(r.u8());
+  p.red_op = static_cast<ReduceOp>(r.u8());
+  p.root_rank = static_cast<int32_t>(r.u32());
+  p.prescale = r.f64();
+  p.postscale = r.f64();
+  uint32_t nn = r.u32();
+  for (uint32_t i = 0; i < nn; ++i) p.tensor_names.push_back(r.str());
+  uint32_t na = r.u32();
+  for (uint32_t i = 0; i < na; ++i) p.aux_sizes.push_back(r.i64());
+  p.last_joined = static_cast<int32_t>(r.u32());
+  return p;
+}
+
+std::vector<uint8_t> CycleRequest::Serialize() const {
+  Writer w;
+  w.u32(static_cast<uint32_t>(rank));
+  w.u8(shutdown ? 1 : 0);
+  w.u8(joined ? 1 : 0);
+  w.bytes(cache_bits);
+  w.u32(static_cast<uint32_t>(requests.size()));
+  for (auto& q : requests) q.Serialize(w);
+  return std::move(w.buf);
+}
+
+CycleRequest CycleRequest::Deserialize(const uint8_t* data, size_t len) {
+  Reader r(data, len);
+  CycleRequest c;
+  c.rank = static_cast<int32_t>(r.u32());
+  c.shutdown = r.u8() != 0;
+  c.joined = r.u8() != 0;
+  c.cache_bits = r.bytes();
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; i < n; ++i)
+    c.requests.push_back(Request::Deserialize(r));
+  return c;
+}
+
+std::vector<uint8_t> CycleResponse::Serialize() const {
+  Writer w;
+  w.u8(shutdown ? 1 : 0);
+  w.u32(static_cast<uint32_t>(responses.size()));
+  for (auto& p : responses) p.Serialize(w);
+  w.i64(static_cast<int64_t>(fusion_threshold));
+  w.f64(cycle_time_ms);
+  return std::move(w.buf);
+}
+
+CycleResponse CycleResponse::Deserialize(const uint8_t* data, size_t len) {
+  Reader r(data, len);
+  CycleResponse c;
+  c.shutdown = r.u8() != 0;
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; i < n; ++i)
+    c.responses.push_back(Response::Deserialize(r));
+  c.fusion_threshold = static_cast<uint64_t>(r.i64());
+  c.cycle_time_ms = r.f64();
+  return c;
+}
+
+}  // namespace hvdtpu

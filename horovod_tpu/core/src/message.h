@@ -1,0 +1,106 @@
+// Negotiation wire format: Request / Response / ResponseList.
+// Counterpart of the reference's horovod/common/message.h (Request: "this
+// tensor is ready on this rank"; Response: "run this (possibly fused)
+// collective now") with a compact hand-rolled binary serialization in
+// place of FlatBuffers.
+#ifndef HVD_TPU_MESSAGE_H
+#define HVD_TPU_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// Binary writer/reader helpers (little-endian, length-prefixed strings).
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v);
+  void i64(int64_t v);
+  void f64(double v);
+  void str(const std::string& s);
+  void bytes(const std::vector<uint8_t>& b);
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+  uint8_t u8();
+  uint32_t u32();
+  int64_t i64();
+  double f64();
+  std::string str();
+  std::vector<uint8_t> bytes();
+  bool ok() const { return !failed_; }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+  bool failed_ = false;
+};
+
+struct Request {
+  OpType op_type = OpType::ALLREDUCE;
+  DataType dtype = DataType::F32;
+  ReduceOp red_op = ReduceOp::SUM;
+  uint32_t process_set_id = 0;
+  int32_t root_rank = 0;
+  double prescale = 1.0, postscale = 1.0;
+  std::string name;
+  TensorShape shape;
+  std::vector<int64_t> splits;  // alltoall send splits
+
+  void Serialize(Writer& w) const;
+  static Request Deserialize(Reader& r);
+};
+
+struct Response {
+  OpType op_type = OpType::ALLREDUCE;
+  bool error = false;
+  std::string error_message;
+  uint32_t process_set_id = 0;
+  DataType dtype = DataType::F32;
+  ReduceOp red_op = ReduceOp::SUM;
+  int32_t root_rank = 0;
+  double prescale = 1.0, postscale = 1.0;
+  std::vector<std::string> tensor_names;  // >1 means fused
+  // allgather: first-dims per (tensor, rank); alltoall: recv splits.
+  std::vector<int64_t> aux_sizes;
+  int32_t last_joined = -1;  // join result
+
+  void Serialize(Writer& w) const;
+  static Response Deserialize(Reader& r);
+};
+
+// Worker -> coordinator, one per cycle.
+struct CycleRequest {
+  int32_t rank = 0;
+  bool shutdown = false;
+  bool joined = false;
+  std::vector<uint8_t> cache_bits;  // readiness bitvector over cache ids
+  std::vector<Request> requests;    // uncached ready tensors
+
+  std::vector<uint8_t> Serialize() const;
+  static CycleRequest Deserialize(const uint8_t* data, size_t len);
+};
+
+// Coordinator -> workers, one per cycle.
+struct CycleResponse {
+  bool shutdown = false;
+  std::vector<Response> responses;
+  // Autotune broadcast (reference: ParameterManager values distributed
+  // from the coordinator).
+  uint64_t fusion_threshold = 0;  // 0 = unchanged
+  double cycle_time_ms = 0.0;     // 0 = unchanged
+
+  std::vector<uint8_t> Serialize() const;
+  static CycleResponse Deserialize(const uint8_t* data, size_t len);
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_MESSAGE_H

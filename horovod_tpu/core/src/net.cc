@@ -1,0 +1,244 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+bool ParseHostPort(const std::string& addr, std::string* host, int* port) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos) return false;
+  *host = addr.substr(0, pos);
+  *port = std::atoi(addr.substr(pos + 1).c_str());
+  return *port > 0;
+}
+
+static void SetSockOpts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+TcpMesh::~TcpMesh() { Shutdown(); }
+
+void TcpMesh::Shutdown() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (shutdown_) return;
+  shutdown_ = true;
+  for (auto& kv : fds_) ::close(kv.second);
+  fds_.clear();
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+  listen_fd_ = -1;
+}
+
+Status TcpMesh::Initialize(int rank, int size,
+                           const std::vector<std::string>& addrs,
+                           double timeout_secs) {
+  rank_ = rank;
+  size_ = size;
+  if (static_cast<int>(addrs.size()) != size)
+    return Status::InvalidArgument("address table size mismatch");
+  if (size == 1) return Status::OK();
+
+  std::string host;
+  int port = 0;
+  if (!ParseHostPort(addrs[rank], &host, &port))
+    return Status::InvalidArgument("bad address " + addrs[rank]);
+
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return Status::UnknownError("socket() failed");
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = INADDR_ANY;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0)
+    return Status::UnknownError("bind failed on port " +
+                                std::to_string(port) + ": " +
+                                strerror(errno));
+  if (::listen(listen_fd_, size) < 0)
+    return Status::UnknownError("listen failed");
+
+  // Connect to lower ranks (they are already listening or will retry-wait
+  // for us); accept from higher ranks.  Identify peers via a hello u32.
+  for (int peer = 0; peer < rank_; ++peer) {
+    Status s = ConnectTo(peer, addrs[peer], timeout_secs);
+    if (!s.ok()) return s;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_secs);
+  int expected = size_ - rank_ - 1;
+  while (static_cast<int>(fds_.size()) < size_ - 1) {
+    if (std::chrono::steady_clock::now() > deadline)
+      return Status::UnknownError(
+          "timeout accepting connections (have " +
+          std::to_string(fds_.size()) + "/" + std::to_string(size_ - 1) +
+          ")");
+    struct pollfd p{listen_fd_, POLLIN, 0};
+    int r = ::poll(&p, 1, 200);
+    if (r <= 0) continue;
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) continue;
+    SetSockOpts(fd);
+    uint32_t hello = 0;
+    size_t got = 0;
+    while (got < 4) {
+      ssize_t n = ::recv(fd, reinterpret_cast<char*>(&hello) + got,
+                         4 - got, 0);
+      if (n <= 0) break;
+      got += static_cast<size_t>(n);
+    }
+    if (got == 4) {
+      std::lock_guard<std::mutex> lk(mu_);
+      fds_[static_cast<int>(hello)] = fd;
+    } else {
+      ::close(fd);
+    }
+  }
+  (void)expected;
+  LOG_DEBUG << "rank " << rank_ << " mesh connected (" << fds_.size()
+            << " peers)";
+  return Status::OK();
+}
+
+Status TcpMesh::ConnectTo(int peer, const std::string& addr,
+                          double timeout) {
+  std::string host;
+  int port = 0;
+  if (!ParseHostPort(addr, &host, &port))
+    return Status::InvalidArgument("bad address " + addr);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return Status::UnknownError("socket() failed");
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    hostent* he = ::gethostbyname(host.c_str());
+    if (!he) {
+      ::close(fd);
+      return Status::UnknownError("cannot resolve " + host);
+    }
+    memcpy(&sa.sin_addr, he->h_addr, static_cast<size_t>(he->h_length));
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
+      SetSockOpts(fd);
+      uint32_t hello = static_cast<uint32_t>(rank_);
+      if (::send(fd, &hello, 4, MSG_NOSIGNAL) != 4) {
+        ::close(fd);
+        return Status::UnknownError("hello send failed");
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      fds_[peer] = fd;
+      return Status::OK();
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline)
+      return Status::UnknownError("timeout connecting to " + addr);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+int TcpMesh::fd_for(int peer) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = fds_.find(peer);
+  return it == fds_.end() ? -1 : it->second;
+}
+
+Status TcpMesh::SendRaw(int peer, const void* data, size_t len) {
+  int fd = fd_for(peer);
+  if (fd < 0) return Status::Aborted("no connection to rank " +
+                                     std::to_string(peer));
+  const char* p = static_cast<const char*>(data);
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd, p + sent, len - sent, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (errno == EINTR) continue;
+      return Status::Aborted("send to rank " + std::to_string(peer) +
+                             " failed: " + strerror(errno));
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status TcpMesh::RecvRaw(int peer, void* data, size_t len,
+                        double timeout_secs) {
+  int fd = fd_for(peer);
+  if (fd < 0) return Status::Aborted("no connection to rank " +
+                                     std::to_string(peer));
+  char* p = static_cast<char*>(data);
+  size_t got = 0;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_secs);
+  while (got < len) {
+    struct pollfd pf{fd, POLLIN, 0};
+    int pr = ::poll(&pf, 1, 200);
+    if (pr < 0 && errno != EINTR)
+      return Status::Aborted("poll failed");
+    if (pr <= 0) {
+      if (std::chrono::steady_clock::now() > deadline)
+        return Status::Aborted("recv timeout from rank " +
+                               std::to_string(peer));
+      continue;
+    }
+    ssize_t n = ::recv(fd, p + got, len - got, 0);
+    if (n == 0)
+      return Status::Aborted("connection closed by rank " +
+                             std::to_string(peer));
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      return Status::Aborted("recv failed: " + std::string(strerror(errno)));
+    }
+    got += static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status TcpMesh::SendFrame(int peer, const uint8_t* data, size_t len) {
+  uint32_t hdr = static_cast<uint32_t>(len);
+  Status s = SendRaw(peer, &hdr, 4);
+  if (!s.ok()) return s;
+  return SendRaw(peer, data, len);
+}
+
+Status TcpMesh::RecvFrame(int peer, std::vector<uint8_t>* out,
+                          double timeout_secs) {
+  uint32_t hdr = 0;
+  Status s = RecvRaw(peer, &hdr, 4, timeout_secs);
+  if (!s.ok()) return s;
+  out->resize(hdr);
+  if (hdr == 0) return Status::OK();
+  return RecvRaw(peer, out->data(), hdr, timeout_secs);
+}
+
+Status TcpMesh::SendRecv(int peer, const void* send, size_t send_len,
+                         void* recv, size_t recv_len) {
+  // Deadlock avoidance for the pairwise data plane: lower rank sends
+  // first.  Payloads here are small (tests/CPU tensors), so the serial
+  // order is fine; large transfers chunk through the OS buffers anyway.
+  if (rank_ < peer) {
+    Status s = SendRaw(peer, send, send_len);
+    if (!s.ok()) return s;
+    return RecvRaw(peer, recv, recv_len);
+  }
+  Status s = RecvRaw(peer, recv, recv_len);
+  if (!s.ok()) return s;
+  return SendRaw(peer, send, send_len);
+}
+
+}  // namespace hvdtpu

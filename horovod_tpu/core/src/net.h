@@ -1,0 +1,66 @@
+// TCP mesh transport: the control+data plane between ranks.
+// Counterpart of the reference's Gloo transport layer
+// (horovod/common/gloo/gloo_context.cc + vendored gloo tcp): a fully
+// connected socket mesh bootstrapped from an address table handed down by
+// the Python rendezvous, framed messages, blocking sends/recvs with
+// timeouts.
+#ifndef HVD_TPU_NET_H
+#define HVD_TPU_NET_H
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class TcpMesh {
+ public:
+  TcpMesh() = default;
+  ~TcpMesh();
+
+  // addrs[i] = "host:port" for rank i; rank `rank` listens on its port,
+  // connects to lower ranks, accepts from higher ranks.
+  Status Initialize(int rank, int size,
+                    const std::vector<std::string>& addrs,
+                    double timeout_secs = 30.0);
+  void Shutdown();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Framed messaging: [u32 length][payload].
+  Status SendFrame(int peer, const uint8_t* data, size_t len);
+  Status RecvFrame(int peer, std::vector<uint8_t>* out,
+                   double timeout_secs = 120.0);
+
+  // Raw payload chunks for the data plane (no extra framing).
+  Status SendRaw(int peer, const void* data, size_t len);
+  Status RecvRaw(int peer, void* data, size_t len,
+                 double timeout_secs = 120.0);
+
+  // Simultaneous exchange with a partner (deadlock-free pairwise).
+  Status SendRecv(int peer, const void* send, size_t send_len, void* recv,
+                  size_t recv_len);
+
+ private:
+  Status ConnectTo(int peer, const std::string& addr, double timeout);
+  int fd_for(int peer);
+
+  int rank_ = -1;
+  int size_ = 0;
+  int listen_fd_ = -1;
+  std::map<int, int> fds_;
+  std::mutex mu_;
+  bool shutdown_ = false;
+};
+
+// Split "host:port".
+bool ParseHostPort(const std::string& addr, std::string* host, int* port);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_NET_H

@@ -1,0 +1,469 @@
+#include "operations.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+double EnvDouble(const char* a, const char* b, double dflt) {
+  const char* v = std::getenv(a);
+  if (!v) v = std::getenv(b);
+  return v ? std::atof(v) : dflt;
+}
+uint64_t EnvU64(const char* a, const char* b, uint64_t dflt) {
+  const char* v = std::getenv(a);
+  if (!v) v = std::getenv(b);
+  return v ? static_cast<uint64_t>(std::atoll(v)) : dflt;
+}
+bool EnvBool(const char* a, const char* b, bool dflt) {
+  const char* v = std::getenv(a);
+  if (!v) v = std::getenv(b);
+  if (!v) return dflt;
+  return std::strcmp(v, "0") != 0 && std::strcmp(v, "false") != 0;
+}
+const char* EnvStr(const char* a, const char* b) {
+  const char* v = std::getenv(a);
+  return v ? v : std::getenv(b);
+}
+}  // namespace
+
+CoreState& CoreState::Get() {
+  static CoreState* state = new CoreState();
+  return *state;
+}
+
+Status CoreState::Initialize(int rank, int size,
+                             const std::vector<std::string>& addrs) {
+  if (initialized_) return Status::OK();
+  rank_ = rank;
+  size_ = size;
+  // Env config (reference: utils/env_parser.cc reads in operations.cc).
+  uint64_t fusion = EnvU64("HVD_TPU_FUSION_THRESHOLD",
+                           "HOROVOD_FUSION_THRESHOLD", 64ull << 20);
+  cycle_time_ms_ = EnvDouble("HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME",
+                             5.0);
+  uint64_t cache_cap = EnvU64("HVD_TPU_CACHE_CAPACITY",
+                              "HOROVOD_CACHE_CAPACITY", 1024);
+  cache_ = ResponseCache(static_cast<size_t>(cache_cap));
+  double stall_warn = EnvDouble("HVD_TPU_STALL_CHECK_TIME_SECONDS",
+                                "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0);
+  double stall_kill = EnvDouble("HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS",
+                                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0);
+  bool stall_off = EnvBool("HVD_TPU_STALL_CHECK_DISABLE",
+                           "HOROVOD_STALL_CHECK_DISABLE", false);
+  stall_.Configure(stall_warn, stall_kill, !stall_off);
+  const char* tl = EnvStr("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE");
+  if (tl)
+    timeline_.Initialize(std::string(tl) + "." + std::to_string(rank),
+                         rank,
+                         EnvBool("HVD_TPU_TIMELINE_MARK_CYCLES",
+                                 "HOROVOD_TIMELINE_MARK_CYCLES", false));
+  bool autotune = EnvBool("HVD_TPU_AUTOTUNE", "HOROVOD_AUTOTUNE", false);
+  const char* at_log = EnvStr("HVD_TPU_AUTOTUNE_LOG",
+                              "HOROVOD_AUTOTUNE_LOG");
+  params_.Configure(fusion, cycle_time_ms_, autotune && rank == 0,
+                    at_log ? at_log : "");
+
+  Status s = mesh_.Initialize(rank, size, addrs);
+  if (!s.ok()) return s;
+  controller_.Initialize(rank, size, &mesh_, &cache_, &process_sets_,
+                         &groups_, &stall_,
+                         autotune && rank == 0 ? &params_ : nullptr,
+                         fusion);
+  initialized_ = true;
+  stopped_ = false;
+  background_ = std::thread([this] { BackgroundLoop(); });
+  LOG_INFO << "core initialized: rank " << rank << "/" << size;
+  return Status::OK();
+}
+
+void CoreState::RequestShutdown() { shutdown_requested_ = true; }
+
+void CoreState::WaitShutdown() {
+  if (background_.joinable()) background_.join();
+  timeline_.Shutdown();
+  mesh_.Shutdown();
+  initialized_ = false;
+}
+
+int32_t CoreState::Enqueue(Request req, const void* data, int64_t nbytes) {
+  if (!initialized_ || stopped_) return -1;
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->request = std::move(req);
+  if (data && nbytes > 0) {
+    entry->input.assign(static_cast<const uint8_t*>(data),
+                        static_cast<const uint8_t*>(data) + nbytes);
+  }
+  timeline_.ActivityStart(entry->request.name,
+                          std::string("NEGOTIATE_") +
+                              OpTypeName(entry->request.op_type));
+  if (!queue_.Add(entry)) {
+    entry->status = Status::InvalidArgument(
+        "A collective for tensor '" + entry->request.name +
+        "' is already pending; names must be unique among in-flight ops");
+    entry->done = true;
+  }
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  int32_t h = next_handle_++;
+  entry->handle = h;
+  handles_[h] = entry;
+  return h;
+}
+
+int32_t CoreState::EnqueueJoin() {
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->request.op_type = OpType::JOIN;
+  entry->request.name = "__join__";
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    join_entry_ = entry;
+    int32_t h = next_handle_++;
+    entry->handle = h;
+    handles_[h] = entry;
+    join_requested_ = true;
+    return h;
+  }
+}
+
+int CoreState::Poll(int32_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return 2;
+  if (!it->second->done) return 0;
+  return it->second->status.ok() ? 1 : 2;
+}
+
+std::shared_ptr<TensorTableEntry> CoreState::GetEntry(int32_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() ? nullptr : it->second;
+}
+
+void CoreState::Release(int32_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  handles_.erase(handle);
+}
+
+void CoreState::CompleteEntry(const std::shared_ptr<TensorTableEntry>& e,
+                              const Status& s) {
+  e->status = s;
+  e->done = true;
+  timeline_.ActivityEnd(e->request.name);
+  queue_.Remove(e->request.name);
+}
+
+void CoreState::BackgroundLoop() {
+  while (true) {
+    auto cycle_start = std::chrono::steady_clock::now();
+    ++cycle_count_;
+    timeline_.MarkCycle(cycle_count_);
+
+    // Build this cycle's message: cache bits for known tensors, full
+    // requests for new ones (reference: RunLoopOnce request path).
+    CycleRequest msg;
+    msg.rank = rank_;
+    msg.shutdown = shutdown_requested_;
+    msg.joined = join_requested_;
+    std::vector<bool> bits(cache_.size(), false);
+    for (auto& q : queue_.DrainNewRequests()) {
+      int32_t id;
+      if (q.op_type != OpType::BARRIER &&
+          cache_.LookupMatching(q, &id)) {
+        if (static_cast<size_t>(id) >= bits.size())
+          bits.resize(static_cast<size_t>(id) + 1, false);
+        bits[static_cast<size_t>(id)] = true;
+      } else {
+        msg.requests.push_back(q);
+      }
+    }
+    msg.cache_bits = PackBits(bits);
+
+    CycleResponse resp;
+    Status s = controller_.RunCycle(msg, &resp);
+    if (!s.ok()) {
+      LOG_ERROR << "negotiation failed: " << s.reason();
+      queue_.AbortAll(s);
+      std::lock_guard<std::mutex> lk(handles_mu_);
+      for (auto& kv : handles_)
+        if (!kv.second->done) {
+          kv.second->status = s;
+          kv.second->done = true;
+        }
+      stopped_ = true;
+      return;
+    }
+
+    uint64_t cycle_bytes = 0;
+    for (auto& r : resp.responses) {
+      // Populate the response cache on every rank, in broadcast order, so
+      // cache ids agree across the world (the bitvector fast path).
+      if (!r.error && ResponseCache::Cacheable(r.op_type)) {
+        for (size_t i = 0; i < r.tensor_names.size(); ++i) {
+          Request q;
+          auto e = queue_.Lookup(r.tensor_names[i]);
+          if (e) {
+            q = e->request;
+          } else {
+            q.op_type = r.op_type;
+            q.dtype = r.dtype;
+            q.red_op = r.red_op;
+            q.process_set_id = r.process_set_id;
+            q.root_rank = r.root_rank;
+            q.prescale = r.prescale;
+            q.postscale = r.postscale;
+            q.name = r.tensor_names[i];
+            if (i < r.aux_sizes.size())
+              q.shape.dims = {r.aux_sizes[i]};
+          }
+          Response single = r;
+          single.tensor_names = {r.tensor_names[i]};
+          if (r.op_type == OpType::ALLREDUCE && i < r.aux_sizes.size())
+            single.aux_sizes = {r.aux_sizes[i]};
+          cache_.Put(q, single);
+        }
+      }
+      PerformOperation(r);
+      if (r.op_type == OpType::ALLREDUCE)
+        for (size_t i = 0; i < r.aux_sizes.size(); ++i)
+          cycle_bytes += static_cast<uint64_t>(r.aux_sizes[i]) *
+                         DataTypeSize(r.dtype);
+    }
+
+    // Autotune: coordinator scores; workers adopt broadcast values.
+    if (rank_ == 0 && cycle_bytes > 0) {
+      double secs = std::chrono::duration<double>(
+          std::chrono::steady_clock::now() - cycle_start).count();
+      params_.Observe(cycle_bytes, secs);
+    }
+    if (resp.cycle_time_ms > 0) cycle_time_ms_ = resp.cycle_time_ms;
+
+    if (rank_ == 0 && stall_.Check()) {
+      Status abort = Status::Aborted("stall shutdown threshold exceeded");
+      queue_.AbortAll(abort);
+    }
+
+    if (resp.shutdown) {
+      queue_.AbortAll(Status::Aborted("shutdown"));
+      stopped_ = true;
+      return;
+    }
+    std::this_thread::sleep_for(
+        std::chrono::duration<double, std::milli>(cycle_time_ms_));
+  }
+}
+
+void CoreState::PerformOperation(const Response& r) {
+  const ProcessSet* ps = process_sets_.Get(r.process_set_id);
+  if (!ps) return;
+  auto members = ps->Members(size_);
+  int my_idx = ps->LocalIndex(rank_, size_);
+  size_t esize = DataTypeSize(r.dtype);
+
+  // Collect local entries for the named tensors (may be missing on a
+  // joined rank, which then contributes zeros).
+  std::vector<std::shared_ptr<TensorTableEntry>> entries;
+  for (auto& name : r.tensor_names) entries.push_back(queue_.Lookup(name));
+
+  if (r.error) {
+    Status err = Status::UnknownError(r.error_message);
+    for (auto& e : entries)
+      if (e) CompleteEntry(e, err);
+    return;
+  }
+  if (my_idx < 0) return;  // not a member of this process set
+
+  switch (r.op_type) {
+    case OpType::ALLREDUCE: {
+      int64_t total = 0;
+      for (size_t i = 0; i < r.aux_sizes.size(); ++i)
+        total += r.aux_sizes[i];
+      auto& fused = fusion_.GetBuffer(r.process_set_id,
+                                      static_cast<size_t>(total) * esize);
+      // MEMCPY_IN_FUSION_BUFFER
+      int64_t off = 0;
+      for (size_t i = 0; i < entries.size(); ++i) {
+        int64_t n = r.aux_sizes[i];
+        if (entries[i]) {
+          timeline_.ActivityStart(r.tensor_names[i],
+                                  "MEMCPY_IN_FUSION_BUFFER");
+          std::memcpy(fused.data() + off * esize,
+                      entries[i]->input.data(),
+                      static_cast<size_t>(n) * esize);
+          timeline_.ActivityEnd(r.tensor_names[i]);
+        } else {
+          std::memset(fused.data() + off * esize, 0,
+                      static_cast<size_t>(n) * esize);
+        }
+        off += n;
+      }
+      if (r.prescale != 1.0)
+        ScaleBytes(fused.data(), total, r.dtype, r.prescale);
+      for (auto& n : r.tensor_names) timeline_.ActivityStart(n, "ALLREDUCE");
+      Status s;
+      if (r.red_op == ReduceOp::ADASUM)
+        s = TreeAdasum(mesh_, members, rank_, fused.data(), total, r.dtype);
+      else
+        s = RingAllreduce(mesh_, members, rank_, fused.data(), total,
+                          r.dtype, r.red_op);
+      for (auto& n : r.tensor_names) timeline_.ActivityEnd(n);
+      if (s.ok() && r.postscale != 1.0)
+        ScaleBytes(fused.data(), total, r.dtype, r.postscale);
+      // MEMCPY_OUT_FUSION_BUFFER
+      off = 0;
+      for (size_t i = 0; i < entries.size(); ++i) {
+        int64_t n = r.aux_sizes[i];
+        if (entries[i]) {
+          auto& e = entries[i];
+          e->output.assign(fused.data() + off * esize,
+                           fused.data() + (off + n) * esize);
+          e->output_dims = e->request.shape.dims;
+          CompleteEntry(e, s);
+        }
+        off += n;
+      }
+      break;
+    }
+    case OpType::ALLGATHER: {
+      auto& e = entries[0];
+      int64_t row_elems = 1;
+      if (e)
+        for (size_t d = 1; d < e->request.shape.dims.size(); ++d)
+          row_elems *= e->request.shape.dims[d];
+      else
+        row_elems = 1;
+      std::vector<int64_t> block_bytes;
+      int64_t total_rows = 0;
+      for (size_t j = 0; j < members.size(); ++j) {
+        int64_t rows = j < r.aux_sizes.size() ? r.aux_sizes[j] : 0;
+        block_bytes.push_back(rows * row_elems *
+                              static_cast<int64_t>(esize));
+        total_rows += rows;
+      }
+      std::vector<uint8_t> out(static_cast<size_t>(
+          total_rows * row_elems * static_cast<int64_t>(esize)));
+      Status s = RingAllgatherV(
+          mesh_, members, rank_,
+          e ? e->input.data() : nullptr, out.data(), block_bytes);
+      if (e) {
+        e->output = std::move(out);
+        e->output_dims = e->request.shape.dims;
+        if (!e->output_dims.empty()) e->output_dims[0] = total_rows;
+        CompleteEntry(e, s);
+      }
+      break;
+    }
+    case OpType::BROADCAST: {
+      auto& e = entries[0];
+      if (!e) break;
+      int64_t nbytes = e->request.shape.num_elements() *
+                       static_cast<int64_t>(esize);
+      std::vector<uint8_t> buf;
+      if (rank_ == r.root_rank) {
+        buf = e->input;
+      } else {
+        buf.resize(static_cast<size_t>(nbytes));
+      }
+      Status s = StarBroadcast(mesh_, members, rank_, r.root_rank,
+                               buf.data(), nbytes);
+      e->output = std::move(buf);
+      e->output_dims = e->request.shape.dims;
+      CompleteEntry(e, s);
+      break;
+    }
+    case OpType::ALLTOALL: {
+      auto& e = entries[0];
+      if (!e) break;
+      int n = static_cast<int>(members.size());
+      int64_t row_elems = 1;
+      for (size_t d = 1; d < e->request.shape.dims.size(); ++d)
+        row_elems *= e->request.shape.dims[d];
+      int64_t row_bytes = row_elems * static_cast<int64_t>(esize);
+      std::vector<int64_t> send_bytes, recv_bytes, recv_rows;
+      for (int j = 0; j < n; ++j) {
+        // aux matrix is member-major rows: row m holds member m's splits.
+        int64_t srows = r.aux_sizes[static_cast<size_t>(my_idx) *
+                                    static_cast<size_t>(n) +
+                                    static_cast<size_t>(j)];
+        int64_t rrows = r.aux_sizes[static_cast<size_t>(j) *
+                                    static_cast<size_t>(n) +
+                                    static_cast<size_t>(my_idx)];
+        send_bytes.push_back(srows * row_bytes);
+        recv_bytes.push_back(rrows * row_bytes);
+        recv_rows.push_back(rrows);
+      }
+      int64_t total_recv = 0;
+      for (auto b : recv_bytes) total_recv += b;
+      std::vector<uint8_t> out(static_cast<size_t>(total_recv));
+      Status s = PairwiseAlltoallV(mesh_, members, rank_,
+                                   e->input.data(), out.data(),
+                                   send_bytes, recv_bytes);
+      e->output = std::move(out);
+      e->output_dims = e->request.shape.dims;
+      if (!e->output_dims.empty()) {
+        int64_t rows = 0;
+        for (auto v : recv_rows) rows += v;
+        e->output_dims[0] = rows;
+      }
+      e->recv_splits = recv_rows;
+      CompleteEntry(e, s);
+      break;
+    }
+    case OpType::REDUCESCATTER: {
+      auto& e = entries[0];
+      if (!e) break;
+      int n = static_cast<int>(members.size());
+      int64_t d0 = e->request.shape.dims.empty()
+                       ? 1 : e->request.shape.dims[0];
+      int64_t row_elems = 1;
+      for (size_t d = 1; d < e->request.shape.dims.size(); ++d)
+        row_elems *= e->request.shape.dims[d];
+      int64_t base = d0 / n, rem = d0 % n;
+      std::vector<int64_t> chunk_elems;
+      for (int j = 0; j < n; ++j)
+        chunk_elems.push_back((base + (j < rem ? 1 : 0)) * row_elems);
+      int64_t total = d0 * row_elems;
+      std::vector<uint8_t> out(static_cast<size_t>(
+          chunk_elems[static_cast<size_t>(my_idx)]) * esize);
+      Status s = RingReducescatter(mesh_, members, rank_,
+                                   e->input.data(), out.data(), total,
+                                   chunk_elems, r.dtype, r.red_op);
+      e->output = std::move(out);
+      e->output_dims = e->request.shape.dims;
+      if (!e->output_dims.empty())
+        e->output_dims[0] = base + (my_idx < rem ? 1 : 0);
+      CompleteEntry(e, s);
+      break;
+    }
+    case OpType::BARRIER: {
+      Status s = MeshBarrier(mesh_, members, rank_);
+      for (auto& e : entries)
+        if (e) CompleteEntry(e, s);
+      break;
+    }
+    case OpType::JOIN: {
+      std::shared_ptr<TensorTableEntry> je;
+      {
+        std::lock_guard<std::mutex> lk(handles_mu_);
+        je = join_entry_;
+        join_entry_ = nullptr;
+      }
+      join_requested_ = false;
+      if (je) {
+        int64_t last = r.last_joined;
+        je->output.resize(8);
+        std::memcpy(je->output.data(), &last, 8);
+        je->output_dims = {1};
+        je->status = Status::OK();
+        je->done = true;
+      }
+      break;
+    }
+  }
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,103 @@
+// Global state + background cycle loop + enqueue API.
+// Counterpart of the reference's horovod/common/operations.cc
+// (HorovodGlobalState, InitializeHorovodOnce, BackgroundThreadLoop /
+// RunLoopOnce, PerformOperation, EnqueueTensor*): one background thread
+// owns all coordination state; callers enqueue named tensors and poll
+// handles.
+#ifndef HVD_TPU_OPERATIONS_H
+#define HVD_TPU_OPERATIONS_H
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "controller.h"
+#include "cpu_ops.h"
+#include "fusion_buffer.h"
+#include "message.h"
+#include "net.h"
+#include "parameter_manager.h"
+#include "process_set.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "tensor_queue.h"
+#include "thread_pool.h"
+#include "timeline.h"
+
+namespace hvdtpu {
+
+class CoreState {
+ public:
+  static CoreState& Get();
+
+  Status Initialize(int rank, int size,
+                    const std::vector<std::string>& addrs);
+  void RequestShutdown();
+  void WaitShutdown();
+  bool initialized() const { return initialized_; }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Enqueue a collective; returns a handle (>=0) or -1 on failure.
+  int32_t Enqueue(Request req, const void* data, int64_t nbytes);
+  int32_t EnqueueJoin();
+
+  // Handle API.
+  // status: 0 = pending, 1 = ok, 2 = error.
+  int Poll(int32_t handle);
+  std::shared_ptr<TensorTableEntry> GetEntry(int32_t handle);
+  void Release(int32_t handle);
+
+  uint32_t RegisterProcessSet(const std::vector<int32_t>& ranks) {
+    return process_sets_.Register(ranks);
+  }
+  bool RemoveProcessSet(uint32_t id) { return process_sets_.Remove(id); }
+  int32_t RegisterGroup(const std::vector<std::string>& names) {
+    return groups_.RegisterGroup(names);
+  }
+
+  ResponseCache& cache() { return cache_; }
+  Timeline& timeline() { return timeline_; }
+  ParameterManager& params() { return params_; }
+
+ private:
+  void BackgroundLoop();
+  void PerformOperation(const Response& r);
+  void CompleteEntry(const std::shared_ptr<TensorTableEntry>& e,
+                     const Status& s);
+
+  bool initialized_ = false;
+  int rank_ = 0, size_ = 1;
+  TcpMesh mesh_;
+  Controller controller_;
+  TensorQueue queue_;
+  ResponseCache cache_{1024};
+  FusionBufferManager fusion_;
+  ProcessSetTable process_sets_;
+  GroupTable groups_;
+  StallInspector stall_;
+  Timeline timeline_;
+  ParameterManager params_;
+
+  std::mutex handles_mu_;
+  std::map<int32_t, std::shared_ptr<TensorTableEntry>> handles_;
+  int32_t next_handle_ = 0;
+  std::shared_ptr<TensorTableEntry> join_entry_;
+
+  std::thread background_;
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> join_requested_{false};
+  std::atomic<bool> stopped_{false};
+  double cycle_time_ms_ = 5.0;
+  uint64_t cycle_count_ = 0;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_OPERATIONS_H

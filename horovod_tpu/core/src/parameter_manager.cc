@@ -1,0 +1,157 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+#include <cmath>
+#include <map>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+// Search space mirrors the reference: fusion 1..128 MiB (powers of two),
+// cycle 1..25 ms.
+const uint64_t kFusion[] = {1ull << 20, 1ull << 21, 1ull << 22, 1ull << 23,
+                            1ull << 24, 1ull << 25, 1ull << 26, 1ull << 27};
+const double kCycle[] = {1.0, 2.5, 5.0, 10.0, 25.0};
+
+double NormalCdf(double z) { return 0.5 * (1.0 + std::erf(z / M_SQRT2)); }
+double NormalPdf(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+}  // namespace
+
+BayesianOptimization::BayesianOptimization() : gp_(1.5) {
+  for (auto f : kFusion)
+    for (auto c : kCycle)
+      grid_.push_back({std::log2(static_cast<double>(f)),
+                       std::log2(c + 1.0)});
+}
+
+void BayesianOptimization::Record(int grid_index, double score) {
+  sampled_idx_.push_back(grid_index);
+  scores_.push_back(score);
+}
+
+int BayesianOptimization::NextSample() {
+  if (scores_.size() < 2)
+    return scores_.empty() ? 0 : static_cast<int>(grid_.size()) - 1;
+  // Normalize scores.
+  double mean = 0, sd = 0;
+  for (double s : scores_) mean += s;
+  mean /= static_cast<double>(scores_.size());
+  for (double s : scores_) sd += (s - mean) * (s - mean);
+  sd = std::sqrt(sd / static_cast<double>(scores_.size()));
+  if (sd <= 0) sd = 1;
+  std::vector<std::vector<double>> xs;
+  std::vector<double> ys;
+  double best = -1e30;
+  for (size_t i = 0; i < scores_.size(); ++i) {
+    xs.push_back(grid_[static_cast<size_t>(sampled_idx_[i])]);
+    double yn = (scores_[i] - mean) / sd;
+    ys.push_back(yn);
+    best = std::max(best, yn);
+  }
+  gp_.Fit(xs, ys);
+  // Expected improvement over the grid.
+  int best_idx = 0;
+  double best_ei = -1;
+  const double xi = 0.01;
+  for (size_t g = 0; g < grid_.size(); ++g) {
+    double mu, sigma;
+    gp_.Predict(grid_[g], &mu, &sigma);
+    double z = (mu - best - xi) / sigma;
+    double ei = (mu - best - xi) * NormalCdf(z) + sigma * NormalPdf(z);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_idx = static_cast<int>(g);
+    }
+  }
+  return best_idx;
+}
+
+int BayesianOptimization::BestSample() const {
+  // Mean score per sampled point; argmax.
+  std::map<int, std::pair<double, int>> agg;
+  for (size_t i = 0; i < scores_.size(); ++i) {
+    auto& e = agg[sampled_idx_[i]];
+    e.first += scores_[i];
+    e.second += 1;
+  }
+  int best = 0;
+  double best_score = -1e300;
+  for (auto& kv : agg) {
+    double m = kv.second.first / kv.second.second;
+    if (m > best_score) {
+      best_score = m;
+      best = kv.first;
+    }
+  }
+  return best;
+}
+
+void ParameterManager::Configure(uint64_t fusion_threshold,
+                                 double cycle_time_ms, bool enabled,
+                                 const std::string& log_path,
+                                 int warmup_cycles, int cycles_per_sample,
+                                 int max_samples) {
+  fusion_threshold_ = fusion_threshold;
+  cycle_time_ms_ = cycle_time_ms;
+  enabled_ = enabled;
+  warmup_ = warmup_cycles;
+  cycles_per_sample_ = cycles_per_sample;
+  max_samples_ = max_samples;
+  if (enabled && !log_path.empty()) {
+    log_ = std::fopen(log_path.c_str(), "w");
+    if (log_)
+      std::fprintf(log_, "sample,fusion_bytes,cycle_ms,score_bytes_per_s\n");
+  }
+}
+
+void ParameterManager::Apply(int grid_index) {
+  const auto& p = bo_.grid()[static_cast<size_t>(grid_index)];
+  fusion_threshold_ = static_cast<uint64_t>(std::pow(2.0, p[0]));
+  cycle_time_ms_ = std::pow(2.0, p[1]) - 1.0;
+  current_idx_ = grid_index;
+}
+
+bool ParameterManager::Observe(uint64_t bytes, double secs) {
+  if (!enabled_ || converged_) return false;
+  if (warmup_ > 0) {
+    --warmup_;
+    return false;
+  }
+  if (current_idx_ < 0) {
+    Apply(bo_.NextSample());
+    return true;
+  }
+  acc_bytes_ += static_cast<double>(bytes);
+  acc_secs_ += std::max(secs, 1e-9);
+  if (++cycles_seen_ < cycles_per_sample_) return false;
+  double score = acc_bytes_ / acc_secs_;
+  bo_.Record(current_idx_, score);
+  ++samples_done_;
+  if (log_) {
+    std::fprintf(log_, "%d,%llu,%.3f,%.1f\n", samples_done_,
+                 static_cast<unsigned long long>(fusion_threshold_),
+                 cycle_time_ms_, score);
+    std::fflush(log_);
+  }
+  acc_bytes_ = acc_secs_ = 0;
+  cycles_seen_ = 0;
+  if (samples_done_ >= max_samples_) {
+    Apply(bo_.BestSample());
+    converged_ = true;
+    LOG_INFO << "autotune converged: fusion=" << fusion_threshold_
+             << " cycle_ms=" << cycle_time_ms_;
+    if (log_) {
+      std::fprintf(log_, "# converged\n");
+      std::fflush(log_);
+    }
+  } else {
+    Apply(bo_.NextSample());
+  }
+  return true;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,72 @@
+// Online autotuner: Bayesian optimization of fusion threshold and cycle
+// time (reference: horovod/common/parameter_manager.cc +
+// optim/bayesian_optimization.cc).  Enabled by HOROVOD_AUTOTUNE=1; the
+// coordinator samples (fusion_bytes, cycle_ms), scores each sample by
+// observed reduced-bytes/sec, fits a GP, maximizes expected improvement
+// over the discrete grid, and converges to the best point; chosen values
+// are broadcast to workers in the CycleResponse.  CSV log via
+// HOROVOD_AUTOTUNE_LOG.
+#ifndef HVD_TPU_PARAMETER_MANAGER_H
+#define HVD_TPU_PARAMETER_MANAGER_H
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "gaussian_process.h"
+
+namespace hvdtpu {
+
+class BayesianOptimization {
+ public:
+  BayesianOptimization();
+  // Record a scored sample by grid index.
+  void Record(int grid_index, double score);
+  int NextSample();  // grid index maximizing EI
+  int BestSample() const;
+  const std::vector<std::vector<double>>& grid() const { return grid_; }
+
+ private:
+  std::vector<std::vector<double>> grid_;
+  std::vector<int> sampled_idx_;
+  std::vector<double> scores_;
+  GaussianProcess gp_;
+};
+
+class ParameterManager {
+ public:
+  void Configure(uint64_t fusion_threshold, double cycle_time_ms,
+                 bool enabled, const std::string& log_path,
+                 int warmup_cycles = 5, int cycles_per_sample = 20,
+                 int max_samples = 25);
+  // Called once per non-empty cycle with reduced bytes and cycle seconds.
+  // Returns true if the tuned values changed (so the coordinator should
+  // re-broadcast them).
+  bool Observe(uint64_t bytes, double secs);
+
+  uint64_t fusion_threshold() const { return fusion_threshold_; }
+  double cycle_time_ms() const { return cycle_time_ms_; }
+  bool converged() const { return converged_; }
+
+ private:
+  void Apply(int grid_index);
+
+  BayesianOptimization bo_;
+  uint64_t fusion_threshold_ = 64ull << 20;
+  double cycle_time_ms_ = 5.0;
+  bool enabled_ = false;
+  bool converged_ = false;
+  int warmup_ = 5;
+  int cycles_per_sample_ = 20;
+  int max_samples_ = 25;
+  int current_idx_ = -1;
+  int cycles_seen_ = 0;
+  int samples_done_ = 0;
+  double acc_bytes_ = 0, acc_secs_ = 0;
+  FILE* log_ = nullptr;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_PARAMETER_MANAGER_H

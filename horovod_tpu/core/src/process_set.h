@@ -1,0 +1,107 @@
+// Process-set table (reference: horovod/common/process_set.cc
+// ProcessSetTable): named rank subsets, each a scope for collectives.
+// Registration must happen in the same order on every rank (ids are
+// assigned deterministically), matching the reference's requirement that
+// process-set creation is collective.
+#ifndef HVD_TPU_PROCESS_SET_H
+#define HVD_TPU_PROCESS_SET_H
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+struct ProcessSet {
+  uint32_t id = 0;
+  std::vector<int32_t> ranks;  // empty = global (all ranks)
+
+  bool Contains(int rank, int world) const {
+    if (ranks.empty()) return rank >= 0 && rank < world;
+    return std::find(ranks.begin(), ranks.end(), rank) != ranks.end();
+  }
+  int SizeIn(int world) const {
+    return ranks.empty() ? world : static_cast<int>(ranks.size());
+  }
+  // Rank list in world terms.
+  std::vector<int32_t> Members(int world) const {
+    if (!ranks.empty()) return ranks;
+    std::vector<int32_t> all(static_cast<size_t>(world));
+    for (int i = 0; i < world; ++i) all[static_cast<size_t>(i)] = i;
+    return all;
+  }
+  // This rank's index within the set, or -1.
+  int LocalIndex(int rank, int world) const {
+    auto m = Members(world);
+    auto it = std::find(m.begin(), m.end(), rank);
+    return it == m.end() ? -1 : static_cast<int>(it - m.begin());
+  }
+};
+
+class ProcessSetTable {
+ public:
+  ProcessSetTable() {
+    ProcessSet global;
+    global.id = 0;
+    table_[0] = global;
+  }
+  uint32_t Register(const std::vector<int32_t>& ranks) {
+    ProcessSet ps;
+    ps.id = next_id_++;
+    ps.ranks = ranks;
+    std::sort(ps.ranks.begin(), ps.ranks.end());
+    table_[ps.id] = ps;
+    return ps.id;
+  }
+  bool Remove(uint32_t id) {
+    if (id == 0) return false;
+    return table_.erase(id) > 0;
+  }
+  const ProcessSet* Get(uint32_t id) const {
+    auto it = table_.find(id);
+    return it == table_.end() ? nullptr : &it->second;
+  }
+
+ private:
+  std::map<uint32_t, ProcessSet> table_;
+  uint32_t next_id_ = 1;
+};
+
+// Grouped-collective table (reference: horovod/common/group_table.cc):
+// tensors enqueued as one group must be negotiated and fused atomically —
+// the coordinator only emits their responses once ALL members are ready
+// on all ranks.
+class GroupTable {
+ public:
+  int32_t RegisterGroup(const std::vector<std::string>& names) {
+    int32_t id = next_group_id_++;
+    for (auto& n : names) group_of_[n] = id;
+    sizes_[id] = static_cast<int32_t>(names.size());
+    return id;
+  }
+  int32_t GroupOf(const std::string& name) const {
+    auto it = group_of_.find(name);
+    return it == group_of_.end() ? -1 : it->second;
+  }
+  int32_t GroupSize(int32_t id) const {
+    auto it = sizes_.find(id);
+    return it == sizes_.end() ? 0 : it->second;
+  }
+  void RemoveGroup(int32_t id) {
+    for (auto it = group_of_.begin(); it != group_of_.end();)
+      it = it->second == id ? group_of_.erase(it) : std::next(it);
+    sizes_.erase(id);
+  }
+
+ private:
+  std::map<std::string, int32_t> group_of_;
+  std::map<int32_t, int32_t> sizes_;
+  int32_t next_group_id_ = 0;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_PROCESS_SET_H

@@ -1,0 +1,92 @@
+#include "response_cache.h"
+
+#include <algorithm>
+
+namespace hvdtpu {
+
+std::string ResponseCache::Key(const Request& q) {
+  std::string k = q.name;
+  k += '|';
+  k += std::to_string(static_cast<int>(q.op_type));
+  k += '|';
+  k += std::to_string(static_cast<int>(q.dtype));
+  k += '|';
+  k += std::to_string(static_cast<int>(q.red_op));
+  k += '|';
+  k += std::to_string(q.process_set_id);
+  k += '|';
+  k += std::to_string(q.root_rank);
+  k += '|';
+  k += std::to_string(q.prescale);
+  k += '|';
+  k += std::to_string(q.postscale);
+  return k;
+}
+
+bool ResponseCache::LookupMatching(const Request& q, int32_t* id) const {
+  if (!Cacheable(q.op_type)) return false;
+  if (!Lookup(q, id)) return false;
+  const auto& slot = by_id_[static_cast<size_t>(*id)];
+  return slot.request.shape == q.shape;
+}
+
+int32_t ResponseCache::Put(const Request& q, const Response& r) {
+  std::string key = Key(q);
+  auto it = index_.find(key);
+  if (it != index_.end()) {
+    by_id_[static_cast<size_t>(it->second)].response = r;
+    return it->second;
+  }
+  int32_t id;
+  if (by_id_.size() < capacity_) {
+    id = static_cast<int32_t>(by_id_.size());
+    by_id_.emplace_back();
+  } else {
+    // Evict least-recently-used slot; its id is reused, which every rank
+    // does identically because evictions follow broadcast order.
+    id = lru_.back();
+    lru_.pop_back();
+    index_.erase(by_id_[static_cast<size_t>(id)].key);
+  }
+  auto& slot = by_id_[static_cast<size_t>(id)];
+  slot.request = q;
+  slot.response = r;
+  slot.key = key;
+  slot.valid = true;
+  index_[key] = id;
+  lru_.push_front(id);
+  return id;
+}
+
+bool ResponseCache::Lookup(const Request& q, int32_t* id) const {
+  auto it = index_.find(Key(q));
+  if (it == index_.end()) return false;
+  *id = it->second;
+  return true;
+}
+
+bool ResponseCache::GetById(int32_t id, Response* out,
+                            Request* req_out) const {
+  if (id < 0 || static_cast<size_t>(id) >= by_id_.size()) return false;
+  const auto& slot = by_id_[static_cast<size_t>(id)];
+  if (!slot.valid) return false;
+  if (out) *out = slot.response;
+  if (req_out) *req_out = slot.request;
+  return true;
+}
+
+std::vector<uint8_t> PackBits(const std::vector<bool>& bits) {
+  std::vector<uint8_t> out((bits.size() + 7) / 8, 0);
+  for (size_t i = 0; i < bits.size(); ++i)
+    if (bits[i]) out[i / 8] |= static_cast<uint8_t>(1u << (i % 8));
+  return out;
+}
+
+std::vector<bool> UnpackBits(const std::vector<uint8_t>& bytes, size_t n) {
+  std::vector<bool> out(n, false);
+  for (size_t i = 0; i < n && i / 8 < bytes.size(); ++i)
+    out[i] = (bytes[i / 8] >> (i % 8)) & 1;
+  return out;
+}
+
+}  // namespace hvdtpu

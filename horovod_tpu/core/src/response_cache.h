@@ -1,0 +1,72 @@
+// Response cache + bitvector coordination: the steady-state fast path.
+// Reference: horovod/common/response_cache.cc (ResponseCache /
+// CacheCoordinator).  After a tensor's first full negotiation, its
+// Response is cached under a stable id agreed on by every rank; in later
+// cycles workers send only a readiness *bitvector* over cache ids and the
+// coordinator ANDs them — no names, shapes, or dtypes on the wire.
+#ifndef HVD_TPU_RESPONSE_CACHE_H
+#define HVD_TPU_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+
+namespace hvdtpu {
+
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity = 1024) : capacity_(capacity) {}
+
+  // Cache key: name + op parameters.  Shape is deliberately NOT in the
+  // key (it is validated on lookup instead): every rank must map the same
+  // tensor to the same id even before seeing each other's shapes, and a
+  // shape change then updates the slot in place rather than growing a new
+  // id (reference behavior: shape change invalidates the entry).
+  static std::string Key(const Request& q);
+
+  // Ops whose Response carries per-negotiation data (allgather first
+  // dims, alltoall splits) are never cached — their aux must be
+  // renegotiated every time.
+  static bool Cacheable(OpType t) {
+    return t == OpType::ALLREDUCE || t == OpType::BROADCAST ||
+           t == OpType::REDUCESCATTER;
+  }
+
+  // Returns the cache id, assigning the next free one on first sight.
+  // Ids are deterministic across ranks because every rank applies Put in
+  // coordinator-broadcast response order.
+  int32_t Put(const Request& q, const Response& r);
+  bool Lookup(const Request& q, int32_t* id) const;
+  // Lookup + verify the enqueued shape matches the cached one; a
+  // mismatch is treated as a miss so the tensor renegotiates fully.
+  bool LookupMatching(const Request& q, int32_t* id) const;
+  bool GetById(int32_t id, Response* out, Request* req_out) const;
+  size_t size() const { return by_id_.size(); }
+  int32_t capacity() const { return static_cast<int32_t>(capacity_); }
+
+  uint64_t hits = 0, misses = 0;
+
+ private:
+  struct Slot {
+    Request request;
+    Response response;
+    std::string key;
+    bool valid = false;
+  };
+  size_t capacity_;
+  std::unordered_map<std::string, int32_t> index_;
+  std::vector<Slot> by_id_;
+  std::list<int32_t> lru_;  // front = most recent
+};
+
+// Bitvector helpers shared by worker and coordinator.
+std::vector<uint8_t> PackBits(const std::vector<bool>& bits);
+std::vector<bool> UnpackBits(const std::vector<uint8_t>& bytes, size_t n);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_RESPONSE_CACHE_H

@@ -1,0 +1,57 @@
+#include "stall_inspector.h"
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+void StallInspector::RecordRankReady(const std::string& tensor, int rank,
+                                     int world) {
+  if (!enabled_) return;
+  auto it = pending_.find(tensor);
+  if (it == pending_.end()) {
+    PendingInfo info;
+    info.first_seen = std::chrono::steady_clock::now();
+    info.ready.assign(static_cast<size_t>(world), false);
+    it = pending_.emplace(tensor, std::move(info)).first;
+  }
+  if (rank >= 0 && rank < static_cast<int>(it->second.ready.size()))
+    it->second.ready[static_cast<size_t>(rank)] = true;
+}
+
+void StallInspector::RecordDone(const std::string& tensor) {
+  pending_.erase(tensor);
+}
+
+bool StallInspector::Check(std::vector<std::string>* report) {
+  if (!enabled_) return false;
+  auto now = std::chrono::steady_clock::now();
+  bool fatal = false;
+  for (auto& kv : pending_) {
+    double age = std::chrono::duration<double>(
+        now - kv.second.first_seen).count();
+    if (age < warning_secs_) continue;
+    double since_warn = std::chrono::duration<double>(
+        now - kv.second.last_warn).count();
+    if (kv.second.last_warn.time_since_epoch().count() == 0 ||
+        since_warn >= warning_secs_) {
+      kv.second.last_warn = now;
+      std::string missing;
+      for (size_t r = 0; r < kv.second.ready.size(); ++r)
+        if (!kv.second.ready[r]) {
+          if (!missing.empty()) missing += ",";
+          missing += std::to_string(r);
+        }
+      std::string line =
+          "Stalled collective: tensor '" + kv.first + "' waiting " +
+          std::to_string(static_cast<int>(age)) + "s; ranks [" + missing +
+          "] have not submitted it. A rank may have died or ranks may be "
+          "issuing collectives in different orders.";
+      LOG_WARNING << line;
+      if (report) report->push_back(line);
+    }
+    if (shutdown_secs_ > 0 && age >= shutdown_secs_) fatal = true;
+  }
+  return fatal;
+}
+
+}  // namespace hvdtpu

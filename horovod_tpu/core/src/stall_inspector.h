@@ -1,0 +1,46 @@
+// Stall inspector (reference: horovod/common/stall_inspector.cc): the
+// coordinator knows which ranks have/haven't submitted each pending
+// tensor; after HOROVOD_STALL_CHECK_TIME_SECONDS it reports exactly which
+// ranks are missing which tensors — turning silent hangs into actionable
+// diagnostics — and can abort past a shutdown threshold.
+#ifndef HVD_TPU_STALL_INSPECTOR_H
+#define HVD_TPU_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+class StallInspector {
+ public:
+  void Configure(double warning_secs, double shutdown_secs, bool enabled) {
+    warning_secs_ = warning_secs;
+    shutdown_secs_ = shutdown_secs;
+    enabled_ = enabled && warning_secs > 0;
+  }
+
+  // Coordinator side: a rank reported this tensor ready.
+  void RecordRankReady(const std::string& tensor, int rank, int world);
+  void RecordDone(const std::string& tensor);
+
+  // Returns true if the shutdown threshold was crossed; warnings are
+  // logged inside.  ``report`` receives human-readable stall lines.
+  bool Check(std::vector<std::string>* report = nullptr);
+
+ private:
+  struct PendingInfo {
+    std::chrono::steady_clock::time_point first_seen;
+    std::vector<bool> ready;
+    std::chrono::steady_clock::time_point last_warn{};
+  };
+  double warning_secs_ = 60.0;
+  double shutdown_secs_ = 0.0;
+  bool enabled_ = true;
+  std::unordered_map<std::string, PendingInfo> pending_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_STALL_INSPECTOR_H

@@ -1,0 +1,62 @@
+#include "tensor_queue.h"
+
+namespace hvdtpu {
+
+bool TensorQueue::Add(std::shared_ptr<TensorTableEntry> entry) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& name = entry->request.name;
+  if (table_.count(name)) return false;
+  table_[name] = entry;
+  new_entries_.push_back(name);
+  return true;
+}
+
+std::vector<Request> TensorQueue::DrainNewRequests() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Request> out;
+  while (!new_entries_.empty()) {
+    auto it = table_.find(new_entries_.front());
+    new_entries_.pop_front();
+    if (it != table_.end()) out.push_back(it->second->request);
+  }
+  return out;
+}
+
+std::shared_ptr<TensorTableEntry> TensorQueue::Lookup(
+    const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = table_.find(name);
+  return it == table_.end() ? nullptr : it->second;
+}
+
+void TensorQueue::Remove(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  table_.erase(name);
+}
+
+void TensorQueue::AbortAll(const Status& reason) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : table_) {
+    if (!kv.second->done) {
+      kv.second->status = reason;
+      kv.second->done = true;
+    }
+  }
+  table_.clear();
+  new_entries_.clear();
+}
+
+std::vector<std::string> TensorQueue::PendingNames() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<std::string> out;
+  out.reserve(table_.size());
+  for (auto& kv : table_) out.push_back(kv.first);
+  return out;
+}
+
+size_t TensorQueue::size() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return table_.size();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,54 @@
+// Pending-collective table (reference: horovod/common/tensor_queue.cc
+// TensorQueue / TensorTableEntry): thread-safe store of enqueued tensors
+// awaiting negotiation, popped when the coordinator's Response names them.
+#ifndef HVD_TPU_TENSOR_QUEUE_H
+#define HVD_TPU_TENSOR_QUEUE_H
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+struct TensorTableEntry {
+  int32_t handle = -1;
+  Request request;                 // op metadata
+  std::vector<uint8_t> input;      // caller data, copied at enqueue
+  std::vector<uint8_t> output;     // filled at completion
+  std::vector<int64_t> output_dims;
+  std::vector<int64_t> recv_splits;  // alltoall
+  Status status = Status::InProgress();
+  bool done = false;
+};
+
+class TensorQueue {
+ public:
+  // Returns false if a pending tensor with this name already exists
+  // (duplicate-name protection, as in the reference).
+  bool Add(std::shared_ptr<TensorTableEntry> entry);
+  // Requests not yet sent to the coordinator (drains the "new" list).
+  std::vector<Request> DrainNewRequests();
+  std::shared_ptr<TensorTableEntry> Lookup(const std::string& name);
+  void Remove(const std::string& name);
+  // Fail every pending entry (shutdown / fatal negotiation error).
+  void AbortAll(const Status& reason);
+  std::vector<std::string> PendingNames();
+  size_t size();
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, std::shared_ptr<TensorTableEntry>> table_;
+  std::deque<std::string> new_entries_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TENSOR_QUEUE_H

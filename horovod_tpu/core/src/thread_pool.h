@@ -1,0 +1,35 @@
+// Minimal worker pool (reference: horovod/common/thread_pool.cc, used
+// there for GPU op finalization; here for parallel peer I/O and future
+// async completion work).
+#ifndef HVD_TPU_THREAD_POOL_H
+#define HVD_TPU_THREAD_POOL_H
+
+#include <condition_variable>
+#include <functional>
+#include <future>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace hvdtpu {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(size_t n_threads = 4);
+  ~ThreadPool();
+
+  std::future<void> Submit(std::function<void()> fn);
+
+ private:
+  void Worker();
+  std::vector<std::thread> threads_;
+  std::queue<std::packaged_task<void()>> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_THREAD_POOL_H

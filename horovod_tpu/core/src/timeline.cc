@@ -1,0 +1,72 @@
+#include "timeline.h"
+
+namespace hvdtpu {
+
+static std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out += '\\';
+    out += c;
+  }
+  return out;
+}
+
+void Timeline::Initialize(const std::string& path, int rank,
+                          bool mark_cycles) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (fh_) return;
+  fh_ = std::fopen(path.c_str(), "w");
+  if (!fh_) return;
+  rank_ = rank;
+  mark_cycles_ = mark_cycles;
+  start_ = std::chrono::steady_clock::now();
+  std::fprintf(fh_, "[\n");
+  first_ = true;
+}
+
+void Timeline::Shutdown() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!fh_) return;
+  std::fprintf(fh_, "\n]\n");
+  std::fclose(fh_);
+  fh_ = nullptr;
+}
+
+int64_t Timeline::NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_).count();
+}
+
+void Timeline::Emit(const std::string& json) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!fh_) return;
+  if (!first_) std::fprintf(fh_, ",\n");
+  first_ = false;
+  std::fputs(json.c_str(), fh_);
+  std::fflush(fh_);
+}
+
+void Timeline::ActivityStart(const std::string& tensor,
+                             const std::string& phase) {
+  if (!fh_) return;
+  Emit("{\"name\": \"" + JsonEscape(phase) + "\", \"ph\": \"B\", \"ts\": " +
+       std::to_string(NowUs()) + ", \"pid\": " + std::to_string(rank_) +
+       ", \"tid\": \"" + JsonEscape(tensor) + "\"}");
+}
+
+void Timeline::ActivityEnd(const std::string& tensor) {
+  if (!fh_) return;
+  Emit("{\"ph\": \"E\", \"ts\": " + std::to_string(NowUs()) +
+       ", \"pid\": " + std::to_string(rank_) + ", \"tid\": \"" +
+       JsonEscape(tensor) + "\"}");
+}
+
+void Timeline::MarkCycle(uint64_t cycle) {
+  if (!fh_ || !mark_cycles_) return;
+  Emit("{\"name\": \"CYCLE_START\", \"ph\": \"i\", \"ts\": " +
+       std::to_string(NowUs()) + ", \"pid\": " + std::to_string(rank_) +
+       ", \"tid\": \"cycle\", \"s\": \"g\", \"args\": {\"cycle\": " +
+       std::to_string(cycle) + "}}");
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,39 @@
+// Chrome-trace timeline writer (reference: horovod/common/timeline.cc):
+// per-tensor lifecycle phases (NEGOTIATE -> QUEUE -> FUSE -> <OP>) emitted
+// as chrome://tracing JSON when HOROVOD_TIMELINE is set, with optional
+// per-cycle instant markers (HOROVOD_TIMELINE_MARK_CYCLES).
+#ifndef HVD_TPU_TIMELINE_H
+#define HVD_TPU_TIMELINE_H
+
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  void Initialize(const std::string& path, int rank, bool mark_cycles);
+  bool Active() const { return fh_ != nullptr; }
+  void Shutdown();
+
+  void ActivityStart(const std::string& tensor, const std::string& phase);
+  void ActivityEnd(const std::string& tensor);
+  void MarkCycle(uint64_t cycle);
+
+ private:
+  int64_t NowUs();
+  void Emit(const std::string& json);
+
+  std::mutex mu_;
+  FILE* fh_ = nullptr;
+  int rank_ = 0;
+  bool first_ = true;
+  bool mark_cycles_ = false;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TIMELINE_H

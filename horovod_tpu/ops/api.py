@@ -69,6 +69,17 @@ def _engine():
     return basics._get_engine()
 
 
+def _tcp_mode() -> bool:
+    """Multi-process world: collectives route through the native core and
+    each call passes THIS rank's tensor (reference semantics), not a
+    rank-major stack."""
+    return basics.is_initialized() and not basics._controller_is_spmd()
+
+
+def _np(tensor):
+    return np.ascontiguousarray(np.asarray(tensor))
+
+
 # -- allreduce -------------------------------------------------------------
 
 def allreduce_async(tensor, average=None, name: Optional[str] = None,
@@ -78,6 +89,11 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
                     ) -> CollectiveHandle:
     red_op = handle_average_backwards_compatibility(op, average)
     ps = process_set or global_process_set
+    if _tcp_mode():
+        return basics._get_tcp_core().allreduce_async(
+            _np(tensor), _auto_name("allreduce", name), op=red_op,
+            prescale=prescale_factor, postscale=postscale_factor,
+            process_set_id=_ps_id(process_set))
     if red_op == ADASUM:
         from ..utils.adasum import adasum_reduce_stacked
         stacked = _stack(tensor, ps.size())
@@ -138,6 +154,10 @@ def allgather_async(tensor, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _tcp_mode():
+        return basics._get_tcp_core().allgather_async(
+            _np(tensor), _auto_name("allgather", name),
+            process_set_id=_ps_id(process_set))
     if isinstance(tensor, (list, tuple)):
         per_rank = [jnp.asarray(t) for t in tensor]
         if len(per_rank) != ps.size():
@@ -160,6 +180,10 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _tcp_mode():
+        return basics._get_tcp_core().broadcast_async(
+            _np(tensor), _auto_name("broadcast", name),
+            root_rank=root_rank, process_set_id=_ps_id(process_set))
     return _engine().enqueue_broadcast(
         _auto_name("broadcast", name), _stack(tensor, ps.size()),
         root_rank, _ps_id(process_set))
@@ -177,6 +201,11 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None
                    ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _tcp_mode():
+        return basics._get_tcp_core().alltoall_async(
+            _np(tensor), _auto_name("alltoall", name),
+            splits=None if splits is None else list(np.asarray(splits)),
+            process_set_id=_ps_id(process_set))
     if isinstance(tensor, (list, tuple)):
         tensor = jnp.stack([jnp.asarray(t) for t in tensor]) \
             if splits is None else [jnp.asarray(t) for t in tensor]
@@ -207,6 +236,10 @@ def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
                         process_set: Optional[ProcessSet] = None
                         ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _tcp_mode():
+        return basics._get_tcp_core().reducescatter_async(
+            _np(tensor), _auto_name("reducescatter", name), op=op,
+            process_set_id=_ps_id(process_set))
     return _engine().enqueue_reducescatter(
         _auto_name("reducescatter", name), _stack(tensor, ps.size()),
         op, _ps_id(process_set))
@@ -223,6 +256,9 @@ def reducescatter(tensor, op=SUM, name=None,
 def barrier(process_set: Optional[ProcessSet] = None):
     """Block until all ranks (and all previously enqueued collectives on
     this process set) have arrived (reference BarrierOp)."""
+    if _tcp_mode():
+        return basics._get_tcp_core().barrier(
+            process_set_id=_ps_id(process_set))
     return _engine().enqueue_barrier(
         _auto_name("barrier", None), _ps_id(process_set)).wait()
 

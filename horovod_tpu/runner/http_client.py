@@ -1,0 +1,62 @@
+"""Rendezvous KV client (reference: horovod/runner/http/http_client.py):
+PUT/GET against the launcher's RendezvousServer with HMAC auth."""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from .http_server import SECRET_HEADER, compute_digest
+
+
+class RendezvousClient:
+    def __init__(self, addr: str, secret: Optional[str] = None):
+        # addr: "host:port"
+        self.base = "http://" + addr
+        self.secret = secret
+
+    def _headers(self, payload: bytes) -> dict:
+        if not self.secret:
+            return {}
+        return {SECRET_HEADER: compute_digest(self.secret, payload)}
+
+    def put(self, key: str, value: str):
+        path = "/" + key.lstrip("/")
+        body = value.encode()
+        req = urllib.request.Request(self.base + path, data=body,
+                                     method="PUT",
+                                     headers=self._headers(body))
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            if resp.status != 200:
+                raise RuntimeError("rendezvous PUT failed: %d" % resp.status)
+
+    def get(self, key: str) -> Optional[str]:
+        path = "/" + key.lstrip("/")
+        req = urllib.request.Request(self.base + path, method="GET",
+                                     headers=self._headers(path.encode()))
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def get_blocking(self, key: str, timeout: float = 60.0,
+                     interval: float = 0.1) -> str:
+        deadline = time.monotonic() + timeout
+        while True:
+            v = self.get(key)
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError("rendezvous key %r never appeared" % key)
+            time.sleep(interval)
+
+    def delete(self, key: str):
+        path = "/" + key.lstrip("/")
+        req = urllib.request.Request(self.base + path, method="DELETE",
+                                     headers=self._headers(path.encode()))
+        urllib.request.urlopen(req, timeout=10)

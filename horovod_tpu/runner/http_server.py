@@ -1,0 +1,115 @@
+"""Rendezvous key-value server over HTTP.
+
+Equivalent of the reference's ``horovod/runner/http/http_server.py``
+``RendezvousServer``: an in-memory KV store the launcher runs on the
+driver host; workers PUT their address/topology and GET everyone else's —
+the MPI-free bootstrap path (used by the TCP core the way Gloo used it),
+and the re-rendezvous point for elastic mode.
+
+Requests are authenticated with an HMAC of the body/path using the
+launcher-distributed secret (reference: horovod/runner/common/util/secret.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+SECRET_HEADER = "X-Hvd-Secret"
+
+
+def compute_digest(secret: Optional[str], payload: bytes) -> str:
+    if not secret:
+        return ""
+    return hmac.new(secret.encode(), payload, hashlib.sha256).hexdigest()
+
+
+class _KvHandler(BaseHTTPRequestHandler):
+    server_version = "HvdTpuRendezvous/1.0"
+
+    def _authorized(self, payload: bytes) -> bool:
+        secret = self.server.secret  # type: ignore[attr-defined]
+        if not secret:
+            return True
+        given = self.headers.get(SECRET_HEADER, "")
+        return hmac.compare_digest(given, compute_digest(secret, payload))
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        if not self._authorized(body):
+            self.send_response(403)
+            self.end_headers()
+            return
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store[self.path] = body  # type: ignore
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._authorized(self.path.encode()):
+            self.send_response(403)
+            self.end_headers()
+            return
+        with self.server.lock:  # type: ignore[attr-defined]
+            value = self.server.store.get(self.path)  # type: ignore
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        if not self._authorized(self.path.encode()):
+            self.send_response(403)
+            self.end_headers()
+            return
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store.pop(self.path, None)  # type: ignore
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class RendezvousServer:
+    """In-memory KV over HTTP; scope keys like /global/addr/0
+    (reference scopes: global/local/cross)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 secret: Optional[str] = None):
+        self._httpd = ThreadingHTTPServer((host, port), _KvHandler)
+        self._httpd.store = {}          # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.secret = secret     # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # Test/introspection access.
+    def snapshot(self) -> Dict[str, bytes]:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return dict(self._httpd.store)  # type: ignore[attr-defined]
+
+    def reset(self):
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.clear()  # type: ignore[attr-defined]

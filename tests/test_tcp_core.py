@@ -1,0 +1,80 @@
+"""Multi-process native-core tests: a real N-process world on localhost
+(reference test strategy: Gloo-on-localhost IS the test backend,
+SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "utils",
+                      "tcp_worker.py")
+
+_port_base = [29700]
+
+
+def _spawn_world(size, scenario, extra_env=None, timeout=120):
+    _port_base[0] += size + 3  # fresh ports per world
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_PORT_BASE": str(_port_base[0]),
+            "TEST_SCENARIO": scenario,
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out.decode(), err.decode()))
+    return outs
+
+
+def _assert_ok(outs):
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, "rank %d failed (rc=%d):\n%s\n%s" % (rank, rc,
+                                                             out, err)
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_tcp_collective_matrix(size):
+    _assert_ok(_spawn_world(size, "collectives"))
+
+
+def test_tcp_response_cache_fast_path():
+    _assert_ok(_spawn_world(2, "cache"))
+
+
+def test_tcp_join_uneven_data():
+    _assert_ok(_spawn_world(3, "join"))
+
+
+def test_tcp_error_propagation():
+    _assert_ok(_spawn_world(2, "error"))
+
+
+def test_tcp_timeline_written(tmp_path):
+    tl = str(tmp_path / "tl.json")
+    _assert_ok(_spawn_world(2, "cache", extra_env={"HOROVOD_TIMELINE": tl}))
+    import json
+    events = json.load(open(tl + ".0"))
+    assert any(e.get("name", "").startswith("NEGOTIATE") for e in events)
+    assert any(e.get("name") == "ALLREDUCE" for e in events)
+
+
+def test_core_library_builds():
+    from horovod_tpu.core.client import core_library_available
+    assert core_library_available()

@@ -1,0 +1,155 @@
+"""Worker process for multi-process TCP core tests (run by
+test_tcp_core.py as a real subprocess world, the way the reference tests
+run under `horovodrun -np 2 pytest` with Gloo-on-localhost)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+from horovod_tpu.common.topology import multiprocess_topology
+from horovod_tpu.common.config import Config
+from horovod_tpu.core.client import TcpCore
+from horovod_tpu.ops.engine import HorovodInternalError
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    scenario = os.environ.get("TEST_SCENARIO", "all")
+    topo = multiprocess_topology(rank, size)
+    core = TcpCore(topo, Config.from_env())
+    core.initialize()
+    try:
+        if scenario in ("all", "collectives"):
+            run_collectives(core, rank, size)
+        if scenario in ("all", "cache"):
+            run_cache(core, rank, size)
+        if scenario == "join":
+            run_join(core, rank, size)
+        if scenario == "error":
+            run_error(core, rank, size)
+    finally:
+        core.shutdown()
+
+
+def run_collectives(core, rank, size):
+    # allreduce sum, fused small tensors.
+    handles = []
+    for i, n in enumerate((3, 5, 1000)):
+        x = np.full((n,), float(rank + 1 + i), dtype=np.float32)
+        handles.append(core.allreduce_async(x, "ar.%d" % i))
+    for i, n in enumerate((3, 5, 1000)):
+        out = handles[i].wait(timeout=30)
+        expected = sum(r + 1 + i for r in range(size))
+        assert out.shape == (n,), out.shape
+        np.testing.assert_allclose(out, expected)
+    # average with prescale/postscale.
+    x = np.full((4,), float(rank), dtype=np.float64)
+    out = core.allreduce_async(x, "avg", op="Average", prescale=2.0,
+                               postscale=0.5).wait(timeout=30)
+    np.testing.assert_allclose(
+        out, 2.0 * np.mean(np.arange(size)) * 0.5)
+    # min / max / product / int32.
+    x = np.array([rank + 1], dtype=np.int32)
+    assert core.allreduce_async(x, "min", op="Min").wait(30)[0] == 1
+    assert core.allreduce_async(x, "max", op="Max").wait(30)[0] == size
+    prod = core.allreduce_async(
+        np.array([2.0], np.float32), "prod", op="Product").wait(30)
+    np.testing.assert_allclose(prod, [2.0 ** size])
+    # adasum (identical vectors collapse to one copy).
+    same = np.arange(8, dtype=np.float32)
+    out = core.allreduce_async(same, "adasum", op="Adasum").wait(30)
+    np.testing.assert_allclose(out, same, rtol=1e-5)
+    # allgather, ragged first dim: rank r contributes r+1 rows.
+    x = np.full((rank + 1, 2), rank, dtype=np.float32)
+    out = core.allgather_async(x, "ag").wait(timeout=30)
+    assert out.shape == (sum(r + 1 for r in range(size)), 2)
+    expected = np.concatenate(
+        [np.full((r + 1, 2), r, np.float32) for r in range(size)])
+    np.testing.assert_allclose(out, expected)
+    # broadcast from root 1.
+    x = (np.arange(6, dtype=np.float32).reshape(2, 3) if rank == 1
+         else np.zeros((2, 3), np.float32))
+    out = core.broadcast_async(x, "bc", root_rank=1).wait(timeout=30)
+    np.testing.assert_allclose(
+        out, np.arange(6, dtype=np.float32).reshape(2, 3))
+    # alltoall with ragged splits: rank r sends (j+1) rows to rank j.
+    splits = [j + 1 for j in range(size)]
+    rows = sum(splits)
+    x = np.full((rows, 2), rank, dtype=np.float32)
+    out, recv_splits = core.alltoall_async(x, "a2a",
+                                           splits=splits).wait(timeout=30)
+    assert recv_splits == [rank + 1] * size, recv_splits
+    assert out.shape == ((rank + 1) * size, 2)
+    expected_col = np.repeat(np.arange(size, dtype=np.float32), rank + 1)
+    np.testing.assert_allclose(out[:, 0], expected_col)
+    # reducescatter with uneven first dim (size*2+1 rows).
+    d0 = size * 2 + 1
+    x = np.tile(np.arange(d0, dtype=np.float32)[:, None], (1, 3))
+    out = core.reducescatter_async(x, "rs").wait(timeout=30)
+    base, rem = divmod(d0, size)
+    my_rows = base + (1 if rank < rem else 0)
+    start = rank * base + min(rank, rem)
+    assert out.shape == (my_rows, 3), out.shape
+    np.testing.assert_allclose(
+        out, size * np.tile(
+            np.arange(start, start + my_rows,
+                      dtype=np.float32)[:, None], (1, 3)))
+    # barrier + process-set collective on even ranks.
+    core.barrier("b1")
+    ps = core.add_process_set(list(range(0, size, 2)))
+    if rank % 2 == 0:
+        x = np.full((3,), float(rank), np.float32)
+        out = core.allreduce_async(x, "ps_ar", process_set_id=ps).wait(30)
+        np.testing.assert_allclose(
+            out, sum(float(r) for r in range(0, size, 2)))
+    core.barrier("b2")
+    # object helpers.
+    objs = core.allgather_object({"rank": rank})
+    assert [o["rank"] for o in objs] == list(range(size))
+    obj = core.broadcast_object({"val": rank * 10}, root_rank=0)
+    assert obj == {"val": 0}
+
+
+def run_cache(core, rank, size):
+    # Same tensor reduced repeatedly: second and later rounds must ride
+    # the bitvector cache path (hits grow, misses stay flat).
+    x = np.full((64,), float(rank), np.float32)
+    core.allreduce_async(x, "steady").wait(30)
+    h0, m0 = core.cache_stats() if rank == 0 else (0, 0)
+    for it in range(5):
+        out = core.allreduce_async(x, "steady").wait(30)
+        np.testing.assert_allclose(out, sum(range(size)))
+    if rank == 0:
+        h1, m1 = core.cache_stats()
+        assert h1 - h0 >= 5, (h0, h1)
+        assert m1 == m0, (m0, m1)
+
+
+def run_join(core, rank, size):
+    # Uneven data: rank r has r+1 batches; after its last batch each rank
+    # joins; allreduces keep working with joined ranks contributing zeros.
+    for step in range(rank + 1):
+        x = np.full((4,), 1.0, np.float32)
+        core.allreduce_async(x, "j.%d.%d" % (rank, step))
+    # Everyone joins after its own work; join returns the last rank.
+    last = core.join()
+    assert 0 <= last < size
+
+
+def run_error(core, rank, size):
+    # Mismatched shapes across ranks must surface an error, not a hang.
+    x = np.zeros((rank + 1,), np.float32)  # different shape per rank
+    try:
+        core.allreduce_async(x, "bad").wait(timeout=30)
+        assert size == 1, "expected HorovodInternalError"
+    except HorovodInternalError as e:
+        assert "Mismatched" in str(e) or "shape" in str(e).lower()
+
+
+if __name__ == "__main__":
+    main()
