@@ -1,0 +1,5 @@
+"""Elastic driver (filled in by the elastic milestone)."""
+
+
+def elastic_run(args):
+    raise NotImplementedError("elastic driver lands in the next milestone")

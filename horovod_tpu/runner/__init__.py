@@ -1,1 +1,5 @@
-"""runner subpackage."""
+"""Launcher layer (reference: horovod/runner/): CLI + programmatic run(),
+rendezvous KV server, driver/task services, safe process spawning."""
+
+from .launch import gloo_run, parse_args, run_commandline  # noqa: F401
+from .run_api import run  # noqa: F401

@@ -1,0 +1,216 @@
+"""``horovodrun``-equivalent launcher.
+
+Reference parity: ``horovod/runner/launch.py`` (+ ``gloo_run.py``): parse
+CLI flags into worker env (``HOROVOD_*``), start the rendezvous KV server
+on the driver, spawn one worker process per slot (locally, or over ssh
+for remote hosts), multiplex their output with rank prefixes, and tear
+everything down when the first worker fails.
+
+Usage::
+
+    python -m horovod_tpu.runner -np 4 python train.py
+    python -m horovod_tpu.runner -np 8 -H a:4,b:4 python train.py
+    python -m horovod_tpu.runner -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./disc.sh python train.py   # elastic
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import sys
+import time
+from typing import Dict, List, Optional
+
+from . import safe_shell_exec, util
+from .http_server import RendezvousServer
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="horovod_tpu.runner",
+        description="Launch a multi-process horovod_tpu job")
+    p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", dest="hosts", default=None,
+                   help="host1:slots,host2:slots (default: localhost)")
+    p.add_argument("--hostfile", default=None,
+                   help="mpirun-style hostfile")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--start-timeout", type=float, default=120.0)
+    p.add_argument("--verbose", "-v", action="store_true")
+    # Tuning flags -> env (reference: launch.py exports HOROVOD_*).
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--stall-check-time", type=float, default=None)
+    p.add_argument("--stall-shutdown-time", type=float, default=None)
+    # Elastic flags (reference: elastic launch surface).
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--elastic-timeout", type=float, default=600.0)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="worker command line")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no worker command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def build_common_env(args, base_env: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    def setif(key, value):
+        if value is not None:
+            env[key] = str(value)
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    setif("HOROVOD_CYCLE_TIME", args.cycle_time_ms)
+    setif("HOROVOD_CACHE_CAPACITY", args.cache_capacity)
+    setif("HOROVOD_TIMELINE", args.timeline_filename)
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    setif("HOROVOD_AUTOTUNE_LOG", args.autotune_log_file)
+    setif("HOROVOD_STALL_CHECK_TIME_SECONDS", args.stall_check_time)
+    setif("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", args.stall_shutdown_time)
+    return env
+
+
+def worker_env(common: Dict[str, str], rank: int, size: int,
+               local_rank: int, local_size: int, cross_rank: int,
+               cross_size: int, rendezvous_addr: str, secret: str,
+               port_base: int) -> Dict[str, str]:
+    env = dict(common)
+    env.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_CROSS_RANK": str(cross_rank),
+        "HOROVOD_CROSS_SIZE": str(cross_size),
+        "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr,
+        "HOROVOD_SECRET_KEY": secret,
+        "HOROVOD_PORT_BASE": str(port_base),
+        "HOROVOD_CONTROLLER": "tcp",
+    })
+    return env
+
+
+def _slot_assignments(hosts: List[util.HostInfo], np_: int):
+    """(hostname, rank, local_rank, local_size, cross_rank) per slot."""
+    out = []
+    rank = 0
+    for cross_rank, h in enumerate(hosts):
+        local_size = min(h.slots, np_ - rank)
+        for local_rank in range(local_size):
+            out.append((h.hostname, rank, local_rank, local_size,
+                        cross_rank))
+            rank += 1
+            if rank >= np_:
+                return out, cross_rank + 1
+    if rank < np_:
+        raise ValueError(
+            "requested -np %d but hosts provide only %d slots"
+            % (np_, rank))
+    return out, len(hosts)
+
+
+def _ssh_wrap(host: str, ssh_port: int, env: Dict[str, str],
+              command: List[str]) -> List[str]:
+    """Build the ssh command carrying HOROVOD_* env to a remote host
+    (reference: gloo_run.py get_remote_command)."""
+    exports = " ".join("%s=%s" % (k, shlex.quote(v))
+                       for k, v in env.items()
+                       if k.startswith(("HOROVOD_", "PYTHON", "PATH")))
+    remote = "cd %s && env %s %s" % (
+        shlex.quote(os.getcwd()), exports,
+        " ".join(shlex.quote(c) for c in command))
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port),
+            host, remote]
+
+
+def gloo_run(args, hosts: List[util.HostInfo],
+             env: Optional[Dict[str, str]] = None) -> int:
+    """Spawn the static (non-elastic) world; returns exit code."""
+    np_ = args.np or util.total_slots(hosts)
+    slots, cross_size = _slot_assignments(hosts, np_)
+    secret = util.make_secret()
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    rendezvous_addr = "127.0.0.1:%d" % port
+    port_base = util.find_free_ports(1)[0]
+    common = build_common_env(args, env)
+
+    procs: List[safe_shell_exec.ManagedProcess] = []
+    try:
+        for hostname, rank, local_rank, local_size, cross_rank in slots:
+            wenv = worker_env(common, rank, np_, local_rank, local_size,
+                              cross_rank, cross_size, rendezvous_addr,
+                              secret, port_base)
+            is_local = hostname in ("localhost", "127.0.0.1",
+                                    util.host_hash())
+            cmd = (args.command if is_local
+                   else _ssh_wrap(hostname, args.ssh_port, wenv,
+                                  args.command))
+            prefix = "[%d]<stdout>" % rank
+            eprefix = "[%d]<stderr>" % rank
+            procs.append(safe_shell_exec.ManagedProcess(
+                cmd, wenv,
+                stdout_sink=lambda l, p=prefix: sys.stdout.write(p + l),
+                stderr_sink=lambda l, p=eprefix: sys.stderr.write(p + l)))
+        # Wait; first failure tears down the world (reference behavior).
+        deadline = (time.monotonic() + args.start_timeout
+                    if args.start_timeout else None)
+        rc = 0
+        remaining = list(procs)
+        while remaining:
+            for mp in list(remaining):
+                code = mp.poll()
+                if code is not None:
+                    remaining.remove(mp)
+                    if code != 0:
+                        rc = code
+                        for other in remaining:
+                            other.terminate()
+                        remaining = []
+                        break
+            time.sleep(0.05)
+        for mp in procs:
+            try:
+                mp.wait(timeout=5)
+            except Exception:
+                mp.terminate()
+        return rc
+    finally:
+        for mp in procs:
+            mp.terminate()
+        server.stop()
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.hostfile:
+        hosts = util.parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = util.parse_hosts(args.hosts)
+    else:
+        hosts = [util.HostInfo("localhost", args.np or 1)]
+    if args.host_discovery_script or (args.min_np or args.max_np):
+        from ..elastic.driver import elastic_run
+        return elastic_run(args)
+    return gloo_run(args, hosts)
+
+
+def main():
+    sys.exit(run_commandline())

@@ -1,0 +1,64 @@
+"""Programmatic launcher: ``horovod_tpu.runner.run(fn, args=...)``.
+
+Reference parity: ``horovod.run(...)`` (``horovod/runner/__init__.py``):
+run a python function across np worker processes and collect each rank's
+return value.  The function is shipped pickled through an env payload
+(top-level functions; same constraint family as the reference without
+cloudpickle) and results come back through per-rank files.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Any, List, Optional
+
+from . import util
+from .launch import parse_args, gloo_run
+
+_STUB = r"""
+import os, pickle, sys
+from horovod_tpu.runner.util import loads_base64
+payload = loads_base64(os.environ["HVD_TPU_RUN_PAYLOAD"])
+fn, args, kwargs = payload
+result = fn(*args, **kwargs)
+out_dir = os.environ["HVD_TPU_RUN_OUT"]
+rank = os.environ["HOROVOD_RANK"]
+with open(os.path.join(out_dir, "result.%s.pkl" % rank), "wb") as fh:
+    pickle.dump(result, fh)
+"""
+
+
+def run(fn, args=(), kwargs=None, np: int = 1,
+        hosts: Optional[str] = None, verbose: bool = False,
+        extra_cli: Optional[List[str]] = None) -> List[Any]:
+    """Execute ``fn(*args, **kwargs)`` on np workers; returns the list of
+    per-rank results (rank order)."""
+    kwargs = kwargs or {}
+    payload = util.dumps_base64((fn, tuple(args), kwargs))
+    with tempfile.TemporaryDirectory() as out_dir:
+        cli = ["-np", str(np)]
+        if hosts:
+            cli += ["-H", hosts]
+        if verbose:
+            cli.append("-v")
+        cli += extra_cli or []
+        cli += [sys.executable, "-c", _STUB]
+        parsed = parse_args(cli)
+        env = dict(os.environ)
+        env["HVD_TPU_RUN_PAYLOAD"] = payload
+        env["HVD_TPU_RUN_OUT"] = out_dir
+        host_list = (util.parse_hosts(hosts) if hosts
+                     else [util.HostInfo("localhost", np)])
+        rc = gloo_run(parsed, host_list, env=env)
+        if rc != 0:
+            raise RuntimeError("horovod_tpu.runner.run failed (rc=%d)" % rc)
+        import pickle
+        results = []
+        for rank in range(np):
+            with open(os.path.join(out_dir,
+                                   "result.%d.pkl" % rank), "rb") as fh:
+                results.append(pickle.load(fh))
+        return results

@@ -1,0 +1,91 @@
+"""Process spawning with clean teardown and output streaming.
+
+Reference parity: ``horovod/runner/common/util/safe_shell_exec.py`` —
+children run in their own process group so the whole tree can be
+terminated (SIGTERM, then SIGKILL after a grace period), and their
+stdout/stderr are streamed line-by-line through a prefixing callback
+(the launcher multiplexes worker output as ``[rank]<line>``).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5.0
+
+
+def _stream(pipe, sink: Callable[[str], None]):
+    try:
+        for line in iter(pipe.readline, b""):
+            sink(line.decode(errors="replace"))
+    finally:
+        pipe.close()
+
+
+class ManagedProcess:
+    def __init__(self, command, env: Optional[Dict[str, str]] = None,
+                 stdout_sink: Optional[Callable[[str], None]] = None,
+                 stderr_sink: Optional[Callable[[str], None]] = None):
+        self.proc = subprocess.Popen(
+            command, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, preexec_fn=os.setsid)
+        self._threads = [
+            threading.Thread(
+                target=_stream,
+                args=(self.proc.stdout,
+                      stdout_sink or (lambda l: sys.stdout.write(l))),
+                daemon=True),
+            threading.Thread(
+                target=_stream,
+                args=(self.proc.stderr,
+                      stderr_sink or (lambda l: sys.stderr.write(l))),
+                daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        rc = self.proc.wait(timeout)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        return rc
+
+    def terminate(self):
+        """SIGTERM the process group; SIGKILL stragglers after a grace
+        period (reference teardown behavior)."""
+        if self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def execute(command: List[str], env: Optional[Dict[str, str]] = None,
+            stdout_sink=None, stderr_sink=None,
+            timeout: Optional[float] = None) -> int:
+    """Run one command to completion with tree teardown on timeout."""
+    mp = ManagedProcess(command, env, stdout_sink, stderr_sink)
+    try:
+        return mp.wait(timeout)
+    except subprocess.TimeoutExpired:
+        mp.terminate()
+        return -1

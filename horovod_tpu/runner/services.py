@@ -1,0 +1,169 @@
+"""Driver/task services: authenticated control-plane RPC.
+
+Reference parity: ``horovod/runner/common/service/driver_service.py`` +
+``task_service.py`` over ``network.py``: small pickled-message TCP
+services authenticated with an HMAC of the payload using the launcher's
+shared secret.  The driver probes each task service to confirm host
+health and discover mutually-routable addresses before spawning the
+world; elastic mode reuses the same machinery for worker notification.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_MAC_LEN = 32
+
+
+def _pack(secret: str, obj: Any) -> bytes:
+    payload = pickle.dumps(obj)
+    mac = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
+    return struct.pack("!I", len(payload) + _MAC_LEN) + mac + payload
+
+
+def _unpack(secret: str, sock) -> Any:
+    hdr = _recv_exact(sock, 4)
+    (length,) = struct.unpack("!I", hdr)
+    blob = _recv_exact(sock, length)
+    mac, payload = blob[:_MAC_LEN], blob[_MAC_LEN:]
+    want = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(mac, want):
+        raise PermissionError("bad message authentication code")
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class MessageServer:
+    """Threaded TCP server dispatching pickled requests to a handler."""
+
+    def __init__(self, handler: Callable[[Any], Any], secret: str,
+                 host: str = "0.0.0.0", port: int = 0):
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = _unpack(outer.secret, self.request)
+                    resp = outer.handler(req)
+                    self.request.sendall(_pack(outer.secret, resp))
+                except PermissionError:
+                    pass  # unauthenticated: drop silently
+                except Exception as exc:  # noqa: BLE001
+                    try:
+                        self.request.sendall(
+                            _pack(outer.secret, {"error": str(exc)}))
+                    except Exception:
+                        pass
+
+        self.handler = handler
+        self.secret = secret
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def send_message(addr: Tuple[str, int], secret: str, obj: Any,
+                 timeout: float = 10.0) -> Any:
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        sock.sendall(_pack(secret, obj))
+        return _unpack(secret, sock)
+
+
+class TaskService:
+    """Per-worker-host agent (reference task_service.py): answers pings,
+    reports addresses, runs commands for the driver, and (elastic)
+    receives host-update notifications."""
+
+    def __init__(self, index: int, secret: str):
+        self.index = index
+        self._notify_cb: Optional[Callable[[Any], None]] = None
+        self.server = MessageServer(self._handle, secret)
+
+    def _handle(self, req: Any) -> Any:
+        kind = req.get("kind")
+        if kind == "ping":
+            return {"ok": True, "index": self.index,
+                    "host": socket.gethostname()}
+        if kind == "addresses":
+            return {"addresses": self._local_addresses()}
+        if kind == "notify":
+            if self._notify_cb:
+                self._notify_cb(req.get("payload"))
+            return {"ok": True}
+        return {"error": "unknown request %r" % kind}
+
+    @staticmethod
+    def _local_addresses():
+        """Candidate NIC addresses (reference: driver probes for mutually
+        routable interfaces)."""
+        addrs = {"127.0.0.1"}
+        try:
+            addrs.add(socket.gethostbyname(socket.gethostname()))
+        except socket.gaierror:
+            pass
+        return sorted(addrs)
+
+    def on_notify(self, cb: Callable[[Any], None]):
+        self._notify_cb = cb
+
+    def start(self) -> int:
+        return self.server.start()
+
+    def stop(self):
+        self.server.stop()
+
+
+class DriverService:
+    """Launcher-side probe (reference driver_service.py): health-check
+    every task service and collect its routable addresses."""
+
+    def __init__(self, secret: str):
+        self.secret = secret
+
+    def probe(self, addr: Tuple[str, int], timeout: float = 10.0) -> Dict:
+        pong = send_message(addr, self.secret, {"kind": "ping"},
+                            timeout=timeout)
+        if not pong.get("ok"):
+            raise RuntimeError("task service at %s unhealthy: %r"
+                               % (addr, pong))
+        addresses = send_message(addr, self.secret,
+                                 {"kind": "addresses"}, timeout=timeout)
+        return {"index": pong["index"], "host": pong["host"],
+                "addresses": addresses["addresses"]}
+
+    def notify(self, addr: Tuple[str, int], payload: Any,
+               timeout: float = 10.0):
+        return send_message(addr, self.secret,
+                            {"kind": "notify", "payload": payload},
+                            timeout=timeout)

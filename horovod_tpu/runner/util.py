@@ -1,0 +1,114 @@
+"""Launcher utilities: host parsing, secrets, codecs, timeouts.
+
+Reference parity: ``horovod/runner/common/util/{hosts.py, secret.py,
+codec.py, timeout.py, host_hash.py}``.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import pickle
+import secrets as _secrets
+import socket
+import time
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    hostname: str
+    slots: int
+
+
+def parse_hosts(hosts: str) -> List[HostInfo]:
+    """Parse "host1:4,host2:2" (slots default 1)."""
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append(HostInfo(name, int(slots)))
+        else:
+            out.append(HostInfo(part, 1))
+    if not out:
+        raise ValueError("no hosts parsed from %r" % hosts)
+    return out
+
+
+def parse_hostfile(path: str) -> List[HostInfo]:
+    """Hostfile lines: "<host> slots=<n>" (mpirun style) or "host:n"."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            if "slots=" in line:
+                name, _, rest = line.partition(" ")
+                slots = int(rest.split("slots=")[1].split()[0])
+                out.append(HostInfo(name.strip(), slots))
+            else:
+                out.extend(parse_hosts(line))
+    if not out:
+        raise ValueError("no hosts in hostfile %s" % path)
+    return out
+
+
+def total_slots(hosts: List[HostInfo]) -> int:
+    return sum(h.slots for h in hosts)
+
+
+def make_secret() -> str:
+    """Shared HMAC secret distributed to workers (reference secret.py)."""
+    return _secrets.token_hex(16)
+
+
+def dumps_base64(obj) -> str:
+    """Pickle+base64 codec for env-safe payloads (reference codec.py)."""
+    return base64.b64encode(pickle.dumps(obj)).decode()
+
+
+def loads_base64(s: str):
+    return pickle.loads(base64.b64decode(s.encode()))
+
+
+def host_hash() -> str:
+    """Stable identifier for this host, used to group local ranks
+    (reference host_hash.py)."""
+    return socket.gethostname()
+
+
+class Timeout:
+    """Deadline helper with contextual error messages (reference
+    timeout.py)."""
+
+    def __init__(self, seconds: float, message: str = "operation"):
+        self._deadline = time.monotonic() + seconds
+        self._message = message
+
+    def remaining(self) -> float:
+        return max(0.0, self._deadline - time.monotonic())
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self._deadline
+
+    def check(self):
+        if self.expired():
+            raise TimeoutError("%s timed out" % self._message)
+
+
+def find_free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports

@@ -1,0 +1,180 @@
+"""Launcher tests: arg/host parsing, env construction, services, safe
+exec, rendezvous auth, and a real static end-to-end run on localhost
+(reference: test/single/test_run.py + test/integration/test_static_run.py)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner import util
+from horovod_tpu.runner.launch import (build_common_env, gloo_run,
+                                       parse_args, worker_env,
+                                       _slot_assignments)
+from horovod_tpu.runner.http_client import RendezvousClient
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.runner.services import DriverService, TaskService
+from horovod_tpu.runner import safe_shell_exec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_hosts():
+    hosts = util.parse_hosts("a:4,b:2,c")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("a", 4), ("b", 2), ("c", 1)]
+    assert util.total_slots(hosts) == 7
+    with pytest.raises(ValueError):
+        util.parse_hosts("")
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("# comment\nnode1 slots=4\nnode2:2\n")
+    hosts = util.parse_hostfile(str(f))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("node1", 4), ("node2", 2)]
+
+
+def test_slot_assignments():
+    hosts = util.parse_hosts("a:2,b:2")
+    slots, cross = _slot_assignments(hosts, 3)
+    assert cross == 2
+    assert [(s[0], s[1], s[2]) for s in slots] == [
+        ("a", 0, 0), ("a", 1, 1), ("b", 2, 0)]
+    with pytest.raises(ValueError):
+        _slot_assignments(hosts, 9)
+
+
+def test_parse_args_and_env():
+    args = parse_args(["-np", "2", "--fusion-threshold-mb", "8",
+                       "--cycle-time-ms", "2", "--autotune",
+                       "--timeline-filename", "/tmp/tl",
+                       "python", "train.py"])
+    assert args.np == 2 and args.command == ["python", "train.py"]
+    env = build_common_env(args, {})
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(8 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.0"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl"
+    wenv = worker_env(env, 1, 2, 1, 2, 0, 1, "127.0.0.1:9", "s", 29600)
+    assert wenv["HOROVOD_RANK"] == "1"
+    assert wenv["HOROVOD_CONTROLLER"] == "tcp"
+
+
+def test_parse_args_requires_command():
+    with pytest.raises(SystemExit):
+        parse_args(["-np", "2"])
+
+
+def test_safe_shell_exec_streams_and_kills():
+    lines = []
+    rc = safe_shell_exec.execute(
+        [sys.executable, "-c", "print('hello'); print('world')"],
+        stdout_sink=lines.append)
+    assert rc == 0
+    assert "".join(lines) == "hello\nworld\n"
+    # Termination of a hanging tree.
+    mp = safe_shell_exec.ManagedProcess(
+        [sys.executable, "-c", "import time; time.sleep(600)"])
+    t0 = time.monotonic()
+    mp.terminate()
+    assert mp.proc.poll() is not None
+    assert time.monotonic() - t0 < safe_shell_exec.\
+        GRACEFUL_TERMINATION_TIME_S + 2
+
+
+def test_rendezvous_kv_and_auth():
+    server = RendezvousServer(secret="topsecret")
+    port = server.start()
+    try:
+        good = RendezvousClient("127.0.0.1:%d" % port, secret="topsecret")
+        good.put("addr/0", "1.2.3.4:5")
+        assert good.get("addr/0") == "1.2.3.4:5"
+        assert good.get("missing") is None
+        bad = RendezvousClient("127.0.0.1:%d" % port, secret="wrong")
+        with pytest.raises(Exception):
+            bad.put("addr/1", "x")
+        assert good.get("addr/1") is None  # unauthorized write rejected
+        good.delete("addr/0")
+        assert good.get("addr/0") is None
+    finally:
+        server.stop()
+
+
+def test_driver_task_services():
+    task = TaskService(index=3, secret="s3cr3t")
+    port = task.start()
+    try:
+        driver = DriverService(secret="s3cr3t")
+        info = driver.probe(("127.0.0.1", port))
+        assert info["index"] == 3
+        assert "127.0.0.1" in info["addresses"]
+        got = []
+        task.on_notify(got.append)
+        driver.notify(("127.0.0.1", port), {"hosts": ["a:1"]})
+        assert got == [{"hosts": ["a:1"]}]
+        # Wrong secret is rejected (connection dropped / no valid reply).
+        bad = DriverService(secret="wrong")
+        with pytest.raises(Exception):
+            bad.probe(("127.0.0.1", port), timeout=2.0)
+    finally:
+        task.stop()
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_static_run_end_to_end():
+    """Real launcher e2e: 3 local workers init tcp mode via rendezvous,
+    allreduce, and verify identity env plumbed by the launcher."""
+    script = (
+        "import horovod_tpu as hvd, numpy as np\n"
+        "hvd.init()\n"
+        "assert hvd.size() == 3\n"
+        "out = hvd.allreduce(np.ones(4, np.float32) * hvd.rank(),"
+        " op=hvd.Sum, name='t')\n"
+        "np.testing.assert_allclose(np.asarray(out), 3.0)\n"
+        "assert hvd.local_size() == 3\n"
+        "print('RANK_OK', hvd.rank())\n"
+        "hvd.shutdown()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "3",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=180, env=_worker_env(),
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(3):
+        assert "RANK_OK %d" % r in proc.stdout
+
+
+def test_static_run_failure_tears_down_world():
+    """One worker exits non-zero -> launcher kills the rest and reports
+    failure (reference exit-propagation behavior)."""
+    script = (
+        "import os, time\n"
+        "if os.environ['HOROVOD_RANK'] == '1':\n"
+        "    raise SystemExit(3)\n"
+        "time.sleep(600)\n")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, env=_worker_env(),
+        cwd=REPO)
+    assert proc.returncode != 0
+    assert time.monotonic() - t0 < 60
+
+
+def test_programmatic_run():
+    from tests.utils.run_fn import rank_times_two
+    from horovod_tpu.runner import run
+    results = run(rank_times_two, np=2)
+    assert results == [0, 2]

@@ -1,0 +1,6 @@
+"""Top-level function for horovod_tpu.runner.run() pickling tests."""
+import os
+
+
+def rank_times_two():
+    return int(os.environ["HOROVOD_RANK"]) * 2
