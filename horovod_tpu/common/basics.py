@@ -75,6 +75,16 @@ def init(devices: Optional[Sequence] = None,
         raise ValueError(
             "MPI communicators do not exist on TPU; use process_sets or "
             "the launcher instead")
+    import os
+    if (os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
+            and "HOROVOD_RANK" not in os.environ):
+        # Elastic worker calling hvd.init() before the run decorator:
+        # fetch a rank assignment from the elastic driver first.
+        from ..elastic.worker import (install_assignment,
+                                      notification_manager)
+        nm = notification_manager()
+        nm.init()
+        install_assignment(nm.rendezvous())
     with _state.lock:
         if _state.initialized:
             return
@@ -112,7 +122,17 @@ def init(devices: Optional[Sequence] = None,
                 config.local_rank, config.local_size,
                 config.cross_rank, config.cross_size)
             _state.tcp_core = TcpCore(_state.topology, config)
-            _state.tcp_core.initialize()
+            try:
+                _state.tcp_core.initialize()
+            except BaseException:
+                # Elastic re-init can race a world change; release the
+                # half-bootstrapped core so a retry starts clean.
+                try:
+                    _state.tcp_core.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+                _state.tcp_core = None
+                raise
         else:
             raise ValueError("unknown controller mode %r" % mode)
 

@@ -48,6 +48,15 @@ Status TcpMesh::Initialize(int rank, int size,
                            double timeout_secs) {
   rank_ = rank;
   size_ = size;
+  {
+    // Elastic re-init: clear any previous world's state.
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = false;
+    for (auto& kv : fds_) ::close(kv.second);
+    fds_.clear();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
   if (static_cast<int>(addrs.size()) != size)
     return Status::InvalidArgument("address table size mismatch");
   if (size == 1) return Status::OK();

@@ -77,6 +77,9 @@ Status CoreState::Initialize(int rank, int size,
                          fusion);
   initialized_ = true;
   stopped_ = false;
+  // Elastic re-init: a prior world's shutdown must not leak into the
+  // new background loop.
+  shutdown_requested_ = false;
   background_ = std::thread([this] { BackgroundLoop(); });
   LOG_INFO << "core initialized: rank " << rank << "/" << size;
   return Status::OK();

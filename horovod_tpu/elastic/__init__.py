@@ -1,1 +1,24 @@
-"""elastic subpackage."""
+"""Elastic (fault-tolerant, resizable) training.
+
+Reference parity: ``horovod.elastic`` — ``hvd.elastic.run`` retry
+decorator, ``State``/``ObjectState`` (+ ``JaxState`` pytree state),
+``ElasticSampler``, plus the driver-side machinery the launcher uses
+(``horovod/runner/elastic/``: ElasticDriver, discovery, registration).
+"""
+
+from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
+                        HostManager, HostUpdateResult)
+from .driver import ElasticDriver, elastic_run
+from .registration import WorkerStateRegistry
+from .sampler import ElasticSampler
+from .state import JaxState, ObjectState, State, run
+from .worker import (HostsUpdatedInterrupt, WorkerNotificationManager,
+                     WorkerStopped, notification_manager)
+
+__all__ = [
+    "run", "State", "ObjectState", "JaxState", "ElasticSampler",
+    "HostsUpdatedInterrupt", "WorkerStopped", "ElasticDriver",
+    "elastic_run", "HostDiscovery", "HostDiscoveryScript", "FixedHosts",
+    "HostManager", "HostUpdateResult", "WorkerStateRegistry",
+    "WorkerNotificationManager", "notification_manager",
+]

@@ -1,0 +1,125 @@
+"""Host discovery for elastic training.
+
+Reference parity: ``horovod/runner/elastic/discovery.py`` —
+``HostDiscoveryScript`` runs a user script that prints ``host:slots``
+lines; ``HostManager`` tracks the current available hosts, diffs
+successive discoveries, and applies the blacklist.  On TPU pods the
+script is typically a thin wrapper over the TPU control plane's
+slice-membership query (preemption notices / slice resize events play
+the role of hosts appearing and disappearing).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class HostUpdateResult:
+    NO_UPDATE = 0
+    ADDED = 1
+    REMOVED = 2
+    MIXED = 3
+
+
+class HostDiscovery:
+    """Base interface: ``find_available_hosts_and_slots`` returns an
+    ordered ``{host: slots}`` mapping."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class FixedHosts(HostDiscovery):
+    """Static host set (elastic retry without discovery)."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user-provided discovery script; each stdout line is
+    ``hostname`` or ``hostname:slots`` (reference format)."""
+
+    def __init__(self, discovery_script: str, default_slots: int = 1):
+        self._script = discovery_script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run(
+            self._script, shell=True, capture_output=True, text=True,
+            timeout=60)
+        if out.returncode != 0:
+            raise RuntimeError(
+                "host discovery script %r failed (rc=%d): %s"
+                % (self._script, out.returncode, out.stderr.strip()))
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                host, slots = line.rsplit(":", 1)
+                hosts[host.strip()] = int(slots)
+            else:
+                hosts[line] = self._default_slots
+        return hosts
+
+
+class HostManager:
+    """Tracks current hosts, applies the blacklist, and reports diffs
+    (reference HostManager.update_available_hosts)."""
+
+    def __init__(self, discovery: HostDiscovery,
+                 is_blacklisted: Callable[[str], bool]):
+        self._discovery = discovery
+        self._is_blacklisted = is_blacklisted
+        self._current: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def current_hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._current)
+
+    def update_available_hosts(self) -> int:
+        """Re-run discovery; returns a HostUpdateResult flag."""
+        found = self._discovery.find_available_hosts_and_slots()
+        found = {h: s for h, s in found.items()
+                 if s > 0 and not self._is_blacklisted(h)}
+        with self._lock:
+            prev = self._current
+            added = [h for h in found if h not in prev]
+            removed = [h for h in prev if h not in found]
+            changed = [h for h in found
+                       if h in prev and prev[h] != found[h]]
+            self._current = found
+        if not added and not removed and not changed:
+            return HostUpdateResult.NO_UPDATE
+        if added and not removed:
+            return HostUpdateResult.ADDED
+        if removed and not added:
+            return HostUpdateResult.REMOVED
+        return HostUpdateResult.MIXED
+
+    def blacklist_refresh(self):
+        """Drop newly blacklisted hosts from the current view."""
+        with self._lock:
+            self._current = {h: s for h, s in self._current.items()
+                             if not self._is_blacklisted(h)}
+
+    def ordered_slots(self, max_np: Optional[int] = None
+                      ) -> List[Tuple[str, int]]:
+        """Flatten to an ordered [(host, local_slot)] list, optionally
+        capped at ``max_np`` total slots."""
+        out: List[Tuple[str, int]] = []
+        for host, slots in self.current_hosts.items():
+            for s in range(slots):
+                out.append((host, s))
+                if max_np is not None and len(out) >= max_np:
+                    return out
+        return out

@@ -1,5 +1,342 @@
-"""Elastic driver (filled in by the elastic milestone)."""
+"""Elastic driver: discovery-driven world management + re-rendezvous.
+
+Reference parity: ``horovod/runner/elastic/driver.py`` (ElasticDriver),
+``rendezvous.py`` and the elastic half of ``gloo_run.py``: a background
+discovery thread polls the host-discovery script; on host add/remove or
+worker failure the driver bumps the world epoch, notifies workers (who
+raise ``HostsUpdatedInterrupt``), blacklists failed hosts
+(``registration.py``), recomputes slot→rank assignments within
+[min_np, max_np], and serves the new assignment to each worker's
+re-rendezvous poll.  Payload bootstrap (the TcpCore address table) goes
+through the same RendezvousServer KV store as the static launcher,
+reset at each epoch.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..runner import safe_shell_exec, util
+from ..runner.http_server import RendezvousServer
+from ..runner.services import MessageServer, send_message
+from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
+                        HostManager, HostUpdateResult)
+from .registration import WorkerStateRegistry
+
+LOG = logging.getLogger("horovod_tpu.elastic.driver")
+
+Slot = Tuple[str, int]
 
 
-def elastic_run(args):
-    raise NotImplementedError("elastic driver lands in the next milestone")
+class ElasticDriver:
+    def __init__(self, command: List[str], discovery: HostDiscovery,
+                 min_np: int, max_np: Optional[int],
+                 env: Optional[Dict[str, str]] = None,
+                 elastic_timeout: float = 600.0,
+                 discovery_interval: float = 1.0,
+                 failure_threshold: int = 1,
+                 start_timeout: float = 120.0,
+                 ssh_port: int = 22):
+        self.command = command
+        self.min_np = max(1, min_np)
+        self.max_np = max_np
+        self.env = dict(env or {})
+        self.elastic_timeout = elastic_timeout
+        self.discovery_interval = discovery_interval
+        self.start_timeout = start_timeout
+        self.ssh_port = ssh_port
+
+        self._registry = WorkerStateRegistry(failure_threshold)
+        self._hosts = HostManager(discovery, self._registry.is_blacklisted)
+        self._secret = util.make_secret()
+        self._server = MessageServer(self._handle, self._secret)
+        self._kv = RendezvousServer(secret=self._secret)
+
+        self._lock = threading.RLock()
+        self._epoch = 0
+        self._target: List[Slot] = []
+        self._ready: set = set()
+        self._published = False
+        self._assignments: Dict[Slot, Dict] = {}
+        self._port_base = 0
+        self._procs: Dict[Slot, safe_shell_exec.ManagedProcess] = {}
+        self._worker_addrs: Dict[Slot, Tuple[str, int]] = {}
+        self._stopped: set = set()       # slots told/forced to stop
+        self._succeeded: set = set()     # slots whose proc exited 0
+        self._shutdown = threading.Event()
+        self._below_min_since: Optional[float] = None
+        self._rc = 0
+
+    # -- message service ---------------------------------------------------
+
+    def _handle(self, req: Dict) -> Dict:
+        kind = req.get("kind")
+        if kind == "register":
+            slot = (req["host"], int(req["slot"]))
+            with self._lock:
+                self._worker_addrs[slot] = (req["host"], int(req["port"]))
+            return {"ok": True}
+        if kind == "rendezvous":
+            return self._handle_rendezvous(
+                (req["host"], int(req["slot"])))
+        if kind == "ping":
+            return {"ok": True, "epoch": self._epoch}
+        return {"error": "unknown request %r" % kind}
+
+    def _handle_rendezvous(self, slot: Slot) -> Dict:
+        with self._lock:
+            if (self._shutdown.is_set() or slot in self._stopped
+                    or self._registry.is_blacklisted(slot[0])):
+                return {"status": "stop"}
+            if not self._target:
+                # Below min_np: hold workers until discovery refills the
+                # world (their in-memory state survives the wait).
+                return {"status": "wait"}
+            if slot not in self._target:
+                return {"status": "stop"}
+            self._ready.add(slot)
+            if not self._published and self._ready >= set(self._target):
+                self._publish_epoch()
+            if self._published and slot in self._assignments:
+                return dict(self._assignments[slot], status="go")
+            return {"status": "wait"}
+
+    def _publish_epoch(self):
+        """All target slots checked in: assign ranks and open the world
+        (caller holds the lock)."""
+        self._kv.reset()
+        self._port_base = util.find_free_ports(1)[0]
+        rendezvous_addr = "%s:%d" % (self._driver_host(), self._kv.port)
+        hosts_in_order: List[str] = []
+        for host, _ in self._target:
+            if host not in hosts_in_order:
+                hosts_in_order.append(host)
+        local_sizes = {h: sum(1 for hh, _ in self._target if hh == h)
+                       for h in hosts_in_order}
+        self._assignments = {}
+        rank = 0
+        for cross_rank, host in enumerate(hosts_in_order):
+            local_rank = 0
+            for slot in [s for s in self._target if s[0] == host]:
+                self._assignments[slot] = {
+                    "epoch": self._epoch, "rank": rank,
+                    "size": len(self._target),
+                    "local_rank": local_rank,
+                    "local_size": local_sizes[host],
+                    "cross_rank": cross_rank,
+                    "cross_size": len(hosts_in_order),
+                    "port_base": self._port_base,
+                    "rendezvous_addr": rendezvous_addr,
+                }
+                rank += 1
+                local_rank += 1
+        self._published = True
+        LOG.info("epoch %d published: %d ranks over %d hosts",
+                 self._epoch, len(self._target), len(hosts_in_order))
+
+    def _driver_host(self) -> str:
+        if all(h == "localhost" or h.startswith("127.")
+               for h, _ in self._target):
+            return "127.0.0.1"
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except socket.gaierror:
+            return "127.0.0.1"
+
+    # -- world management --------------------------------------------------
+
+    def _recompute_world(self, reason: str):
+        """Epoch bump: recompute target slots, spawn/stop workers,
+        notify live ones (caller must NOT hold the lock)."""
+        with self._lock:
+            new_target = self._hosts.ordered_slots(self.max_np)
+            if len(new_target) < self.min_np:
+                if self._below_min_since is None:
+                    self._below_min_since = time.monotonic()
+                LOG.warning(
+                    "world below min_np (%d < %d) after %s; waiting for "
+                    "discovery", len(new_target), self.min_np, reason)
+                new_target = []
+            else:
+                self._below_min_since = None
+            def _alive(slot):
+                mp = self._procs.get(slot)
+                return mp is not None and mp.poll() is None
+            if (new_target == self._target and self._published
+                    and all(_alive(s) for s in new_target)):
+                return
+            self._epoch += 1
+            self._target = new_target
+            self._ready = set()
+            self._published = False
+            self._assignments = {}
+            LOG.info("world change (%s): epoch %d, target %d slots",
+                     reason, self._epoch, len(new_target))
+            target_hosts = {h for h, _ in new_target}
+            # Stop procs on hosts no longer in the world.
+            for slot, mp in list(self._procs.items()):
+                if slot[0] not in target_hosts and mp.poll() is None:
+                    self._stopped.add(slot)
+            # Spawn procs for target slots without a live process.
+            for slot in new_target:
+                mp = self._procs.get(slot)
+                if mp is None or mp.poll() is not None:
+                    self._spawn_worker(slot)
+            addrs = list(self._worker_addrs.items())
+        # Notify outside the lock (network).
+        for slot, addr in addrs:
+            try:
+                send_message(addr, self._secret, {
+                    "kind": "notify",
+                    "payload": {"type": "hosts_updated",
+                                "epoch": self._epoch}}, timeout=5.0)
+            except Exception:  # noqa: BLE001 — worker may be dead
+                pass
+        with self._lock:
+            for slot in self._stopped:
+                mp = self._procs.get(slot)
+                if mp is not None and mp.poll() is None:
+                    mp.terminate()
+
+    def _spawn_worker(self, slot: Slot):
+        host, idx = slot
+        env = dict(self.env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_DRIVER_ADDR": "%s:%d" % (
+                self._driver_host() or "127.0.0.1", self._server.port),
+            "HOROVOD_ELASTIC_SLOT": str(idx),
+            "HOROVOD_HOSTNAME": host,
+            "HOROVOD_SECRET_KEY": self._secret,
+            "HOROVOD_ELASTIC_TIMEOUT": str(self.elastic_timeout),
+        })
+        is_local = (host == "localhost" or host.startswith("127.")
+                    or host == util.host_hash())
+        if is_local:
+            cmd = self.command
+        else:
+            from ..runner.launch import _ssh_wrap
+            cmd = _ssh_wrap(host, self.ssh_port, env, self.command)
+        prefix = "[%s:%d]" % (host, idx)
+        mp = safe_shell_exec.ManagedProcess(
+            cmd, env,
+            stdout_sink=lambda l, p=prefix: sys.stdout.write(
+                p + "<stdout>" + l),
+            stderr_sink=lambda l, p=prefix: sys.stderr.write(
+                p + "<stderr>" + l))
+        self._procs[slot] = mp
+        self._stopped.discard(slot)
+        self._succeeded.discard(slot)
+        LOG.info("spawned worker %s:%d", host, idx)
+
+    # -- monitoring --------------------------------------------------------
+
+    def _discovery_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                result = self._hosts.update_available_hosts()
+            except Exception as exc:  # noqa: BLE001
+                LOG.warning("host discovery failed: %s", exc)
+                result = HostUpdateResult.NO_UPDATE
+            if result != HostUpdateResult.NO_UPDATE:
+                self._recompute_world("discovery update")
+            self._shutdown.wait(self.discovery_interval)
+
+    def _check_procs(self) -> bool:
+        """Reap exited workers; returns True when the run is finished."""
+        failed_hosts = []
+        with self._lock:
+            for slot, mp in list(self._procs.items()):
+                rc = mp.poll()
+                if rc is None:
+                    continue
+                del self._procs[slot]
+                if slot in self._stopped:
+                    continue
+                if rc == 0:
+                    self._succeeded.add(slot)
+                    self._registry.record_success(slot[0])
+                else:
+                    LOG.warning("worker %s:%d failed (rc=%d)",
+                                slot[0], slot[1], rc)
+                    failed_hosts.append(slot[0])
+            target = list(self._target)
+            done = (bool(target) and self._published
+                    and all(s in self._succeeded for s in target))
+        if done:
+            self._rc = 0
+            return True
+        for host in set(failed_hosts):
+            if self._registry.record_failure(host):
+                LOG.warning("blacklisting host %s", host)
+        if failed_hosts:
+            self._hosts.blacklist_refresh()
+            self._recompute_world("worker failure")
+        with self._lock:
+            if (self._below_min_since is not None
+                    and time.monotonic() - self._below_min_since
+                    > self.elastic_timeout):
+                LOG.error("gave up: below min_np for %.0fs",
+                          self.elastic_timeout)
+                self._rc = 1
+                return True
+        return False
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self) -> int:
+        self._server.start()
+        self._kv.start()
+        deadline = time.monotonic() + self.start_timeout
+        while True:
+            try:
+                self._hosts.update_available_hosts()
+            except Exception as exc:  # noqa: BLE001 — flaky script
+                LOG.warning("startup discovery failed: %s", exc)
+            if len(self._hosts.ordered_slots(self.max_np)) >= self.min_np:
+                break
+            if time.monotonic() > deadline:
+                LOG.error("discovery never found min_np=%d hosts",
+                          self.min_np)
+                return 1
+            time.sleep(1.0)
+        self._recompute_world("startup")
+        disc = threading.Thread(target=self._discovery_loop, daemon=True)
+        disc.start()
+        try:
+            while not self._check_procs():
+                time.sleep(0.1)
+            return self._rc
+        finally:
+            self._shutdown.set()
+            with self._lock:
+                procs = list(self._procs.values())
+            for mp in procs:
+                mp.terminate()
+            self._server.stop()
+            self._kv.stop()
+
+
+def elastic_run(args) -> int:
+    """Entry from the launcher (``horovodrun --min-np ... --host-
+    discovery-script disc.sh python train.py``)."""
+    from ..runner.launch import build_common_env
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script)
+    else:
+        hosts = util.parse_hosts(args.hosts) if args.hosts else \
+            [util.HostInfo("127.0.0.1", args.np or 1)]
+        discovery = FixedHosts({h.hostname: h.slots for h in hosts})
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np
+    driver = ElasticDriver(
+        args.command, discovery, min_np, max_np,
+        env=build_common_env(args),
+        elastic_timeout=args.elastic_timeout,
+        ssh_port=getattr(args, "ssh_port", 22))
+    return driver.run()

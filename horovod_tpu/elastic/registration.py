@@ -1,0 +1,55 @@
+"""Worker/host failure registry and blacklist.
+
+Reference parity: ``horovod/runner/elastic/registration.py``
+(WorkerStateRegistry) — records per-host failures observed by the
+driver; hosts whose workers fail are blacklisted so rediscovery does
+not re-add them, and slot assignment skips them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+
+class WorkerStateRegistry:
+    def __init__(self, failure_threshold: int = 1,
+                 cooldown_secs: float = 0.0):
+        # failure_threshold: failures before a host is blacklisted
+        # (reference blacklists on first failure by default).
+        self._failures: Dict[str, int] = {}
+        self._blacklist: Dict[str, float] = {}
+        self._threshold = max(1, failure_threshold)
+        self._cooldown = cooldown_secs
+        self._lock = threading.Lock()
+
+    def record_failure(self, host: str) -> bool:
+        """Record a worker failure on ``host``; returns True if the host
+        is now blacklisted."""
+        with self._lock:
+            self._failures[host] = self._failures.get(host, 0) + 1
+            if self._failures[host] >= self._threshold:
+                self._blacklist[host] = time.monotonic()
+                return True
+            return False
+
+    def record_success(self, host: str):
+        with self._lock:
+            self._failures.pop(host, None)
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            ts = self._blacklist.get(host)
+            if ts is None:
+                return False
+            if self._cooldown and time.monotonic() - ts > self._cooldown:
+                # Cooldown elapsed: give the host another chance.
+                del self._blacklist[host]
+                self._failures.pop(host, None)
+                return False
+            return True
+
+    def blacklisted_hosts(self) -> List[str]:
+        with self._lock:
+            return sorted(self._blacklist)

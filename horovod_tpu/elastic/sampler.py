@@ -1,0 +1,88 @@
+"""ElasticSampler: data resharding that survives world resizes.
+
+Reference parity: ``horovod/torch/elastic/sampler.py`` — shards sample
+indices over the current world, records which indices each epoch has
+already processed, and on reset (world change) re-shards only the
+remaining indices so resumed epochs do not revisit seen samples.
+Framework-free (index-based), so it works with any JAX/torch data
+pipeline.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Iterator, List, Optional
+
+from ..common import basics
+
+
+class ElasticSampler:
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0):
+        self.dataset_size = int(dataset_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: List[int] = []
+        self._reshard()
+
+    # -- State integration (pickles cleanly through ObjectState) ----------
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "processed_indices": list(self.processed_indices)}
+
+    def load_state_dict(self, sd: dict):
+        self.epoch = sd["epoch"]
+        self.processed_indices = list(sd["processed_indices"])
+        self._reshard()
+
+    # -- epoch / progress --------------------------------------------------
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.processed_indices = []
+        self._reshard()
+
+    def record_batch(self, batch_idx: int, batch_size: int):
+        """Mark ``batch_size`` samples starting at local batch
+        ``batch_idx`` as processed on this rank."""
+        start = batch_idx * batch_size
+        self.record_indices(self.indices[start:start + batch_size])
+
+    def record_indices(self, indices):
+        self.processed_indices.extend(int(i) for i in indices)
+
+    def on_reset(self):
+        """World changed: re-shard the *remaining* indices."""
+        self._reshard()
+
+    # -- sharding ----------------------------------------------------------
+
+    def _world(self):
+        if basics.is_initialized():
+            return basics.rank(), basics.size()
+        return 0, 1
+
+    def _reshard(self):
+        rank, size = self._world()
+        seen = set(self.processed_indices)
+        remaining = [i for i in range(self.dataset_size)
+                     if i not in seen]
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(remaining)
+        self.num_samples = int(math.ceil(len(remaining) / size)) \
+            if remaining else 0
+        total = self.num_samples * size
+        # Pad by wrapping so every rank yields the same count (keeps
+        # collectives in step; reference DistributedSampler behavior).
+        padded = (remaining * (total // max(len(remaining), 1) + 1)
+                  )[:total] if remaining else []
+        self.indices = padded[rank::size] if padded else []
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(list(self.indices))
+
+    def __len__(self) -> int:
+        return len(self.indices)

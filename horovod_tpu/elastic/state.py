@@ -1,0 +1,222 @@
+"""Elastic state: commit / restore / sync across world changes.
+
+Reference parity: ``horovod/common/elastic.py`` (``State``,
+``ObjectState``, ``run_fn``) and ``horovod/torch/elastic/state.py``
+(``TorchState`` — here ``JaxState`` holding pytrees).  The contract:
+
+* ``commit()``  — snapshot state in host memory AND check for pending
+  host updates (cheap in-memory checkpoint; called every N batches).
+* ``restore()`` — roll back to the last commit (after a failure).
+* ``sync()``    — broadcast state from rank 0 to the (possibly new)
+  world after a re-rendezvous.
+* user code runs inside ``hvd.elastic.run(train)(state)`` which retries
+  on ``HorovodInternalError`` (restore) and ``HostsUpdatedInterrupt``
+  (no rollback), re-rendezvousing in between.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..common import basics
+from ..ops.engine import HorovodInternalError
+from .worker import (HostsUpdatedInterrupt, WorkerStopped,
+                     install_assignment, notification_manager)
+
+LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+class State:
+    """Base elastic state (reference horovod/common/elastic.py State)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable[[], None]] = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt if the driver notified us of a
+        world change since the last check."""
+        nm = notification_manager()
+        if nm.has_update():
+            nm.consume_update()
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    # Subclass hooks -------------------------------------------------------
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Attribute-bag state synced by pickling (reference ObjectState):
+    every public attribute is committed/restored/broadcast."""
+
+    def __init__(self, **kwargs):
+        self._saved: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+        self.save()
+
+    def _public_attrs(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def save(self):
+        self._saved = copy.deepcopy(self._public_attrs())
+
+    def restore(self):
+        for k, v in copy.deepcopy(self._saved).items():
+            setattr(self, k, v)
+
+    def sync(self):
+        if not basics.is_initialized() or basics.size() <= 1:
+            return
+        from ..jax.functions import broadcast_object
+        synced = broadcast_object(self._public_attrs(), root_rank=0,
+                                  name="elastic.ObjectState")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Pytree-aware elastic state (the TorchState equivalent for JAX):
+    array-pytree attributes (params, opt_state, ...) are snapshotted to
+    host numpy on commit and broadcast leaf-wise on sync; scalar
+    attributes (epoch, batch, ...) ride the ObjectState path.
+
+    Example::
+
+        state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                     epoch=0, batch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            for state.epoch in range(state.epoch, epochs):
+                ...
+                state.commit()
+    """
+
+    def __init__(self, **kwargs):
+        import jax
+        self._jax = jax
+        self._tree_attrs = [k for k, v in kwargs.items()
+                            if self._is_tree(v)]
+        super().__init__(**kwargs)
+
+    @staticmethod
+    def _is_tree(v) -> bool:
+        import jax
+        leaves = jax.tree.leaves(v)
+        return bool(leaves) and all(
+            hasattr(l, "shape") and hasattr(l, "dtype") for l in leaves)
+
+    def _public_attrs(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_") and k not in self._tree_attrs}
+
+    def save(self):
+        super().save()
+        self._saved_trees = {
+            k: self._jax.tree.map(lambda x: np.asarray(x),
+                                  getattr(self, k))
+            for k in self._tree_attrs}
+
+    def restore(self):
+        super().restore()
+        for k, tree in self._saved_trees.items():
+            setattr(self, k, self._jax.tree.map(np.copy, tree))
+
+    def sync(self):
+        super().sync()
+        if not basics.is_initialized() or basics.size() <= 1:
+            return
+        from ..jax.functions import broadcast_parameters
+        for k in self._tree_attrs:
+            setattr(self, k, broadcast_parameters(getattr(self, k),
+                                                  root_rank=0))
+        self.save()
+
+
+def _reset_and_reinit():
+    """Tear down the old world and join the new one (reference:
+    shutdown → driver re-rendezvous → init)."""
+    try:
+        basics.shutdown()
+    except Exception:  # noqa: BLE001 — old world may already be broken
+        LOG.debug("shutdown of old world failed", exc_info=True)
+    nm = notification_manager()
+    if nm.active:
+        info = nm.rendezvous()
+        install_assignment(info)
+    basics.init()
+
+
+def run(func):
+    """Elastic retry decorator: ``hvd.elastic.run(train)(state, ...)``
+    (reference ``run_fn`` in horovod/common/elastic.py)."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        nm = notification_manager()
+        nm.init()
+        if not basics.is_initialized():
+            _reset_and_reinit()
+        skip_sync = False
+        first = True
+        while True:
+            if not first:
+                state.on_reset()
+            first = False
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as exc:
+                LOG.warning("collective failed (%s); restoring last "
+                            "commit and re-rendezvousing", exc)
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as exc:
+                LOG.info("hosts updated; re-rendezvousing")
+                skip_sync = exc.skip_sync
+            except WorkerStopped:
+                raise
+            # Re-rendezvous with backoff-on-failure: init itself can
+            # race a second world change.
+            deadline = time.monotonic() + 600.0
+            while True:
+                try:
+                    _reset_and_reinit()
+                    break
+                except WorkerStopped:
+                    raise
+                except Exception as exc:  # noqa: BLE001
+                    if time.monotonic() > deadline:
+                        raise
+                    LOG.warning("re-init failed (%s); retrying", exc)
+                    time.sleep(1.0)
+
+    return wrapper

@@ -1,0 +1,164 @@
+"""Worker-side elastic machinery: notification listener + rendezvous.
+
+Reference parity: ``horovod/runner/elastic/worker.py``
+(``WorkerNotificationService`` / ``WorkerNotificationManager``) — each
+worker runs a small authenticated TCP service; the elastic driver pings
+it when the host set changes, and the worker raises
+``HostsUpdatedInterrupt`` at the next ``state.check_host_updates()``
+(called from ``state.commit()``).
+
+The rendezvous half replaces the reference's Gloo re-rendezvous: the
+worker polls the driver's message service with its (host, slot)
+identity until the driver has a rank assignment for the new world
+epoch, then installs the assignment into the environment and re-inits.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import time
+from typing import Any, Dict, Optional
+
+from ..runner import services
+
+LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised in the worker when the driver reported a host-set change
+    (reference: horovod.runner.elastic.worker.HostsUpdatedInterrupt)."""
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class WorkerStopped(SystemExit):
+    """The driver removed this worker's slot from the world."""
+
+    def __init__(self):
+        super().__init__(0)
+
+
+def _driver_addr() -> Optional[tuple]:
+    addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
+    if not addr:
+        return None
+    host, port = addr.rsplit(":", 1)
+    return (host, int(port))
+
+
+class WorkerNotificationManager:
+    """Singleton per worker process; lazily started by
+    ``hvd.elastic.run`` (no-op outside an elastic launch)."""
+
+    def __init__(self):
+        self._server: Optional[services.MessageServer] = None
+        self._pending_epoch: Optional[int] = None
+        self._update_result: Optional[int] = None
+        self.host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+        self.slot = int(os.environ.get("HOROVOD_ELASTIC_SLOT", "0"))
+
+    @property
+    def active(self) -> bool:
+        return _driver_addr() is not None
+
+    def init(self):
+        if self._server is not None or not self.active:
+            return
+        secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+        self._server = services.MessageServer(self._handle, secret)
+        port = self._server.start()
+        services.send_message(
+            _driver_addr(), secret,
+            {"kind": "register", "host": self.host, "slot": self.slot,
+             "port": port, "pid": os.getpid()})
+        LOG.debug("worker %s:%d notification service on port %d",
+                  self.host, self.slot, port)
+
+    def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if req.get("kind") == "notify":
+            payload = req.get("payload") or {}
+            if payload.get("type") == "hosts_updated":
+                self._pending_epoch = payload.get("epoch")
+                self._update_result = payload.get("update_result")
+            return {"ok": True}
+        if req.get("kind") == "ping":
+            return {"ok": True, "host": self.host, "slot": self.slot}
+        return {"error": "unknown request"}
+
+    def has_update(self) -> bool:
+        return self._pending_epoch is not None
+
+    def consume_update(self) -> Optional[int]:
+        ep, self._pending_epoch = self._pending_epoch, None
+        return ep
+
+    def rendezvous(self, timeout: Optional[float] = None
+                   ) -> Dict[str, Any]:
+        """Poll the driver until it hands this (host, slot) a rank
+        assignment for the current epoch (or tells it to stop)."""
+        secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+        deadline = time.monotonic() + (timeout or float(
+            os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")))
+        while True:
+            try:
+                resp = services.send_message(
+                    _driver_addr(), secret,
+                    {"kind": "rendezvous", "host": self.host,
+                     "slot": self.slot})
+            except (ConnectionError, OSError, socket.timeout) as exc:
+                # Transient RPC failure: retry until the deadline; a
+                # persistently unreachable driver is a job failure, not
+                # a clean stop (exit 0 would read as success).
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "elastic driver unreachable: %s" % exc)
+                time.sleep(1.0)
+                continue
+            status = resp.get("status")
+            if status == "go":
+                # New epoch assignment supersedes any pending update
+                # notification for an older epoch.
+                if (self._pending_epoch is not None
+                        and self._pending_epoch <= resp["epoch"]):
+                    self._pending_epoch = None
+                return resp
+            if status == "stop":
+                raise WorkerStopped()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "elastic rendezvous timed out for worker %s:%d"
+                    % (self.host, self.slot))
+            time.sleep(0.25)
+
+    def shutdown(self):
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+_manager: Optional[WorkerNotificationManager] = None
+
+
+def notification_manager() -> WorkerNotificationManager:
+    global _manager
+    if _manager is None:
+        _manager = WorkerNotificationManager()
+    return _manager
+
+
+def install_assignment(info: Dict[str, Any]):
+    """Write a driver rank assignment into the environment so the next
+    ``hvd.init()`` (tcp controller) picks it up."""
+    os.environ["HOROVOD_RANK"] = str(info["rank"])
+    os.environ["HOROVOD_SIZE"] = str(info["size"])
+    os.environ["HOROVOD_LOCAL_RANK"] = str(info["local_rank"])
+    os.environ["HOROVOD_LOCAL_SIZE"] = str(info["local_size"])
+    os.environ["HOROVOD_CROSS_RANK"] = str(info["cross_rank"])
+    os.environ["HOROVOD_CROSS_SIZE"] = str(info["cross_size"])
+    os.environ["HOROVOD_PORT_BASE"] = str(info["port_base"])
+    os.environ["HOROVOD_RENDEZVOUS_ADDR"] = info["rendezvous_addr"]
+    os.environ["HOROVOD_CONTROLLER"] = "tcp"

@@ -1,0 +1,227 @@
+"""Elastic training tests.
+
+Reference parity: ``test/integration/test_elastic_torch.py`` + the
+elastic driver unit tests — discovery/registry/sampler/state units, and
+real-process integration runs where a worker is killed mid-training
+(failure → blacklist → resume from commit) and where the discovery
+script's output is mutated mid-run (scale-up → re-rendezvous), with
+multi-host faked as loopback-alias hosts on localhost.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.discovery import (FixedHosts, HostDiscoveryScript,
+                                           HostManager, HostUpdateResult)
+from horovod_tpu.elastic.registration import WorkerStateRegistry
+from horovod_tpu.elastic.sampler import ElasticSampler
+from horovod_tpu.elastic.state import ObjectState
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- units -----------------------------------------------------------------
+
+def test_discovery_script_parsing(tmp_path):
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\necho host1:4\necho '# comment'\n"
+                      "echo host2\n")
+    script.chmod(0o755)
+    disc = HostDiscoveryScript(str(script), default_slots=2)
+    assert disc.find_available_hosts_and_slots() == {
+        "host1": 4, "host2": 2}
+
+
+def test_discovery_script_failure(tmp_path):
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\nexit 7\n")
+    script.chmod(0o755)
+    with pytest.raises(RuntimeError):
+        HostDiscoveryScript(str(script)).find_available_hosts_and_slots()
+
+
+def test_host_manager_diffs_and_blacklist():
+    registry = WorkerStateRegistry()
+    hosts = {"a": 2, "b": 1}
+    disc = FixedHosts(hosts)
+    hm = HostManager(disc, registry.is_blacklisted)
+    assert hm.update_available_hosts() == HostUpdateResult.ADDED
+    assert hm.update_available_hosts() == HostUpdateResult.NO_UPDATE
+    hosts["c"] = 1
+    disc._hosts["c"] = 1
+    assert hm.update_available_hosts() == HostUpdateResult.ADDED
+    registry.record_failure("b")
+    assert registry.is_blacklisted("b")
+    assert hm.update_available_hosts() == HostUpdateResult.REMOVED
+    assert "b" not in hm.current_hosts
+    assert hm.ordered_slots(max_np=2) == [("a", 0), ("a", 1)]
+    assert hm.ordered_slots() == [("a", 0), ("a", 1), ("c", 0)]
+
+
+def test_worker_state_registry_threshold():
+    reg = WorkerStateRegistry(failure_threshold=2)
+    assert not reg.record_failure("h")
+    assert not reg.is_blacklisted("h")
+    assert reg.record_failure("h")
+    assert reg.is_blacklisted("h")
+    reg2 = WorkerStateRegistry()
+    reg2.record_failure("x")
+    assert reg2.blacklisted_hosts() == ["x"]
+
+
+def test_elastic_sampler_shard_and_resume():
+    s = ElasticSampler(dataset_size=10, shuffle=False)
+    # Uninitialized world -> single rank sees everything.
+    assert sorted(s) == list(range(10))
+    s.record_indices([0, 1, 2, 3])
+    s.on_reset()
+    assert sorted(s) == [4, 5, 6, 7, 8, 9]
+    sd = s.state_dict()
+    s2 = ElasticSampler(dataset_size=10, shuffle=False)
+    s2.load_state_dict(sd)
+    assert sorted(s2) == [4, 5, 6, 7, 8, 9]
+    s2.set_epoch(1)
+    assert len(s2) == 10
+
+
+def test_object_state_commit_restore():
+    st = ObjectState(batch=0, lr=0.1)
+    st.batch = 5
+    st.commit()
+    st.batch = 9
+    st.lr = 0.5
+    st.restore()
+    assert st.batch == 5 and st.lr == 0.1
+
+
+# -- integration: real local worker processes ------------------------------
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_RANK", None)
+    env.pop("HOROVOD_ELASTIC_DRIVER_ADDR", None)
+    return env
+
+
+WORKER_COMMON = """
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(batch=0, total=0.0)
+"""
+
+
+def test_elastic_fixed_world_completes(tmp_path):
+    """Static elastic run: 2 workers, commits every batch, clean finish."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+@elastic.run
+def train(state):
+    while state.batch < 5:
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.total += float(np.asarray(out)[0])
+        state.batch += 1
+        state.commit()
+    print("DONE rank=%d size=%d total=%.1f"
+          % (hvd.rank(), hvd.size(), state.total), flush=True)
+    return state.total
+
+train(state)
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--min-np", "2", "--max-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, env=_env(), cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DONE rank=0 size=2 total=10.0" in proc.stdout
+    assert "DONE rank=1 size=2 total=10.0" in proc.stdout
+
+
+def test_elastic_worker_failure_blacklist_and_resume(tmp_path):
+    """A worker dies mid-training: its host is blacklisted, the survivor
+    restores the last commit and finishes alone (reference fault
+    injection: kill a real worker process)."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+@elastic.run
+def train(state):
+    while state.batch < 8:
+        if (os.environ.get("HOROVOD_HOSTNAME") == "127.0.0.2"
+                and state.batch == 3):
+            os._exit(17)  # simulated hardware failure
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.total += float(np.asarray(out)[0])
+        state.batch += 1
+        state.commit()
+    print("DONE rank=%d size=%d batch=%d"
+          % (hvd.rank(), hvd.size(), state.batch), flush=True)
+
+train(state)
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, env=_env(), cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Survivor finished the epoch alone after the resize.
+    assert "DONE rank=0 size=1 batch=8" in proc.stdout
+
+
+def test_elastic_scale_up_mid_run(tmp_path):
+    """Discovery output gains a host mid-run: workers re-rendezvous into
+    the larger world and the joiner syncs state (reference: discovery
+    script output mutated mid-test)."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("127.0.0.1:2\n")
+    disc = tmp_path / "disc.sh"
+    disc.write_text("#!/bin/sh\ncat %s\n" % hosts_file)
+    disc.chmod(0o755)
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+state.extra = 0
+
+@elastic.run
+def train(state):
+    while hvd.size() < 3 or state.extra < 3:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.batch += 1
+        if hvd.size() >= 3:
+            state.extra += 1
+        time.sleep(0.05)
+        state.commit()
+    print("DONE rank=%d size=%d" % (hvd.rank(), hvd.size()), flush=True)
+
+train(state)
+""")
+
+    def add_host_later():
+        time.sleep(12.0)
+        hosts_file.write_text("127.0.0.1:2\n127.0.0.2:1\n")
+
+    t = threading.Thread(target=add_host_later, daemon=True)
+    t.start()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "--host-discovery-script", str(disc),
+         "--min-np", "2", "--max-np", "4",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=_env(), cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(3):
+        assert "DONE rank=%d size=3" % r in proc.stdout, proc.stdout
