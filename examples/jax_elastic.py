@@ -1,0 +1,45 @@
+"""Elastic data-parallel training (reference:
+examples/elastic/pytorch/pytorch_mnist_elastic.py): survives worker
+failures and host add/remove via commit/restore/sync.
+
+    python -m horovod_tpu.runner --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/jax_elastic.py
+"""
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+
+def main(batches: int = 200):
+    hvd.init()
+    state = elastic.ObjectState(batch=0, loss_sum=0.0)
+    sampler = elastic.ElasticSampler(dataset_size=8192)
+    state.sampler_state = sampler.state_dict()
+
+    @elastic.run
+    def train(state):
+        sampler.load_state_dict(state.sampler_state)
+        sampler.on_reset()
+        while state.batch < batches:
+            # One "training step": a gradient-sized allreduce.
+            grad = np.ones(1024, np.float32) * hvd.rank()
+            avg = hvd.allreduce(grad, op=hvd.Average,
+                                name="grad.%d" % state.batch)
+            state.loss_sum += float(np.asarray(avg)[0])
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.sampler_state = sampler.state_dict()
+                state.commit()
+        if hvd.rank() == 0:
+            print("finished %d batches over final world size %d"
+                  % (state.batch, hvd.size()))
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Data-parallel MNIST in JAX (reference:
+examples/pytorch/pytorch_mnist.py, the BASELINE config workload).
+
+Run in-process over all local TPU/CPU devices:
+
+    python examples/jax_mnist.py
+
+or as a multi-process world via the launcher:
+
+    python -m horovod_tpu.runner -np 2 python examples/jax_mnist.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.models.mlp import (accuracy, init_mlp, mlp_loss,
+                                    synthetic_mnist)
+
+
+def main(epochs: int = 3, batch_per_rank: int = 64, lr: float = 0.01):
+    hvd.init()
+    world = hvd.size()
+    key = jax.random.PRNGKey(0)
+    params = init_mlp(key)
+
+    # Linear LR scaling + warmup (reference mnist example pattern).
+    warmup = hvd.callbacks.LearningRateWarmupCallback(
+        initial_lr=lr, warmup_epochs=1, steps_per_epoch=100,
+        multiplier=world)
+    metric_avg = hvd.callbacks.MetricAverageCallback()
+
+    # The jit-safe form of the warmup policy (see as_optax_schedule).
+    opt = optax.sgd(warmup.as_optax_schedule())
+    step, opt_init = hvd.make_data_parallel_step(mlp_loss, opt)
+    opt_state = opt_init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    data = synthetic_mnist(np.random.RandomState(1234), 8 * 1024)
+    xs, ys = data["x"], data["y"]
+    n_batches = len(xs) // (batch_per_rank * world)
+    for epoch in range(epochs):
+        warmup.on_epoch_begin(epoch)
+        t0 = time.time()
+        loss = None
+        for b in range(n_batches):
+            lo = b * batch_per_rank * world
+            hi = lo + batch_per_rank * world
+            batch = {"x": jnp.asarray(xs[lo:hi]),
+                     "y": jnp.asarray(ys[lo:hi])}
+            params, opt_state, loss = step(params, opt_state, batch)
+        logs = {"loss": float(loss),
+                "acc": float(accuracy(params,
+                                      {"x": jnp.asarray(xs[:1024]),
+                                       "y": jnp.asarray(ys[:1024])}))}
+        metric_avg.on_epoch_end(epoch, logs)
+        if hvd.rank() == 0:
+            print("epoch %d: loss=%.4f acc=%.3f (%.2fs)"
+                  % (epoch, logs["loss"], logs["acc"], time.time() - t0))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Data-parallel MNIST with the torch adapter (reference:
+examples/pytorch/pytorch_mnist.py).  Synthetic data (zero-egress env).
+
+    python -m horovod_tpu.runner -np 2 python examples/pytorch_mnist.py
+"""
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def synthetic_mnist(rng, n):
+    protos = rng.randn(10, 784).astype("float32")
+    y = rng.randint(0, 10, size=n)
+    x = protos[y] + 0.5 * rng.randn(n, 784).astype("float32")
+    return torch.from_numpy(x), torch.from_numpy(y.astype("int64"))
+
+
+def main(epochs: int = 3, batch_size: int = 64, lr: float = 0.01):
+    hvd.init()
+    torch.manual_seed(42)
+    model = Net()
+    # Linear LR scaling with world size (reference pattern).
+    opt = torch.optim.SGD(model.parameters(), lr=lr * hvd.size(),
+                          momentum=0.5)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        compression=hvd.Compression.fp16)
+
+    x, y = synthetic_mnist(np.random.RandomState(0), 8 * 1024)
+    # Shard the dataset across ranks (DistributedSampler equivalent).
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+    for epoch in range(epochs):
+        perm = torch.randperm(len(x))
+        total = 0.0
+        for lo in range(0, len(x) - batch_size + 1, batch_size):
+            idx = perm[lo:lo + batch_size]
+            opt.zero_grad()
+            loss = F.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            opt.step()
+            total += float(loss.detach())
+        avg = hvd.allreduce(torch.tensor([total]), op=hvd.Average,
+                            name="epoch_loss")
+        if hvd.rank() == 0:
+            print("epoch %d: loss=%.4f" % (epoch, float(avg)))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

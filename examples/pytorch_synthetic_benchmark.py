@@ -1,0 +1,70 @@
+"""Torch-adapter synthetic benchmark (reference:
+examples/pytorch/pytorch_synthetic_benchmark.py): fixed model on random
+data, img/sec per iteration over the multi-process world.
+
+    python -m horovod_tpu.runner -np 2 \
+        python examples/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def make_model(width: int = 1024, depth: int = 4,
+               classes: int = 1000) -> torch.nn.Module:
+    layers = []
+    for _ in range(depth):
+        layers += [torch.nn.Linear(width, width), torch.nn.ReLU()]
+    return torch.nn.Sequential(*layers, torch.nn.Linear(width, classes))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--width", type=int, default=1024)
+    p.add_argument("--num-iters", type=int, default=20)
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    model = make_model(args.width)
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        compression=compression)
+
+    x = torch.randn(args.batch_size, args.width)
+    y = torch.randint(0, 1000, (args.batch_size,))
+
+    times = []
+    for it in range(args.num_warmup + args.num_iters):
+        t0 = time.time()
+        opt.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        dt = time.time() - t0
+        if it >= args.num_warmup:
+            times.append(dt)
+    imgs = args.batch_size / float(np.median(times))
+    total = hvd.allreduce(torch.tensor([imgs]), op=hvd.Sum,
+                          name="imgsec")
+    if hvd.rank() == 0:
+        print("img/sec per rank: %.1f" % imgs)
+        print("total img/sec on %d ranks: %.1f"
+              % (hvd.size(), float(total)))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

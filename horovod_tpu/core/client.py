@@ -29,6 +29,11 @@ _DTYPES = {
     np.dtype("float16"): 6, np.dtype("float32"): 7,
     np.dtype("float64"): 8, np.dtype("bool"): 9,
 }
+try:  # bf16 wire format (the TPU-native low-precision dtype).
+    import ml_dtypes
+    _DTYPES[np.dtype(ml_dtypes.bfloat16)] = 10
+except ImportError:  # pragma: no cover
+    pass
 _OP_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "alltoall": 3,
              "reducescatter": 4, "barrier": 5, "join": 6}
 _RED_OPS = {"Sum": 0, "Average": 1, "Min": 2, "Max": 3, "Product": 4,

@@ -158,6 +158,8 @@ void CoreState::CompleteEntry(const std::shared_ptr<TensorTableEntry>& e,
   e->done = true;
   timeline_.ActivityEnd(e->request.name);
   queue_.Remove(e->request.name);
+  // Transient grouped-collective record: drop with its last member.
+  groups_.RemoveName(e->request.name);
 }
 
 void CoreState::BackgroundLoop() {

@@ -77,28 +77,51 @@ class ProcessSetTable {
 class GroupTable {
  public:
   int32_t RegisterGroup(const std::vector<std::string>& names) {
+    std::lock_guard<std::mutex> lk(mu_);
     int32_t id = next_group_id_++;
     for (auto& n : names) group_of_[n] = id;
     sizes_[id] = static_cast<int32_t>(names.size());
+    remaining_[id] = static_cast<int32_t>(names.size());
     return id;
   }
   int32_t GroupOf(const std::string& name) const {
+    std::lock_guard<std::mutex> lk(mu_);
     auto it = group_of_.find(name);
     return it == group_of_.end() ? -1 : it->second;
   }
   int32_t GroupSize(int32_t id) const {
+    std::lock_guard<std::mutex> lk(mu_);
     auto it = sizes_.find(id);
     return it == sizes_.end() ? 0 : it->second;
   }
+  // Groups are transient (one grouped_allreduce call each): once a
+  // member's collective has executed its entry is dropped, and the
+  // group record disappears with its last member — the table stays
+  // bounded over long training runs.
+  void RemoveName(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = group_of_.find(name);
+    if (it == group_of_.end()) return;
+    int32_t id = it->second;
+    group_of_.erase(it);
+    if (--remaining_[id] <= 0) {
+      sizes_.erase(id);
+      remaining_.erase(id);
+    }
+  }
   void RemoveGroup(int32_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
     for (auto it = group_of_.begin(); it != group_of_.end();)
       it = it->second == id ? group_of_.erase(it) : std::next(it);
     sizes_.erase(id);
+    remaining_.erase(id);
   }
 
  private:
+  mutable std::mutex mu_;
   std::map<std::string, int32_t> group_of_;
   std::map<int32_t, int32_t> sizes_;
+  std::map<int32_t, int32_t> remaining_;
   int32_t next_group_id_ = 0;
 };
 

@@ -37,6 +37,8 @@ from .sync_batch_norm import (SyncBatchNorm, sync_batch_norm_stats,
 from .data_parallel import (make_data_parallel_step, make_sharded_jit_step,
                             shard_batch, replicate, metric_average)
 from . import spmd
+from . import callbacks
+from .. import elastic
 
 Sum = SUM
 Average = AVERAGE

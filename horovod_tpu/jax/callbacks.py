@@ -1,0 +1,170 @@
+"""Training-loop callbacks (the Keras callback set, JAX-idiomatic).
+
+Reference parity: ``horovod/_keras/callbacks.py`` +
+``horovod/callbacks`` exposure — ``BroadcastGlobalVariablesCallback``,
+``MetricAverageCallback``, ``LearningRateWarmupCallback``,
+``LearningRateScheduleCallback``.  There is no Keras fit-loop here;
+callbacks are small objects a JAX training loop invokes at the same
+hook points, and the LR callbacks can also be lowered to an optax
+schedule (``as_optax_schedule``) so the policy can live inside a jitted
+update — the TPU-idiomatic form.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..common import basics
+from ..ops import api as eager
+from .functions import broadcast_parameters
+
+
+class Callback:
+    """Hook points mirroring the Keras callback protocol."""
+
+    def on_train_begin(self, state=None):
+        pass
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        pass
+
+    def on_batch_end(self, batch: int, logs: Optional[Dict] = None):
+        pass
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None):
+        pass
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial parameters from ``root_rank`` at train begin so
+    all replicas start identical (reference
+    BroadcastGlobalVariablesCallback)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_begin(self, state=None):
+        if state is None or self.broadcast_done:
+            return state
+        out = broadcast_parameters(state, self.root_rank)
+        self.broadcast_done = True
+        return out
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all ranks before they are logged
+    (reference MetricAverageCallback)."""
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None):
+        if not logs or not basics.is_initialized():
+            return logs
+        if basics.size() <= 1 or basics._controller_is_spmd():
+            # In-process SPMD: the single controller already sees global
+            # metrics; only multi-process worlds need the average.
+            return logs
+        for k in list(logs.keys()):
+            v = np.asarray(logs[k], dtype=np.float64)
+            logs[k] = float(np.asarray(eager.allreduce(
+                v, op=eager.AVERAGE,
+                name="metric.%s" % k)).reshape(()))
+        return logs
+
+
+class LearningRateWarmupCallback(Callback):
+    """Scale LR from ``initial_lr`` to ``initial_lr * multiplier`` over
+    the first ``warmup_epochs`` (reference LearningRateWarmupCallback;
+    multiplier defaults to world size per the linear-scaling rule)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: Optional[int] = None,
+                 multiplier: Optional[float] = None,
+                 verbose: bool = False):
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.multiplier = (multiplier if multiplier is not None
+                           else float(basics.size()
+                                      if basics.is_initialized() else 1))
+        self.verbose = verbose
+        self.current_lr = initial_lr
+
+    def lr_at(self, epoch: float) -> float:
+        if epoch >= self.warmup_epochs:
+            return self.initial_lr * self.multiplier
+        # Exponential ramp matching the reference's per-batch warmup.
+        frac = epoch / max(self.warmup_epochs, 1e-9)
+        return self.initial_lr * self.multiplier ** frac
+
+    def on_batch_end(self, batch: int, logs: Optional[Dict] = None):
+        if self.steps_per_epoch is None:
+            # Reference behavior: per-batch warmup cannot work without
+            # knowing the epoch length — fail loudly, don't mis-ramp.
+            raise ValueError(
+                "LearningRateWarmupCallback needs steps_per_epoch for "
+                "per-batch warmup (epoch-granular use is fine without)")
+        epoch_f = getattr(self, "_epoch", 0) + \
+            batch / float(self.steps_per_epoch)
+        self.current_lr = self.lr_at(epoch_f)
+        if logs is not None:
+            logs["lr"] = self.current_lr
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        self._epoch = epoch
+        self.current_lr = self.lr_at(epoch)
+        if self.verbose and (not basics.is_initialized()
+                             or basics.rank() == 0):
+            print("Epoch %d: warmup lr = %g" % (epoch, self.current_lr))
+
+    def as_optax_schedule(self) -> Callable[[int], float]:
+        """Lower to an optax-style schedule(step)->lr for use inside a
+        jitted update (TPU-idiomatic form)."""
+        import jax.numpy as jnp
+
+        if self.steps_per_epoch is None:
+            raise ValueError(
+                "as_optax_schedule needs steps_per_epoch to convert the "
+                "epoch-based warmup into a per-step schedule")
+        warmup_steps = self.warmup_epochs * self.steps_per_epoch
+
+        def schedule(step):
+            frac = jnp.minimum(step / max(warmup_steps, 1), 1.0)
+            return self.initial_lr * self.multiplier ** frac
+        return schedule
+
+
+class LearningRateScheduleCallback(Callback):
+    """Piecewise LR schedule (reference LearningRateScheduleCallback):
+    between ``start_epoch`` and ``end_epoch`` the LR is
+    ``initial_lr * multiplier`` where ``multiplier`` is a constant or a
+    function of epoch; ``staircase`` applies it at integer epochs."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        if callable(multiplier):
+            self._mult = multiplier
+        else:
+            self._mult = lambda epoch: multiplier
+        self.current_lr = initial_lr
+
+    def _active(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def lr_at(self, epoch: float) -> float:
+        e = math.floor(epoch) if self.staircase else epoch
+        if self._active(e):
+            return self.initial_lr * self._mult(e)
+        return self.current_lr
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        self.current_lr = self.lr_at(epoch)

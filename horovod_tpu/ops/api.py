@@ -18,7 +18,8 @@ C++ core, which negotiates readiness across ranks before executing.
 
 from __future__ import annotations
 
-import uuid
+import collections
+import itertools
 from typing import List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -41,8 +42,16 @@ __all__ = [
 ]
 
 
+# Auto names must be identical across ranks: the multi-process
+# controller negotiates collectives by exact name match, so unnamed ops
+# get a per-op-type sequence number (deterministic when all ranks issue
+# the same call sequence — the reference's contract for unnamed ops).
+_name_counters = collections.defaultdict(itertools.count)
+
+
 def _auto_name(prefix: str, name: Optional[str]) -> str:
-    return name if name else "%s.noname.%s" % (prefix, uuid.uuid4().hex[:8])
+    return name if name else \
+        "%s.noname.%d" % (prefix, next(_name_counters[prefix]))
 
 
 def _ps_id(process_set: Optional[ProcessSet]) -> int:
@@ -131,10 +140,20 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
     ps_id = _ps_id(process_set)
     ps = process_set or global_process_set
     base = _auto_name("grouped_allreduce", name)
+    names = ["%s.%d" % (base, i) for i in range(len(tensors))]
+    if _tcp_mode():
+        core = basics._get_tcp_core()
+        # Register the group so the controller negotiates/fuses it
+        # atomically (reference: group_table.cc).
+        core.register_group(names)
+        return [core.allreduce_async(
+            _np(t), n, op=red_op, prescale=prescale_factor,
+            postscale=postscale_factor, process_set_id=ps_id)
+            for t, n in zip(tensors, names)]
     handles = []
-    for i, t in enumerate(tensors):
+    for t, n in zip(tensors, names):
         handles.append(_engine().enqueue_allreduce(
-            "%s.%d" % (base, i), _stack(t, ps.size()), red_op,
+            n, _stack(t, ps.size()), red_op,
             prescale_factor, postscale_factor, ps_id))
     return handles
 

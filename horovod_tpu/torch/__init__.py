@@ -1,0 +1,57 @@
+"""PyTorch adapter: ``import horovod_tpu.torch as hvd``.
+
+Reference parity: ``horovod/torch/__init__.py`` + ``mpi_ops.py`` — the
+same surface (init/rank/size, the 8 collectives with ``*_async``/
+in-place variants, ``DistributedOptimizer`` with per-parameter gradient
+hooks, ``broadcast_parameters`` / ``broadcast_optimizer_state`` /
+``broadcast_object``, ``Compression``, ``SyncBatchNorm``, elastic
+``TorchState``) routed through this framework's native TCP core instead
+of the reference's pybind extension (``horovod/torch/mpi_ops_v2.cc``).
+
+Torch tensors here live on CPU hosts (the TPU compute path is the JAX
+adapter); collectives move them through the multi-process world the
+launcher spawns.  Without a launcher this adapter initializes a
+size-1 tcp world so scripts run unmodified.
+"""
+
+from ..common.basics import (shutdown, is_initialized, rank, size,
+                             local_rank, local_size, cross_rank,
+                             cross_size, is_homogeneous, topology,
+                             start_timeline, stop_timeline, xla_built,
+                             tcp_built, gloo_built, mpi_built,
+                             nccl_built, ccl_built, ddl_built,
+                             cuda_built, rocm_built, mpi_enabled,
+                             mpi_threads_supported)
+from ..common.basics import init as _base_init
+from ..common.process_sets import (ProcessSet, global_process_set,
+                                   add_process_set, remove_process_set)
+from ..ops.engine import HorovodInternalError
+from ..ops.xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
+from .compression import Compression
+from .functions import (allgather_object, broadcast_object,
+                        broadcast_optimizer_state, broadcast_parameters)
+from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
+                      allreduce_async, allreduce_async_, alltoall,
+                      alltoall_async, barrier, broadcast, broadcast_,
+                      broadcast_async, broadcast_async_,
+                      grouped_allreduce, grouped_allreduce_async, join,
+                      poll, reducescatter, reducescatter_async,
+                      synchronize)
+from .optimizer import DistributedOptimizer
+from .sync_batch_norm import SyncBatchNorm
+from . import elastic
+
+Sum = SUM
+Average = AVERAGE
+Min = MIN
+Max = MAX
+Product = PRODUCT
+Adasum = ADASUM
+
+
+def init(*args, **kwargs):
+    """``hvd.init()`` — defaults to the multi-process (tcp) controller:
+    torch semantics are per-process tensors, so even an unlaunched
+    script gets a real size-1 world through the native core."""
+    kwargs.setdefault("controller", "tcp")
+    return _base_init(*args, **kwargs)

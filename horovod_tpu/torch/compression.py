@@ -1,0 +1,56 @@
+"""Torch gradient compression (reference: horovod/torch/compression.py):
+``Compression.none`` / ``Compression.fp16`` (+ ``bf16``) bracketing the
+allreduce with dtype casts."""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: torch.dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating_point:
+            return tensor.to(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = torch.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = torch.bfloat16
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,72 @@
+"""Elastic state for torch models.
+
+Reference parity: ``horovod/torch/elastic/state.py`` (``TorchState``) +
+``horovod/torch/elastic/sampler.py`` (``ElasticSampler`` — reused from
+the framework-free implementation): model/optimizer ``state_dict``s are
+cloned to host memory on ``commit()``, restored after failures, and
+broadcast from rank 0 on ``sync()`` after a re-rendezvous.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import torch
+
+from ..common import basics
+from ..elastic import run  # noqa: F401 — re-export (hvd.elastic.run)
+from ..elastic import ElasticSampler  # noqa: F401 — re-export
+from ..elastic.state import ObjectState, State  # noqa: F401
+from .functions import broadcast_object, broadcast_parameters
+
+
+class TorchState(ObjectState):
+    """Elastic state holding torch modules/optimizers plus scalars::
+
+        state = hvd.elastic.TorchState(model=model, optimizer=opt,
+                                       epoch=0, batch=0)
+    """
+
+    def __init__(self, model: torch.nn.Module = None,
+                 optimizer: torch.optim.Optimizer = None, **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        self._saved_model: Dict[str, Any] = {}
+        self._saved_opt: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+
+    @property
+    def model(self):
+        return self._model
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def save(self):
+        super().save()
+        if self._model is not None:
+            self._saved_model = copy.deepcopy(self._model.state_dict())
+        if self._optimizer is not None:
+            self._saved_opt = copy.deepcopy(self._optimizer.state_dict())
+
+    def restore(self):
+        super().restore()
+        if self._model is not None and self._saved_model:
+            self._model.load_state_dict(self._saved_model)
+        if self._optimizer is not None and self._saved_opt:
+            self._optimizer.load_state_dict(self._saved_opt)
+
+    def sync(self):
+        super().sync()
+        if not basics.is_initialized() or basics.size() <= 1:
+            return
+        if self._model is not None:
+            broadcast_parameters(self._model.state_dict(), root_rank=0)
+        if self._optimizer is not None:
+            sd = broadcast_object(self._optimizer.state_dict(),
+                                  root_rank=0,
+                                  name="TorchState.optimizer")
+            self._optimizer.load_state_dict(sd)
+        self.save()

@@ -1,0 +1,56 @@
+"""Torch parameter/object broadcast helpers.
+
+Reference parity: ``horovod/torch/functions.py`` —
+``broadcast_parameters`` (accepts a ``state_dict()`` or
+``named_parameters()`` iterable), ``broadcast_optimizer_state``,
+``broadcast_object``, ``allgather_object``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import torch
+
+from ..jax.functions import allgather_object as _allgather_object
+from ..jax.functions import broadcast_object as _broadcast_object
+from . import mpi_ops
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """In-place broadcast of model parameters from ``root_rank``:
+    ``hvd.broadcast_parameters(model.state_dict(), root_rank=0)``."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    elif isinstance(params, Iterable):
+        items = list(params)
+    else:
+        raise ValueError("invalid params of type %r" % type(params))
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if isinstance(p, torch.Tensor):
+            handles.append(mpi_ops.broadcast_async_(
+                p.data, root_rank, name="broadcast_parameters.%s" % name))
+    for h in handles:
+        h.wait()
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0):
+    """Broadcast the optimizer's ``state_dict`` from root and load it on
+    every rank (reference implementation walks tensors; pickling the
+    whole dict over the same wire is equivalent for CPU state)."""
+    sd = _broadcast_object(optimizer.state_dict(), root_rank,
+                           name="broadcast_optimizer_state")
+    optimizer.load_state_dict(sd)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    return _broadcast_object(obj, root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None):
+    return _allgather_object(obj, name=name)

@@ -1,0 +1,219 @@
+"""Torch collective ops over the native core.
+
+Reference parity: ``horovod/torch/mpi_ops.py`` (+ the handle table in
+``mpi_ops_v2.cc`` / ``handle_manager.cc``): every op has a synchronous
+form, an ``*_async`` form returning a handle resolved by
+``synchronize``/``poll``, and (where the reference has one) an in-place
+``*_`` form.  Tensors are CPU torch tensors; the wire format is their
+zero-copy numpy view.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import torch
+
+from ..ops import api as _api
+from ..ops.xla_ops import AVERAGE, SUM
+
+__all__ = [
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "barrier", "join",
+    "synchronize", "poll",
+]
+
+
+def _np_view(t: torch.Tensor) -> np.ndarray:
+    if t.device.type != "cpu":
+        raise ValueError(
+            "torch adapter moves CPU tensors; device tensors belong to "
+            "the JAX adapter (got %s)" % t.device)
+    if t.dtype == torch.bfloat16:
+        # numpy has no native bf16: reinterpret through uint16 onto the
+        # ml_dtypes wire representation (same bits, zero copy).
+        import ml_dtypes
+        return t.detach().contiguous().view(torch.uint16).numpy() \
+            .view(ml_dtypes.bfloat16)
+    return t.detach().contiguous().numpy()
+
+
+class TorchHandle:
+    """Async handle returning torch tensors (reference HandleManager)."""
+
+    def __init__(self, inner, like: Optional[torch.Tensor] = None,
+                 out: Optional[torch.Tensor] = None):
+        self._inner = inner
+        self._like = like
+        self._out = out  # in-place target
+
+    def poll(self) -> bool:
+        return self._inner.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        res = self._inner.wait(timeout)
+        splits = None
+        if isinstance(res, tuple):
+            res, splits = res
+        arr = np.ascontiguousarray(np.asarray(res))
+        if arr.dtype.name == "bfloat16":
+            t = torch.from_numpy(arr.view(np.uint16)) \
+                .view(torch.bfloat16)
+        else:
+            t = torch.from_numpy(arr)
+        if self._like is not None and t.dtype != self._like.dtype:
+            t = t.to(self._like.dtype)
+        if self._out is not None:
+            self._out.data.copy_(t.reshape(self._out.shape))
+            t = self._out
+        return (t, splits) if splits is not None else t
+
+
+def synchronize(handle: TorchHandle):
+    return handle.wait()
+
+
+def poll(handle: TorchHandle) -> bool:
+    return handle.poll()
+
+
+# -- allreduce -------------------------------------------------------------
+
+def allreduce_async(tensor: torch.Tensor, average=None,
+                    name: Optional[str] = None, op=None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set=None) -> TorchHandle:
+    h = _api.allreduce_async(_np_view(tensor), average, name, op,
+                             prescale_factor, postscale_factor,
+                             process_set)
+    return TorchHandle(h, like=tensor)
+
+
+def allreduce_async_(tensor: torch.Tensor, average=None,
+                     name: Optional[str] = None, op=None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     process_set=None) -> TorchHandle:
+    """In-place async allreduce (reference ``hvd.allreduce_async_``)."""
+    h = _api.allreduce_async(_np_view(tensor), average, name, op,
+                             prescale_factor, postscale_factor,
+                             process_set)
+    return TorchHandle(h, like=tensor, out=tensor)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=None) -> torch.Tensor:
+    return allreduce_async(tensor, average, name, op, prescale_factor,
+                           postscale_factor, process_set).wait()
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=None) -> torch.Tensor:
+    return allreduce_async_(tensor, average, name, op, prescale_factor,
+                            postscale_factor, process_set).wait()
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
+                            name: Optional[str] = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set=None) -> List[TorchHandle]:
+    hs = _api.grouped_allreduce_async(
+        [_np_view(t) for t in tensors], average, name, op,
+        prescale_factor, postscale_factor, process_set)
+    return [TorchHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None) -> List[torch.Tensor]:
+    return [h.wait() for h in grouped_allreduce_async(
+        tensors, average, name, op, prescale_factor, postscale_factor,
+        process_set)]
+
+
+# -- allgather -------------------------------------------------------------
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    process_set=None) -> TorchHandle:
+    h = _api.allgather_async(_np_view(tensor), name, process_set)
+    return TorchHandle(h, like=tensor)
+
+
+def allgather(tensor, name=None, process_set=None) -> torch.Tensor:
+    return allgather_async(tensor, name, process_set).wait()
+
+
+# -- broadcast -------------------------------------------------------------
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None,
+                    process_set=None) -> TorchHandle:
+    h = _api.broadcast_async(_np_view(tensor), root_rank, name,
+                             process_set)
+    return TorchHandle(h, like=tensor)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None,
+                     process_set=None) -> TorchHandle:
+    h = _api.broadcast_async(_np_view(tensor), root_rank, name,
+                             process_set)
+    return TorchHandle(h, like=tensor, out=tensor)
+
+
+def broadcast(tensor, root_rank: int, name=None,
+              process_set=None) -> torch.Tensor:
+    return broadcast_async(tensor, root_rank, name, process_set).wait()
+
+
+def broadcast_(tensor, root_rank: int, name=None,
+               process_set=None) -> torch.Tensor:
+    return broadcast_async_(tensor, root_rank, name, process_set).wait()
+
+
+# -- alltoall / reducescatter ----------------------------------------------
+
+def alltoall_async(tensor: torch.Tensor, splits=None,
+                   name: Optional[str] = None,
+                   process_set=None) -> TorchHandle:
+    if splits is not None and isinstance(splits, torch.Tensor):
+        splits = splits.tolist()
+    h = _api.alltoall_async(_np_view(tensor), splits, name, process_set)
+    return TorchHandle(h, like=tensor)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    res = alltoall_async(tensor, splits, name, process_set).wait()
+    if splits is None and isinstance(res, tuple):
+        return res[0]
+    return res
+
+
+def reducescatter_async(tensor: torch.Tensor, op=SUM,
+                        name: Optional[str] = None,
+                        process_set=None) -> TorchHandle:
+    h = _api.reducescatter_async(_np_view(tensor), op, name, process_set)
+    return TorchHandle(h, like=tensor)
+
+
+def reducescatter(tensor, op=SUM, name=None,
+                  process_set=None) -> torch.Tensor:
+    return reducescatter_async(tensor, op, name, process_set).wait()
+
+
+# -- barrier / join --------------------------------------------------------
+
+def barrier(process_set=None):
+    return _api.barrier(process_set)
+
+
+def join(device=None) -> int:
+    return _api.join(device)

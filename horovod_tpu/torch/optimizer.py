@@ -1,0 +1,174 @@
+"""DistributedOptimizer for torch: per-parameter gradient hooks.
+
+Reference parity: ``horovod/torch/optimizer.py``
+(``_DistributedOptimizer``): wraps any ``torch.optim.Optimizer``; when a
+parameter's gradient is fully accumulated a hook fires an async
+allreduce named ``DistributedOptimizer.gradient/<param>``; ``step()``
+synchronizes every outstanding handle (writing the averaged gradient
+back in place) before the inner optimizer step applies it.  Supports
+``backward_passes_per_step`` (local gradient aggregation: only every
+k-th backward triggers communication) and gradient compression.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Iterator, Optional, Tuple
+
+import torch
+
+from ..ops.xla_ops import AVERAGE
+from . import mpi_ops
+from ..common import basics
+from .compression import Compression
+
+
+class _DistributedOptimizer:
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op=AVERAGE,
+                 gradient_predivide_factor: float = 1.0,
+                 process_set=None):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self._predivide = gradient_predivide_factor
+        self.backward_passes_per_step = backward_passes_per_step
+        self._require_sync = True
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = []
+            for gi, group in enumerate(optimizer.param_groups):
+                for pi, p in enumerate(group["params"]):
+                    named.append(("group%d.param%d" % (gi, pi), p))
+        self._param_names: Dict[torch.Tensor, str] = {
+            p: name for name, p in named}
+        self._handles: Dict[torch.Tensor, object] = {}
+        self._passes: Dict[torch.Tensor, int] = {}
+        self._grad_ctx: Dict[torch.Tensor, object] = {}
+        self._hook_handles = []
+        if basics.size() > 1:
+            self._register_hooks()
+
+    # -- reference surface -------------------------------------------------
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def _register_hooks(self):
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._passes[p] = 0
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor):
+            self._passes[p] = self._passes.get(p, 0) + 1
+            if self._passes[p] < self.backward_passes_per_step:
+                return
+            self._passes[p] = 0
+            self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p: torch.Tensor):
+        name = "DistributedOptimizer.gradient/%s" % \
+            self._param_names.get(p, "param%d" % id(p))
+        grad = p.grad
+        if self.backward_passes_per_step > 1:
+            grad = grad / float(self.backward_passes_per_step)
+        wire, ctx = self._compression.compress(grad)
+        prescale = 1.0 / self._predivide if self._predivide != 1.0 else 1.0
+        postscale = self._predivide if self._predivide != 1.0 else 1.0
+        self._grad_ctx[p] = ctx
+        self._handles[p] = mpi_ops.allreduce_async(
+            wire, name=name, op=self._op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=self._process_set)
+
+    def synchronize(self):
+        """Wait for every outstanding gradient allreduce and install the
+        results (reference ``optimizer.synchronize()``)."""
+        for p, handle in list(self._handles.items()):
+            out = handle.wait()
+            out = self._compression.decompress(out, self._grad_ctx.get(p))
+            p.grad.data.copy_(out.reshape(p.grad.shape))
+        self._handles.clear()
+        self._grad_ctx.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Reference API: inside this context ``step()`` will not call
+        ``synchronize()`` again (for use after a manual call)."""
+        self._require_sync = False
+        try:
+            yield
+        finally:
+            self._require_sync = True
+
+    def step(self, closure=None):
+        if self._require_sync and basics.size() > 1:
+            # Any param whose hook never fired this step (frozen layers,
+            # conditional branches) simply has no handle.
+            self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with outstanding gradient allreduces; "
+                "call optimizer.step() or synchronize() first")
+        return self._opt.zero_grad(*args, **kwargs)
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, *args, **kwargs):
+        return self._opt.load_state_dict(*args, **kwargs)
+
+    def add_param_group(self, group):
+        self._opt.add_param_group(group)
+        gi = len(self._opt.param_groups) - 1
+        for pi, p in enumerate(group["params"]):
+            # Deterministic cross-rank name (id() would differ per
+            # process and wedge the name-keyed negotiation).
+            self._param_names.setdefault(
+                p, "group%d.param%d" % (gi, pi))
+        if basics.size() > 1:
+            for p in group["params"]:
+                if p.requires_grad and p not in self._passes:
+                    self._passes[p] = 0
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()))
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterator[Tuple[str,
+                                                    torch.Tensor]]] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=AVERAGE,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None) -> _DistributedOptimizer:
+    """Wrap a torch optimizer for data-parallel training (reference
+    ``hvd.DistributedOptimizer``)."""
+    return _DistributedOptimizer(
+        optimizer, named_parameters, compression,
+        backward_passes_per_step, op, gradient_predivide_factor,
+        process_set)

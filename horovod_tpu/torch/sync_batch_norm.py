@@ -1,0 +1,128 @@
+"""Synchronized BatchNorm across ranks.
+
+Reference parity: ``horovod/torch/sync_batch_norm.py`` — batch statistics
+are computed over the GLOBAL batch by allreducing per-rank sums and
+square-sums, and the backward pass allreduces the gradient sums so
+``grad_input`` matches single-process BN over the concatenated batch
+(the reference uses the same two-collective forward/backward structure).
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from ..common import basics
+from . import mpi_ops
+from ..ops.xla_ops import SUM
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, mean, invstd, total_count,
+                tag):
+        shape = [1, input.size(1)] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        ctx.save_for_backward(input, weight, mean, invstd)
+        ctx.total_count = total_count
+        ctx.tag = tag
+        if weight is not None:
+            return xhat * weight.view(shape) + bias.view(shape)
+        return xhat
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        input, weight, mean, invstd = ctx.saved_tensors
+        shape = [1, input.size(1)] + [1] * (input.dim() - 2)
+        dims = [0] + list(range(2, input.dim()))
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+
+        g = grad_out if weight is None else \
+            grad_out * weight.view(shape)
+        sum_g = g.sum(dim=dims)
+        sum_gx = (g * xhat).sum(dim=dims)
+        packed = torch.cat([sum_g, sum_gx]).to(torch.float64)
+        packed = mpi_ops.allreduce(
+            packed, op=SUM, name="sync_batch_norm.bwd.%s" % ctx.tag)
+        c = sum_g.numel()
+        sum_g = packed[:c].to(input.dtype)
+        sum_gx = packed[c:].to(input.dtype)
+
+        n = float(ctx.total_count)
+        grad_input = invstd.view(shape) * (
+            g - (sum_g.view(shape) + xhat * sum_gx.view(shape)) / n)
+        grad_weight = (grad_out * xhat).sum(dim=dims) \
+            if weight is not None else None
+        grad_bias = grad_out.sum(dim=dims) if weight is not None else None
+        return grad_input, grad_weight, grad_bias, None, None, None, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm that synchronizes statistics across the world
+    during training (``hvd.SyncBatchNorm``)."""
+
+    _tag_counter = 0
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        SyncBatchNorm._tag_counter += 1
+        self._tag = "bn%d" % SyncBatchNorm._tag_counter
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError("expected at least 2D input")
+
+    @classmethod
+    def convert_sync_batchnorm(cls, module: torch.nn.Module
+                               ) -> torch.nn.Module:
+        """Recursively replace BatchNorm layers (reference
+        ``convert_sync_batchnorm`` shape)."""
+        out = module
+        if isinstance(module, _BatchNorm) and not isinstance(module, cls):
+            out = cls(module.num_features, module.eps, module.momentum,
+                      module.affine, module.track_running_stats)
+            if module.affine:
+                with torch.no_grad():
+                    out.weight.copy_(module.weight)
+                    out.bias.copy_(module.bias)
+            out.running_mean = module.running_mean
+            out.running_var = module.running_var
+            out.num_batches_tracked = module.num_batches_tracked
+        for name, child in module.named_children():
+            out.add_module(name, cls.convert_sync_batchnorm(child))
+        return out
+
+    def forward(self, input: torch.Tensor) -> torch.Tensor:
+        self._check_input_dim(input)
+        world = basics.size() if basics.is_initialized() else 1
+        if not self.training or world <= 1:
+            return super().forward(input)
+
+        dims = [0] + list(range(2, input.dim()))
+        with torch.no_grad():
+            count = torch.tensor(
+                [input.numel() // input.size(1)], dtype=torch.float64)
+            local_sum = input.sum(dim=dims).to(torch.float64)
+            local_sqsum = (input * input).sum(dim=dims).to(torch.float64)
+            packed = torch.cat([count, local_sum, local_sqsum])
+            packed = mpi_ops.allreduce(
+                packed, op=SUM, name="sync_batch_norm.fwd.%s" % self._tag)
+            n = float(packed[0])
+            mean = (packed[1:1 + self.num_features] / n).to(input.dtype)
+            sqmean = (packed[1 + self.num_features:] / n).to(input.dtype)
+            var = sqmean - mean * mean
+            invstd = torch.rsqrt(var + self.eps)
+
+            if self.track_running_stats:
+                m = self.momentum if self.momentum is not None else 0.1
+                unbiased = var * (n / max(n - 1.0, 1.0))
+                self.running_mean.mul_(1 - m).add_(mean, alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+                self.num_batches_tracked += 1
+
+        return _SyncBatchNormFn.apply(
+            input, self.weight if self.affine else None,
+            self.bias if self.affine else None, mean, invstd, n,
+            self._tag)

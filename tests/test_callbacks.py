@@ -1,0 +1,62 @@
+"""Callback tests (reference: the Keras callback tests in
+test/parallel/test_tensorflow2_keras.py, framework-free here)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.jax.callbacks import (BroadcastGlobalVariablesCallback,
+                                       LearningRateScheduleCallback,
+                                       LearningRateWarmupCallback,
+                                       MetricAverageCallback)
+
+
+def test_warmup_ramp():
+    cb = LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=4,
+                                    multiplier=8.0)
+    assert cb.lr_at(0) == pytest.approx(0.1)
+    assert cb.lr_at(4) == pytest.approx(0.8)
+    assert cb.lr_at(10) == pytest.approx(0.8)
+    # Monotone ramp in between.
+    assert 0.1 < cb.lr_at(2) < 0.8
+    # Batch hook tracks fractional epochs.
+    cb.steps_per_epoch = 10
+    cb.on_epoch_begin(1)
+    cb.on_batch_end(5, logs={})
+    assert cb.current_lr == pytest.approx(cb.lr_at(1.5))
+
+
+def test_warmup_optax_schedule_is_traceable():
+    import jax
+    cb = LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=2,
+                                    steps_per_epoch=10, multiplier=4.0)
+    sched = cb.as_optax_schedule()
+    lrs = jax.jit(sched)(jax.numpy.arange(30))
+    np.testing.assert_allclose(float(lrs[0]), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(lrs[-1]), 0.4, rtol=1e-5)
+
+
+def test_schedule_callback():
+    cb = LearningRateScheduleCallback(initial_lr=0.1, multiplier=0.5,
+                                      start_epoch=2, end_epoch=5)
+    cb.on_epoch_begin(0)
+    assert cb.current_lr == pytest.approx(0.1)
+    cb.on_epoch_begin(3)
+    assert cb.current_lr == pytest.approx(0.05)
+    cb.on_epoch_begin(7)  # outside window: keeps last value
+    assert cb.current_lr == pytest.approx(0.05)
+    cb2 = LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e, start_epoch=0)
+    cb2.on_epoch_begin(2)
+    assert cb2.current_lr == pytest.approx(0.01)
+
+
+def test_broadcast_and_metric_average_inprocess(hvd_world):
+    import jax.numpy as jnp
+    cb = BroadcastGlobalVariablesCallback(root_rank=0)
+    params = {"w": jnp.ones((4, 4))}
+    out = cb.on_train_begin(params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    assert cb.broadcast_done
+    logs = {"loss": 1.5}
+    out = MetricAverageCallback().on_epoch_end(0, logs)
+    assert out["loss"] == pytest.approx(1.5)
