@@ -180,6 +180,15 @@ def gloo_run(args, hosts: List[util.HostInfo],
                 if code is not None:
                     remaining.remove(mp)
                     if code != 0:
+                        rank_i = procs.index(mp)
+                        if code < 0:
+                            sys.stderr.write(
+                                "[launcher] worker rank %d killed by "
+                                "signal %d\n" % (rank_i, -code))
+                        else:
+                            sys.stderr.write(
+                                "[launcher] worker rank %d exited with "
+                                "code %d\n" % (rank_i, code))
                         rc = code
                         for other in remaining:
                             other.terminate()

@@ -100,6 +100,23 @@ class Timeout:
             raise TimeoutError("%s timed out" % self._message)
 
 
+def routable_ip() -> str:
+    """This host's address as peers would route to it (reference:
+    driver-service NIC discovery): the source address of an outbound
+    UDP connect, falling back to hostname resolution."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except socket.gaierror:
+            return "127.0.0.1"
+
+
 def find_free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
     socks, ports = [], []
     try:

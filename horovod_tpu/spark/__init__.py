@@ -1,0 +1,109 @@
+"""Spark platform integration.
+
+Reference parity: ``horovod/spark/__init__.py`` (``horovod.spark.run``)
+— run a distributed training function on Spark executors.  The
+reference orchestrates task services + mpirun into executors; here the
+natural carrier is Spark's **barrier execution mode**: one barrier task
+per rank, rank = partition id, bootstrap through the driver's
+rendezvous KV server, collectives over the native TCP core (exactly the
+world the launcher would build, with Spark doing the process placement).
+
+pyspark is not bundled in this environment; everything imports lazily
+so the module is importable (and unit-testable) without it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from ..runner import util
+from ..runner.http_server import RendezvousServer
+
+__all__ = ["run", "default_num_proc"]
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as exc:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark (pip install pyspark)"
+        ) from exc
+
+
+def default_num_proc() -> int:
+    pyspark = _require_pyspark()
+    sc = pyspark.SparkContext._active_spark_context
+    return sc.defaultParallelism if sc else 1
+
+
+_driver_ip = util.routable_ip
+
+
+def _make_mapper(fn: Callable, args: tuple, kwargs: Dict,
+                 num_proc: int, rendezvous_addr: str, secret: str,
+                 extra_env: Dict[str, str]):
+    """The barrier-task body (runs on executors; must be picklable)."""
+
+    def mapper(_):
+        from pyspark import BarrierTaskContext
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        infos = ctx.getTaskInfos()
+        hosts = [t.address.split(":")[0] for t in infos]
+        my_host = hosts[rank]
+        local_ranks = [i for i, h in enumerate(hosts) if h == my_host]
+        unique_hosts: List[str] = []
+        for h in hosts:
+            if h not in unique_hosts:
+                unique_hosts.append(h)
+        os.environ.update(extra_env)
+        os.environ.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(num_proc),
+            "HOROVOD_LOCAL_RANK": str(local_ranks.index(rank)),
+            "HOROVOD_LOCAL_SIZE": str(len(local_ranks)),
+            "HOROVOD_CROSS_RANK": str(unique_hosts.index(my_host)),
+            "HOROVOD_CROSS_SIZE": str(len(unique_hosts)),
+            "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr,
+            "HOROVOD_SECRET_KEY": secret,
+            "HOROVOD_HOSTNAME": my_host,
+            "HOROVOD_CONTROLLER": "tcp",
+        })
+        result = fn(*args, **(kwargs or {}))
+        ctx.barrier()
+        yield rank, result
+
+    return mapper
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[Dict] = None,
+        num_proc: Optional[int] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+        verbose: int = 1) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` Spark executors as one Horovod world
+    (reference ``horovod.spark.run``); returns per-rank results ordered
+    by rank."""
+    pyspark = _require_pyspark()
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("no active SparkContext; create a "
+                           "SparkSession before horovod_tpu.spark.run")
+    num_proc = num_proc or sc.defaultParallelism
+    secret = util.make_secret()
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    addr = "%s:%d" % (_driver_ip(), port)
+    if verbose:
+        print("horovod_tpu.spark: %d ranks, rendezvous at %s"
+              % (num_proc, addr))
+    mapper = _make_mapper(fn, args, kwargs or {}, num_proc, addr,
+                          secret, extra_env or {})
+    try:
+        rdd = sc.parallelize(range(num_proc), num_proc)
+        results = rdd.barrier().mapPartitions(mapper).collect()
+        return [r for _, r in sorted(results)]
+    finally:
+        server.stop()

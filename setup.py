@@ -1,0 +1,32 @@
+"""Build driver (reference: Horovod's setup.py + CMakeLists.txt, pared
+to this framework's needs): compiles the native coordination core
+(``horovod_tpu/core/libhvdtpu_core.so``) at build time via its
+Makefile — plain g++/make, no third-party build deps.  The library is
+also built lazily on first use (``horovod_tpu.core.client``), so a
+source checkout works without installation.
+"""
+
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BuildWithNativeCore(build_py):
+    def run(self):
+        subprocess.run(["make", "-C", "horovod_tpu/core", "-j", "-s"],
+                       check=True)
+        super().run()
+
+
+class BinaryDistribution(Distribution):
+    """The shipped .so makes wheels platform-specific; without this the
+    wheel would be tagged py3-none-any and break cross-platform."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildWithNativeCore},
+      distclass=BinaryDistribution)

@@ -1,0 +1,49 @@
+"""Ray/Spark integration units (reference: test/single/test_ray.py,
+test/integration/test_spark.py — here only the dependency-free parts:
+rank planning, env construction, graceful gating without ray/pyspark)."""
+
+import pytest
+
+from horovod_tpu.ray import RayExecutor, plan_ranks
+from horovod_tpu.spark import _make_mapper, default_num_proc
+
+
+def test_plan_ranks_groups_by_node():
+    plans = plan_ranks(["10.0.0.1", "10.0.0.1", "10.0.0.2"])
+    assert [p["rank"] for p in plans] == [0, 1, 2]
+    assert [p["local_rank"] for p in plans] == [0, 1, 0]
+    assert [p["local_size"] for p in plans] == [2, 2, 1]
+    assert [p["cross_rank"] for p in plans] == [0, 0, 1]
+    assert all(p["cross_size"] == 2 for p in plans)
+    assert all(p["size"] == 3 for p in plans)
+
+
+def _missing(mod: str) -> bool:
+    import importlib.util
+    return importlib.util.find_spec(mod) is None
+
+
+@pytest.mark.skipif(not _missing("ray"), reason="ray installed")
+def test_ray_gated_without_dependency():
+    ex = RayExecutor(num_workers=2)
+    with pytest.raises(ImportError, match="ray"):
+        ex.start()
+
+
+@pytest.mark.skipif(not _missing("pyspark"), reason="pyspark installed")
+def test_spark_gated_without_dependency():
+    with pytest.raises(ImportError, match="pyspark"):
+        default_num_proc()
+
+
+def test_ray_run_before_start_errors():
+    with pytest.raises(RuntimeError, match="not started"):
+        RayExecutor(num_workers=1).run(lambda: 1)
+
+
+def test_spark_mapper_is_constructible():
+    # The barrier-task body (Spark ships it with cloudpickle; stdlib
+    # pickle cannot round-trip closures, so only shape-check here).
+    mapper = _make_mapper(lambda: 1, (), {}, 4, "1.2.3.4:5", "s",
+                          {"X": "1"})
+    assert callable(mapper)
