@@ -21,6 +21,16 @@ void Controller::Initialize(int rank, int size, TcpMesh* mesh,
   stall_ = stall;
   params_ = params;
   fusion_threshold_ = fusion_threshold;
+  // Elastic re-init: negotiation state from a previous world (notably
+  // the shutdown/join rank sets) must not leak into the new one, or the
+  // fresh background loop observes an immediate all-ranks shutdown.
+  pending_.clear();
+  tensor_bytes_.clear();
+  cache_ready_.clear();
+  joined_.clear();
+  last_joined_ = -1;
+  shutdown_requested_.clear();
+  cycle_count_ = 0;
 }
 
 Status Controller::RunCycle(const CycleRequest& mine, CycleResponse* out) {

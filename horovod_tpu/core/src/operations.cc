@@ -77,9 +77,14 @@ Status CoreState::Initialize(int rank, int size,
                          fusion);
   initialized_ = true;
   stopped_ = false;
-  // Elastic re-init: a prior world's shutdown must not leak into the
-  // new background loop.
+  // Elastic re-init: a prior world's shutdown/join must not leak into
+  // the new background loop.
   shutdown_requested_ = false;
+  join_requested_ = false;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    join_entry_ = nullptr;
+  }
   background_ = std::thread([this] { BackgroundLoop(); });
   LOG_INFO << "core initialized: rank " << rank << "/" << size;
   return Status::OK();
@@ -253,7 +258,18 @@ void CoreState::BackgroundLoop() {
     }
 
     if (resp.shutdown) {
-      queue_.AbortAll(Status::Aborted("shutdown"));
+      Status abort = Status::Aborted("shutdown");
+      queue_.AbortAll(abort);
+      {
+        // A join in flight lives only in handles_/join_entry_ (not the
+        // queue); abort it too or its poller spins forever.
+        std::lock_guard<std::mutex> lk(handles_mu_);
+        if (join_entry_ && !join_entry_->done) {
+          join_entry_->status = abort;
+          join_entry_->done = true;
+        }
+        join_entry_ = nullptr;
+      }
       stopped_ = true;
       return;
     }

@@ -1,0 +1,41 @@
+"""Keras adapter: ``import horovod_tpu.keras as hvd``.
+
+Reference parity: ``horovod/keras/__init__.py`` +
+``horovod/tensorflow/keras/__init__.py`` — ``DistributedOptimizer``
+for Keras models, the collectives, broadcast helpers, and the
+training callbacks (``horovod_tpu.keras.callbacks``).
+"""
+
+from ..tensorflow import (  # noqa: F401
+    ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM, Adasum, Average, Compression,
+    DistributedOptimizer, HorovodInternalError, Max, Min, Product,
+    ProcessSet, Sum, add_process_set, allgather, allgather_object,
+    allreduce, alltoall, barrier, broadcast, broadcast_object,
+    broadcast_variables, cross_rank, cross_size, global_process_set,
+    grouped_allreduce, init, is_initialized, join, local_rank,
+    local_size, rank, reducescatter, remove_process_set, shutdown, size)
+from . import callbacks  # noqa: F401
+
+
+def broadcast_global_variables(root_rank: int = 0, model=None):
+    """Broadcast a model's variables from ``root_rank`` (reference
+    ``hvd.callbacks.BroadcastGlobalVariablesCallback`` / the TF1-style
+    ``broadcast_global_variables``)."""
+    if model is None:
+        raise ValueError("pass model= (Keras 3 has no global graph "
+                         "variable collection)")
+    broadcast_variables(model.weights, root_rank)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a Keras model wrapping its optimizer in
+    ``DistributedOptimizer`` (reference ``hvd.load_model``)."""
+    import keras
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects)
+    if model.optimizer is not None:
+        dist = DistributedOptimizer(model.optimizer,
+                                    compression=compression)
+        model.compile(optimizer=dist, loss=model.loss)
+    return model

@@ -1,0 +1,169 @@
+"""Keras training callbacks.
+
+Reference parity: ``horovod/_keras/callbacks.py`` (shared impl behind
+``horovod/keras/callbacks.py`` and ``horovod/tensorflow/keras/callbacks.py``):
+``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateWarmupCallback``, ``LearningRateScheduleCallback``.
+Written against Keras 3 (`keras.callbacks.Callback`,
+``optimizer.learning_rate``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import keras
+import numpy as np
+
+from .. import tensorflow as hvd
+
+
+def _get_lr(optimizer) -> float:
+    return float(keras.ops.convert_to_numpy(optimizer.learning_rate))
+
+
+def _set_lr(optimizer, lr: float):
+    optimizer.learning_rate = lr
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast model + optimizer state from ``root_rank`` before the
+    first batch so all ranks start identical."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        weights = hvd.broadcast_object(
+            [keras.ops.convert_to_numpy(w) for w in self.model.weights],
+            root_rank=self.root_rank,
+            name="BroadcastGlobalVariablesCallback.model")
+        for v, val in zip(self.model.weights, weights):
+            v.assign(val)
+        if self.model.optimizer is not None:
+            opt_vars = self.model.optimizer.variables
+            vals = hvd.broadcast_object(
+                [keras.ops.convert_to_numpy(v) for v in opt_vars],
+                root_rank=self.root_rank,
+                name="BroadcastGlobalVariablesCallback.optimizer")
+            for v, val in zip(opt_vars, vals):
+                v.assign(val)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks (reference: wraps logs at
+    epoch end with an allreduce per metric)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or hvd.size() <= 1:
+            return
+        for k in sorted(logs.keys()):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                logs[k] = float(hvd.allreduce(
+                    np.asarray(v, np.float64), op=hvd.Average,
+                    name="MetricAverageCallback.%s.%d" % (k, epoch)))
+
+
+class LearningRateWarmupCallback(keras.callbacks.Callback):
+    """Ramp LR from ``initial_lr / size`` (or given start) to
+    ``initial_lr`` over ``warmup_epochs`` (reference: gradual warmup of
+    the linearly-scaled learning rate, Goyal et al.)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.current_epoch = 0
+        self._steps = None
+
+    def on_train_begin(self, logs=None):
+        self._steps = self.steps_per_epoch or self.params.get("steps")
+        if self._steps is None:
+            raise ValueError(
+                "LearningRateWarmupCallback needs steps_per_epoch when "
+                "Keras cannot infer steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def _warmup_lr(self, step_in_warmup: float) -> float:
+        # size^(progress): exponential interpolation from lr/size to lr.
+        total = self.warmup_epochs * self._steps
+        progress = min(1.0, step_in_warmup / max(1, total))
+        return self.initial_lr / hvd.size() * \
+            math.pow(hvd.size(), progress)
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.current_epoch >= self.warmup_epochs:
+            return
+        step = self.current_epoch * self._steps + batch
+        _set_lr(self.model.optimizer, self._warmup_lr(step))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1:
+            _set_lr(self.model.optimizer, self.initial_lr)
+            if self.verbose and hvd.rank() == 0:
+                print("LearningRateWarmupCallback: warmup complete, "
+                      "lr=%g" % self.initial_lr)
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply LR by ``multiplier`` within ``[start_epoch, end_epoch)``
+    (reference: piecewise/exponential decay schedules; ``multiplier``
+    may be a constant or a function of epoch)."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.current_epoch = 0
+        self._steps = None
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_train_begin(self, logs=None):
+        self._steps = self.steps_per_epoch or self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            _set_lr(self.model.optimizer,
+                    self.initial_lr * self.multiplier(epoch))
+            if self.verbose and hvd.rank() == 0:
+                print("LearningRateScheduleCallback: epoch %d lr=%g"
+                      % (epoch, _get_lr(self.model.optimizer)))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range(self.current_epoch):
+            return
+        if self._steps is None:
+            return
+        epoch = self.current_epoch + batch / float(self._steps)
+        _set_lr(self.model.optimizer,
+                self.initial_lr * self.multiplier(epoch))

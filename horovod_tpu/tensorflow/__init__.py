@@ -1,0 +1,213 @@
+"""TensorFlow adapter: ``import horovod_tpu.tensorflow as hvd``.
+
+Reference parity: ``horovod/tensorflow/__init__.py`` — the same surface
+(init/rank/size, the eight collectives with registered gradients,
+``DistributedGradientTape``, ``DistributedOptimizer``,
+``broadcast_variables`` / ``broadcast_object``, ``Compression``, local
+gradient aggregation via ``backward_passes_per_step``, elastic
+``TensorFlowKerasState``) routed through this framework's native core
+instead of the reference's custom TF C++ kernels
+(``horovod/tensorflow/mpi_ops.cc``).
+
+As with the torch adapter, TF tensors here are host tensors — the TPU
+compute path is the JAX adapter; this adapter gives TF training scripts
+the reference's CPU (MPI/Gloo-path) semantics over the native TCP core.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import tensorflow as tf
+
+from ..common.basics import (shutdown, is_initialized, rank, size,
+                             local_rank, local_size, cross_rank,
+                             cross_size, is_homogeneous, topology,
+                             start_timeline, stop_timeline, xla_built,
+                             tcp_built, gloo_built, mpi_built,
+                             nccl_built, ccl_built, ddl_built,
+                             cuda_built, rocm_built, mpi_enabled,
+                             mpi_threads_supported)
+from ..common.basics import init as _base_init
+from ..common.process_sets import (ProcessSet, global_process_set,
+                                   add_process_set, remove_process_set)
+from ..ops.engine import HorovodInternalError
+from ..ops.xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
+from .compression import Compression
+from .functions import (allgather_object, broadcast_object,
+                        broadcast_variables)
+from .gradient_aggregation import LocalGradientAggregationHelper
+from .mpi_ops import (allgather, allgather_async, allreduce,
+                      allreduce_async, alltoall, barrier, broadcast,
+                      broadcast_async, grouped_allreduce, join, poll,
+                      reducescatter, synchronize)
+
+Sum = SUM
+Average = AVERAGE
+Min = MIN
+Max = MAX
+Product = PRODUCT
+Adasum = ADASUM
+
+
+def init(*args, **kwargs):
+    """``hvd.init()`` — defaults to the multi-process (tcp) controller,
+    matching the torch adapter: per-process tensors need a real world
+    even when unlaunched (size-1)."""
+    kwargs.setdefault("controller", "tcp")
+    return _base_init(*args, **kwargs)
+
+
+def _densify(grad):
+    if isinstance(grad, tf.IndexedSlices):
+        return tf.convert_to_tensor(grad)
+    return grad
+
+
+def _make_allreduce_grads_fn(name_prefix: str, op, compression,
+                             process_set):
+    def allreduce_grads(grads):
+        out = []
+        for i, g in enumerate(grads):
+            if g is None:
+                out.append(None)
+                continue
+            g = _densify(g)
+            c, ctx = compression.compress(g)
+            r = allreduce(c, op=op, process_set=process_set,
+                          name="%s.grad_%d" % (name_prefix, i))
+            out.append(compression.decompress(r, ctx))
+        return out
+    return allreduce_grads
+
+
+class _DistributedGradientTape:
+    """Wraps a ``tf.GradientTape`` so ``gradient()`` returns globally
+    reduced gradients (reference ``DistributedGradientTape``)."""
+
+    def __init__(self, tape: tf.GradientTape, device_dense="",
+                 device_sparse="", compression=Compression.none,
+                 sparse_as_dense=True, op=AVERAGE, process_set=None,
+                 backward_passes_per_step: int = 1):
+        self._tape = tape
+        self._allreduce_grads = _make_allreduce_grads_fn(
+            "DistributedGradientTape", op, compression, process_set)
+        self._agg = (LocalGradientAggregationHelper(
+            backward_passes_per_step, self._allreduce_grads)
+            if backward_passes_per_step > 1 else None)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        single = not isinstance(grads, (list, tuple))
+        glist = [grads] if single else list(grads)
+        if self._agg is not None:
+            _, glist = self._agg.apply(glist)
+        else:
+            glist = self._allreduce_grads(glist)
+        return glist[0] if single else glist
+
+
+def DistributedGradientTape(gradtape: tf.GradientTape, *args, **kwargs):
+    return _DistributedGradientTape(gradtape, *args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none,
+                         sparse_as_dense: bool = True, op=AVERAGE,
+                         process_set=None,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True):
+    """Wrap a Keras optimizer so every ``apply``/``apply_gradients``
+    first averages gradients across ranks (reference
+    ``hvd.DistributedOptimizer`` for tf.keras).
+
+    Built by subclassing the optimizer's own class and rebuilding it
+    from config — the reference's construction — so the result is a
+    genuine Keras optimizer usable in ``model.compile``.
+    """
+    allreduce_grads = _make_allreduce_grads_fn(
+        name or "DistributedOptimizer", op, compression, process_set)
+    agg = LocalGradientAggregationHelper(
+        backward_passes_per_step, allreduce_grads,
+        average_aggregated_gradients) \
+        if backward_passes_per_step > 1 else None
+
+    cls = optimizer.__class__
+
+    class _DistributedKerasOptimizer(cls):
+        _hvd_distributed = True
+
+        def apply(self, grads, trainable_variables=None, **kw):
+            grads = [_densify(g) for g in grads]
+            if agg is not None:
+                should, grads = agg.apply(grads)
+                if not should:
+                    return
+            else:
+                grads = allreduce_grads(grads)
+            return super().apply(grads, trainable_variables, **kw)
+
+    _DistributedKerasOptimizer.__name__ = "Distributed" + cls.__name__
+    return _DistributedKerasOptimizer.from_config(optimizer.get_config())
+
+
+class elastic:
+    """Elastic namespace: ``hvd.elastic.TensorFlowKerasState`` etc.
+    (reference ``horovod/tensorflow/elastic.py``)."""
+
+    from ..elastic import run  # noqa: F401  (retry decorator)
+    from ..elastic.state import ObjectState, State  # noqa: F401
+    from ..elastic.worker import HostsUpdatedInterrupt  # noqa: F401
+
+    class TensorFlowKerasState(ObjectState):
+        """Keras model + optimizer elastic state: weights snapshotted on
+        commit, broadcast from rank 0 on sync (reference
+        ``TensorFlowKerasState`` in horovod/tensorflow/elastic.py)."""
+
+        def __init__(self, model, optimizer=None, **kwargs):
+            self._model = model
+            self._optimizer = optimizer
+            super().__init__(**kwargs)
+
+        def _weights(self):
+            w = {"model": [v.numpy() for v in self._model.weights]}
+            if self._optimizer is not None:
+                w["optimizer"] = [v.numpy()
+                                  for v in self._optimizer.variables]
+            return w
+
+        def _set_weights(self, w):
+            for v, val in zip(self._model.weights, w["model"]):
+                v.assign(val)
+            if self._optimizer is not None and "optimizer" in w:
+                for v, val in zip(self._optimizer.variables,
+                                  w["optimizer"]):
+                    v.assign(val)
+
+        def save(self):
+            super().save()
+            self._saved_weights = self._weights()
+
+        def restore(self):
+            super().restore()
+            self._set_weights(self._saved_weights)
+
+        def sync(self):
+            super().sync()
+            from ..common import basics
+            if basics.is_initialized() and basics.size() > 1:
+                synced = broadcast_object(
+                    self._weights(), root_rank=0,
+                    name="elastic.TensorFlowKerasState")
+                self._set_weights(synced)
+            self.save()

@@ -1,0 +1,38 @@
+"""TF variable/object broadcast helpers.
+
+Reference parity: ``horovod/tensorflow/functions.py`` —
+``broadcast_variables``, ``broadcast_object``, ``allgather_object``,
+plus Keras-model/optimizer broadcast used by the callbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import tensorflow as tf
+
+from ..jax.functions import allgather_object as _allgather_object
+from ..jax.functions import broadcast_object as _broadcast_object
+from . import mpi_ops
+
+
+def broadcast_variables(variables: Iterable[tf.Variable],
+                        root_rank: int = 0):
+    """Assign every variable the root rank's value (reference
+    ``hvd.broadcast_variables(model.variables, root_rank=0)``)."""
+    handles = []
+    for i, v in enumerate(variables):
+        name = "broadcast_variables.%d.%s" % (i, getattr(v, "name", ""))
+        handles.append((v, mpi_ops.broadcast_async(
+            v, root_rank, name=name.replace("/", "_").replace(":", "_"))))
+    for v, h in handles:
+        v.assign(tf.reshape(h.wait(), tf.shape(v)))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    return _broadcast_object(obj, root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None):
+    return _allgather_object(obj, name=name)

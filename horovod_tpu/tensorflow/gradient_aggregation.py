@@ -1,0 +1,54 @@
+"""Local gradient aggregation for TF.
+
+Reference parity: ``horovod/tensorflow/gradient_aggregation_eager.py``
+(``LocalGradientAggregationHelperEager``) — accumulate gradients
+locally for ``backward_passes_per_step`` steps and allreduce only on
+the boundary step, trading extra memory for fewer collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+
+class LocalGradientAggregationHelper:
+    """Accumulates grads for N passes; fires ``allreduce_fn`` on the Nth.
+
+    ``apply(grads)`` returns ``(should_apply, grads)``: on non-boundary
+    passes ``should_apply`` is False and the caller must skip the inner
+    optimizer update (the reference's helper likewise suppresses
+    ``apply_gradients`` between boundaries).
+    """
+
+    def __init__(self, backward_passes_per_step: int,
+                 allreduce_fn: Callable[[List], List],
+                 average_aggregated_gradients: bool = True):
+        if backward_passes_per_step <= 0:
+            raise ValueError("backward_passes_per_step must be > 0")
+        self.backward_passes_per_step = backward_passes_per_step
+        self.allreduce_fn = allreduce_fn
+        self.average_aggregated_gradients = average_aggregated_gradients
+        self.counter = 0
+        self._acc: Optional[List] = None
+
+    def apply(self, grads: Sequence):
+        import tensorflow as tf
+        grads = list(grads)
+        if self.backward_passes_per_step == 1:
+            return True, self.allreduce_fn(grads)
+        if self._acc is None:
+            self._acc = [tf.zeros_like(g) if g is not None else None
+                         for g in grads]
+        self._acc = [a + g if (a is not None and g is not None)
+                     else (g if a is None else a)
+                     for a, g in zip(self._acc, grads)]
+        self.counter += 1
+        if self.counter < self.backward_passes_per_step:
+            return False, grads
+        out = self._acc
+        if self.average_aggregated_gradients:
+            out = [g / float(self.backward_passes_per_step)
+                   if g is not None else None for g in out]
+        self.counter = 0
+        self._acc = None
+        return True, self.allreduce_fn(out)

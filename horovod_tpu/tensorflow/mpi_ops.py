@@ -1,0 +1,206 @@
+"""TensorFlow collective ops over the native core.
+
+Reference parity: ``horovod/tensorflow/mpi_ops.py`` (+ the custom-op
+kernels in ``horovod/tensorflow/mpi_ops.cc``): the eight collectives on
+``tf.Tensor`` values, each with a gradient registered so they compose
+with ``tf.GradientTape``.  The wire format is the tensor's numpy view
+into the same engine the torch adapter uses; on TPU the compute path is
+the JAX adapter — this adapter moves host tensors through the
+multi-process world, which is exactly the role the reference's CPU
+(MPI/Gloo) path plays.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import tensorflow as tf
+
+from ..ops import api as _api
+from ..ops.xla_ops import AVERAGE, SUM
+
+__all__ = [
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "alltoall", "reducescatter", "barrier", "join",
+    "allreduce_async", "allgather_async", "broadcast_async",
+    "synchronize", "poll",
+]
+
+
+def _np_view(t) -> np.ndarray:
+    """Numpy view of an eager tf.Tensor (bfloat16 rides its ml_dtypes
+    representation, which is already the engine's wire format)."""
+    t = tf.convert_to_tensor(t)
+    return np.asarray(t)
+
+
+def _run_op(fn, x, out_shape=None):
+    """Run ``fn`` (an eager collective) on ``x``; inside a traced
+    ``tf.function`` the call is staged as a ``tf.py_function`` so the
+    collective still executes on the host at step time — the role the
+    reference's registered TF custom kernels play in graph mode
+    (``horovod/tensorflow/mpi_ops.cc``)."""
+    if tf.is_symbolic_tensor(x):
+        y = tf.py_function(fn, [x], Tout=x.dtype)
+        y.set_shape(out_shape if out_shape is not None else x.shape)
+        return y
+    return fn(x)
+
+
+def _to_tf(arr, like=None):
+    t = tf.convert_to_tensor(np.ascontiguousarray(np.asarray(arr)))
+    if like is not None and t.dtype != like.dtype:
+        t = tf.cast(t, like.dtype)
+    return t
+
+
+class TFHandle:
+    """Async handle returning tf tensors (reference: the AsyncOpKernel
+    completion callback in mpi_ops.cc)."""
+
+    def __init__(self, inner, like=None):
+        self._inner = inner
+        self._like = like
+
+    def poll(self) -> bool:
+        return self._inner.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        res = self._inner.wait(timeout)
+        splits = None
+        if isinstance(res, tuple):
+            res, splits = res
+        t = _to_tf(res, like=self._like)
+        return (t, splits) if splits is not None else t
+
+
+def synchronize(handle: TFHandle):
+    return handle.wait()
+
+
+def poll(handle: TFHandle) -> bool:
+    return handle.poll()
+
+
+# -- allreduce -------------------------------------------------------------
+
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set=None) -> TFHandle:
+    tensor = tf.convert_to_tensor(tensor)
+    h = _api.allreduce_async(_np_view(tensor), average, name, op,
+                             prescale_factor, postscale_factor,
+                             process_set)
+    return TFHandle(h, like=tensor)
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None):
+    """Sum/average ``tensor`` over all ranks.  Differentiable: the
+    gradient of an allreduce is the allreduce of the gradient
+    (reference: the ``HorovodAllreduce`` gradient registration in
+    ``horovod/tensorflow/mpi_ops.py``)."""
+    tensor = tf.convert_to_tensor(tensor)
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(
+            lambda v: allreduce_async(v, average, name, op,
+                                      prescale_factor, postscale_factor,
+                                      process_set).wait(), x)
+
+        def grad(dy):
+            return _run_op(
+                lambda v: allreduce_async(
+                    v, average,
+                    None if name is None else name + "_grad", op,
+                    prescale_factor, postscale_factor,
+                    process_set).wait(), dy)
+
+        return y, grad
+
+    return _op(tensor)
+
+
+def grouped_allreduce(tensors: Sequence, average=None,
+                      name: Optional[str] = None, op=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set=None) -> List:
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    hs = _api.grouped_allreduce_async(
+        [_np_view(t) for t in tensors], average, name, op,
+        prescale_factor, postscale_factor, process_set)
+    return [TFHandle(h, like=t).wait() for h, t in zip(hs, tensors)]
+
+
+# -- allgather -------------------------------------------------------------
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set=None) -> TFHandle:
+    tensor = tf.convert_to_tensor(tensor)
+    h = _api.allgather_async(_np_view(tensor), name, process_set)
+    return TFHandle(h, like=tensor)
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    tensor = tf.convert_to_tensor(tensor)
+    out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    return _run_op(
+        lambda v: allgather_async(v, name, process_set).wait(),
+        tensor, out_shape=out_shape)
+
+
+# -- broadcast -------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set=None) -> TFHandle:
+    tensor = tf.convert_to_tensor(tensor)
+    h = _api.broadcast_async(_np_view(tensor), root_rank, name,
+                             process_set)
+    return TFHandle(h, like=tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set=None):
+    tensor = tf.convert_to_tensor(tensor)
+    return _run_op(
+        lambda v: broadcast_async(v, root_rank, name,
+                                  process_set).wait(), tensor)
+
+
+# -- alltoall / reducescatter ----------------------------------------------
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    tensor = tf.convert_to_tensor(tensor)
+    if splits is not None and isinstance(splits, tf.Tensor):
+        splits = splits.numpy().tolist()
+    h = _api.alltoall_async(_np_view(tensor), splits, name, process_set)
+    res = TFHandle(h, like=tensor).wait()
+    if splits is None and isinstance(res, tuple):
+        return res[0]
+    return res
+
+
+def reducescatter(tensor, op=SUM, name: Optional[str] = None,
+                  process_set=None):
+    tensor = tf.convert_to_tensor(tensor)
+    out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    return _run_op(
+        lambda v: TFHandle(_api.reducescatter_async(
+            _np_view(v), op, name, process_set), like=v).wait(),
+        tensor, out_shape=out_shape)
+
+
+# -- barrier / join --------------------------------------------------------
+
+def barrier(process_set=None):
+    return _api.barrier(process_set)
+
+
+def join(device=None) -> int:
+    return _api.join(device)

@@ -1,0 +1,72 @@
+"""Keras adapter/callback tests.
+
+Reference parity: the Keras callback coverage inside
+``test/parallel/test_tensorflow2_keras.py`` — broadcast callback, metric
+averaging, LR warmup and schedule.  Size-1 tcp world.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module")
+def hvd():
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def _model(lr=0.1):
+    m = keras.Sequential(
+        [keras.layers.Dense(1, input_shape=(2,), use_bias=False)])
+    m.compile(optimizer=keras.optimizers.SGD(lr), loss="mse")
+    return m
+
+
+def _fit(model, cbs, epochs=1, batches=4):
+    x = np.ones((batches * 2, 2), np.float32)
+    y = np.zeros((batches * 2, 1), np.float32)
+    return model.fit(x, y, epochs=epochs, batch_size=2, verbose=0,
+                     shuffle=False, callbacks=cbs)
+
+
+def test_broadcast_callback(hvd):
+    model = _model()
+    cb = hvd.callbacks.BroadcastGlobalVariablesCallback(root_rank=0)
+    _fit(model, [cb])
+    assert cb.broadcast_done
+
+
+def test_metric_average_callback(hvd):
+    model = _model()
+    cb = hvd.callbacks.MetricAverageCallback()
+    hist = _fit(model, [cb])
+    assert "loss" in hist.history
+
+
+def test_lr_warmup(hvd):
+    model = _model(lr=0.5)
+    cb = hvd.callbacks.LearningRateWarmupCallback(
+        initial_lr=0.5, warmup_epochs=2, steps_per_epoch=4)
+    _fit(model, [cb], epochs=3)
+    assert np.isclose(float(model.optimizer.learning_rate.numpy()), 0.5)
+
+
+def test_lr_schedule(hvd):
+    model = _model(lr=1.0)
+    cb = hvd.callbacks.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e, staircase=True)
+    _fit(model, [cb], epochs=2)
+    assert np.isclose(float(model.optimizer.learning_rate.numpy()), 0.1)
+
+
+def test_load_model_rewraps_optimizer(hvd, tmp_path):
+    model = _model()
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    loaded = hvd.load_model(path)
+    assert getattr(type(loaded.optimizer), "_hvd_distributed", False)

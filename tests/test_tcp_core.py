@@ -78,3 +78,18 @@ def test_tcp_timeline_written(tmp_path):
 def test_core_library_builds():
     from horovod_tpu.core.client import core_library_available
     assert core_library_available()
+
+
+def test_world_reinit():
+    """Shutdown → init must yield a working fresh world (the elastic
+    path); regression: controller shutdown/join rank-sets leaking across
+    worlds killed the new background loop after one cycle."""
+    import time
+    import horovod_tpu.torch as hvd
+    import torch
+    for w in range(2):
+        hvd.init()
+        time.sleep(0.2)  # let a few negotiation cycles run
+        out = hvd.broadcast(torch.ones(2), 0, name="reinit_b%d" % w)
+        assert torch.equal(out, torch.ones(2))
+        hvd.shutdown()

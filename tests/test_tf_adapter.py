@@ -1,0 +1,160 @@
+"""TensorFlow adapter tests.
+
+Reference parity: ``test/parallel/test_tensorflow.py`` /
+``test_tensorflow2_keras.py`` — collectives on tf tensors, gradient
+registration, DistributedGradientTape, the Keras DistributedOptimizer,
+variable broadcast, compression, local aggregation.  Single-process
+cases run a size-1 tcp world (the multi-process wire behavior is
+covered by the launcher/core tests).
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.fixture(scope="module")
+def hvd():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def test_size1_collectives(hvd):
+    assert hvd.size() == 1 and hvd.rank() == 0
+    t = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    out = hvd.allreduce(t, op=hvd.Sum, name="tf_ar")
+    assert np.allclose(out.numpy(), t.numpy())
+    g = hvd.allgather(t, name="tf_ag")
+    assert np.allclose(g.numpy(), t.numpy())
+    b = hvd.broadcast(t, root_rank=0, name="tf_bc")
+    assert np.allclose(b.numpy(), t.numpy())
+    rs = hvd.reducescatter(t, name="tf_rs")
+    assert np.allclose(rs.numpy(), t.numpy())
+    a2a = hvd.alltoall(tf.range(4), name="tf_a2a")
+    assert np.allclose(a2a.numpy(), np.arange(4))
+    outs = hvd.grouped_allreduce([t, 2 * t], op=hvd.Sum, name="tf_gar")
+    assert np.allclose(outs[1].numpy(), 2 * t.numpy())
+    hvd.barrier()
+
+
+def test_bfloat16_wire(hvd):
+    t = tf.cast(tf.reshape(tf.range(8, dtype=tf.float32), (2, 4)),
+                tf.bfloat16)
+    out = hvd.allreduce(t, op=hvd.Sum, name="tf_bf16")
+    assert out.dtype == tf.bfloat16
+    assert np.allclose(tf.cast(out, tf.float32).numpy(),
+                       np.arange(8, dtype=np.float32).reshape(2, 4))
+
+
+def test_allreduce_gradient_registered(hvd):
+    x = tf.Variable([1.0, 2.0, 3.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Sum, name="tf_grad"))
+    g = tape.gradient(y, x)
+    # size-1 world: d(allreduce(x))/dx = allreduce(ones) = ones
+    assert np.allclose(g.numpy(), np.ones(3))
+
+
+def test_distributed_gradient_tape(hvd):
+    v = tf.Variable([2.0, 4.0])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(v * v)
+    grads = tape.gradient(loss, [v])
+    assert np.allclose(grads[0].numpy(), [4.0, 8.0])
+
+
+def test_local_gradient_aggregation(hvd):
+    from horovod_tpu.tensorflow.gradient_aggregation import (
+        LocalGradientAggregationHelper)
+    calls = []
+
+    def fake_allreduce(grads):
+        calls.append(len(grads))
+        return grads
+
+    agg = LocalGradientAggregationHelper(2, fake_allreduce)
+    should, _ = agg.apply([tf.constant([2.0])])
+    assert not should and not calls
+    should, grads = agg.apply([tf.constant([4.0])])
+    # Boundary: (2+4)/2 = 3, one allreduce fired.
+    assert should and calls == [1]
+    assert np.allclose(grads[0].numpy(), [3.0])
+
+
+def test_compression_fp16(hvd):
+    t = tf.constant([1.5, 2.5], dtype=tf.float32)
+    c, ctx = hvd.Compression.fp16.compress(t)
+    assert c.dtype == tf.float16
+    d = hvd.Compression.fp16.decompress(c, ctx)
+    assert d.dtype == tf.float32 and np.allclose(d.numpy(), [1.5, 2.5])
+
+
+def test_broadcast_variables(hvd):
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable(np.eye(2, dtype=np.float32))
+    hvd.broadcast_variables([v1, v2], root_rank=0)
+    assert np.allclose(v1.numpy(), [1.0, 2.0])
+    assert np.allclose(v2.numpy(), np.eye(2))
+
+
+def test_broadcast_and_allgather_object(hvd):
+    obj = {"epoch": 3, "arr": np.arange(4)}
+    out = hvd.broadcast_object(obj, root_rank=0, name="tf_obj")
+    assert out["epoch"] == 3 and np.allclose(out["arr"], np.arange(4))
+    gathered = hvd.allgather_object("x", name="tf_objs")
+    assert gathered == ["x"]
+
+
+def test_keras_distributed_optimizer(hvd):
+    import keras
+    model = keras.Sequential(
+        [keras.layers.Dense(2, input_shape=(4,), use_bias=False)])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    assert type(opt).__name__ == "DistributedSGD"
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.zeros((8, 2), np.float32)
+    w0 = model.get_weights()[0].copy()
+    model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+    assert not np.allclose(model.get_weights()[0], w0)
+
+
+def test_keras_optimizer_matches_plain(hvd):
+    import keras
+    rs = np.random.RandomState(1)
+    x = rs.randn(16, 3).astype(np.float32)
+    y = rs.randn(16, 1).astype(np.float32)
+
+    def build():
+        keras.utils.set_random_seed(7)
+        m = keras.Sequential([keras.layers.Dense(1, input_shape=(3,))])
+        return m
+
+    m_plain, m_dist = build(), build()
+    m_dist.set_weights(m_plain.get_weights())
+    m_plain.compile(optimizer=keras.optimizers.SGD(0.05), loss="mse")
+    m_dist.compile(
+        optimizer=hvd.DistributedOptimizer(keras.optimizers.SGD(0.05)),
+        loss="mse")
+    m_plain.fit(x, y, epochs=2, batch_size=16, shuffle=False, verbose=0)
+    m_dist.fit(x, y, epochs=2, batch_size=16, shuffle=False, verbose=0)
+    for a, b in zip(m_plain.get_weights(), m_dist.get_weights()):
+        assert np.allclose(a, b, atol=1e-6)
+
+
+def test_elastic_state(hvd):
+    import keras
+    model = keras.Sequential(
+        [keras.layers.Dense(1, input_shape=(2,), use_bias=False)])
+    model.build((None, 2))
+    state = hvd.elastic.TensorFlowKerasState(model, epoch=0)
+    w0 = model.get_weights()[0].copy()
+    state.commit()
+    model.weights[0].assign(np.zeros_like(w0))
+    state.epoch = 5
+    state.restore()
+    assert state.epoch == 0
+    assert np.allclose(model.get_weights()[0], w0)
