@@ -30,12 +30,29 @@ def broadcast_global_variables(root_rank: int = 0, model=None):
 def load_model(filepath, custom_optimizers=None, custom_objects=None,
                compression=Compression.none):
     """Load a Keras model wrapping its optimizer in
-    ``DistributedOptimizer`` (reference ``hvd.load_model``)."""
+    ``DistributedOptimizer`` (reference ``hvd.load_model``, which
+    injects wrapped optimizer classes into ``custom_objects`` so the
+    checkpoint's optimizer state survives the wrap).
+
+    ``custom_optimizers`` — extra optimizer classes the checkpoint may
+    reference (merged into ``custom_objects`` by class name, as in the
+    reference).
+    """
     import keras
+    custom_objects = dict(custom_objects or {})
+    for cls in custom_optimizers or ():
+        custom_objects.setdefault(cls.__name__, cls)
     model = keras.models.load_model(filepath,
                                     custom_objects=custom_objects)
-    if model.optimizer is not None:
-        dist = DistributedOptimizer(model.optimizer,
-                                    compression=compression)
-        model.compile(optimizer=dist, loss=model.loss)
+    loaded = getattr(model, "optimizer", None)
+    if loaded is not None:
+        dist = DistributedOptimizer(loaded, compression=compression)
+        # Carry the checkpoint's slot state (moments, iteration count)
+        # into the wrapped optimizer instead of recompiling, which
+        # would also drop compiled metrics.
+        if getattr(loaded, "built", False):
+            dist.build(model.trainable_variables)
+            for src, dst in zip(loaded.variables, dist.variables):
+                dst.assign(src)
+        model.optimizer = dist
     return model

@@ -27,6 +27,37 @@ def _set_lr(optimizer, lr: float):
     optimizer.learning_rate = lr
 
 
+class _MomentumCorrectionMixin:
+    """Momentum correction (reference ``_keras/callbacks.py``, after
+    Goyal et al. A.1): Keras SGD folds the LR into the velocity
+    (``v = m*v - lr*g``), so when the LR changes the accumulated
+    velocity is scaled by ``new_lr / old_lr`` to keep the history
+    consistent with the new rate.  Scaling the slot *variables* (not
+    the ``momentum`` hyperparameter, a Python float baked into the
+    traced train step) works under compiled Keras training.
+    """
+
+    momentum_correction = False
+
+    def _adjust_lr(self, new_lr: float):
+        opt = self.model.optimizer
+        old_lr = _get_lr(opt)
+        _set_lr(opt, new_lr)
+        if (self.momentum_correction and old_lr > 0
+                and new_lr != old_lr):
+            slots = getattr(opt, "momentums", None)
+            if slots:
+                scale = new_lr / old_lr
+                for v in slots:
+                    v.assign(v * scale)
+
+    def _restore_momentum_if_needed(self):
+        # Velocity scaling is a one-time correction at the LR change —
+        # nothing to restore (the reference's hyperparameter variant
+        # restores; the slot-scaling formulation does not need to).
+        pass
+
+
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     """Broadcast model + optimizer state from ``root_rank`` before the
     first batch so all ranks start identical."""
@@ -71,7 +102,8 @@ class MetricAverageCallback(keras.callbacks.Callback):
                     name="MetricAverageCallback.%s.%d" % (k, epoch)))
 
 
-class LearningRateWarmupCallback(keras.callbacks.Callback):
+class LearningRateWarmupCallback(_MomentumCorrectionMixin,
+                                 keras.callbacks.Callback):
     """Ramp LR from ``initial_lr / size`` (or given start) to
     ``initial_lr`` over ``warmup_epochs`` (reference: gradual warmup of
     the linearly-scaled learning rate, Goyal et al.)."""
@@ -82,6 +114,7 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
         super().__init__()
         self.initial_lr = initial_lr
         self.warmup_epochs = warmup_epochs
+        self.momentum_correction = momentum_correction
         self.steps_per_epoch = steps_per_epoch
         self.verbose = verbose
         self.current_epoch = 0
@@ -108,7 +141,10 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
         if self.current_epoch >= self.warmup_epochs:
             return
         step = self.current_epoch * self._steps + batch
-        _set_lr(self.model.optimizer, self._warmup_lr(step))
+        self._adjust_lr(self._warmup_lr(step))
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
 
     def on_epoch_end(self, epoch, logs=None):
         if epoch == self.warmup_epochs - 1:
@@ -118,7 +154,8 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
                       "lr=%g" % self.initial_lr)
 
 
-class LearningRateScheduleCallback(keras.callbacks.Callback):
+class LearningRateScheduleCallback(_MomentumCorrectionMixin,
+                                   keras.callbacks.Callback):
     """Multiply LR by ``multiplier`` within ``[start_epoch, end_epoch)``
     (reference: piecewise/exponential decay schedules; ``multiplier``
     may be a constant or a function of epoch)."""
@@ -133,6 +170,7 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         self.start_epoch = start_epoch
         self.end_epoch = end_epoch
         self.staircase = staircase
+        self.momentum_correction = momentum_correction
         self.steps_per_epoch = steps_per_epoch
         self.verbose = verbose
         self.current_epoch = 0
@@ -153,8 +191,7 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch
         if self.staircase and self._in_range(epoch):
-            _set_lr(self.model.optimizer,
-                    self.initial_lr * self.multiplier(epoch))
+            self._adjust_lr(self.initial_lr * self.multiplier(epoch))
             if self.verbose and hvd.rank() == 0:
                 print("LearningRateScheduleCallback: epoch %d lr=%g"
                       % (epoch, _get_lr(self.model.optimizer)))
@@ -165,5 +202,7 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         if self._steps is None:
             return
         epoch = self.current_epoch + batch / float(self._steps)
-        _set_lr(self.model.optimizer,
-                self.initial_lr * self.multiplier(epoch))
+        self._adjust_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()

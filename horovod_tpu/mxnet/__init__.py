@@ -1,0 +1,187 @@
+"""MXNet adapter: ``import horovod_tpu.mxnet as hvd``.
+
+Reference parity: ``horovod/mxnet/__init__.py`` + ``mpi_ops.py`` (native
+extension ``horovod/mxnet/mpi_ops.cc``/``adapter.cc``) — the same
+surface: init/rank/size, the collectives with async/in-place variants,
+``DistributedOptimizer`` (wraps an ``mx.optimizer.Optimizer``,
+allreducing gradients inside ``update``/``update_multi_precision``),
+``DistributedTrainer`` (gluon ``Trainer`` whose ``_allreduce_grads``
+averages over the world), and ``broadcast_parameters``.
+
+MXNet is optional in this environment: every entry point that does not
+strictly need the mxnet runtime (the collectives, the optimizer wrapper,
+parameter broadcast) is duck-typed over NDArray-likes; only
+``DistributedTrainer`` requires gluon and raises ImportError without it.
+"""
+
+from __future__ import annotations
+
+from ..common.basics import (shutdown, is_initialized, rank, size,
+                             local_rank, local_size, cross_rank,
+                             cross_size, is_homogeneous, topology,
+                             start_timeline, stop_timeline, xla_built,
+                             tcp_built, gloo_built, mpi_built,
+                             nccl_built, ccl_built, ddl_built,
+                             cuda_built, rocm_built, mpi_enabled,
+                             mpi_threads_supported)
+from ..common.basics import init as _base_init
+from ..common.process_sets import (ProcessSet, global_process_set,
+                                   add_process_set, remove_process_set)
+from ..ops.engine import HorovodInternalError
+from ..ops.xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
+from .functions import (allgather_object, broadcast_object,
+                        broadcast_parameters)
+from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
+                      allreduce_async, allreduce_async_, alltoall,
+                      alltoall_async, barrier, broadcast, broadcast_,
+                      broadcast_async, broadcast_async_,
+                      grouped_allreduce, grouped_allreduce_async, join,
+                      poll, reducescatter, reducescatter_async,
+                      synchronize)
+
+try:  # optional dependency
+    import mxnet as _mx  # type: ignore
+except ImportError:  # pragma: no cover
+    _mx = None
+
+Sum = SUM
+Average = AVERAGE
+Min = MIN
+Max = MAX
+Product = PRODUCT
+Adasum = ADASUM
+
+
+def init(*args, **kwargs):
+    """``hvd.init()`` — multi-process (tcp) controller by default, like
+    the torch adapter: mxnet semantics are per-process NDArrays."""
+    kwargs.setdefault("controller", "tcp")
+    return _base_init(*args, **kwargs)
+
+
+class DistributedOptimizer:
+    """Wraps an ``mx.optimizer.Optimizer``: gradients are averaged over
+    the world before the inner update (reference
+    ``horovod/mxnet/__init__.py`` ``DistributedOptimizer``).
+
+    Duck-typed: the inner optimizer only needs ``update`` (and
+    optionally ``update_multi_precision``); works with mxnet optimizers
+    and with test doubles alike.
+    """
+
+    def __init__(self, optimizer, gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0, process_set=None):
+        self._optimizer = optimizer
+        self._predivide = float(gradient_predivide_factor)
+        self._num_groups = num_groups
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _allreduce_grads(self, grads, names):
+        ps_size = (self._process_set.size()
+                   if self._process_set is not None else size())
+        if ps_size <= 1:
+            return
+        # predivide factor splits the averaging between pre/post scaling
+        # (reference torch/mxnet semantics): Sum with prescale 1/f,
+        # postscale f/size.
+        pre = 1.0 / self._predivide
+        post = self._predivide / ps_size
+        if self._num_groups > 0:
+            pairs = list(zip(grads, names))
+            groups = [pairs[i::self._num_groups]
+                      for i in range(self._num_groups)]
+            for gi, group in enumerate(g for g in groups if g):
+                tensors = [g for g, _ in group]
+                outs = grouped_allreduce(
+                    tensors, op=SUM, prescale_factor=pre,
+                    postscale_factor=post,
+                    name="DistributedOptimizer.grad_group.%d" % gi,
+                    process_set=self._process_set)
+                for (g, _), o in zip(group, outs):
+                    g[:] = o
+        else:
+            handles = [allreduce_async_(
+                g, op=SUM, prescale_factor=pre, postscale_factor=post,
+                name="DistributedOptimizer.gradient.%s" % n,
+                process_set=self._process_set)
+                for g, n in zip(grads, names)]
+            for h in handles:
+                h.wait()
+
+    def _do_update(self, method, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):
+            grads = list(grad)
+            names = [str(i) for i in index]
+        else:
+            grads = [grad]
+            names = [str(index)]
+        self._allreduce_grads(grads, names)
+        return method(index, weight, grad, state)
+
+    def update(self, index, weight, grad, state):
+        return self._do_update(self._optimizer.update, index, weight,
+                               grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        method = getattr(self._optimizer, "update_multi_precision",
+                         self._optimizer.update)
+        return self._do_update(method, index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+if _mx is not None:  # pragma: no cover - needs mxnet runtime
+
+    class DistributedTrainer(_mx.gluon.Trainer):
+        """gluon ``Trainer`` averaging gradients over the world
+        (reference ``DistributedTrainer``): scales the loss down by
+        ``size()`` via ``rescale_grad`` and allreduces with Sum."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     gradient_predivide_factor: float = 1.0,
+                     process_set=None, **kwargs):
+            if isinstance(optimizer, DistributedOptimizer):
+                optimizer = optimizer._optimizer
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params, **kwargs)
+            self._hvd_predivide = float(gradient_predivide_factor)
+            self._hvd_process_set = process_set
+            self._scale /= (process_set.size() if process_set is not None
+                            else size())
+
+        def _allreduce_grads(self):
+            ps = self._hvd_process_set
+            ps_size = ps.size() if ps is not None else size()
+            if ps_size <= 1:
+                return
+            pre = 1.0 / self._hvd_predivide
+            post = self._hvd_predivide  # _scale already divided by size
+            handles = []
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        handles.append(allreduce_async_(
+                            g, op=SUM, prescale_factor=pre,
+                            postscale_factor=post,
+                            name="DistributedTrainer.grad.%d" % i,
+                            process_set=ps))
+            for h in handles:
+                h.wait()
+
+else:
+
+    def DistributedTrainer(*args, **kwargs):  # type: ignore[misc]
+        raise ImportError(
+            "DistributedTrainer requires mxnet (gluon); mxnet is not "
+            "installed in this environment. The rest of the "
+            "horovod_tpu.mxnet surface works without it.")

@@ -1,0 +1,67 @@
+"""MXNet parameter/object broadcast helpers.
+
+Reference parity: ``horovod/mxnet/__init__.py`` —
+``broadcast_parameters`` accepts a gluon ``ParameterDict`` or a plain
+``dict`` of NDArrays (the reference dispatches on both), and
+``broadcast_object`` pickles arbitrary Python state across the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..jax.functions import allgather_object as _allgather_object
+from ..jax.functions import broadcast_object as _broadcast_object
+from . import mpi_ops
+
+
+def _is_parameter_dict(params) -> bool:
+    # gluon ParameterDict / gluon2 dict-of-Parameter: values expose
+    # list_data()/data() rather than being NDArrays themselves.
+    try:
+        vals = list(params.values())
+    except AttributeError:
+        return False
+    return bool(vals) and all(hasattr(v, "data") and not hasattr(v, "asnumpy")
+                              for v in vals)
+
+
+def broadcast_parameters(params, root_rank: int = 0, prefix: str = ""):
+    """In-place broadcast of model parameters from ``root_rank``.
+
+    Accepts a ``dict`` name→NDArray (e.g. from ``get_params``), or a
+    gluon ``ParameterDict``-like mapping name→Parameter.
+    """
+    handles = []
+    if _is_parameter_dict(params):
+        for name in sorted(params.keys()):
+            p = params[name]
+            try:
+                tensors = p.list_data()
+            except Exception:
+                tensors = [p.data()]
+            for i, t in enumerate(tensors):
+                handles.append(mpi_ops.broadcast_async_(
+                    t, root_rank,
+                    name="%sbroadcast_parameters.%s.%d" % (prefix, name, i)))
+    elif isinstance(params, dict):
+        for name in sorted(params.keys()):
+            t = params[name]
+            if t is None:
+                continue
+            handles.append(mpi_ops.broadcast_async_(
+                t, root_rank,
+                name="%sbroadcast_parameters.%s" % (prefix, name)))
+    else:
+        raise ValueError("invalid params of type %r" % type(params))
+    for h in handles:
+        h.wait()
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    return _broadcast_object(obj, root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None):
+    return _allgather_object(obj, name=name)

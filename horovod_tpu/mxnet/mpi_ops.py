@@ -1,0 +1,220 @@
+"""MXNet collective ops over the native core.
+
+Reference parity: ``horovod/mxnet/mpi_ops.py`` (+ the native extension
+``horovod/mxnet/mpi_ops.cc`` / ``adapter.cc`` / ``tensor_util.cc``) —
+every op has a synchronous form, an ``*_async`` form returning a handle,
+and in-place ``*_`` variants.  The reference integrates with MXNet's
+dependency engine; here NDArrays cross the wire as their numpy
+realization (``asnumpy()``), which is the correct host-side view for a
+TPU build whose device compute path is the JAX adapter.
+
+MXNet itself is an optional dependency: the ops are duck-typed over
+"NDArray-like" values (anything with ``asnumpy()``; plain numpy arrays
+also work), so the adapter logic is fully testable without an mxnet
+runtime, and binds to real ``mx.nd.NDArray`` when mxnet is installed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..ops import api as _api
+from ..ops.xla_ops import AVERAGE, SUM
+
+try:  # optional dependency
+    import mxnet as _mx  # type: ignore
+except ImportError:  # pragma: no cover - exercised when mxnet missing
+    _mx = None
+
+__all__ = [
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "barrier", "join",
+    "synchronize", "poll",
+]
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "asnumpy"):
+        return t.asnumpy()
+    return np.asarray(t)
+
+
+def _from_np(arr: np.ndarray, like):
+    """Rebuild an output in the input's container type."""
+    arr = np.ascontiguousarray(arr)
+    if _mx is not None and isinstance(like, _mx.nd.NDArray):
+        return _mx.nd.array(arr, ctx=like.context, dtype=arr.dtype)
+    if hasattr(like, "_from_numpy_"):  # test shims / custom containers
+        return like._from_numpy_(arr)
+    return arr
+
+
+def _write_inplace(out, arr: np.ndarray):
+    out[:] = _from_np(arr.reshape(_to_np(out).shape), out)
+    return out
+
+
+class MXHandle:
+    """Async handle returning NDArray-likes (reference handle table in
+    ``horovod/mxnet/mpi_ops.cc``)."""
+
+    def __init__(self, inner, like=None, out=None):
+        self._inner = inner
+        self._like = like
+        self._out = out
+
+    def poll(self) -> bool:
+        return self._inner.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        res = self._inner.wait(timeout)
+        splits = None
+        if isinstance(res, tuple):
+            res, splits = res
+        arr = np.ascontiguousarray(np.asarray(res))
+        if self._out is not None:
+            t = _write_inplace(self._out, arr)
+        else:
+            t = _from_np(arr, self._like)
+        return (t, splits) if splits is not None else t
+
+
+def synchronize(handle: MXHandle):
+    return handle.wait()
+
+
+def poll(handle: MXHandle) -> bool:
+    return handle.poll()
+
+
+# -- allreduce -------------------------------------------------------------
+
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set=None) -> MXHandle:
+    h = _api.allreduce_async(_to_np(tensor), average, name, op,
+                             prescale_factor, postscale_factor,
+                             process_set)
+    return MXHandle(h, like=tensor)
+
+
+def allreduce_async_(tensor, average=None, name: Optional[str] = None,
+                     op=None, prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     process_set=None) -> MXHandle:
+    h = _api.allreduce_async(_to_np(tensor), average, name, op,
+                             prescale_factor, postscale_factor,
+                             process_set)
+    return MXHandle(h, like=tensor, out=tensor)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=None):
+    return allreduce_async(tensor, average, name, op, prescale_factor,
+                           postscale_factor, process_set).wait()
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=None):
+    return allreduce_async_(tensor, average, name, op, prescale_factor,
+                            postscale_factor, process_set).wait()
+
+
+def grouped_allreduce_async(tensors: Sequence, average=None,
+                            name: Optional[str] = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set=None) -> List[MXHandle]:
+    hs = _api.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], average, name, op,
+        prescale_factor, postscale_factor, process_set)
+    return [MXHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None) -> List:
+    return [h.wait() for h in grouped_allreduce_async(
+        tensors, average, name, op, prescale_factor, postscale_factor,
+        process_set)]
+
+
+# -- allgather -------------------------------------------------------------
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set=None) -> MXHandle:
+    h = _api.allgather_async(_to_np(tensor), name, process_set)
+    return MXHandle(h, like=tensor)
+
+
+def allgather(tensor, name=None, process_set=None):
+    return allgather_async(tensor, name, process_set).wait()
+
+
+# -- broadcast -------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set=None) -> MXHandle:
+    h = _api.broadcast_async(_to_np(tensor), root_rank, name,
+                             process_set)
+    return MXHandle(h, like=tensor)
+
+
+def broadcast_async_(tensor, root_rank: int, name: Optional[str] = None,
+                     process_set=None) -> MXHandle:
+    h = _api.broadcast_async(_to_np(tensor), root_rank, name,
+                             process_set)
+    return MXHandle(h, like=tensor, out=tensor)
+
+
+def broadcast(tensor, root_rank: int, name=None, process_set=None):
+    return broadcast_async(tensor, root_rank, name, process_set).wait()
+
+
+def broadcast_(tensor, root_rank: int, name=None, process_set=None):
+    return broadcast_async_(tensor, root_rank, name, process_set).wait()
+
+
+# -- alltoall / reducescatter ----------------------------------------------
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set=None) -> MXHandle:
+    if splits is not None and hasattr(splits, "asnumpy"):
+        splits = splits.asnumpy().tolist()
+    h = _api.alltoall_async(_to_np(tensor), splits, name, process_set)
+    return MXHandle(h, like=tensor)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    res = alltoall_async(tensor, splits, name, process_set).wait()
+    if splits is None and isinstance(res, tuple):
+        return res[0]
+    return res
+
+
+def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
+                        process_set=None) -> MXHandle:
+    h = _api.reducescatter_async(_to_np(tensor), op, name, process_set)
+    return MXHandle(h, like=tensor)
+
+
+def reducescatter(tensor, op=SUM, name=None, process_set=None):
+    return reducescatter_async(tensor, op, name, process_set).wait()
+
+
+# -- barrier / join --------------------------------------------------------
+
+def barrier(process_set=None):
+    return _api.barrier(process_set)
+
+
+def join(device=None) -> int:
+    return _api.join(device)

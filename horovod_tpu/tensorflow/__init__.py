@@ -67,17 +67,36 @@ def _densify(grad):
 def _make_allreduce_grads_fn(name_prefix: str, op, compression,
                              process_set):
     def allreduce_grads(grads):
-        out = []
+        grads = [None if g is None else _densify(g) for g in grads]
+        if any(g is not None and tf.is_symbolic_tensor(g)
+               for g in grads):
+            # traced inside tf.function: stage per-tensor through the
+            # differentiable py_function path
+            out = []
+            for i, g in enumerate(grads):
+                if g is None:
+                    out.append(None)
+                    continue
+                c, ctx = compression.compress(g)
+                r = allreduce(c, op=op, process_set=process_set,
+                              name="%s.grad_%d" % (name_prefix, i))
+                out.append(compression.decompress(r, ctx))
+            return out
+        # eager: submit every allreduce before waiting on any, so
+        # negotiation/transfer of all gradients overlap (the reference's
+        # async enqueue + single synchronize pattern)
+        pending = []
         for i, g in enumerate(grads):
             if g is None:
-                out.append(None)
+                pending.append((None, None))
                 continue
-            g = _densify(g)
             c, ctx = compression.compress(g)
-            r = allreduce(c, op=op, process_set=process_set,
-                          name="%s.grad_%d" % (name_prefix, i))
-            out.append(compression.decompress(r, ctx))
-        return out
+            h = allreduce_async(c, op=op, process_set=process_set,
+                                name="%s.grad_%d" % (name_prefix, i))
+            pending.append((h, ctx))
+        return [None if h is None else compression.decompress(h.wait(),
+                                                              ctx)
+                for h, ctx in pending]
     return allreduce_grads
 
 
@@ -87,14 +106,14 @@ class _DistributedGradientTape:
 
     def __init__(self, tape: tf.GradientTape, device_dense="",
                  device_sparse="", compression=Compression.none,
-                 sparse_as_dense=True, op=AVERAGE, process_set=None,
-                 backward_passes_per_step: int = 1):
+                 sparse_as_dense=True, op=AVERAGE, process_set=None):
+        # No backward_passes_per_step here: the tape API has no way to
+        # tell the caller to skip an optimizer update on non-boundary
+        # passes, so local aggregation lives on DistributedOptimizer
+        # only — same split as the reference.
         self._tape = tape
         self._allreduce_grads = _make_allreduce_grads_fn(
             "DistributedGradientTape", op, compression, process_set)
-        self._agg = (LocalGradientAggregationHelper(
-            backward_passes_per_step, self._allreduce_grads)
-            if backward_passes_per_step > 1 else None)
 
     def __enter__(self):
         self._tape.__enter__()
@@ -110,10 +129,7 @@ class _DistributedGradientTape:
         grads = self._tape.gradient(target, sources, output_gradients)
         single = not isinstance(grads, (list, tuple))
         glist = [grads] if single else list(grads)
-        if self._agg is not None:
-            _, glist = self._agg.apply(glist)
-        else:
-            glist = self._allreduce_grads(glist)
+        glist = self._allreduce_grads(glist)
         return glist[0] if single else glist
 
 

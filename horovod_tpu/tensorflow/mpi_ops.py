@@ -3,7 +3,9 @@
 Reference parity: ``horovod/tensorflow/mpi_ops.py`` (+ the custom-op
 kernels in ``horovod/tensorflow/mpi_ops.cc``): the eight collectives on
 ``tf.Tensor`` values, each with a gradient registered so they compose
-with ``tf.GradientTape``.  The wire format is the tensor's numpy view
+with ``tf.GradientTape`` (reference registrations ``HorovodAllreduce``,
+``HorovodAllgather``, ``HorovodBroadcast``, ``HorovodAlltoall``,
+``HorovodReducescatter``).  The wire format is the tensor's numpy view
 into the same engine the torch adapter uses; on TPU the compute path is
 the JAX adapter — this adapter moves host tensors through the
 multi-process world, which is exactly the role the reference's CPU
@@ -53,6 +55,13 @@ def _to_tf(arr, like=None):
     if like is not None and t.dtype != like.dtype:
         t = tf.cast(t, like.dtype)
     return t
+
+
+def _ps_rank(process_set) -> int:
+    if process_set is not None:
+        return process_set.rank()
+    from ..common import basics
+    return basics.rank()
 
 
 class TFHandle:
@@ -125,16 +134,34 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
     return _op(tensor)
 
 
+def _grouped_allreduce_eager(tensors: List, average, name, op,
+                             prescale_factor, postscale_factor,
+                             process_set) -> List:
+    hs = _api.grouped_allreduce_async(
+        [_np_view(t) for t in tensors], average, name, op,
+        prescale_factor, postscale_factor, process_set)
+    return [TFHandle(h, like=t).wait() for h, t in zip(hs, tensors)]
+
+
 def grouped_allreduce(tensors: Sequence, average=None,
                       name: Optional[str] = None, op=None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
                       process_set=None) -> List:
     tensors = [tf.convert_to_tensor(t) for t in tensors]
-    hs = _api.grouped_allreduce_async(
-        [_np_view(t) for t in tensors], average, name, op,
-        prescale_factor, postscale_factor, process_set)
-    return [TFHandle(h, like=t).wait() for h, t in zip(hs, tensors)]
+    if any(tf.is_symbolic_tensor(t) for t in tensors):
+        ys = tf.py_function(
+            lambda *xs: _grouped_allreduce_eager(
+                list(xs), average, name, op, prescale_factor,
+                postscale_factor, process_set),
+            tensors, Tout=[t.dtype for t in tensors])
+        ys = list(ys) if isinstance(ys, (list, tuple)) else [ys]
+        for y, t in zip(ys, tensors):
+            y.set_shape(t.shape)
+        return ys
+    return _grouped_allreduce_eager(tensors, average, name, op,
+                                    prescale_factor, postscale_factor,
+                                    process_set)
 
 
 # -- allgather -------------------------------------------------------------
@@ -147,11 +174,41 @@ def allgather_async(tensor, name: Optional[str] = None,
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
+    """Concatenate ``tensor`` from all ranks along axis 0.
+    Differentiable: the gradient sums upstream grads over ranks and
+    slices out this rank's segment (reference ``HorovodAllgather``
+    gradient: allreduce + split by the allgathered first dims)."""
     tensor = tf.convert_to_tensor(tensor)
     out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
-    return _run_op(
-        lambda v: allgather_async(v, name, process_set).wait(),
-        tensor, out_shape=out_shape)
+    n_local = tensor.shape[0]
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(
+            lambda v: allgather_async(v, name, process_set).wait(),
+            x, out_shape=out_shape)
+
+        def grad(dy):
+            if n_local is None:
+                raise NotImplementedError(
+                    "allgather gradient needs a static first dimension")
+
+            def _g(dyv):
+                gname = None if name is None else name + "_grad"
+                summed = allreduce_async(dyv, op=SUM, name=gname,
+                                         process_set=process_set).wait()
+                sizes = np.asarray(_api.allgather(
+                    np.asarray([int(n_local)], np.int64),
+                    name=None if gname is None else gname + "_sizes",
+                    process_set=process_set))
+                off = int(sizes[:_ps_rank(process_set)].sum())
+                return summed[off:off + int(n_local)]
+
+            return _run_op(_g, dy, out_shape=x.shape)
+
+        return y, grad
+
+    return _op(tensor)
 
 
 # -- broadcast -------------------------------------------------------------
@@ -166,34 +223,119 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set=None):
+    """Broadcast from ``root_rank``.  Differentiable: the root's
+    gradient is the sum of upstream grads over ranks; non-roots get
+    zero (reference ``HorovodBroadcast`` gradient registration)."""
     tensor = tf.convert_to_tensor(tensor)
-    return _run_op(
-        lambda v: broadcast_async(v, root_rank, name,
-                                  process_set).wait(), tensor)
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(
+            lambda v: broadcast_async(v, root_rank, name,
+                                      process_set).wait(), x)
+
+        def grad(dy):
+            g = _run_op(
+                lambda v: allreduce_async(
+                    v, op=SUM,
+                    name=None if name is None else name + "_grad",
+                    process_set=process_set).wait(), dy)
+            # root_rank is a GLOBAL rank (core operations.cc broadcast
+            # semantics), so compare against the global rank even when
+            # scoped to a process set.
+            from ..common import basics
+            if basics.rank() == root_rank:
+                return g
+            return tf.zeros_like(g)
+
+        return y, grad
+
+    return _op(tensor)
 
 
 # -- alltoall / reducescatter ----------------------------------------------
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
+    """Exchange row blocks between all ranks.  Differentiable: the
+    gradient is the reverse alltoall of the upstream grad, routed by
+    the received splits (reference ``HorovodAlltoall`` gradient)."""
     tensor = tf.convert_to_tensor(tensor)
-    if splits is not None and isinstance(splits, tf.Tensor):
-        splits = splits.numpy().tolist()
-    h = _api.alltoall_async(_np_view(tensor), splits, name, process_set)
-    res = TFHandle(h, like=tensor).wait()
-    if splits is None and isinstance(res, tuple):
-        return res[0]
-    return res
+    if splits is not None:
+        if tf.is_symbolic_tensor(tensor) or (
+                isinstance(splits, tf.Tensor)
+                and tf.is_symbolic_tensor(splits)):
+            # The eager contract returns (output, recv_splits); the
+            # received splits only exist once the staged py_function
+            # runs, so there is no trace-time value to return.
+            raise NotImplementedError(
+                "alltoall with explicit splits is not supported inside "
+                "tf.function; call it eagerly (the splits=None equal-"
+                "split form works in both modes)")
+        if isinstance(splits, tf.Tensor):
+            splits = splits.numpy().tolist()
+    out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    rcell = {}
+
+    @tf.custom_gradient
+    def _op(x):
+        def _fwd(v):
+            res = TFHandle(_api.alltoall_async(
+                _np_view(v), splits, name, process_set), like=v).wait()
+            if isinstance(res, tuple):
+                res, rcell["recv_splits"] = res
+            return res
+
+        y = _run_op(_fwd, x, out_shape=out_shape)
+
+        def grad(dy):
+            def _bwd(v):
+                rs = rcell.get("recv_splits")
+                rs = list(rs) if rs is not None else None
+                res = TFHandle(_api.alltoall_async(
+                    _np_view(v), rs,
+                    None if name is None else name + "_grad",
+                    process_set), like=v).wait()
+                return res[0] if isinstance(res, tuple) else res
+
+            return _run_op(_bwd, dy, out_shape=x.shape)
+
+        return y, grad
+
+    out = _op(tensor)
+    if splits is not None:
+        rs = rcell.get("recv_splits")
+        if rs is not None:
+            return out, rs
+    return out
 
 
 def reducescatter(tensor, op=SUM, name: Optional[str] = None,
                   process_set=None):
+    """Reduce over ranks and scatter row blocks.  Differentiable: the
+    gradient is the allgather of the upstream grad (reference
+    ``HorovodReducescatter`` gradient registration)."""
     tensor = tf.convert_to_tensor(tensor)
     out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
-    return _run_op(
-        lambda v: TFHandle(_api.reducescatter_async(
-            _np_view(v), op, name, process_set), like=v).wait(),
-        tensor, out_shape=out_shape)
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(
+            lambda v: TFHandle(_api.reducescatter_async(
+                _np_view(v), op, name, process_set), like=v).wait(),
+            x, out_shape=out_shape)
+
+        def grad(dy):
+            return _run_op(
+                lambda v: TFHandle(_api.allgather_async(
+                    _np_view(v),
+                    None if name is None else name + "_grad",
+                    process_set), like=v).wait(),
+                dy, out_shape=x.shape)
+
+        return y, grad
+
+    return _op(tensor)
 
 
 # -- barrier / join --------------------------------------------------------

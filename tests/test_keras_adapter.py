@@ -70,3 +70,24 @@ def test_load_model_rewraps_optimizer(hvd, tmp_path):
     model.save(path)
     loaded = hvd.load_model(path)
     assert getattr(type(loaded.optimizer), "_hvd_distributed", False)
+
+
+def test_momentum_correction_scales_velocity(hvd):
+    model = _model(lr=1.0)
+    model.compile(optimizer=keras.optimizers.SGD(1.0, momentum=0.9),
+                  loss="mse")
+    # Build the optimizer slots, then seed a known velocity.
+    _fit(model, [], epochs=1)
+    for v in model.optimizer.momentums:
+        v.assign(keras.ops.ones_like(v) * 4.0)
+    cb = hvd.callbacks.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.5, staircase=True,
+        momentum_correction=True)
+    cb.set_model(model)
+    cb.set_params({"steps": 4})
+    cb.on_train_begin()
+    cb.on_epoch_begin(1)
+    # LR 1.0 -> 0.5: velocity scaled by 0.5 (4.0 -> 2.0).
+    got = keras.ops.convert_to_numpy(model.optimizer.momentums[0])
+    assert np.allclose(got, 2.0), got
+    assert np.isclose(float(model.optimizer.learning_rate.numpy()), 0.5)

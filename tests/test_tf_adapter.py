@@ -158,3 +158,54 @@ def test_elastic_state(hvd):
     state.restore()
     assert state.epoch == 0
     assert np.allclose(model.get_weights()[0], w0)
+
+
+def test_allgather_gradient_registered(hvd):
+    x = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allgather(x, name="tf_ag_grad") * 2.0)
+    g = tape.gradient(y, x)
+    assert g is not None
+    # size-1: gathered == x, so grad is 2 everywhere.
+    assert np.allclose(g.numpy(), np.full((2, 2), 2.0))
+
+
+def test_broadcast_gradient_registered(hvd):
+    x = tf.Variable([1.0, 5.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.broadcast(x, root_rank=0,
+                                        name="tf_bc_grad") * 3.0)
+    g = tape.gradient(y, x)
+    # rank 0 IS the root in a size-1 world: grad = sum over ranks = 3.
+    assert np.allclose(g.numpy(), [3.0, 3.0])
+
+
+def test_reducescatter_gradient_registered(hvd):
+    x = tf.Variable([[1.0], [2.0]])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.reducescatter(x, name="tf_rs_grad"))
+    g = tape.gradient(y, x)
+    assert g is not None and np.allclose(g.numpy(), np.ones((2, 1)))
+
+
+def test_alltoall_gradient_registered(hvd):
+    x = tf.Variable([[1.0, 2.0]])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.alltoall(x, name="tf_a2a_grad") * 4.0)
+    g = tape.gradient(y, x)
+    assert g is not None and np.allclose(g.numpy(), [[4.0, 4.0]])
+
+
+def test_collectives_inside_tf_function(hvd):
+    @tf.function
+    def step(x):
+        a = hvd.allreduce(x, op=hvd.Sum, name="tfn_ar")
+        b = hvd.alltoall(x, name="tfn_a2a")
+        c = hvd.grouped_allreduce([x, x * 2], op=hvd.Sum,
+                                  name="tfn_gar")
+        return a + b + c[0] + c[1]
+
+    x = tf.constant([[1.0, 2.0]])
+    out = step(x)
+    # size-1 world: every collective is identity → 1+1+1+2 = 5x.
+    assert np.allclose(out.numpy(), [[5.0, 10.0]])
