@@ -16,7 +16,8 @@ from typing import Any, Callable, Dict, List, Optional
 from ..runner import util
 from ..runner.http_server import RendezvousServer
 
-__all__ = ["RayExecutor", "plan_ranks"]
+__all__ = ["RayExecutor", "ElasticRayExecutor", "RayHostDiscovery",
+           "plan_ranks"]
 
 
 def _require_ray():
@@ -62,14 +63,36 @@ class RayExecutor:
         executor.shutdown()
     """
 
-    def __init__(self, num_workers: int = 1, cpus_per_worker: int = 1,
-                 use_gpu: bool = False,
+    def __init__(self, num_workers: Optional[int] = None,
+                 cpus_per_worker: int = 1, use_gpu: bool = False,
+                 gpus_per_worker: int = 1,
+                 num_hosts: Optional[int] = None,
+                 num_workers_per_host: int = 1,
                  extra_env: Optional[Dict[str, str]] = None):
-        self.num_workers = num_workers
+        if num_workers is None and num_hosts is None:
+            raise ValueError("give num_workers (packed placement) or "
+                             "num_hosts × num_workers_per_host "
+                             "(spread placement)")
+        if num_workers is not None and num_hosts is not None:
+            raise ValueError("num_workers and num_hosts are mutually "
+                             "exclusive placement specs")
+        gpus = gpus_per_worker if use_gpu else 0
+        from .strategy import PackStrategy, SpreadStrategy
+        if num_hosts is not None:
+            self.strategy = SpreadStrategy(
+                num_hosts, num_workers_per_host,
+                cpus_per_worker, gpus)
+            self.num_workers = num_hosts * num_workers_per_host
+        else:
+            self.strategy = PackStrategy(
+                num_workers, cpus_per_worker, gpus)
+            self.num_workers = num_workers
+        self.gpus_per_worker = gpus_per_worker
         self.cpus_per_worker = cpus_per_worker
         self.use_gpu = use_gpu
         self.extra_env = dict(extra_env or {})
         self._workers = []
+        self._pg = None
         self._server: Optional[RendezvousServer] = None
         self._secret = util.make_secret()
 
@@ -77,7 +100,8 @@ class RayExecutor:
         ray = _require_ray()
 
         @ray.remote(num_cpus=self.cpus_per_worker,
-                    num_gpus=1 if self.use_gpu else 0)
+                    num_gpus=self.gpus_per_worker if self.use_gpu
+                    else 0)
         class _Worker:
             def node_ip(self):
                 import ray as _ray
@@ -91,8 +115,26 @@ class RayExecutor:
             def execute(self, fn, args, kwargs):
                 return fn(*args, **(kwargs or {}))
 
-        self._workers = [_Worker.remote()
-                         for _ in range(self.num_workers)]
+        # placement-group scheduling (reference strategy.py): bundles
+        # from the chosen strategy; PACK for plain num_workers,
+        # STRICT_SPREAD for num_hosts × num_workers_per_host.  Only a
+        # missing PG API falls back to plain scheduling — a PG that
+        # cannot be satisfied is a real error (its reservation is
+        # already cleaned up by create_placement_group).
+        try:
+            from ray.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+        except ImportError:
+            self._workers = [_Worker.remote()
+                             for _ in range(self.num_workers)]
+        else:
+            self._pg, plan = self.strategy.create_placement_group()
+            self._workers = [
+                _Worker.options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=self._pg,
+                        placement_group_bundle_index=b)).remote()
+                for b in plan.worker_to_bundle]
         ips = ray.get([w.node_ip.remote() for w in self._workers])
         self._server = RendezvousServer(secret=self._secret)
         port = self._server.start()
@@ -132,10 +174,27 @@ class RayExecutor:
         return self.run(fn)
 
     def shutdown(self):
+        # each step independent: a dead actor / already-invalidated PG
+        # must not leak the remaining resources (esp. the rendezvous
+        # server thread)
         ray = _require_ray()
         for w in self._workers:
-            ray.kill(w)
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
         self._workers = []
+        if self._pg is not None:
+            try:
+                from ray.util.placement_group import \
+                    remove_placement_group
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
         if self._server is not None:
             self._server.stop()
             self._server = None
+
+
+from .elastic import ElasticRayExecutor, RayHostDiscovery  # noqa: E402,F401

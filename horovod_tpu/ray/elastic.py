@@ -1,0 +1,145 @@
+"""Elastic training on Ray.
+
+Reference parity: ``horovod/ray/elastic_v2.py`` — a ``RayHostDiscovery``
+that treats the live Ray cluster membership as the host set (autoscaler
+adds/removes nodes → the elastic world grows/shrinks), plus an
+``ElasticRayExecutor`` wiring that discovery into the framework's
+elastic machinery (``horovod_tpu.elastic``): min/max np, blacklist,
+re-rendezvous, worker retry via ``hvd.elastic.run``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..elastic.discovery import HostDiscovery
+
+__all__ = ["RayHostDiscovery", "ElasticRayExecutor"]
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Host discovery backed by ``ray.nodes()`` (reference
+    ``RayHostDiscovery``): every alive node contributes
+    ``floor(resource / per-worker)`` slots for the chosen resource
+    (GPU when ``use_gpu``, CPU otherwise)."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def _nodes(self) -> List[Dict[str, Any]]:
+        import ray
+        return ray.nodes()
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        hosts: Dict[str, int] = {}
+        for node in self._nodes():
+            if not node.get("Alive", False):
+                continue
+            res = node.get("Resources", {}) or {}
+            ip = node.get("NodeManagerAddress")
+            if not ip:
+                continue
+            if self.use_gpu:
+                slots = int(res.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(res.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts[ip] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Elastic actor-based runner (reference ``ElasticRayExecutor``):
+    worker actors run ``fn`` under the elastic retry decorator; the
+    world is re-discovered and resized within ``[min_np, max_np]`` at
+    every (re)start boundary — i.e. after a worker failure or host
+    change, not mid-run (growth is picked up on the next restart).
+
+    Failures surface as ``HorovodInternalError`` /
+    ``HostsUpdatedInterrupt`` (collective plane) or Ray actor errors
+    (a node died); all tear the world down and retry, with state
+    rolling back to the last ``state.commit()``.
+    """
+
+    def __init__(self, min_np: int = 1, max_np: Optional[int] = None,
+                 use_gpu: bool = False, cpus_per_worker: int = 1,
+                 gpus_per_worker: int = 1,
+                 retries: int = 3, cooldown_s: float = 1.0,
+                 override_discovery: Optional[HostDiscovery] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.min_np = min_np
+        self.max_np = max_np
+        self.discovery = override_discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_worker,
+            gpus_per_slot=gpus_per_worker)
+        self.use_gpu = use_gpu
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+        self.retries = retries
+        self.cooldown_s = cooldown_s
+        self.extra_env = dict(extra_env or {})
+        self._executor = None
+
+    def _current_np(self) -> int:
+        hosts = self.discovery.find_available_hosts_and_slots()
+        total = sum(hosts.values())
+        if total < self.min_np:
+            raise RuntimeError(
+                "elastic: only %d slots discovered, min_np=%d"
+                % (total, self.min_np))
+        return min(total, self.max_np) if self.max_np else total
+
+    def start(self):
+        from . import RayExecutor
+        np_now = self._current_np()
+        self._executor = RayExecutor(
+            num_workers=np_now, cpus_per_worker=self.cpus_per_worker,
+            use_gpu=self.use_gpu,
+            gpus_per_worker=self.gpus_per_worker,
+            extra_env=self.extra_env)
+        self._executor.start()
+
+    @staticmethod
+    def _retryable_exceptions() -> tuple:
+        from ..ops.engine import HorovodInternalError
+        from ..elastic.worker import HostsUpdatedInterrupt
+        excs = [HorovodInternalError, HostsUpdatedInterrupt]
+        try:
+            # a worker actor dying (node removed) surfaces from
+            # ray.get as a RayError, not a collective-plane error
+            from ray.exceptions import RayError
+            excs.append(RayError)
+        except ImportError:
+            pass
+        return tuple(excs)
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[Dict] = None) -> List[Any]:
+        """Run ``fn`` elastically: on a membership change or worker
+        failure the world is torn down, re-discovered, and ``fn``
+        re-invoked (callers use ``hvd.elastic.run``-decorated fns with
+        committed state for exactly-once semantics).  Gives up after
+        ``retries`` consecutive failed attempts, with ``cooldown_s``
+        between rebuilds."""
+        import time
+        retryable = self._retryable_exceptions()
+        failures = 0
+        while True:
+            if self._executor is None:
+                self.start()
+            try:
+                return self._executor.run(fn, args=args, kwargs=kwargs)
+            except retryable:
+                self.shutdown()
+                failures += 1
+                if failures > self.retries:
+                    raise
+                time.sleep(self.cooldown_s)
+
+    def shutdown(self):
+        if self._executor is not None:
+            self._executor.shutdown()
+            self._executor = None

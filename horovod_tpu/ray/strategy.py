@@ -1,0 +1,112 @@
+"""Worker placement strategies for the Ray executor.
+
+Reference parity: ``horovod/ray/strategy.py`` — two ways of turning a
+worker count into Ray placement-group bundles:
+
+* ``PackStrategy`` (reference ``PGStrategy``): ``num_workers`` workers
+  packed onto as few nodes as possible (strategy ``PACK``).
+* ``SpreadStrategy`` (reference ``ColocationStrategy``):
+  ``num_hosts × num_workers_per_host``, one bundle per host, strictly
+  spread (``STRICT_SPREAD``).
+
+The bundle math is pure (unit-testable without ray); only
+``create_placement_group`` touches the ray runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["PlacementPlan", "PackStrategy", "SpreadStrategy"]
+
+
+class PlacementPlan:
+    """Bundles + per-worker bundle index + ray PG strategy name."""
+
+    def __init__(self, bundles: List[Dict[str, float]],
+                 worker_to_bundle: List[int], strategy: str):
+        self.bundles = bundles
+        self.worker_to_bundle = worker_to_bundle
+        self.strategy = strategy
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.worker_to_bundle)
+
+
+class _BaseStrategy:
+    def __init__(self, cpus_per_worker: int = 1,
+                 gpus_per_worker: int = 0):
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+
+    def _worker_resources(self) -> Dict[str, float]:
+        res = {"CPU": float(self.cpus_per_worker)}
+        if self.gpus_per_worker:
+            res["GPU"] = float(self.gpus_per_worker)
+        return res
+
+    def plan(self) -> PlacementPlan:
+        raise NotImplementedError
+
+    def create_placement_group(self, timeout_s: Optional[float] = 100):
+        """Materialize the plan as a ray placement group (requires
+        ray).  On a ready-timeout the reservation is removed before
+        re-raising, so a failed attempt cannot starve the cluster."""
+        import ray
+        from ray.util.placement_group import (placement_group,
+                                              remove_placement_group)
+        p = self.plan()
+        pg = placement_group(p.bundles, strategy=p.strategy)
+        try:
+            ray.get(pg.ready(), timeout=timeout_s)
+        except Exception:
+            remove_placement_group(pg)
+            raise
+        return pg, p
+
+
+class PackStrategy(_BaseStrategy):
+    """``num_workers`` anywhere, packed (reference ``PGStrategy``):
+    one bundle per worker, ray packs bundles onto nodes."""
+
+    def __init__(self, num_workers: int, cpus_per_worker: int = 1,
+                 gpus_per_worker: int = 0):
+        super().__init__(cpus_per_worker, gpus_per_worker)
+        if num_workers <= 0:
+            raise ValueError("num_workers must be positive")
+        self.num_workers = num_workers
+
+    def plan(self) -> PlacementPlan:
+        bundles = [self._worker_resources()
+                   for _ in range(self.num_workers)]
+        return PlacementPlan(bundles, list(range(self.num_workers)),
+                             "PACK")
+
+
+class SpreadStrategy(_BaseStrategy):
+    """``num_hosts × num_workers_per_host``, one bundle per host
+    (reference ``ColocationStrategy``): each bundle carries the whole
+    host's worker resources so co-located workers share it."""
+
+    def __init__(self, num_hosts: int, num_workers_per_host: int = 1,
+                 cpus_per_worker: int = 1, gpus_per_worker: int = 0):
+        super().__init__(cpus_per_worker, gpus_per_worker)
+        if num_hosts <= 0 or num_workers_per_host <= 0:
+            raise ValueError("num_hosts and num_workers_per_host must "
+                             "be positive")
+        self.num_hosts = num_hosts
+        self.num_workers_per_host = num_workers_per_host
+
+    def plan(self) -> PlacementPlan:
+        per_host = {
+            "CPU": float(self.cpus_per_worker *
+                         self.num_workers_per_host)}
+        if self.gpus_per_worker:
+            per_host["GPU"] = float(self.gpus_per_worker *
+                                    self.num_workers_per_host)
+        bundles = [dict(per_host) for _ in range(self.num_hosts)]
+        worker_to_bundle = [h for h in range(self.num_hosts)
+                            for _ in range(self.num_workers_per_host)]
+        return PlacementPlan(bundles, worker_to_bundle,
+                             "STRICT_SPREAD")

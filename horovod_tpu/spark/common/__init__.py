@@ -1,0 +1,10 @@
+"""Shared Spark-estimator machinery (reference ``horovod/spark/common/``):
+``store`` (artifact/dataset storage), ``params`` (estimator params),
+``backend`` (how the distributed training fn is executed),
+``serialization`` (model <-> bytes).
+"""
+
+from .backend import Backend, LocalBackend, SparkBackend  # noqa: F401
+from .params import EstimatorParams  # noqa: F401
+from .store import (DBFSLocalStore, FilesystemStore, HDFSStore,  # noqa: F401
+                    LocalStore, Store)

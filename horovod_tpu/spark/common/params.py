@@ -1,0 +1,92 @@
+"""Estimator hyper-parameters.
+
+Reference parity: ``horovod/spark/common/params.py`` —
+``EstimatorParams`` defines the shared param surface (num_proc, model,
+store, feature/label columns, batch size, epochs, validation split,
+shuffle, verbose, callbacks, custom objects) with getter/setter pairs
+in the Spark ML ``Params`` style.  The reference builds on
+``pyspark.ml.param``; this build keeps the same ``setX``/``getX``
+surface over plain attributes so the estimators work (and are
+testable) with or without pyspark.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["EstimatorParams"]
+
+
+class EstimatorParams:
+    """Shared estimator params with reference-style accessors:
+    ``est.setEpochs(3).setBatchSize(32)`` chains, ``est.getEpochs()``
+    reads, and keyword construction works too."""
+
+    _param_names = [
+        "num_proc", "model", "store", "backend", "loss", "metrics",
+        "optimizer", "feature_cols", "label_cols", "validation",
+        "batch_size", "epochs", "verbose", "shuffle", "callbacks",
+        "custom_objects", "run_id", "train_steps_per_epoch",
+        "validation_steps_per_epoch", "sample_weight_col",
+    ]
+
+    _defaults: Dict[str, Any] = {
+        "num_proc": None, "model": None, "store": None, "backend": None,
+        "loss": None, "metrics": [], "optimizer": None,
+        "feature_cols": ["features"], "label_cols": ["label"],
+        "validation": None, "batch_size": 32, "epochs": 1,
+        "verbose": 1, "shuffle": True, "callbacks": [],
+        "custom_objects": None, "run_id": None,
+        "train_steps_per_epoch": None,
+        "validation_steps_per_epoch": None, "sample_weight_col": None,
+    }
+
+    def __init__(self, **kwargs):
+        for name in self._param_names:
+            default = self._defaults[name]
+            setattr(self, name,
+                    list(default) if isinstance(default, list)
+                    else default)
+        unknown = set(kwargs) - set(self._param_names)
+        if unknown:
+            raise ValueError("unknown estimator params: %s"
+                             % sorted(unknown))
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # Reference-style accessors: setNumProc/getNumProc for every param.
+    @staticmethod
+    def _camel(name: str) -> str:
+        return "".join(p.capitalize() for p in name.split("_"))
+
+    def __getattr__(self, item):
+        # only called for missing attributes: resolve setX/getX
+        if item.startswith("set") or item.startswith("get"):
+            kind, camel = item[:3], item[3:]
+            for name in object.__getattribute__(self, "_param_names"):
+                if self._camel(name) == camel:
+                    if kind == "get":
+                        return lambda: getattr(self, name)
+
+                    def setter(value, _name=name):
+                        setattr(self, _name, value)
+                        return self
+                    return setter
+        raise AttributeError(item)
+
+    def _check_params(self):
+        if self.model is None:
+            raise ValueError("model is required")
+        if self.store is None:
+            raise ValueError("store is required (e.g. "
+                             "Store.create('/tmp/hvd_store'))")
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if self.epochs <= 0:
+            raise ValueError("epochs must be positive")
+        if not self.feature_cols or not self.label_cols:
+            raise ValueError("feature_cols and label_cols are required")
+
+    def _params_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name)
+                for name in self._param_names}

@@ -1,0 +1,65 @@
+"""Model (de)serialization for the estimators.
+
+Reference parity: ``horovod/spark/common/serialization.py`` /
+``horovod/spark/keras/util.py`` — models cross the driver→worker and
+worker→store boundaries as bytes.  Keras models ride the ``.keras``
+saved format; torch models ride ``torch.save`` of the module (and
+``state_dict`` for checkpoints); generic payloads ride pickle.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import tempfile
+from typing import Any
+
+__all__ = ["serialize_keras_model", "deserialize_keras_model",
+           "serialize_torch_model", "deserialize_torch_model",
+           "serialize_generic", "deserialize_generic"]
+
+
+def serialize_keras_model(model) -> bytes:
+    import keras  # noqa: F401
+    fd, path = tempfile.mkstemp(suffix=".keras")
+    os.close(fd)
+    try:
+        model.save(path)
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        os.remove(path)
+
+
+def deserialize_keras_model(data: bytes, custom_objects=None):
+    import keras
+    fd, path = tempfile.mkstemp(suffix=".keras")
+    os.close(fd)
+    try:
+        with open(path, "wb") as f:
+            f.write(data)
+        return keras.models.load_model(path,
+                                       custom_objects=custom_objects)
+    finally:
+        os.remove(path)
+
+
+def serialize_torch_model(model) -> bytes:
+    import torch
+    buf = io.BytesIO()
+    torch.save(model, buf)
+    return buf.getvalue()
+
+
+def deserialize_torch_model(data: bytes):
+    import torch
+    return torch.load(io.BytesIO(data), weights_only=False)
+
+
+def serialize_generic(obj: Any) -> bytes:
+    return pickle.dumps(obj)
+
+
+def deserialize_generic(data: bytes) -> Any:
+    return pickle.loads(data)

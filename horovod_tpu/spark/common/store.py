@@ -1,0 +1,236 @@
+"""Estimator storage abstraction.
+
+Reference parity: ``horovod/spark/common/store.py`` — a ``Store`` knows
+where intermediate training data, run artifacts, and checkpoints live
+(``get_train_data_path``/``get_val_data_path``/``get_run_path``/
+``get_checkpoint_path``, ``exists``/``read``/``write_text``,
+``sync_fn``), with concrete stores for the local filesystem
+(``LocalStore``), HDFS (``HDFSStore``), and Databricks DBFS
+(``DBFSLocalStore``).  The reference materializes DataFrames through
+Petastorm; this build's native dataset format is **parquet via
+pyarrow** (read sharded by row on the workers), which needs no extra
+dependency and feeds numpy/JAX directly.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+__all__ = ["Store", "FilesystemStore", "LocalStore", "HDFSStore",
+           "DBFSLocalStore"]
+
+
+class Store:
+    """Base class (reference ``Store``): path layout +  IO primitives.
+
+    Layout under ``prefix_path``:
+      ``intermediate_train_data/`` — materialized training parquet
+      ``intermediate_val_data/``   — materialized validation parquet
+      ``runs/<run_id>/``           — per-run artifacts (checkpoints,
+                                     logs, final model)
+    """
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+
+    # -- path layout (reference get_*_path methods) --------------------
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        p = os.path.join(self.prefix_path, "intermediate_train_data")
+        return p if idx is None else "%s.%d" % (p, idx)
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        p = os.path.join(self.prefix_path, "intermediate_val_data")
+        return p if idx is None else "%s.%d" % (p, idx)
+
+    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+        p = os.path.join(self.prefix_path, "intermediate_test_data")
+        return p if idx is None else "%s.%d" % (p, idx)
+
+    def get_runs_path(self) -> str:
+        return os.path.join(self.prefix_path, "runs")
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self.get_runs_path(), run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id),
+                            self.checkpoint_filename())
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def checkpoint_filename(self) -> str:
+        return "checkpoint.bin"
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        try:
+            return any(n.endswith(".parquet")
+                       for n in self.listdir(path))
+        except OSError:
+            return False
+
+    # -- IO primitives (implemented by concrete stores) ----------------
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes):
+        raise NotImplementedError
+
+    def listdir(self, path: str):
+        raise NotImplementedError
+
+    def makedirs(self, path: str):
+        raise NotImplementedError
+
+    def delete(self, path: str):
+        raise NotImplementedError
+
+    def sync_fn(self, run_id: str):
+        """Return a callable(local_dir) that publishes a worker's local
+        artifacts into the store's run dir (reference ``sync_fn``)."""
+        raise NotImplementedError
+
+    # -- factory (reference Store.create) ------------------------------
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith("dbfs:/") or \
+                prefix_path.startswith("/dbfs"):
+            return DBFSLocalStore(prefix_path, *args, **kwargs)
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Store over a mounted POSIX filesystem (reference
+    ``FilesystemStore``): plain ``os``/``shutil`` IO."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(os.path.abspath(
+            prefix_path[len("file://"):] if
+            prefix_path.startswith("file://") else prefix_path))
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def listdir(self, path: str):
+        return sorted(os.listdir(path))
+
+    def makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id)
+
+        def fn(local_dir: str):
+            os.makedirs(run_path, exist_ok=True)
+            for root, _, files in os.walk(local_dir):
+                rel = os.path.relpath(root, local_dir)
+                dst_root = (run_path if rel == "." else
+                            os.path.join(run_path, rel))
+                os.makedirs(dst_root, exist_ok=True)
+                for name in files:
+                    shutil.copy2(os.path.join(root, name),
+                                 os.path.join(dst_root, name))
+
+        return fn
+
+
+class LocalStore(FilesystemStore):
+    """Local-FS store (reference ``LocalStore``)."""
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS mounted under ``/dbfs`` (reference
+    ``DBFSLocalStore``): same POSIX IO, normalized prefix."""
+
+    def __init__(self, prefix_path: str):
+        if prefix_path.startswith("dbfs:/"):
+            prefix_path = "/dbfs/" + prefix_path[len("dbfs:/"):].lstrip("/")
+        super().__init__(prefix_path)
+
+
+class HDFSStore(Store):
+    """HDFS store (reference ``HDFSStore``), via ``pyarrow.fs``.
+
+    Requires a reachable HDFS (libhdfs); constructing one without it
+    raises with instructions, keeping the rest of the package usable.
+    """
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None):
+        super().__init__(prefix_path)
+        try:
+            from pyarrow import fs as pafs
+        except ImportError as exc:  # pragma: no cover
+            raise ImportError("HDFSStore requires pyarrow") from exc
+        try:
+            self._fs = pafs.HadoopFileSystem(
+                host or "default", port or 0, user=user)
+        except Exception as exc:  # pragma: no cover - needs a cluster
+            raise RuntimeError(
+                "HDFSStore could not connect to HDFS (is libhdfs / a "
+                "cluster available?): %s" % exc) from exc
+
+    def exists(self, path: str) -> bool:  # pragma: no cover - needs hdfs
+        from pyarrow import fs as pafs
+        info = self._fs.get_file_info([path])[0]
+        return info.type != pafs.FileType.NotFound
+
+    def read(self, path: str) -> bytes:  # pragma: no cover
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):  # pragma: no cover
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)
+
+    def listdir(self, path: str):  # pragma: no cover
+        from pyarrow import fs as pafs
+        sel = pafs.FileSelector(path)
+        return sorted(i.path for i in self._fs.get_file_info(sel))
+
+    def makedirs(self, path: str):  # pragma: no cover
+        self._fs.create_dir(path, recursive=True)
+
+    def delete(self, path: str):  # pragma: no cover
+        self._fs.delete_dir_contents(path, missing_dir_ok=True)
+
+    def sync_fn(self, run_id: str):  # pragma: no cover
+        run_path = self.get_run_path(run_id)
+
+        def fn(local_dir: str):
+            for root, _, files in os.walk(local_dir):
+                rel = os.path.relpath(root, local_dir)
+                dst_root = (run_path if rel == "." else
+                            os.path.join(run_path, rel))
+                for name in files:
+                    with open(os.path.join(root, name), "rb") as f:
+                        self.write(os.path.join(dst_root, name),
+                                   f.read())
+
+        return fn

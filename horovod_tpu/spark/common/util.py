@@ -1,0 +1,87 @@
+"""Dataset materialization + sharded reading for the estimators.
+
+Reference parity: ``horovod/spark/common/util.py`` — the reference
+materializes a Spark DataFrame to Parquet (via Petastorm) and each
+worker reads its shard.  Here the writer accepts a Spark **or** pandas
+DataFrame (parquet via Spark's writer or pyarrow respectively) and
+workers read a row-sharded numpy view with pyarrow — the natural feed
+into numpy/JAX/keras/torch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["materialize_dataframe", "read_parquet_shard",
+           "check_validation"]
+
+
+def _is_spark_df(df) -> bool:
+    mod = type(df).__module__
+    return mod.startswith("pyspark.")
+
+
+def materialize_dataframe(df, path: str, store,
+                          partitions: Optional[int] = None):
+    """Write ``df`` (Spark or pandas) as a parquet dataset at ``path``
+    inside ``store``; skips rewrite if already materialized there."""
+    if store.is_parquet_dataset(path):
+        store.delete(path)
+    if _is_spark_df(df):
+        writer = df.repartition(partitions) if partitions else df
+        writer.write.mode("overwrite").parquet(path)
+        return
+    # pandas path (LocalBackend / tests)
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    store.makedirs(path)
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    pq.write_table(table, os.path.join(path, "part-00000.parquet"))
+
+
+def read_parquet_shard(path: str, rank: int, size: int,
+                       feature_cols: Sequence[str],
+                       label_cols: Sequence[str],
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Read rows ``rank::size`` of the parquet dataset into
+    ``(features, labels)`` float32 arrays.  Multiple feature columns
+    are stacked along the last axis; a single column holding
+    fixed-length lists becomes a 2-D array."""
+    import pyarrow.parquet as pq
+    files = sorted(os.path.join(path, n) for n in os.listdir(path)
+                   if n.endswith(".parquet"))
+    if not files:
+        raise FileNotFoundError("no parquet files under %s" % path)
+    tables = [pq.read_table(f, columns=list(feature_cols) +
+                            list(label_cols)) for f in files]
+    import pyarrow as pa
+    table = pa.concat_tables(tables)
+
+    def cols_to_array(cols: Sequence[str]) -> np.ndarray:
+        arrays: List[np.ndarray] = []
+        for c in cols:
+            col = table.column(c).to_numpy(zero_copy_only=False)
+            if col.dtype == object:  # list column → 2-D
+                col = np.stack([np.asarray(v, np.float32) for v in col])
+            arrays.append(col.astype(np.float32))
+        if len(arrays) == 1:
+            return arrays[0]
+        return np.stack(arrays, axis=-1)
+
+    x = cols_to_array(feature_cols)[rank::size]
+    y = cols_to_array(label_cols)[rank::size]
+    return x, y
+
+
+def check_validation(validation) -> float:
+    """Normalize the ``validation`` param (reference semantics: a float
+    in (0,1) = split fraction; None = no validation)."""
+    if validation is None:
+        return 0.0
+    v = float(validation)
+    if not 0.0 < v < 1.0:
+        raise ValueError("validation must be a fraction in (0, 1)")
+    return v

@@ -1,0 +1,200 @@
+"""Spark Keras estimator.
+
+Reference parity: ``horovod/spark/keras/__init__.py``
+(``KerasEstimator`` / ``KerasModel``): ``est.fit(df)`` materializes the
+DataFrame into the store, trains the Keras model data-parallel — one
+world rank per task, gradients averaged through the framework's
+``DistributedOptimizer`` — and returns a ``KerasModel`` whose
+``transform(df)`` appends predictions.
+
+Works through any ``Backend``: ``SparkBackend`` (barrier tasks) or
+``LocalBackend`` (the launcher's local multi-process world — also the
+test path, mirroring the reference's local-mode-Spark tests).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from ..common.backend import (LocalBackend, SparkBackend,
+                              has_active_spark)
+from ..common.params import EstimatorParams
+from ..common.serialization import (deserialize_keras_model,
+                                    serialize_keras_model)
+from ..common.util import (check_validation, materialize_dataframe,
+                           read_parquet_shard)
+
+__all__ = ["KerasEstimator", "KerasModel"]
+
+
+def _keras_train_fn(payload):
+    """Per-rank training body (top-level: must be picklable)."""
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    try:
+        import keras
+        model = deserialize_keras_model(
+            payload["model"], custom_objects=payload["custom_objects"])
+        optimizer = (keras.optimizers.get(payload["optimizer"])
+                     if payload["optimizer"] is not None
+                     else model.optimizer)
+        if optimizer is None:
+            raise ValueError("model is not compiled and no optimizer "
+                             "was given to KerasEstimator")
+        loss = payload["loss"] if payload["loss"] is not None \
+            else model.loss
+        dist = hvd.DistributedOptimizer(optimizer)
+        model.compile(optimizer=dist, loss=loss,
+                      metrics=payload["metrics"])
+
+        x, y = read_parquet_shard(
+            payload["train_path"], hvd.rank(), hvd.size(),
+            payload["feature_cols"], payload["label_cols"])
+        val_frac = payload["validation"]
+        fit_kwargs = dict(batch_size=payload["batch_size"],
+                          epochs=payload["epochs"],
+                          verbose=payload["verbose"]
+                          if hvd.rank() == 0 else 0,
+                          shuffle=payload["shuffle"])
+        if val_frac:
+            fit_kwargs["validation_split"] = val_frac
+        callbacks = [
+            hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd.callbacks.MetricAverageCallback(),
+        ] + list(payload["callbacks"])
+        history = model.fit(x, y, callbacks=callbacks, **fit_kwargs)
+        out = {"history": {k: [float(v) for v in vs] for k, vs in
+                           history.history.items()},
+               "model": None}
+        if hvd.rank() == 0:
+            # the wrapped optimizer class is process-local (built by
+            # subclassing at runtime) — swap the plain class back in,
+            # carrying slot state, so the artifact deserializes anywhere
+            base_cls = type(dist).__mro__[1]
+            plain = base_cls.from_config(dist.get_config())
+            if getattr(dist, "built", False):
+                plain.build(model.trainable_variables)
+                for src, dst in zip(dist.variables, plain.variables):
+                    dst.assign(src)
+            # keras serializes the compile-time config, so a recompile
+            # (not attribute swap) is what changes the artifact
+            model.compile(optimizer=plain, loss=loss,
+                          metrics=payload["metrics"])
+            out["model"] = serialize_keras_model(model)
+        return out
+    finally:
+        hvd.shutdown()
+
+
+class KerasEstimator(EstimatorParams):
+    """Trains a Keras model over a DataFrame (reference
+    ``KerasEstimator``).  Params via keywords or reference-style
+    setters (``setEpochs`` …)."""
+
+    def fit(self, df=None) -> "KerasModel":
+        self._check_params()
+        check_validation(self.validation)
+        backend = self.backend or (
+            SparkBackend(self.num_proc) if has_active_spark()
+            else LocalBackend(self.num_proc or 1))
+        run_id = self.run_id or ("keras_" + uuid.uuid4().hex[:8])
+        train_path = self.store.get_train_data_path()
+        if df is not None:
+            materialize_dataframe(df, train_path, self.store)
+        payload = {
+            "model": serialize_keras_model(self.model),
+            "optimizer": self.optimizer,
+            "loss": self.loss,
+            "metrics": list(self.metrics),
+            "custom_objects": self.custom_objects,
+            "train_path": train_path,
+            "feature_cols": list(self.feature_cols),
+            "label_cols": list(self.label_cols),
+            "validation": check_validation(self.validation),
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "verbose": self.verbose,
+            "shuffle": self.shuffle,
+            "callbacks": list(self.callbacks),
+        }
+        results = backend.run(_keras_train_fn, args=(payload,))
+        rank0 = results[0]
+        model = deserialize_keras_model(rank0["model"],
+                                        custom_objects=self.custom_objects)
+        # publish the final model into the store's run dir
+        ckpt = self.store.get_checkpoint_path(run_id)
+        self.store.write(ckpt, rank0["model"])
+        return KerasModel(model=model,
+                          feature_cols=list(self.feature_cols),
+                          label_cols=list(self.label_cols),
+                          history=rank0["history"], run_id=run_id,
+                          custom_objects=self.custom_objects)
+
+
+class KerasModel:
+    """Fitted transformer (reference ``KerasModel``): ``transform(df)``
+    appends prediction columns; ``predict`` serves numpy/pandas."""
+
+    def __init__(self, model=None, feature_cols=None, label_cols=None,
+                 history=None, run_id: Optional[str] = None,
+                 custom_objects=None):
+        self.model = model
+        self.feature_cols = feature_cols or ["features"]
+        self.label_cols = label_cols or ["label"]
+        self.history = history or {}
+        self.run_id = run_id
+        self.custom_objects = custom_objects
+
+    def getModel(self):
+        return self.model
+
+    def _features_of(self, pdf) -> np.ndarray:
+        cols = [np.asarray(pdf[c].tolist(), np.float32)
+                for c in self.feature_cols]
+        if len(cols) == 1:
+            return cols[0]
+        return np.stack(cols, axis=-1)
+
+    def predict(self, data) -> np.ndarray:
+        if hasattr(data, "columns"):  # pandas
+            data = self._features_of(data)
+        return np.asarray(self.model.predict(
+            np.asarray(data, np.float32), verbose=0))
+
+    def transform(self, df):
+        if type(df).__module__.startswith("pyspark."):
+            model_bytes = serialize_keras_model(self.model)
+            feature_cols = self.feature_cols
+            label_cols = self.label_cols
+            custom_objects = self.custom_objects
+
+            def map_fn(iterator):
+                m = deserialize_keras_model(model_bytes,
+                                            custom_objects)
+                for pdf in iterator:
+                    cols = [np.asarray(pdf[c].tolist(), np.float32)
+                            for c in feature_cols]
+                    x = cols[0] if len(cols) == 1 \
+                        else np.stack(cols, axis=-1)
+                    pred = np.asarray(m.predict(x, verbose=0))
+                    for i, lc in enumerate(label_cols):
+                        p = pred if pred.ndim == 1 else pred[..., i]
+                        pdf[lc + "__output"] = list(p)
+                    yield pdf
+            import pyspark.sql.types as T  # noqa: F401
+            schema = df.schema
+            for lc in self.label_cols:
+                import pyspark.sql.types as T
+                schema = schema.add(lc + "__output", T.FloatType())
+            return df.mapInPandas(map_fn, schema=schema)
+        # pandas path
+        out = df.copy()
+        pred = self.predict(df)
+        for i, lc in enumerate(self.label_cols):
+            p = pred if pred.ndim == 1 else pred[..., i]
+            out[lc + "__output"] = list(np.asarray(p, np.float32))
+        return out

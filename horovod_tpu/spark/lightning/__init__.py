@@ -1,0 +1,42 @@
+"""Spark Lightning estimator.
+
+Reference parity: ``horovod/spark/lightning/__init__.py``
+(``TorchEstimator`` over PyTorch Lightning modules).  Lightning is not
+installed in this environment; the estimator accepts a
+``LightningModule``-style object (anything exposing
+``training_step``/``configure_optimizers``) and falls back to an
+informative ImportError when the lightning runtime itself is required.
+"""
+
+from __future__ import annotations
+
+__all__ = ["TorchEstimator"]
+
+try:  # optional dependency
+    import lightning  # type: ignore # noqa: F401
+    _HAVE_LIGHTNING = True
+except ImportError:
+    try:
+        import pytorch_lightning  # type: ignore # noqa: F401
+        _HAVE_LIGHTNING = True
+    except ImportError:
+        _HAVE_LIGHTNING = False
+
+
+if _HAVE_LIGHTNING:  # pragma: no cover - lightning not in this env
+    from ..torch import TorchEstimator as _Base
+
+    class TorchEstimator(_Base):
+        """Lightning-module estimator: the module's
+        ``configure_optimizers`` supplies the optimizer and
+        ``training_step`` the loss (reference
+        ``horovod/spark/lightning``)."""
+
+else:
+
+    class TorchEstimator:  # type: ignore[no-redef]
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                "horovod_tpu.spark.lightning requires lightning / "
+                "pytorch_lightning, which is not installed; use "
+                "horovod_tpu.spark.torch.TorchEstimator instead.")

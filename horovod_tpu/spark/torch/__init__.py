@@ -1,0 +1,174 @@
+"""Spark Torch estimator.
+
+Reference parity: ``horovod/spark/torch/__init__.py``
+(``TorchEstimator`` / ``TorchModel``): ``est.fit(df)`` trains a torch
+module data-parallel across backend ranks with the framework's
+``DistributedOptimizer`` (per-parameter async allreduce hooks) and
+returns a ``TorchModel`` transformer.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..common.backend import (LocalBackend, SparkBackend,
+                              has_active_spark)
+from ..common.params import EstimatorParams
+from ..common.serialization import (deserialize_torch_model,
+                                    serialize_torch_model)
+from ..common.util import (check_validation, materialize_dataframe,
+                           read_parquet_shard)
+__all__ = ["TorchEstimator", "TorchModel"]
+
+
+def _torch_train_fn(payload):
+    """Per-rank training body (top-level: must be picklable)."""
+    import torch
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    try:
+        model = deserialize_torch_model(payload["model"])
+        loss_fn = payload["loss"] or torch.nn.functional.mse_loss
+        opt_factory = payload["optimizer"]
+        optimizer = (opt_factory(model.parameters()) if opt_factory
+                     else torch.optim.SGD(model.parameters(), lr=0.01))
+        optimizer = hvd.DistributedOptimizer(
+            optimizer, named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+        x, y = read_parquet_shard(
+            payload["train_path"], hvd.rank(), hvd.size(),
+            payload["feature_cols"], payload["label_cols"])
+        x = torch.from_numpy(np.ascontiguousarray(x))
+        y = torch.from_numpy(np.ascontiguousarray(y))
+        bs = payload["batch_size"]
+        history = []
+        for epoch in range(payload["epochs"]):
+            perm = (torch.randperm(len(x)) if payload["shuffle"]
+                    else torch.arange(len(x)))
+            epoch_loss, batches = 0.0, 0
+            for i in range(0, len(x), bs):
+                idx = perm[i:i + bs]
+                optimizer.zero_grad()
+                out = model(x[idx])
+                loss = loss_fn(out.squeeze(-1), y[idx].squeeze(-1))
+                loss.backward()
+                optimizer.step()
+                epoch_loss += float(loss.detach())
+                batches += 1
+            avg = epoch_loss / max(1, batches)
+            avg = float(hvd.allreduce(
+                torch.tensor(avg), op=hvd.Average,
+                name="TorchEstimator.epoch_loss.%d" % epoch))
+            history.append({"epoch": epoch, "loss": avg})
+            if payload["verbose"] and hvd.rank() == 0:
+                print("epoch %d loss %.6f" % (epoch, avg))
+        out = {"history": history, "model": None}
+        if hvd.rank() == 0:
+            out["model"] = serialize_torch_model(model)
+        return out
+    finally:
+        hvd.shutdown()
+
+
+class TorchEstimator(EstimatorParams):
+    """Trains a torch module over a DataFrame (reference
+    ``TorchEstimator``).  ``optimizer`` is a factory
+    ``params -> torch.optim.Optimizer`` (picklable, e.g. a top-level
+    function or ``functools.partial``); ``loss`` a picklable callable.
+    """
+
+    def fit(self, df=None) -> "TorchModel":
+        self._check_params()
+        check_validation(self.validation)
+        backend = self.backend or (
+            SparkBackend(self.num_proc) if has_active_spark()
+            else LocalBackend(self.num_proc or 1))
+        run_id = self.run_id or ("torch_" + uuid.uuid4().hex[:8])
+        train_path = self.store.get_train_data_path()
+        if df is not None:
+            materialize_dataframe(df, train_path, self.store)
+        payload = {
+            "model": serialize_torch_model(self.model),
+            "optimizer": self.optimizer,
+            "loss": self.loss,
+            "train_path": train_path,
+            "feature_cols": list(self.feature_cols),
+            "label_cols": list(self.label_cols),
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "verbose": self.verbose,
+            "shuffle": self.shuffle,
+        }
+        results = backend.run(_torch_train_fn, args=(payload,))
+        rank0 = results[0]
+        model = deserialize_torch_model(rank0["model"])
+        ckpt = self.store.get_checkpoint_path(run_id)
+        self.store.write(ckpt, rank0["model"])
+        return TorchModel(model=model,
+                          feature_cols=list(self.feature_cols),
+                          label_cols=list(self.label_cols),
+                          history=rank0["history"], run_id=run_id)
+
+
+class TorchModel:
+    """Fitted transformer (reference ``TorchModel``)."""
+
+    def __init__(self, model=None, feature_cols=None, label_cols=None,
+                 history=None, run_id: Optional[str] = None):
+        self.model = model
+        self.feature_cols = feature_cols or ["features"]
+        self.label_cols = label_cols or ["label"]
+        self.history = history or []
+        self.run_id = run_id
+
+    def getModel(self):
+        return self.model
+
+    def predict(self, data) -> np.ndarray:
+        import torch
+        if hasattr(data, "columns"):
+            cols = [np.asarray(data[c].tolist(), np.float32)
+                    for c in self.feature_cols]
+            data = cols[0] if len(cols) == 1 \
+                else np.stack(cols, axis=-1)
+        with torch.no_grad():
+            out = self.model(torch.from_numpy(
+                np.asarray(data, np.float32)))
+        return out.numpy()
+
+    def transform(self, df):
+        if type(df).__module__.startswith("pyspark."):
+            model_bytes = serialize_torch_model(self.model)
+            feature_cols = self.feature_cols
+            label_cols = self.label_cols
+
+            def map_fn(iterator):
+                import torch
+                m = deserialize_torch_model(model_bytes)
+                for pdf in iterator:
+                    cols = [np.asarray(pdf[c].tolist(), np.float32)
+                            for c in feature_cols]
+                    x = cols[0] if len(cols) == 1 \
+                        else np.stack(cols, axis=-1)
+                    with torch.no_grad():
+                        pred = m(torch.from_numpy(x)).numpy()
+                    for i, lc in enumerate(label_cols):
+                        p = pred if pred.ndim == 1 else pred[..., i]
+                        pdf[lc + "__output"] = list(p)
+                    yield pdf
+            import pyspark.sql.types as T
+            schema = df.schema
+            for lc in self.label_cols:
+                schema = schema.add(lc + "__output", T.FloatType())
+            return df.mapInPandas(map_fn, schema=schema)
+        out = df.copy()
+        pred = self.predict(df)
+        for i, lc in enumerate(self.label_cols):
+            p = pred if pred.ndim == 1 else pred[..., i]
+            out[lc + "__output"] = list(np.asarray(p, np.float32))
+        return out

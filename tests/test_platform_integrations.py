@@ -47,3 +47,104 @@ def test_spark_mapper_is_constructible():
     mapper = _make_mapper(lambda: 1, (), {}, 4, "1.2.3.4:5", "s",
                           {"X": "1"})
     assert callable(mapper)
+
+
+def test_pack_strategy_plan():
+    from horovod_tpu.ray.strategy import PackStrategy
+    p = PackStrategy(num_workers=3, cpus_per_worker=2).plan()
+    assert p.strategy == "PACK"
+    assert p.bundles == [{"CPU": 2.0}] * 3
+    assert p.worker_to_bundle == [0, 1, 2]
+
+
+def test_spread_strategy_plan():
+    from horovod_tpu.ray.strategy import SpreadStrategy
+    p = SpreadStrategy(num_hosts=2, num_workers_per_host=3,
+                       cpus_per_worker=1, gpus_per_worker=1).plan()
+    assert p.strategy == "STRICT_SPREAD"
+    assert p.bundles == [{"CPU": 3.0, "GPU": 3.0}] * 2
+    assert p.worker_to_bundle == [0, 0, 0, 1, 1, 1]
+    assert p.num_workers == 6
+
+
+def test_strategy_validation():
+    import pytest
+    from horovod_tpu.ray.strategy import PackStrategy, SpreadStrategy
+    with pytest.raises(ValueError):
+        PackStrategy(0)
+    with pytest.raises(ValueError):
+        SpreadStrategy(1, 0)
+
+
+def test_ray_host_discovery_slot_math():
+    from horovod_tpu.ray.elastic import RayHostDiscovery
+
+    class FakeDiscovery(RayHostDiscovery):
+        def _nodes(self):
+            return [
+                {"Alive": True, "NodeManagerAddress": "10.0.0.1",
+                 "Resources": {"CPU": 8.0, "GPU": 2.0}},
+                {"Alive": True, "NodeManagerAddress": "10.0.0.2",
+                 "Resources": {"CPU": 3.0}},
+                {"Alive": False, "NodeManagerAddress": "10.0.0.3",
+                 "Resources": {"CPU": 64.0}},
+            ]
+
+    cpu = FakeDiscovery(use_gpu=False, cpus_per_slot=2)
+    assert cpu.find_available_hosts_and_slots() == {
+        "10.0.0.1": 4, "10.0.0.2": 1}
+    gpu = FakeDiscovery(use_gpu=True, gpus_per_slot=1)
+    assert gpu.find_available_hosts_and_slots() == {"10.0.0.1": 2}
+
+
+def test_elastic_ray_executor_min_np_guard():
+    import pytest
+    from horovod_tpu.ray.elastic import (ElasticRayExecutor,
+                                         RayHostDiscovery)
+
+    class Empty(RayHostDiscovery):
+        def _nodes(self):
+            return []
+
+    ex = ElasticRayExecutor(min_np=2, override_discovery=Empty())
+    with pytest.raises(RuntimeError):
+        ex._current_np()
+
+
+def test_ray_executor_requires_worker_spec():
+    import pytest
+    from horovod_tpu.ray import RayExecutor
+    with pytest.raises(ValueError):
+        RayExecutor()
+
+
+def test_elastic_ray_retry_budget(monkeypatch):
+    from horovod_tpu.ops.engine import HorovodInternalError
+    from horovod_tpu.ray.elastic import (ElasticRayExecutor,
+                                         RayHostDiscovery)
+
+    class One(RayHostDiscovery):
+        def _nodes(self):
+            return [{"Alive": True, "NodeManagerAddress": "h",
+                     "Resources": {"CPU": 1.0}}]
+
+    ex = ElasticRayExecutor(min_np=1, retries=2, cooldown_s=0,
+                            override_discovery=One())
+    attempts = []
+
+    class FakeExecutor:
+        def run(self, fn, args=(), kwargs=None):
+            attempts.append(1)
+            raise HorovodInternalError("boom")
+
+        def shutdown(self):
+            pass
+
+    monkeypatch.setattr(ElasticRayExecutor, "start",
+                        lambda self: setattr(self, "_executor",
+                                             FakeExecutor()))
+    import pytest
+    with pytest.raises(HorovodInternalError):
+        ex.run(lambda: None)
+    # initial attempt + 2 retries
+    assert len(attempts) == 3
