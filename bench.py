@@ -30,7 +30,8 @@ def main():
     on_accel = platform not in ("cpu",)
     # CPU fallback keeps the harness runnable in dev; real numbers come
     # from the TPU chip.
-    batch = 64 if on_accel else 8
+    batch = 128 if on_accel else 8  # measured best MXU occupancy
+                                    # (vs 64/192/256) on one chip
     image = 224 if on_accel else 64
     steps = 30 if on_accel else 3
     warmup = 5 if on_accel else 1
@@ -54,7 +55,6 @@ def main():
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
 
-    @jax.jit
     def train_step(params, batch_stats, opt_state, batch):
         def loss(p):
             nll, new_state = resnet_loss_fn(
@@ -66,6 +66,9 @@ def main():
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, nll
+
+    # donated state buffers: in-place updates, no HBM copies per step
+    train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     fetch = jax.jit(lambda v: v.astype(jnp.float32))
 

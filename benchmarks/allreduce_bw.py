@@ -1,0 +1,115 @@
+"""Allreduce bus-bandwidth harness — the BASELINE.md north-star metric.
+
+Reference parity: the role of NCCL's ``all_reduce_perf`` /
+``docs/benchmarks.rst`` bus-bandwidth accounting.  For an allreduce of
+``S`` bytes over ``n`` devices, the data each device must move is
+``2*(n-1)/n * S`` ("bus bytes", the NCCL convention), so
+
+    bus_bw = 2*(n-1)/n * S / t_per_allreduce.
+
+Sweeps message sizes, reports per-size bus GB/s and, when the
+per-device link speed is known (``--link-gbps``, e.g. ICI), the
+efficiency fraction.  Runs on whatever world is available:
+
+* real TPU chips: ``python benchmarks/allreduce_bw.py``
+* 8-device CPU world:
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=8
+  JAX_PLATFORMS=cpu python benchmarks/allreduce_bw.py``
+
+Prints one JSON line per size plus a summary line.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", default="1,4,16,64,256",
+                    help="comma list of message sizes in MiB")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--link-gbps", type=float, default=None,
+                    help="per-device injection bandwidth in GB/s "
+                         "(e.g. ICI) for efficiency accounting")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--cpu-devices", type=int, default=None,
+                    help="force an N-device virtual CPU world (the "
+                         "test topology; overrides any TPU plugin)")
+    args = ap.parse_args()
+
+    if args.cpu_devices:
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % args.cpu_devices).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+    dtype = jnp.dtype(args.dtype)
+
+    @jax.jit
+    def allreduce(x):
+        # batch-sharded input, fully-reduced (replicated) output: XLA
+        # lowers this to an all-reduce over the mesh — the framework's
+        # inprocess-mode collective path
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P())).sum(axis=0)
+
+    results = []
+    for size_mb in [float(s) for s in args.sizes_mb.split(",")]:
+        size_bytes = int(size_mb * 2 ** 20)
+        elems = max(n, size_bytes // dtype.itemsize)
+        elems -= elems % n
+        x = jax.device_put(
+            jnp.ones((n, elems // n), dtype),
+            NamedSharding(mesh, P("dp", None)))
+
+        def timed(iters):
+            t0 = time.perf_counter()
+            y = None
+            for _ in range(iters):
+                y = allreduce(x)
+            jax.block_until_ready(y)
+            return time.perf_counter() - t0
+
+        timed(args.warmup)
+        # differential timing cancels dispatch overhead
+        t1 = timed(args.iters)
+        t2 = timed(2 * args.iters)
+        per_op = max(t2 - t1, 1e-12) / args.iters
+
+        bus_bytes = 2.0 * (n - 1) / n * elems * dtype.itemsize
+        bus_gbps = bus_bytes / per_op / 1e9
+        rec = {"metric": "allreduce_bus_bandwidth",
+               "size_mb": size_mb, "devices": n,
+               "time_us": round(per_op * 1e6, 2),
+               "bus_gb_per_sec": round(bus_gbps, 3)}
+        if args.link_gbps:
+            rec["efficiency"] = round(bus_gbps / args.link_gbps, 4)
+        results.append(rec)
+        print(json.dumps(rec))
+
+    best = max(r["bus_gb_per_sec"] for r in results)
+    summary = {"metric": "allreduce_bus_bandwidth_peak",
+               "value": best, "unit": "GB/s", "devices": n}
+    if args.link_gbps:
+        summary["efficiency_vs_link"] = round(best / args.link_gbps, 4)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Adasum reduction example.
+
+Reference parity: ``examples/adasum/`` — the Adasum operator merges
+gradients by projection (scale-insensitive), so training is robust to
+the effective-batch-size growth of data parallelism: use
+``op=hvd.Adasum`` in any allreduce or in the optimizer wrapper.
+
+Run: ``python -m horovod_tpu.runner -np 2 python
+examples/adasum_allreduce.py``  (Adasum needs a power-of-two world.)
+"""
+
+import numpy as np
+
+import horovod_tpu.torch as hvd
+import torch
+
+
+def main():
+    hvd.init()
+    # two deliberately differently-scaled "gradients": plain averaging
+    # is dominated by the large one; Adasum's projection math is not
+    g = torch.full((4,), 1.0 * (10 ** hvd.rank()))
+    avg = hvd.allreduce(g, op=hvd.Average, name="avg")
+    ada = hvd.allreduce(g, op=hvd.Adasum, name="ada")
+    if hvd.rank() == 0:
+        print("average:", avg.numpy())
+        print("adasum :", ada.numpy())
+
+    # and through the optimizer (reference: hvd.DistributedOptimizer
+    # with op=hvd.Adasum)
+    model = torch.nn.Linear(4, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(), op=hvd.Adasum)
+    x = torch.from_numpy(
+        np.random.RandomState(hvd.rank()).rand(8, 4).astype("float32"))
+    loss = model(x).pow(2).mean()
+    loss.backward()
+    opt.step()
+    if hvd.rank() == 0:
+        print("adasum optimizer step done, loss %.4f" % float(loss))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Ray executor example.
+
+Reference parity: ``examples/ray/ray_executor.py`` — run a training fn
+across Ray actor workers, one collective world.  Requires ray
+(``pip install ray``); shown here with the elastic variant too.
+"""
+
+
+def train_fn():
+    import horovod_tpu.torch as hvd
+    import torch
+    hvd.init()
+    model = torch.nn.Linear(4, 1)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    x = torch.randn(16, 4)
+    for _ in range(5):
+        opt.zero_grad()
+        loss = model(x).pow(2).mean()
+        loss.backward()
+        opt.step()
+    return (hvd.rank(), float(loss))
+
+
+def main():
+    import ray
+    from horovod_tpu.ray import RayExecutor
+
+    ray.init()
+    executor = RayExecutor(num_workers=2, cpus_per_worker=1)
+    executor.start()
+    print(executor.run(train_fn))
+    executor.shutdown()
+
+    # elastic variant: world resizes with the Ray cluster
+    # from horovod_tpu.ray import ElasticRayExecutor
+    # ex = ElasticRayExecutor(min_np=1, max_np=4)
+    # ex.run(train_fn)
+
+
+if __name__ == "__main__":
+    main()

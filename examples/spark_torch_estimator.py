@@ -1,0 +1,38 @@
+"""Spark TorchEstimator example.
+
+Reference parity: ``examples/spark/pytorch/pytorch_spark_mnist.py`` —
+fit a torch model over a DataFrame through the estimator API.  With
+pyspark installed and a session active the estimator runs on barrier
+tasks; without it, this example uses the LocalBackend (the launcher's
+real multi-process world), so it runs anywhere.
+"""
+
+import numpy as np
+import pandas as pd
+import torch
+
+from horovod_tpu.spark.common import LocalBackend, Store
+from horovod_tpu.spark.torch import TorchEstimator
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    w = np.arange(1, 5, dtype=np.float32)
+    df = pd.DataFrame({"features": [list(r) for r in x],
+                       "label": x @ w})
+
+    store = Store.create("/tmp/horovod_tpu_spark_example")
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1),
+        store=store,
+        backend=LocalBackend(num_proc=2),  # or SparkBackend(num_proc)
+        epochs=3, batch_size=16, verbose=1)
+    fitted = est.fit(df)
+    print("history:", fitted.history)
+    out = fitted.transform(df.head(4))
+    print(out[["label", "label__output"]])
+
+
+if __name__ == "__main__":
+    main()

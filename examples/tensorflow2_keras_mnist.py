@@ -1,0 +1,49 @@
+"""Keras MNIST-style training with DistributedOptimizer + callbacks.
+
+Reference parity: ``examples/keras/keras_mnist.py`` /
+``examples/tensorflow2/tensorflow2_keras_mnist.py`` — ``model.fit``
+with the wrapped optimizer, broadcast/metric-average callbacks, and
+LR warmup, sharded synthetic data per rank.
+
+Run: ``python -m horovod_tpu.runner -np 2 python
+examples/tensorflow2_keras_mnist.py``
+"""
+
+import numpy as np
+import keras
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    hvd.init()
+    rng = np.random.RandomState(42 + hvd.rank())
+    x = rng.rand(512, 784).astype("float32")
+    y = rng.randint(0, 10, 512)
+
+    model = keras.Sequential([
+        keras.layers.Input((784,)),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    opt = keras.optimizers.SGD(0.01 * hvd.size(), momentum=0.9)
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(opt),
+        loss=keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.01 * hvd.size(), warmup_epochs=1,
+            steps_per_epoch=8, verbose=0),
+    ]
+    model.fit(x, y, batch_size=64, epochs=2, callbacks=callbacks,
+              verbose=2 if hvd.rank() == 0 else 0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -214,7 +214,19 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     elif args.hosts:
         hosts = util.parse_hosts(args.hosts)
     else:
-        hosts = [util.HostInfo("localhost", args.np or 1)]
+        # no explicit hosts: a batch scheduler allocation (LSF/Slurm)
+        # supplies them — but only if it can satisfy -np; a smaller
+        # allocation falls back to the historical localhost behavior
+        # (with a warning) instead of hard-failing slot assignment
+        hosts = util.scheduler_hosts()
+        if hosts and args.np and util.total_slots(hosts) < args.np:
+            import sys
+            print("[launcher] WARNING: scheduler allocation has %d "
+                  "slots < -np %d; launching %d local workers instead"
+                  % (util.total_slots(hosts), args.np, args.np),
+                  file=sys.stderr)
+            hosts = []
+        hosts = hosts or [util.HostInfo("localhost", args.np or 1)]
     if args.host_discovery_script or (args.min_np or args.max_np):
         from ..elastic.driver import elastic_run
         return elastic_run(args)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import base64
 import dataclasses
+import os
 import pickle
 import secrets as _secrets
 import socket
@@ -98,6 +99,119 @@ class Timeout:
     def check(self):
         if self.expired():
             raise TimeoutError("%s timed out" % self._message)
+
+
+def lsf_available() -> bool:
+    """True under an LSF allocation (reference ``util/lsf.py``)."""
+    return "LSB_MCPU_HOSTS" in os.environ or "LSB_HOSTS" in os.environ
+
+
+def parse_lsf_hosts() -> List[HostInfo]:
+    """Hosts/slots from the LSF environment (reference ``lsf.py``):
+    ``LSB_MCPU_HOSTS`` = "host1 4 host2 4"; ``LSB_HOSTS`` = one token
+    per slot."""
+    mcpu = os.environ.get("LSB_MCPU_HOSTS")
+    if mcpu:
+        toks = mcpu.split()
+        if len(toks) % 2:
+            raise ValueError("malformed LSB_MCPU_HOSTS: %r" % mcpu)
+        return [HostInfo(toks[i], int(toks[i + 1]))
+                for i in range(0, len(toks), 2)]
+    hosts = os.environ.get("LSB_HOSTS", "").split()
+    if not hosts:
+        raise ValueError("no LSF host environment found")
+    # one token per slot, possibly interleaved: count ALL occurrences
+    # per host, first-seen order (adjacent-only runs would split a
+    # host into duplicate entries and collide local_ranks)
+    counts: dict = {}
+    for h in hosts:
+        counts[h] = counts.get(h, 0) + 1
+    return [HostInfo(h, c) for h, c in counts.items()]
+
+
+def slurm_available() -> bool:
+    """True under a Slurm allocation."""
+    return "SLURM_JOB_NODELIST" in os.environ or \
+        "SLURM_NODELIST" in os.environ
+
+
+def _expand_slurm_nodelist(nodelist: str) -> List[str]:
+    """Expand "node[1-3,7],gpu01" into explicit hostnames (the subset
+    of Slurm's syntax schedulers actually emit: comma lists and one
+    [a-b,c] range block per name, zero-padded)."""
+    hosts: List[str] = []
+    i, n = 0, len(nodelist)
+    while i < n:
+        j = i
+        while j < n and nodelist[j] not in ",[":
+            j += 1
+        prefix = nodelist[i:j]
+        if j < n and nodelist[j] == "[":
+            k = nodelist.index("]", j)
+            for part in nodelist[j + 1:k].split(","):
+                if "-" in part:
+                    lo, hi = part.split("-")
+                    width = len(lo)
+                    for v in range(int(lo), int(hi) + 1):
+                        hosts.append(prefix + str(v).zfill(width))
+                else:
+                    hosts.append(prefix + part)
+            i = k + 2  # skip "]," if present
+        else:
+            if prefix:
+                hosts.append(prefix)
+            i = j + 1
+    return hosts
+
+
+def _expand_slurm_tasks(spec: str, num_hosts: int) -> List[int]:
+    """Expand SLURM_TASKS_PER_NODE "4(x2),2" into per-host counts."""
+    counts: List[int] = []
+    for part in spec.split(","):
+        if "(x" in part:
+            base, times = part.split("(x")
+            counts.extend([int(base)] * int(times.rstrip(")")))
+        else:
+            counts.append(int(part))
+    if len(counts) < num_hosts:  # pad with last
+        counts.extend([counts[-1]] * (num_hosts - len(counts)))
+    return counts[:num_hosts]
+
+
+def parse_slurm_hosts() -> List[HostInfo]:
+    """Hosts/slots from the Slurm environment."""
+    nodelist = os.environ.get("SLURM_JOB_NODELIST") or \
+        os.environ.get("SLURM_NODELIST")
+    if not nodelist:
+        raise ValueError("no Slurm host environment found")
+    hosts = _expand_slurm_nodelist(nodelist)
+    tasks = os.environ.get("SLURM_TASKS_PER_NODE") or \
+        os.environ.get("SLURM_NTASKS_PER_NODE") or "1"
+    counts = _expand_slurm_tasks(tasks, len(hosts))
+    return [HostInfo(h, c) for h, c in zip(hosts, counts)]
+
+
+def scheduler_hosts() -> List[HostInfo]:
+    """Hosts from a detected batch scheduler (LSF, then Slurm), or an
+    empty list when not running under one — the launcher's fallback
+    when no -H/--hostfile is given (reference: lsf/slurm detection in
+    ``horovod/runner/launch.py``).  A malformed scheduler environment
+    is reported loudly (then the next source is tried) rather than
+    silently degrading to single-host."""
+    import sys
+    if lsf_available():
+        try:
+            return parse_lsf_hosts()
+        except ValueError as exc:
+            print("[launcher] WARNING: LSF detected but unusable: %s"
+                  % exc, file=sys.stderr)
+    if slurm_available():
+        try:
+            return parse_slurm_hosts()
+        except ValueError as exc:
+            print("[launcher] WARNING: Slurm detected but unusable: %s"
+                  % exc, file=sys.stderr)
+    return []
 
 
 def routable_ip() -> str:

@@ -178,3 +178,54 @@ def test_programmatic_run():
     from horovod_tpu.runner import run
     results = run(rank_times_two, np=2)
     assert results == [0, 2]
+
+
+def test_lsf_host_parsing(monkeypatch):
+    from horovod_tpu.runner import util
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 2")
+    assert util.lsf_available()
+    hosts = util.parse_lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("nodeA", 4), ("nodeB", 2)]
+    monkeypatch.delenv("LSB_MCPU_HOSTS")
+    monkeypatch.setenv("LSB_HOSTS", "n1 n1 n1 n2")
+    hosts = util.parse_lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("n1", 3), ("n2", 1)]
+
+
+def test_slurm_host_parsing(monkeypatch):
+    from horovod_tpu.runner import util
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "node[01-03,07],gpu5")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "4(x3),2")
+    assert util.slurm_available()
+    hosts = util.parse_slurm_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("node01", 4), ("node02", 4), ("node03", 4), ("node07", 2),
+        ("gpu5", 2)]
+
+
+def test_scheduler_hosts_fallback(monkeypatch):
+    from horovod_tpu.runner import util
+    for var in ("LSB_MCPU_HOSTS", "LSB_HOSTS", "SLURM_JOB_NODELIST",
+                "SLURM_NODELIST"):
+        monkeypatch.delenv(var, raising=False)
+    assert util.scheduler_hosts() == []
+
+
+def test_lsf_interleaved_hosts(monkeypatch):
+    from horovod_tpu.runner import util
+    monkeypatch.delenv("LSB_MCPU_HOSTS", raising=False)
+    monkeypatch.setenv("LSB_HOSTS", "n1 n2 n1 n2")
+    hosts = util.parse_lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("n1", 2), ("n2", 2)]
+
+
+def test_scheduler_hosts_warns_on_malformed(monkeypatch, capsys):
+    from horovod_tpu.runner import util
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "host1 4 host2")  # odd tokens
+    for var in ("SLURM_JOB_NODELIST", "SLURM_NODELIST"):
+        monkeypatch.delenv(var, raising=False)
+    assert util.scheduler_hosts() == []
+    assert "LSF detected but unusable" in capsys.readouterr().err
