@@ -1,0 +1,277 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference keeps its hot device code in hand-written CUDA
+(``horovod/common/ops/cuda/cuda_kernels.cu`` — batched fusion memcpy,
+fused scale+sum).  The TPU-native equivalents live here as Pallas
+kernels (SURVEY.md §7 phase 7):
+
+* ``flash_attention`` — fused blocked attention with online softmax:
+  scores never materialize in HBM (O(seq) memory instead of O(seq²)),
+  K/V stream through VMEM block by block, matmuls hit the MXU at
+  (block_q × block_k) tiles.  This is the hot op of the transformer
+  family; the sequence-parallel ring attention composes with it (ring
+  moves KV between chips, this kernel computes each local block).
+* ``fused_scale_sum`` — the reference's fused prescale+sum kernel
+  (``ScaleAdd`` in cuda_kernels.cu): one VPU pass over fused gradient
+  buffers instead of two HBM round trips.
+
+Both run compiled on TPU and fall back to the interpreter off-TPU, so
+the CPU test world exercises the same kernel code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                       acc_scr, *, block_q: int, block_k: int,
+                       causal: bool, scale: float):
+    # grid = (bh, nq, nk): K/V stream through VMEM one block per inner
+    # step (double-buffered by the Pallas pipeline); the online-softmax
+    # state (m, l, acc) persists in VMEM scratch across the inner axis.
+    j = pl.program_id(1)
+    t = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: blocks entirely above the diagonal contribute nothing
+    block_live = jnp.logical_or(
+        jnp.logical_not(causal),
+        t * block_k <= j * block_q + block_q - 1)
+
+    @pl.when(block_live)
+    def _update():
+        # matmuls stay in the input dtype (bf16 hits the MXU at full
+        # rate; accumulation is f32 via preferred_element_type)
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = t * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_scr[:]
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_attention_fwd_flat(q, k, v, *, causal: bool, block_q: int,
+                              block_k: int, interpret: bool):
+    """(BH, S, D) → (BH, S, D), D already lane-padded."""
+    from jax.experimental.pallas import tpu as pltpu
+    bh, seq, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, seq // block_q, seq // block_k)
+    kernel = functools.partial(
+        _flash_attn_kernel, block_q=block_q, block_k=block_k,
+        causal=causal, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda i, j, t: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, causal: bool):
+    """Plain attention on (B, S, H, D): the single oracle shared with
+    the model's non-TPU path and the SP tests."""
+    from ..parallel.ring_attention import local_attention
+    return local_attention(q, k, v, causal=causal)
+
+
+def _chunked_attention_bwd(q, k, v, g, causal: bool, block_q: int):
+    """Memory-efficient attention backward: iterate q blocks, so peak
+    extra memory is O(block_q·seq) per (batch,head) instead of the
+    O(seq²) score matrix (the standard flash-attention backward
+    recurrence, expressed in XLA ops)."""
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # (B,H,S,D)
+    kf = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vf = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    gf = jnp.swapaxes(g, 1, 2).astype(jnp.float32)
+    nq = s // block_q
+
+    def step(carry, i):
+        dk, dv = carry
+        start = i * block_q
+        q_blk = jax.lax.dynamic_slice_in_dim(qf, start, block_q, 2)
+        g_blk = jax.lax.dynamic_slice_in_dim(gf, start, block_q, 2)
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kf) * scale
+        if causal:
+            rows = start + jnp.arange(block_q)[:, None]
+            cols = jnp.arange(s)[None, :]
+            s_blk = jnp.where(cols <= rows, s_blk, _NEG_INF)
+        p = jax.nn.softmax(s_blk, axis=-1)             # (B,H,BQ,S)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, g_blk)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g_blk, vf)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk) * scale
+        return (dk, dv), dq_blk
+
+    (dk, dv), dq_blocks = jax.lax.scan(
+        step, (jnp.zeros_like(kf), jnp.zeros_like(vf)),
+        jnp.arange(nq))
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(b, h, s, d)
+    to_out = lambda x, like: jnp.swapaxes(x, 1, 2).astype(like.dtype)
+    return to_out(dq, q), to_out(dk, k), to_out(dv, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention(q, k, v, causal):
+    return _flash_attention_impl(q, k, v, causal)
+
+
+def _flash_attention_impl(q, k, v, causal):
+    b, s, h, d = q.shape
+    # large tiles amortize per-grid-step overhead; MXU tiles are
+    # 128-aligned so any divisor ≥64 works
+    block_q = next((bq for bq in (512, 256, 128, 64) if s % bq == 0),
+                   None)
+    block_k = next((bk for bk in (1024, 512, 256, 128, 64)
+                    if s % bk == 0), None)
+    if block_q is None or block_k is None:
+        return _reference_attention(q, k, v, causal)
+    # lane-pad the head dim to 128 (zero columns change nothing: they
+    # add 0 to every dot product) and fold heads into the grid axis
+    d_pad = max(128, ((d + 127) // 128) * 128)
+    scale_fix = math.sqrt(d_pad / d)  # kernel scales by 1/sqrt(d_pad)
+
+    def to_flat(x):
+        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+        if d_pad != d:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
+        return x
+
+    out = _flash_attention_fwd_flat(
+        to_flat(q * scale_fix), to_flat(k), to_flat(v),
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=not _on_tpu())
+    out = out[:, :, :d].reshape(b, h, s, d)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_fwd(q, k, v, causal):
+    return _flash_attention_impl(q, k, v, causal), (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    q, k, v = res
+    s = q.shape[1]
+    block = next((bq for bq in (512, 256, 128, 64) if s % bq == 0),
+                 None)
+    if block is None:  # irregular seq: small anyway, direct vjp
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal),
+            q, k, v)
+        return vjp(g)
+    return _chunked_attention_bwd(q, k, v, g, causal, block)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    """Fused blocked attention, layout ``(batch, seq, heads, dim)``
+    (the framework's attention layout).  Differentiable; compiled
+    Pallas on TPU, interpreted elsewhere.  Sequences not divisible by
+    64 fall back to plain XLA attention.  GQA (kv_heads < heads) is
+    handled by repeating KV head groups."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return _flash_attention(q, k, v, causal)
+
+
+# ---------------------------------------------------------------------------
+# fused scale + sum (the reference's ScaleAdd fusion kernel)
+# ---------------------------------------------------------------------------
+
+def _scale_sum_kernel(a_ref, b_ref, o_ref, *, alpha: float, beta: float):
+    o_ref[:] = (alpha * a_ref[:].astype(jnp.float32) +
+                beta * b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def fused_scale_sum(a, b, alpha: float = 1.0, beta: float = 1.0):
+    """``alpha*a + beta*b`` in one VPU pass (reference ``ScaleAdd`` in
+    ``cuda_kernels.cu``, used for pre/postscaled fusion-buffer math).
+    Gridded in ~2MB tiles so fusion buffers far larger than VMEM
+    stream through."""
+    flat_a = a.reshape(-1)
+    flat_b = b.reshape(-1)
+    n = flat_a.shape[0]
+    lane = 128
+    block_rows = 4096                       # 4096×128 f32 = 2 MiB/tile
+    rows = (n + lane - 1) // lane
+    rows = ((rows + block_rows - 1) // block_rows) * block_rows
+    pad = rows * lane - n
+    if pad:
+        flat_a = jnp.pad(flat_a, (0, pad))
+        flat_b = jnp.pad(flat_b, (0, pad))
+    kernel = functools.partial(_scale_sum_kernel, alpha=alpha,
+                               beta=beta)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, lane), lambda i: (i, 0)),
+                  pl.BlockSpec((block_rows, lane), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, lane), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, lane), a.dtype),
+        interpret=not _on_tpu(),
+    )(flat_a.reshape(rows, lane), flat_b.reshape(rows, lane))
+    return out.reshape(-1)[:n].reshape(a.shape)
