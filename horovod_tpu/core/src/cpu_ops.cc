@@ -245,6 +245,64 @@ Status RingAllreduce(TcpMesh& mesh, const std::vector<int32_t>& members,
   return Status::OK();
 }
 
+Status HierarchicalAllreduce(TcpMesh& mesh,
+                             const std::vector<int32_t>& members,
+                             const std::vector<int32_t>& host_of,
+                             int me, uint8_t* buffer, int64_t count,
+                             DataType dtype, ReduceOp op) {
+  int n = static_cast<int>(members.size());
+  if (n <= 1 || count == 0)
+    return RingAllreduce(mesh, members, me, buffer, count, dtype, op);
+  // Partition the set by host id, preserving member order; the first
+  // member of each group is its leader (reference: local-root rank).
+  std::vector<int32_t> group_ids;
+  std::vector<std::vector<int32_t>> groups;
+  for (int32_t r : members) {
+    int32_t h = (r < static_cast<int32_t>(host_of.size()))
+                    ? host_of[static_cast<size_t>(r)] : r;
+    size_t gi = 0;
+    for (; gi < group_ids.size(); ++gi)
+      if (group_ids[gi] == h) break;
+    if (gi == group_ids.size()) {
+      group_ids.push_back(h);
+      groups.emplace_back();
+    }
+    groups[gi].push_back(r);
+  }
+  if (groups.size() <= 1 || groups.size() == members.size())
+    // all one host, or one rank per host: plain ring is the same
+    return RingAllreduce(mesh, members, me, buffer, count, dtype, op);
+
+  const std::vector<int32_t>* local = nullptr;
+  std::vector<int32_t> leaders;
+  for (auto& g : groups) {
+    leaders.push_back(g[0]);
+    for (int32_t r : g)
+      if (r == me) local = &g;
+  }
+  if (!local) return Status::InvalidArgument("rank not in process set");
+  // AVERAGE divides once at the end by the full world count.
+  ReduceOp inner = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+  size_t nbytes = static_cast<size_t>(count) * DataTypeSize(dtype);
+
+  // 1. intra-host reduction
+  Status s = RingAllreduce(mesh, *local, me, buffer, count, dtype,
+                           inner);
+  if (!s.ok()) return s;
+  // 2. inter-host allreduce among the leaders
+  if (me == (*local)[0]) {
+    s = RingAllreduce(mesh, leaders, me, buffer, count, dtype, inner);
+    if (!s.ok()) return s;
+  }
+  // 3. intra-host broadcast of the global result
+  s = StarBroadcast(mesh, *local, me, (*local)[0], buffer,
+                    static_cast<int64_t>(nbytes));
+  if (!s.ok()) return s;
+  if (op == ReduceOp::AVERAGE)
+    ScaleBytes(buffer, count, dtype, 1.0 / n);
+  return Status::OK();
+}
+
 namespace {
 void AdasumCombine(float* a, const float* b, int64_t n) {
   double dot = 0, na = 0, nb = 0;

@@ -26,6 +26,16 @@ Status RingAllreduce(TcpMesh& mesh, const std::vector<int32_t>& members,
                      int me, uint8_t* buffer, int64_t count,
                      DataType dtype, ReduceOp op);
 
+// Hierarchical allreduce (reference HOROVOD_HIERARCHICAL_ALLREDUCE in
+// ops/nccl_operations.cc: intra-node reduce, inter-node allreduce among
+// node leaders, intra-node broadcast).  `host_of` maps each WORLD rank
+// to a host-group id; groups with one member degrade gracefully.
+Status HierarchicalAllreduce(TcpMesh& mesh,
+                             const std::vector<int32_t>& members,
+                             const std::vector<int32_t>& host_of,
+                             int me, uint8_t* buffer, int64_t count,
+                             DataType dtype, ReduceOp op);
+
 Status TreeAdasum(TcpMesh& mesh, const std::vector<int32_t>& members,
                   int me, uint8_t* buffer, int64_t count, DataType dtype);
 

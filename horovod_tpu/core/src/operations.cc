@@ -69,6 +69,46 @@ Status CoreState::Initialize(int rank, int size,
   params_.Configure(fusion, cycle_time_ms_, autotune && rank == 0,
                     at_log ? at_log : "");
 
+  // Hierarchical allreduce (reference HOROVOD_HIERARCHICAL_ALLREDUCE):
+  // host groups come from the rendezvous addresses' host part, or from
+  // HVD_TPU_HOST_OF_RANK="0,0,1,1" (tests fake a multi-host topology
+  // on localhost with it).
+  hierarchical_ = EnvBool("HVD_TPU_HIERARCHICAL_ALLREDUCE",
+                          "HOROVOD_HIERARCHICAL_ALLREDUCE", false);
+  host_of_.assign(static_cast<size_t>(size), 0);
+  const char* fake_topo = EnvStr("HVD_TPU_HOST_OF_RANK",
+                                 "HOROVOD_HOST_OF_RANK");
+  if (fake_topo) {
+    std::string spec(fake_topo);
+    size_t pos = 0;
+    int parsed = 0;
+    for (int r = 0; r < size && pos <= spec.size(); ++r) {
+      size_t comma = spec.find(',', pos);
+      host_of_[static_cast<size_t>(r)] =
+          std::atoi(spec.substr(pos, comma - pos).c_str());
+      ++parsed;
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+    if (parsed < size) {
+      LOG_WARNING << "HVD_TPU_HOST_OF_RANK has " << parsed
+                  << " entries for a " << size << "-rank world; "
+                  << "remaining ranks assigned to host 0";
+    }
+  } else {
+    std::vector<std::string> hosts;
+    for (int r = 0; r < size; ++r) {
+      std::string h = r < static_cast<int>(addrs.size())
+                          ? addrs[static_cast<size_t>(r)] : "";
+      h = h.substr(0, h.rfind(':'));
+      size_t gi = 0;
+      for (; gi < hosts.size(); ++gi)
+        if (hosts[gi] == h) break;
+      if (gi == hosts.size()) hosts.push_back(h);
+      host_of_[static_cast<size_t>(r)] = static_cast<int32_t>(gi);
+    }
+  }
+
   Status s = mesh_.Initialize(rank, size, addrs);
   if (!s.ok()) return s;
   controller_.Initialize(rank, size, &mesh_, &cache_, &process_sets_,
@@ -328,6 +368,10 @@ void CoreState::PerformOperation(const Response& r) {
       Status s;
       if (r.red_op == ReduceOp::ADASUM)
         s = TreeAdasum(mesh_, members, rank_, fused.data(), total, r.dtype);
+      else if (hierarchical_)
+        s = HierarchicalAllreduce(mesh_, members, host_of_, rank_,
+                                  fused.data(), total, r.dtype,
+                                  r.red_op);
       else
         s = RingAllreduce(mesh_, members, rank_, fused.data(), total,
                           r.dtype, r.red_op);

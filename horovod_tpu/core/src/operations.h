@@ -84,6 +84,8 @@ class CoreState {
   StallInspector stall_;
   Timeline timeline_;
   ParameterManager params_;
+  bool hierarchical_ = false;
+  std::vector<int32_t> host_of_;  // world rank -> host-group id
 
   std::mutex handles_mu_;
   std::map<int32_t, std::shared_ptr<TensorTableEntry>> handles_;

@@ -93,3 +93,21 @@ def test_world_reinit():
         out = hvd.broadcast(torch.ones(2), 0, name="reinit_b%d" % w)
         assert torch.equal(out, torch.ones(2))
         hvd.shutdown()
+
+
+def test_tcp_hierarchical_allreduce():
+    # fake a 2-host x 2-slot topology on localhost: intra-host ring,
+    # leader ring across "hosts", intra-host broadcast — results must
+    # match the flat ring exactly
+    _assert_ok(_spawn_world(4, "collectives", extra_env={
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HVD_TPU_HOST_OF_RANK": "0,0,1,1",
+    }))
+
+
+def test_tcp_hierarchical_uneven_groups():
+    # 3 ranks on host0, 1 on host1 (uneven groups + singleton leader)
+    _assert_ok(_spawn_world(4, "collectives", extra_env={
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HVD_TPU_HOST_OF_RANK": "0,0,0,1",
+    }))
