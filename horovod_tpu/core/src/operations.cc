@@ -84,8 +84,16 @@ Status CoreState::Initialize(int rank, int size,
     int parsed = 0;
     for (int r = 0; r < size && pos <= spec.size(); ++r) {
       size_t comma = spec.find(',', pos);
-      host_of_[static_cast<size_t>(r)] =
-          std::atoi(spec.substr(pos, comma - pos).c_str());
+      std::string tok = spec.substr(pos, comma - pos);
+      char* end = nullptr;
+      long v = std::strtol(tok.c_str(), &end, 10);
+      if (end == tok.c_str() || *end != '\0') {
+        LOG_WARNING << "HVD_TPU_HOST_OF_RANK: non-numeric token '"
+                    << tok << "' for rank " << r
+                    << "; assigning host 0";
+        v = 0;
+      }
+      host_of_[static_cast<size_t>(r)] = static_cast<int32_t>(v);
       ++parsed;
       if (comma == std::string::npos) break;
       pos = comma + 1;

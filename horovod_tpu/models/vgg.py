@@ -1,0 +1,72 @@
+"""VGG family (VGG-16 flagship) in flax.
+
+Parity with the reference's bandwidth-bound benchmark workload: its
+scaling study (``docs/benchmarks.rst``) singles out VGG-16 as the model
+whose ~138M dense parameters stress the allreduce path (~68–79%
+scaling efficiency vs ~90% for ResNet) — the workload that makes
+tensor fusion and hierarchical/compressed allreduce earn their keep.
+TPU-first choices: NHWC, bf16 on the MXU, the classifier folded to
+matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# conv widths per stage; 'M' = 2x2 max pool (the torchvision cfgs)
+CFGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+         "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512,
+         512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512,
+         512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    classifier_width: int = 4096
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for item in self.cfg:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(item, (3, 3), padding=1,
+                            dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.relu(nn.Dense(self.classifier_width,
+                                 dtype=self.dtype)(x))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+def create_vgg16(num_classes: int = 1000, dtype=jnp.bfloat16) -> VGG:
+    return VGG(cfg=CFGS[16], num_classes=num_classes, dtype=dtype)
+
+
+def create_vgg(depth: int, num_classes: int = 1000,
+               dtype=jnp.bfloat16) -> VGG:
+    return VGG(cfg=CFGS[depth], num_classes=num_classes, dtype=dtype)
+
+
+def vgg_loss_fn(model: VGG, variables, batch, train: bool = True):
+    """Cross-entropy on {'x','y'}, mirroring ``resnet_loss_fn``."""
+    logits = model.apply(variables, batch["x"], train=train)
+    one_hot = jax.nn.one_hot(batch["y"], logits.shape[-1])
+    nll = -jnp.mean(jnp.sum(one_hot *
+                            jax.nn.log_softmax(logits), axis=-1))
+    return nll

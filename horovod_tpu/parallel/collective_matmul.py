@@ -1,0 +1,107 @@
+"""Latency-hiding collective matmuls.
+
+Beyond-reference extension (SURVEY.md §7 phase 7): the tensor-parallel
+building blocks that overlap communication with MXU compute instead of
+serializing ``all_gather → matmul`` / ``matmul → reduce_scatter``.
+The technique is the standard TPU "collective matmul" decomposition
+(as popularized by the scaling playbook): walk the ring one shard per
+step with ``ppermute`` while multiplying the shard already on-chip —
+XLA's async collective-permute then hides the hop latency behind each
+partial matmul.
+
+Both functions are written for use inside ``shard_map`` over a named
+axis and are exact (bitwise-equal chunk math, no approximation):
+
+* ``all_gather_matmul(x, w, axis_name)``   ≡ ``all_gather(x) @ w``
+* ``matmul_reduce_scatter(x, w, axis_name)`` ≡
+  ``reduce_scatter(x @ w)`` (row shard of the summed product)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["all_gather_matmul", "matmul_reduce_scatter"]
+
+
+def _ring_perm(n: int, forward: bool = True):
+    if forward:
+        return [(i, (i + 1) % n) for i in range(n)]
+    return [(i, (i - 1) % n) for i in range(n)]
+
+
+def all_gather_matmul(x, w, axis_name: str):
+    """``all_gather(x, axis) @ w`` with the gather overlapped.
+
+    ``x``: this device's row shard ``(m_loc, k)``;
+    ``w``: the local weight ``(k, n_loc)`` (replicated or col-sharded —
+    either way it never moves).  Returns ``(n_dev*m_loc, n_loc)``: the
+    full row dimension, each block computed the step its shard arrived.
+    """
+    n_dev = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m_loc = x.shape[0]
+    out = jnp.zeros((n_dev * m_loc, w.shape[1]), dtype=x.dtype)
+    perm = _ring_perm(n_dev)
+
+    def step(t, carry):
+        buf, out = carry
+        src = (idx - t) % n_dev          # whose shard we hold at step t
+        y = jnp.dot(buf, w, preferred_element_type=jnp.float32) \
+            .astype(out.dtype)
+        out = lax.dynamic_update_slice(out, y, (src * m_loc, 0))
+        # rotate while the NEXT step's matmul runs (async ppermute)
+        buf = lax.cond(
+            t < n_dev - 1,
+            lambda b: lax.ppermute(b, axis_name, perm),
+            lambda b: b, buf)
+        return buf, out
+
+    _, out = lax.fori_loop(0, n_dev, step, (x, out))
+    return out
+
+
+def matmul_reduce_scatter(x, w, axis_name: str):
+    """``reduce_scatter(x @ w, axis)`` with the scatter overlapped.
+
+    ``x``: local activation ``(m, k_loc)``; ``w``: local weight shard
+    ``(k_loc, n)`` — each device holds a partial product ``x @ w`` that
+    must be summed over the axis and row-scattered.  Instead of
+    materializing the full ``(m, n)`` partial and reduce-scattering it,
+    the ring walks ``n_dev`` row chunks: each step multiplies ONE
+    ``(m/n_dev, ·)`` chunk and adds it to the accumulator arriving from
+    the neighbor.  Returns this device's ``(m/n_dev, n)`` row of the
+    summed product.
+    """
+    n_dev = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x.shape[0]
+    if m % n_dev:
+        raise ValueError("row dim %d not divisible by axis size %d"
+                         % (m, n_dev))
+    m_loc = m // n_dev
+    perm = _ring_perm(n_dev)
+    acc0 = jnp.zeros((m_loc, w.shape[1]), dtype=x.dtype)
+
+    def chunk(i):
+        return lax.dynamic_slice(x, (i * m_loc, 0), (m_loc, x.shape[1]))
+
+    def step(t, acc):
+        # consistency: the chunk device d adds at step t must match the
+        # accumulator it passes to d+1 (q(d+1,t+1) == q(d,t)), and the
+        # final un-permuted step must leave chunk idx at home — hence
+        # q(d,t) = (d - t - 1) mod n
+        src = (idx - t - 1) % n_dev
+        part = jnp.dot(chunk(src), w,
+                       preferred_element_type=jnp.float32) \
+            .astype(acc.dtype)
+        acc = acc + part
+        acc = lax.cond(
+            t < n_dev - 1,
+            lambda a: lax.ppermute(a, axis_name, perm),
+            lambda a: a, acc)
+        return acc
+
+    return lax.fori_loop(0, n_dev, step, acc0)

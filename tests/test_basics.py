@@ -79,3 +79,18 @@ def test_config_env_parsing(monkeypatch):
     # HVD_TPU_* alias wins over HOROVOD_*.
     monkeypatch.setenv("HVD_TPU_CYCLE_TIME", "7")
     assert Config.from_env().cycle_time_ms == 7.0
+
+
+def test_vgg16_forward_and_loss():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models.vgg import create_vgg16, vgg_loss_fn
+    model = create_vgg16(num_classes=10, dtype=jnp.float32)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    nll = vgg_loss_fn(model, variables,
+                      {"x": x, "y": np.array([1, 2])})
+    assert np.isfinite(float(nll))
