@@ -64,9 +64,11 @@ def create_vgg(depth: int, num_classes: int = 1000,
 
 
 def vgg_loss_fn(model: VGG, variables, batch, train: bool = True):
-    """Cross-entropy on {'x','y'}, mirroring ``resnet_loss_fn``."""
+    """Cross-entropy on {'x','y'}.  Same ``(nll, new_state)`` contract
+    as ``resnet_loss_fn`` so the benchmark harnesses take either model
+    (VGG has no mutable batch-norm state, so new_state is empty)."""
     logits = model.apply(variables, batch["x"], train=train)
     one_hot = jax.nn.one_hot(batch["y"], logits.shape[-1])
     nll = -jnp.mean(jnp.sum(one_hot *
                             jax.nn.log_softmax(logits), axis=-1))
-    return nll
+    return nll, {}

@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
+import jax.profiler
 import jax.numpy as jnp
 import numpy as np
 
@@ -261,6 +262,14 @@ class CollectiveEngine:
 
     def _execute_fused_allreduce(self, entries: List[_Entry]):
         names = [e.name for e in entries]
+        # xprof span (the reference's NVTX op range, nvtx_op_range.cc):
+        # collective executions show up named in jax.profiler traces
+        with jax.profiler.TraceAnnotation(
+                "hvd.allreduce[%d tensors]" % len(entries)):
+            self._execute_fused_allreduce_inner(entries, names)
+
+    def _execute_fused_allreduce_inner(self, entries: List[_Entry],
+                                       names: List[str]):
         try:
             mc = self.collectives_for(entries[0].process_set_id)
             size = mc.size
@@ -309,18 +318,20 @@ class CollectiveEngine:
         try:
             mc = self.collectives_for(e.process_set_id)
             self.timeline.activity_start(e.name, "EXEC_" + e.op_type.upper())
-            if e.op_type == _OP_ALLGATHER:
-                out = mc.allgather(e.payload)
-            elif e.op_type == _OP_BROADCAST:
-                out = mc.broadcast(e.payload, e.root_rank)
-            elif e.op_type == _OP_ALLTOALL:
-                out = mc.alltoall(e.payload, e.splits)
-            elif e.op_type == _OP_REDUCESCATTER:
-                out = mc.reducescatter(e.payload, e.red_op)
-            elif e.op_type == _OP_BARRIER:
-                out = mc.barrier()
-            else:
-                raise NotImplementedError(e.op_type)
+            # xprof span (reference NVTX op range, nvtx_op_range.cc)
+            with jax.profiler.TraceAnnotation("hvd.%s" % e.op_type):
+                if e.op_type == _OP_ALLGATHER:
+                    out = mc.allgather(e.payload)
+                elif e.op_type == _OP_BROADCAST:
+                    out = mc.broadcast(e.payload, e.root_rank)
+                elif e.op_type == _OP_ALLTOALL:
+                    out = mc.alltoall(e.payload, e.splits)
+                elif e.op_type == _OP_REDUCESCATTER:
+                    out = mc.reducescatter(e.payload, e.red_op)
+                elif e.op_type == _OP_BARRIER:
+                    out = mc.barrier()
+                else:
+                    raise NotImplementedError(e.op_type)
             self.timeline.activity_end(e.name)
             self.stall_inspector.record_done(e.name)
             e.handle._set_result(out)

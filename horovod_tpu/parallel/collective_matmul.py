@@ -26,10 +26,8 @@ from jax import lax
 __all__ = ["all_gather_matmul", "matmul_reduce_scatter"]
 
 
-def _ring_perm(n: int, forward: bool = True):
-    if forward:
-        return [(i, (i + 1) % n) for i in range(n)]
-    return [(i, (i - 1) % n) for i in range(n)]
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
 
 
 def all_gather_matmul(x, w, axis_name: str):
@@ -52,11 +50,10 @@ def all_gather_matmul(x, w, axis_name: str):
         y = jnp.dot(buf, w, preferred_element_type=jnp.float32) \
             .astype(out.dtype)
         out = lax.dynamic_update_slice(out, y, (src * m_loc, 0))
-        # rotate while the NEXT step's matmul runs (async ppermute)
-        buf = lax.cond(
-            t < n_dev - 1,
-            lambda b: lax.ppermute(b, axis_name, perm),
-            lambda b: b, buf)
+        # rotate every step (ring_attention's pattern): an unconditional
+        # trailing ppermute lets XLA split it into start/done and overlap
+        # it with the slice update; the final hop returns x home unused
+        buf = lax.ppermute(buf, axis_name, perm)
         return buf, out
 
     _, out = lax.fori_loop(0, n_dev, step, (x, out))
@@ -83,25 +80,24 @@ def matmul_reduce_scatter(x, w, axis_name: str):
                          % (m, n_dev))
     m_loc = m // n_dev
     perm = _ring_perm(n_dev)
-    acc0 = jnp.zeros((m_loc, w.shape[1]), dtype=x.dtype)
 
     def chunk(i):
         return lax.dynamic_slice(x, (i * m_loc, 0), (m_loc, x.shape[1]))
 
     def step(t, acc):
-        # consistency: the chunk device d adds at step t must match the
-        # accumulator it passes to d+1 (q(d+1,t+1) == q(d,t)), and the
-        # final un-permuted step must leave chunk idx at home — hence
-        # q(d,t) = (d - t - 1) mod n
+        # permute-then-add with the hop FIRST keeps the loop free of
+        # conditionals (XLA can overlap the permute with this step's
+        # dot, which does not depend on the arriving accumulator);
+        # chunk schedule q(d,t) = (d - t - 1) mod n lands each row sum
+        # on its home device at t = n-1
+        acc = lax.ppermute(acc, axis_name, perm)
         src = (idx - t - 1) % n_dev
         part = jnp.dot(chunk(src), w,
                        preferred_element_type=jnp.float32) \
             .astype(acc.dtype)
-        acc = acc + part
-        acc = lax.cond(
-            t < n_dev - 1,
-            lambda a: lax.ppermute(a, axis_name, perm),
-            lambda a: a, acc)
-        return acc
+        return acc + part
 
-    return lax.fori_loop(0, n_dev, step, acc0)
+    # step 0 needs no incoming hop: seed with this device's first chunk
+    first = jnp.dot(chunk((idx - 1) % n_dev), w,
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    return lax.fori_loop(1, n_dev, step, first)

@@ -91,6 +91,6 @@ def test_vgg16_forward_and_loss():
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     logits = model.apply(variables, x, train=False)
     assert logits.shape == (2, 10)
-    nll = vgg_loss_fn(model, variables,
-                      {"x": x, "y": np.array([1, 2])})
-    assert np.isfinite(float(nll))
+    nll, new_state = vgg_loss_fn(model, variables,
+                                 {"x": x, "y": np.array([1, 2])})
+    assert np.isfinite(float(nll)) and new_state == {}
