@@ -119,6 +119,9 @@ Status CoreState::Initialize(int rank, int size,
 
   Status s = mesh_.Initialize(rank, size, addrs);
   if (!s.ok()) return s;
+  // worker pool lives only in initialized worlds (fork safety: threads
+  // must not exist before a client process settles into its role)
+  pool_ = std::make_unique<ThreadPool>(4);
   controller_.Initialize(rank, size, &mesh_, &cache_, &process_sets_,
                          &groups_, &stall_,
                          autotune && rank == 0 ? &params_ : nullptr,
@@ -142,6 +145,7 @@ void CoreState::RequestShutdown() { shutdown_requested_ = true; }
 
 void CoreState::WaitShutdown() {
   if (background_.joinable()) background_.join();
+  pool_.reset();
   timeline_.Shutdown();
   mesh_.Shutdown();
   initialized_ = false;
@@ -386,18 +390,35 @@ void CoreState::PerformOperation(const Response& r) {
       for (auto& n : r.tensor_names) timeline_.ActivityEnd(n);
       if (s.ok() && r.postscale != 1.0)
         ScaleBytes(fused.data(), total, r.dtype, r.postscale);
-      // MEMCPY_OUT_FUSION_BUFFER
-      off = 0;
-      for (size_t i = 0; i < entries.size(); ++i) {
-        int64_t n = r.aux_sizes[i];
-        if (entries[i]) {
-          auto& e = entries[i];
-          e->output.assign(fused.data() + off * esize,
-                           fused.data() + (off + n) * esize);
-          e->output_dims = e->request.shape.dims;
-          CompleteEntry(e, s);
+      // MEMCPY_OUT_FUSION_BUFFER — large scatter copies fan out on
+      // the worker pool (reference: thread_pool.cc backing GPU
+      // finalization/d2d); small ones copy inline, where pool
+      // dispatch would cost more than the memcpy itself
+      {
+        constexpr size_t kPoolCopyBytes = 64 << 10;
+        std::vector<std::future<void>> copies;
+        off = 0;
+        for (size_t i = 0; i < entries.size(); ++i) {
+          int64_t n = r.aux_sizes[i];
+          if (entries[i]) {
+            auto e = entries[i];
+            const uint8_t* src = fused.data() + off * esize;
+            size_t nb = static_cast<size_t>(n) * esize;
+            if (pool_ && entries.size() > 1 && nb >= kPoolCopyBytes) {
+              copies.push_back(pool_->Submit([e, src, nb] {
+                e->output.assign(src, src + nb);
+                e->output_dims = e->request.shape.dims;
+              }));
+            } else {
+              e->output.assign(src, src + nb);
+              e->output_dims = e->request.shape.dims;
+            }
+          }
+          off += n;
         }
-        off += n;
+        for (auto& f : copies) f.get();
+        for (size_t i = 0; i < entries.size(); ++i)
+          if (entries[i]) CompleteEntry(entries[i], s);
       }
       break;
     }

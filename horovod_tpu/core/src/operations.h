@@ -84,6 +84,7 @@ class CoreState {
   StallInspector stall_;
   Timeline timeline_;
   ParameterManager params_;
+  std::unique_ptr<ThreadPool> pool_;  // created in Initialize
   bool hierarchical_ = false;
   std::vector<int32_t> host_of_;  // world rank -> host-group id
 
