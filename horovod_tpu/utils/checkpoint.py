@@ -1,0 +1,79 @@
+"""Rank-0 checkpointing helpers.
+
+The reference deliberately delegates durable checkpointing to the
+framework — its examples save on rank 0 only, and elastic mode keeps
+*in-memory* state (SURVEY.md §5 "Checkpoint / resume").  This module is
+the thin idiomatic equivalent for JAX users: orbax-backed pytree
+save/restore that only rank 0 writes, everyone restores, composing
+with ``hvd.elastic`` (commit in memory every N steps, checkpoint to
+disk every M).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, "step_%08d" % step)
+
+
+def save_checkpoint(directory: str, step: int, state: Any,
+                    keep: Optional[int] = None):
+    """Write ``state`` (any pytree) under ``directory/step_NNNNNNNN``;
+    call on every rank — only rank 0 writes (reference examples'
+    ``if hvd.rank() == 0: save`` pattern), others return immediately."""
+    from ..common import basics
+    if basics.is_initialized() and basics.rank() != 0:
+        return
+    path = _step_dir(directory, step)
+    _checkpointer().save(path, state, force=True)
+    if keep:
+        # prune by recency of WRITE, not by step number: after an
+        # elastic rollback a newly saved lower step must survive and
+        # the stale higher steps should be the ones to go
+        import shutil
+        steps = all_steps(directory)
+        steps.sort(key=lambda st: os.path.getmtime(_step_dir(directory,
+                                                             st)))
+        for st in steps[:-keep]:
+            shutil.rmtree(_step_dir(directory, st), ignore_errors=True)
+
+
+def all_steps(directory: str):
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            try:
+                out.append(int(name[len("step_"):]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(directory: str, step: Optional[int] = None,
+                       item: Any = None) -> Any:
+    """Restore the pytree at ``step`` (default: latest).  ``item`` — a
+    template pytree for structure/dtype guidance (orbax ``item=``)."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError("no checkpoints under %s"
+                                    % directory)
+    return _checkpointer().restore(_step_dir(directory, step),
+                                   item=item)

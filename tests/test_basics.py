@@ -94,3 +94,24 @@ def test_vgg16_forward_and_loss():
     nll, new_state = vgg_loss_fn(model, variables,
                                  {"x": x, "y": np.array([1, 2])})
     assert np.isfinite(float(nll)) and new_state == {}
+
+
+def test_checkpoint_save_restore(tmp_path, hvd_world):
+    import numpy as np
+    import jax.numpy as jnp
+    from horovod_tpu.utils.checkpoint import (all_steps, latest_step,
+                                              restore_checkpoint,
+                                              save_checkpoint)
+    state = {"w": jnp.arange(4, dtype=jnp.float32), "step": 3}
+    save_checkpoint(str(tmp_path), 3, state)
+    save_checkpoint(str(tmp_path), 7, {"w": jnp.ones(4), "step": 7})
+    assert all_steps(str(tmp_path)) == [3, 7]
+    assert latest_step(str(tmp_path)) == 7
+    got = restore_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.ones(4))
+    got3 = restore_checkpoint(str(tmp_path), step=3)
+    np.testing.assert_array_equal(np.asarray(got3["w"]), np.arange(4))
+    # keep= prunes old steps
+    save_checkpoint(str(tmp_path), 9, {"w": jnp.zeros(2), "step": 9},
+                    keep=2)
+    assert all_steps(str(tmp_path)) == [7, 9]
