@@ -67,7 +67,13 @@ Status CoreState::Initialize(int rank, int size,
   const char* at_log = EnvStr("HVD_TPU_AUTOTUNE_LOG",
                               "HOROVOD_AUTOTUNE_LOG");
   params_.Configure(fusion, cycle_time_ms_, autotune && rank == 0,
-                    at_log ? at_log : "");
+                    at_log ? at_log : "",
+                    static_cast<int>(EnvU64(
+                        "HVD_TPU_AUTOTUNE_WARMUP_CYCLES",
+                        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 5)),
+                    static_cast<int>(EnvU64(
+                        "HVD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE",
+                        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 20)));
 
   // Hierarchical allreduce (reference HOROVOD_HIERARCHICAL_ALLREDUCE):
   // host groups come from the rendezvous addresses' host part, or from

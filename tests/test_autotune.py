@@ -1,0 +1,59 @@
+"""Autotuner behavior tests (Python mirror of parameter_manager.cc +
+bayesian_optimization.cc; the C++ twin is driven by the tcp worlds)."""
+
+import numpy as np
+
+from horovod_tpu.utils.autotune import (BayesianOptimizer,
+                                        GaussianProcess,
+                                        ParameterManager,
+                                        expected_improvement)
+
+
+def test_gp_fits_and_predicts():
+    gp = GaussianProcess(length_scale=1.0)
+    x = np.array([[0.0], [1.0], [2.0]])
+    y = np.array([0.0, 1.0, 0.0])
+    gp.fit(x, y)
+    mu, sigma = gp.predict(np.array([[1.0], [10.0]]))
+    # near a training point: confident and close; far away: uncertain
+    assert abs(mu[0] - 1.0) < 0.2
+    assert sigma[1] > sigma[0]
+
+
+def test_expected_improvement_prefers_uncertain_high_mean():
+    mu = np.array([0.0, 1.0, 1.0])
+    sigma = np.array([0.1, 0.1, 1.0])
+    ei = expected_improvement(mu, sigma, best=0.5)
+    assert ei[2] > ei[1] > ei[0]
+
+
+def test_bayesian_optimizer_converges_to_better_region():
+    bo = BayesianOptimizer()
+    # synthetic objective: reward large fusion + small cycle (the
+    # common real-world optimum); the BO should concentrate samples
+    # toward the high-scoring corner
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        idx = bo.next_index()
+        f_log, c_log = bo.grid[idx]
+        score = float(2 * f_log - c_log + rng.normal(0, 0.1))
+        bo.record(idx, score)
+    best = bo.grid[bo.best_index()]
+    assert best[0] >= np.median(bo.grid[:, 0])  # large fusion chosen
+
+
+def test_parameter_manager_samples_and_freezes(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(fusion_threshold=1 << 20, cycle_time_ms=5.0,
+                          log_path=str(log), warmup=1,
+                          steps_per_sample=2, max_samples=3)
+    # throughput is higher for larger fusion thresholds
+    for _ in range(1 + 2 * 3 + 2):
+        pm.observe(nbytes=pm.fusion_threshold, secs=1e-3)
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("sample,")
+    assert len(lines) >= 4  # header + 3 samples
+    # after max_samples the manager settles on the best point
+    settled = pm.fusion_threshold
+    pm.observe(nbytes=123, secs=1e-3)
+    assert pm.fusion_threshold == settled

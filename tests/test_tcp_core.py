@@ -111,3 +111,18 @@ def test_tcp_hierarchical_uneven_groups():
         "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
         "HVD_TPU_HOST_OF_RANK": "0,0,0,1",
     }))
+
+
+def test_tcp_autotune_samples_written(tmp_path):
+    # rank 0 runs the BO autotuner in the C++ core: with pacing lowered
+    # it must SCORE samples (data rows), not just write the csv header
+    log = str(tmp_path / "autotune.csv")
+    _assert_ok(_spawn_world(2, "autotune", extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": log,
+        "HVD_TPU_AUTOTUNE_WARMUP_CYCLES": "1",
+        "HVD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+    }))
+    lines = open(log).read().strip().splitlines()
+    assert lines[0].startswith("sample,")
+    assert len(lines) >= 3, lines  # header + >=2 scored samples

@@ -28,6 +28,8 @@ def main():
             run_collectives(core, rank, size)
         if scenario in ("all", "cache"):
             run_cache(core, rank, size)
+        if scenario == "autotune":
+            run_autotune(core, rank, size)
         if scenario == "join":
             run_join(core, rank, size)
         if scenario == "error":
@@ -128,6 +130,14 @@ def run_cache(core, rank, size):
         h1, m1 = core.cache_stats()
         assert h1 - h0 >= 5, (h0, h1)
         assert m1 == m0, (m0, m1)
+
+
+def run_autotune(core, rank, size):
+    # steady allreduce traffic long enough for the BO autotuner to
+    # complete several samples (pacing lowered via env in the test)
+    x = np.full((4096,), float(rank), np.float32)
+    for it in range(30):
+        core.allreduce_async(x, "tune.%d" % (it % 3)).wait(30)
 
 
 def run_join(core, rank, size):
