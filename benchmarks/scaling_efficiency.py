@@ -1,0 +1,115 @@
+"""Data-parallel scaling efficiency — the reference's headline metric.
+
+The reference's benchmark story (BASELINE.md) is *scaling efficiency*:
+throughput at n workers / (n × throughput at 1 worker) — ~90% for
+ResNet-class models on its 128-GPU testbed.  This harness measures the
+same ratio for this framework's DP step over an expanding device mesh,
+using a fixed per-device batch (weak scaling, the reference's setup).
+
+    python benchmarks/scaling_efficiency.py                 # real chips
+    python benchmarks/scaling_efficiency.py --cpu-devices 8 # CPU world
+
+On the CPU world the numbers characterize the harness (CPU collectives
+are slow), not ICI; on a pod slice they are the real ICI measurement.
+Prints one JSON line per world size plus a summary.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-device-batch", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--cpu-devices", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % args.cpu_devices).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu.jax as hvd
+
+    all_devices = jax.devices()
+    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64, 128)
+             if n <= len(all_devices)]
+    rng = np.random.RandomState(0)
+    dims = [args.dim] * args.layers
+
+    def loss_fn(params, batch):
+        h = batch["x"]
+        for w in params["ws"]:
+            h = jnp.tanh(h @ w)
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    # host copy stays numpy: device_put always copies it, so the jitted
+    # step's donation can never delete the master weights
+    params_host = {"ws": [
+        (rng.randn(d, d) / np.sqrt(d)).astype(np.float32)
+        for d in dims]}
+
+    results = []
+    for n in sizes:
+        hvd.shutdown()
+        hvd.init(devices=all_devices[:n])
+        step, opt_init = hvd.make_data_parallel_step(
+            loss_fn, optax.sgd(0.01))
+        params = hvd.replicate(params_host)
+        opt_state = opt_init(params)
+        x = rng.randn(n * args.per_device_batch, args.dim) \
+            .astype(np.float32)
+        batch = hvd.shard_batch({"x": x, "y": np.zeros_like(x)})
+
+        def run(k, p, o):
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(k):
+                p, o, loss = step(p, o, batch)
+            float(np.asarray(loss))  # blocks on the step chain
+            return time.perf_counter() - t0, p, o
+
+        _, params, opt_state = run(3, params, opt_state)
+        best = float("inf")
+        for _ in range(3):
+            dt, params, opt_state = run(args.steps, params, opt_state)
+            best = min(best, dt)
+        samples_s = n * args.per_device_batch * args.steps / best
+        results.append((n, samples_s))
+        rec = {"metric": "dp_scaling", "devices": n,
+               "samples_per_sec": round(samples_s, 1)}
+        if n > 1:
+            rec["efficiency"] = round(
+                samples_s / (n * results[0][1]), 4)
+        print(json.dumps(rec))
+
+    if len(results) > 1:
+        n, s = results[-1]
+        print(json.dumps({
+            "metric": "dp_scaling_efficiency",
+            "value": round(s / (n * results[0][1]), 4),
+            "devices": n, "unit": "fraction"}))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

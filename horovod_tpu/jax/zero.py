@@ -1,0 +1,141 @@
+"""ZeRO-1: optimizer-state sharding over the data-parallel axis.
+
+Beyond-reference extension (SURVEY.md §2.5 lists ZeRO as absent
+upstream, with ``reducescatter``/``allgather`` as the primitives users
+would build it from — this module builds it).  Memory per device for
+optimizer state (and the fp32 work the update does) drops by the DP
+world size:
+
+    grads --reducescatter--> my 1/n shard (mean-reduced)
+    optimizer.update on the shard (1/n of the state)
+    params --allgather-- updated shards
+
+With Adam the optimizer state (mu+nu = 2 of the 3 training-state
+units) shards n ways: total training-state HBM drops by (2 - 2/n)/3 —
+50% at n=4, approaching 2/3 as n grows.  XLA overlaps the
+reduce-scatter with backward compute like any collective.
+
+ONLY ELEMENTWISE optimizers are exact under ZeRO-1 sharding (adam,
+sgd, rmsprop, adagrad, ...): each rank updates its flat shard
+independently.  Optimizers that couple elements across the whole tree
+— ``clip_by_global_norm``, LAMB/LARS trust ratios, Adafactor's
+factored second moment — would compute their norms over 1/n of the
+data and silently diverge; do not use them here.
+
+Usage (mirrors ``make_data_parallel_step``)::
+
+    step, init = make_zero1_step(loss_fn, optax.adam(3e-4))
+    params = hvd.replicate(params)
+    opt_state = init(params)              # sharded along the world axis
+    params, opt_state, loss = step(params, opt_state,
+                                   hvd.shard_batch(batch))
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from . import spmd
+from .data_parallel import _world_mesh
+from ..ops.xla_ops import AVERAGE
+
+__all__ = ["make_zero1_step"]
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _flat_pad(x, n):
+    flat = x.reshape(-1)
+    padded = _pad_to(flat.shape[0], n)
+    if padded != flat.shape[0]:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros(padded - flat.shape[0], flat.dtype)])
+    return flat
+
+
+def make_zero1_step(loss_fn: Callable,
+                    optimizer: optax.GradientTransformation,
+                    axis_name: str = spmd.DEFAULT_AXIS):
+    """Build ``(step, init)`` with ZeRO-1 sharded optimizer state.
+
+    ``loss_fn(params, batch) -> scalar`` on the local batch shard.
+    Call ``init(params)`` (params replicated) once — it derives the
+    state sharding and compiles the step — then
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``;
+    params stay replicated, optimizer state lives sharded.  Params and
+    opt state are donated each step: keep using the returned values.
+
+    ``optimizer`` must be elementwise (see module docstring).
+    """
+    mesh = _world_mesh()
+    n = mesh.shape[axis_name]
+
+    def shard_params_local(params, idx):
+        def leaf(x):
+            flat = _flat_pad(x, n)
+            per = flat.shape[0] // n
+            return jax.lax.dynamic_slice(flat, (idx * per,), (per,))
+        return jax.tree.map(leaf, params)
+
+    def local_init(params):
+        idx = jax.lax.axis_index(axis_name)
+        return optimizer.init(shard_params_local(params, idx))
+
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+
+        def rs(g):
+            # mean-reduce + scatter my 1/n of every gradient
+            return spmd.reducescatter(_flat_pad(g, n), op=AVERAGE,
+                                      axis_name=axis_name)
+
+        grad_shards = jax.tree.map(rs, grads)
+        param_shards = shard_params_local(params, idx)
+        updates, opt_state = optimizer.update(grad_shards, opt_state,
+                                              param_shards)
+        new_shards = optax.apply_updates(param_shards, updates)
+
+        def ag(shard, like):
+            full = spmd.allgather(shard, axis_name=axis_name)
+            return full[:like.size].reshape(like.shape) \
+                .astype(like.dtype)
+
+        params = jax.tree.map(ag, new_shards, params)
+        return params, opt_state, loss
+
+    compiled = {}
+
+    def init(params):
+        # state sharding: array leaves are per-rank shards (dim 0
+        # concatenates across the axis); scalar leaves (step counters)
+        # are replicated
+        state_shapes = jax.eval_shape(
+            lambda p: optimizer.init(shard_params_local(p, 0)), params)
+        state_spec = jax.tree.map(
+            lambda s: P(axis_name) if getattr(s, "ndim", 0) >= 1
+            else P(), state_shapes)
+        mapped_init = jax.shard_map(
+            local_init, mesh=mesh, in_specs=(P(),),
+            out_specs=state_spec, check_vma=False)
+        mapped_step = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(), state_spec, P(axis_name)),
+            out_specs=(P(), state_spec, P()), check_vma=False)
+        compiled["step"] = jax.jit(mapped_step, donate_argnums=(0, 1))
+        return jax.jit(mapped_init)(params)
+
+    def step(params, opt_state, batch):
+        if "step" not in compiled:
+            raise RuntimeError("call init(params) before step(...)")
+        return compiled["step"](params, opt_state, batch)
+
+    return step, init
