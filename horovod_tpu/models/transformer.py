@@ -60,6 +60,17 @@ class TransformerConfig:
     dp_axis: str = "dp"
     sp_axis: str = "sp"
     tp_axis: str = "tp"
+    # sequence-parallel strategy when sp>1: "ring" (ppermute KV
+    # rotation, any head count) or "ulysses" (alltoall head/sequence
+    # exchange; the PER-TP-SHARD head counts — n_heads/tp and
+    # n_kv_heads/tp — must both divide by sp; composes with flash
+    # attention)
+    sp_mode: str = "ring"
+
+    def __post_init__(self):
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError("sp_mode must be 'ring' or 'ulysses', "
+                             "got %r" % (self.sp_mode,))
 
     @property
     def head_dim(self) -> int:
@@ -232,7 +243,14 @@ def _attention_block(x, lp, cfg: TransformerConfig, cos, sin, sp_size):
     v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, -1, hd)
     q = _rope(cos, sin, q)
     k = _rope(cos, sin, k)
-    if sp_size > 1:
+    if sp_size > 1 and cfg.sp_mode == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+        attn_fn = None
+        if _use_flash_attention():
+            from ..ops.pallas_kernels import flash_attention as attn_fn
+        attn = ulysses_attention(q, k, v, axis_name=cfg.sp_axis,
+                                 causal=True, attn_fn=attn_fn)
+    elif sp_size > 1:
         attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
     elif _use_flash_attention():
         # Pallas fused attention on TPU (ops/pallas_kernels.py):

@@ -111,3 +111,24 @@ def test_remat_matches_no_remat(hvd_world):
 
     np.testing.assert_allclose(gradnorm(cfg), gradnorm(cfg_plain),
                                rtol=1e-4)
+
+
+def test_ulysses_sp_matches_ring(hvd_world):
+    # same model, same batch: ulysses (alltoall head exchange) must
+    # produce the same loss surface as ring SP. heads=4 % sp=2 == 0.
+    rng = np.random.RandomState(3)
+    batch_host = _batch(rng, 4, 32)
+    losses = {}
+    for mode in ("ring", "ulysses"):
+        cfg = _cfg(n_kv_heads=4, sp_mode=mode)
+        mesh = _mesh((2, 2, 2), ("dp", "sp", "tp"))
+        build, shard_batch = make_train_step(cfg, mesh,
+                                             optax.sgd(1e-2))
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        step, params, opt_state = build(params)
+        batch = shard_batch(batch_host)
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, batch)
+        losses[mode] = float(loss)
+    assert np.isclose(losses["ring"], losses["ulysses"],
+                      rtol=1e-4), losses
