@@ -37,6 +37,7 @@ from .sync_batch_norm import (SyncBatchNorm, sync_batch_norm_stats,
 from .data_parallel import (make_data_parallel_step, make_sharded_jit_step,
                             shard_batch, replicate, metric_average)
 from .zero import make_zero1_step
+from .mesh import create_mesh, create_hybrid_mesh
 from . import spmd
 from . import callbacks
 from .. import elastic

@@ -1,0 +1,41 @@
+"""Mesh construction helper tests (8-device CPU world)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.jax.mesh import create_hybrid_mesh, create_mesh
+
+
+def test_create_mesh_shapes_and_collectives(hvd_world):
+    mesh = create_mesh((2, 4), ("dp", "tp"))
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    out = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+        in_specs=P(None, "tp"), out_specs=P(None, None),
+        check_vma=False))(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+
+
+def test_create_mesh_validates_count(hvd_world):
+    with pytest.raises(ValueError):
+        create_mesh((3, 4), ("a", "b"))
+
+
+def test_create_hybrid_mesh_fallback_layout(hvd_world):
+    # 2 "slices" x 4 chips: dp crosses slices, mp stays inner
+    mesh = create_hybrid_mesh((1, 4), (2, 1), ("dp", "mp"))
+    assert mesh.shape == {"dp": 2, "mp": 4}
+    # inner mp rows must be the contiguous per-slice device groups
+    devs = np.asarray(jax.devices())
+    arr = np.array(mesh.devices)
+    assert set(d.id for d in arr[0]) == set(d.id for d in devs[:4])
+    assert set(d.id for d in arr[1]) == set(d.id for d in devs[4:])
+
+
+def test_create_hybrid_mesh_validates(hvd_world):
+    with pytest.raises(ValueError):
+        create_hybrid_mesh((1, 4), (2,), ("dp", "mp"))
+    with pytest.raises(ValueError):
+        create_hybrid_mesh((1, 2), (2, 1), ("dp", "mp"))
