@@ -245,19 +245,19 @@ Status RingAllreduce(TcpMesh& mesh, const std::vector<int32_t>& members,
   return Status::OK();
 }
 
-Status HierarchicalAllreduce(TcpMesh& mesh,
-                             const std::vector<int32_t>& members,
-                             const std::vector<int32_t>& host_of,
-                             int me, uint8_t* buffer, int64_t count,
-                             DataType dtype, ReduceOp op) {
-  int n = static_cast<int>(members.size());
-  if (n <= 1 || count == 0)
-    return RingAllreduce(mesh, members, me, buffer, count, dtype, op);
-  // Partition the set by host id, preserving member order; the first
-  // member of each group is its leader (reference: local-root rank).
+namespace {
+// Partition process-set member INDICES by host id, preserving member
+// order; the first index of each group is its leader (reference:
+// local-root rank).  Shared by the hierarchical collectives so the
+// allreduce and allgather topologies can never diverge.
+std::vector<std::vector<int>> GroupByHost(
+    const std::vector<int32_t>& members,
+    const std::vector<int32_t>& host_of, int* my_group, int me) {
   std::vector<int32_t> group_ids;
-  std::vector<std::vector<int32_t>> groups;
-  for (int32_t r : members) {
+  std::vector<std::vector<int>> groups;
+  int n = static_cast<int>(members.size());
+  for (int j = 0; j < n; ++j) {
+    int32_t r = members[static_cast<size_t>(j)];
     int32_t h = (r < static_cast<int32_t>(host_of.size()))
                     ? host_of[static_cast<size_t>(r)] : r;
     size_t gi = 0;
@@ -267,20 +267,37 @@ Status HierarchicalAllreduce(TcpMesh& mesh,
       group_ids.push_back(h);
       groups.emplace_back();
     }
-    groups[gi].push_back(r);
+    groups[gi].push_back(j);
+    if (r == me) *my_group = static_cast<int>(gi);
   }
-  if (groups.size() <= 1 || groups.size() == members.size())
+  return groups;
+}
+}  // namespace
+
+Status HierarchicalAllreduce(TcpMesh& mesh,
+                             const std::vector<int32_t>& members,
+                             const std::vector<int32_t>& host_of,
+                             int me, uint8_t* buffer, int64_t count,
+                             DataType dtype, ReduceOp op) {
+  int n = static_cast<int>(members.size());
+  if (n <= 1 || count == 0)
+    return RingAllreduce(mesh, members, me, buffer, count, dtype, op);
+  int my_g = -1;
+  auto idx_groups = GroupByHost(members, host_of, &my_g, me);
+  if (my_g < 0) return Status::InvalidArgument("rank not in process set");
+  if (idx_groups.size() <= 1 || idx_groups.size() == members.size())
     // all one host, or one rank per host: plain ring is the same
     return RingAllreduce(mesh, members, me, buffer, count, dtype, op);
 
-  const std::vector<int32_t>* local = nullptr;
   std::vector<int32_t> leaders;
-  for (auto& g : groups) {
-    leaders.push_back(g[0]);
-    for (int32_t r : g)
-      if (r == me) local = &g;
+  std::vector<int32_t> local_ranks;
+  for (size_t g = 0; g < idx_groups.size(); ++g) {
+    leaders.push_back(members[static_cast<size_t>(idx_groups[g][0])]);
+    if (static_cast<int>(g) == my_g)
+      for (int j : idx_groups[g])
+        local_ranks.push_back(members[static_cast<size_t>(j)]);
   }
-  if (!local) return Status::InvalidArgument("rank not in process set");
+  const std::vector<int32_t>* local = &local_ranks;
   // AVERAGE divides once at the end by the full world count.
   ReduceOp inner = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
   size_t nbytes = static_cast<size_t>(count) * DataTypeSize(dtype);
@@ -374,6 +391,97 @@ Status RingAllgatherV(TcpMesh& mesh, const std::vector<int32_t>& members,
   }
   return Status::OK();
 }
+
+Status HierarchicalAllgatherV(TcpMesh& mesh,
+                              const std::vector<int32_t>& members,
+                              const std::vector<int32_t>& host_of,
+                              int me, const uint8_t* in, uint8_t* out,
+                              const std::vector<int64_t>& block_bytes) {
+  // reference HOROVOD_HIERARCHICAL_ALLGATHER: members gather to their
+  // host leader, leaders ring-exchange whole host groups, leaders
+  // broadcast the complete result locally.  Blocks land at the same
+  // global offsets as the flat ring, so results are byte-identical.
+  int n = static_cast<int>(members.size());
+  int i = IndexIn(members, me);
+  if (i < 0) return Status::InvalidArgument("rank not in process set");
+  int my_g = -1;
+  auto groups = GroupByHost(members, host_of, &my_g, me);
+  if (groups.size() <= 1 || groups.size() == members.size())
+    return RingAllgatherV(mesh, members, me, in, out, block_bytes);
+
+  std::vector<int64_t> offs(static_cast<size_t>(n) + 1, 0);
+  for (int j = 0; j < n; ++j) offs[j + 1] = offs[j] + block_bytes[j];
+  int64_t total = offs[static_cast<size_t>(n)];
+
+  const auto& local = groups[static_cast<size_t>(my_g)];
+  int leader_idx = local[0];
+  int32_t leader = members[static_cast<size_t>(leader_idx)];
+  int G = static_cast<int>(groups.size());
+
+  if (me == leader) {
+    // 1. gather local blocks onto the leader at their global offsets
+    std::memcpy(out + offs[i], in,
+                static_cast<size_t>(block_bytes[i]));
+    for (size_t t = 1; t < local.size(); ++t) {
+      int j = local[t];
+      Status s = mesh.RecvRaw(members[static_cast<size_t>(j)],
+                              out + offs[j],
+                              static_cast<size_t>(block_bytes[j]));
+      if (!s.ok()) return s;
+    }
+    // 2. leaders ring-exchange whole groups (per-member blocks go
+    // straight to their final offsets, so interleaved host
+    // assignments keep the flat ordering).  Whole-group payloads can
+    // exceed socket buffering, so deadlock-freedom comes from send/
+    // recv ORDER, not buffer capacity: even group positions send
+    // first, odd ones receive first (and the last group of an odd
+    // ring always receives first) — every ring step then has at
+    // least one receiver-first leader unblocking its neighbor.
+    int gpos = my_g;
+    bool recv_first = (gpos % 2 == 1) || (G % 2 == 1 && gpos == G - 1);
+    int32_t next = members[static_cast<size_t>(
+        groups[static_cast<size_t>((gpos + 1) % G)][0])];
+    int32_t prev = members[static_cast<size_t>(
+        groups[static_cast<size_t>((gpos - 1 + G) % G)][0])];
+    for (int step = 0; step < G - 1; ++step) {
+      int send_g = ((gpos - step) % G + G) % G;
+      int recv_g = ((gpos - step - 1) % G + G) % G;
+      auto send_all = [&]() -> Status {
+        for (int j : groups[static_cast<size_t>(send_g)]) {
+          Status s = mesh.SendRaw(
+              next, out + offs[j],
+              static_cast<size_t>(block_bytes[j]));
+          if (!s.ok()) return s;
+        }
+        return Status::OK();
+      };
+      auto recv_all = [&]() -> Status {
+        for (int j : groups[static_cast<size_t>(recv_g)]) {
+          Status s = mesh.RecvRaw(
+              prev, out + offs[j],
+              static_cast<size_t>(block_bytes[j]));
+          if (!s.ok()) return s;
+        }
+        return Status::OK();
+      };
+      Status s = recv_first ? recv_all() : send_all();
+      if (!s.ok()) return s;
+      s = recv_first ? send_all() : recv_all();
+      if (!s.ok()) return s;
+    }
+  } else {
+    // non-leaders only contribute; the broadcast below fills out
+    Status s = mesh.SendRaw(leader, in,
+                            static_cast<size_t>(block_bytes[i]));
+    if (!s.ok()) return s;
+  }
+  // 3. full result fans out within the host
+  std::vector<int32_t> local_ranks;
+  for (int j : local)
+    local_ranks.push_back(members[static_cast<size_t>(j)]);
+  return StarBroadcast(mesh, local_ranks, me, leader, out, total);
+}
+
 
 Status StarBroadcast(TcpMesh& mesh, const std::vector<int32_t>& members,
                      int me, int root_world_rank, uint8_t* buffer,

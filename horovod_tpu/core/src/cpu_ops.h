@@ -41,6 +41,12 @@ Status TreeAdasum(TcpMesh& mesh, const std::vector<int32_t>& members,
 
 // in: this rank's block (bytes); block_bytes[i] = rank i's block size.
 // out must hold sum(block_bytes), blocks concatenated in member order.
+Status HierarchicalAllgatherV(TcpMesh& mesh,
+                              const std::vector<int32_t>& members,
+                              const std::vector<int32_t>& host_of,
+                              int me, const uint8_t* in, uint8_t* out,
+                              const std::vector<int64_t>& block_bytes);
+
 Status RingAllgatherV(TcpMesh& mesh, const std::vector<int32_t>& members,
                       int me, const uint8_t* in, uint8_t* out,
                       const std::vector<int64_t>& block_bytes);

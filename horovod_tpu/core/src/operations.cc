@@ -446,9 +446,15 @@ void CoreState::PerformOperation(const Response& r) {
       }
       std::vector<uint8_t> out(static_cast<size_t>(
           total_rows * row_elems * static_cast<int64_t>(esize)));
-      Status s = RingAllgatherV(
-          mesh_, members, rank_,
-          e ? e->input.data() : nullptr, out.data(), block_bytes);
+      Status s;
+      if (hierarchical_)
+        s = HierarchicalAllgatherV(
+            mesh_, members, host_of_, rank_,
+            e ? e->input.data() : nullptr, out.data(), block_bytes);
+      else
+        s = RingAllgatherV(
+            mesh_, members, rank_,
+            e ? e->input.data() : nullptr, out.data(), block_bytes);
       if (e) {
         e->output = std::move(out);
         e->output_dims = e->request.shape.dims;

@@ -126,3 +126,23 @@ def test_tcp_autotune_samples_written(tmp_path):
     lines = open(log).read().strip().splitlines()
     assert lines[0].startswith("sample,")
     assert len(lines) >= 3, lines  # header + >=2 scored samples
+
+
+def test_tcp_hierarchical_interleaved_hosts():
+    # ranks alternate hosts (0,1,0,1): group blocks are NON-contiguous
+    # in member order, so this catches any ordering mistake in the
+    # hierarchical allgather/allreduce paths
+    _assert_ok(_spawn_world(4, "collectives", extra_env={
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HVD_TPU_HOST_OF_RANK": "0,1,0,1",
+    }))
+
+
+def test_tcp_hierarchical_big_allgather():
+    # G=2 leader exchange with multi-MB payloads: completes only with
+    # the ordered send/recv protocol (simultaneous blocking sends
+    # would deadlock once socket buffers fill)
+    _assert_ok(_spawn_world(4, "big_allgather", extra_env={
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HVD_TPU_HOST_OF_RANK": "0,0,1,1",
+    }, timeout=180))

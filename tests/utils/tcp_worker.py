@@ -28,6 +28,8 @@ def main():
             run_collectives(core, rank, size)
         if scenario in ("all", "cache"):
             run_cache(core, rank, size)
+        if scenario == "big_allgather":
+            run_big_allgather(core, rank, size)
         if scenario == "autotune":
             run_autotune(core, rank, size)
         if scenario == "join":
@@ -138,6 +140,19 @@ def run_autotune(core, rank, size):
     x = np.full((4096,), float(rank), np.float32)
     for it in range(30):
         core.allreduce_async(x, "tune.%d" % (it % 3)).wait(30)
+
+
+def run_big_allgather(core, rank, size):
+    # multi-MB blocks: leader group exchange far exceeds socket
+    # buffering, so only the ordered (parity) send/recv protocol
+    # completes — guards the hierarchical-allgather deadlock case
+    rows = 250_000  # 1 MB per rank (f32), 2-4 MB group payloads
+    x = np.full((rows,), float(rank), np.float32)
+    out = core.allgather_async(x, "big_ag").wait(timeout=120)
+    assert out.shape == (rows * size,)
+    for r in range(size):
+        assert out[r * rows] == float(r)
+        assert out[(r + 1) * rows - 1] == float(r)
 
 
 def run_join(core, rank, size):
