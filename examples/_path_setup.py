@@ -1,0 +1,18 @@
+"""Make the repo importable for examples run from a source checkout.
+
+Imported for side effects (``import _path_setup``): prepends the repo
+root to BOTH ``sys.path`` (this process) and ``PYTHONPATH`` (worker
+processes the launcher / backends / Ray actors spawn).  A pip-installed
+package makes this a no-op.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+_pp = os.environ.get("PYTHONPATH", "")
+if _ROOT not in _pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = (_ROOT + os.pathsep + _pp).rstrip(
+        os.pathsep)

@@ -9,6 +9,8 @@ Run: ``python -m horovod_tpu.runner -np 2 python
 examples/adasum_allreduce.py``  (Adasum needs a power-of-two world.)
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import numpy as np
 
 import horovod_tpu.torch as hvd

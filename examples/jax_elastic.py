@@ -7,6 +7,8 @@ failures and host add/remove via commit/restore/sync.
         python examples/jax_elastic.py
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import numpy as np
 
 import horovod_tpu as hvd

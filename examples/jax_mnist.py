@@ -10,6 +10,8 @@ or as a multi-process world via the launcher:
     python -m horovod_tpu.runner -np 2 python examples/jax_mnist.py
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import time
 
 import jax

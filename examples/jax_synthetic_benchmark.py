@@ -6,6 +6,8 @@ random data, printing img/sec per iteration.
     python examples/jax_synthetic_benchmark.py --batch-size 32
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import argparse
 import time
 

@@ -12,6 +12,8 @@ Runs on any world; for the 8-device CPU test topology::
     JAX_PLATFORMS=cpu python examples/long_context_ring_attention.py
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

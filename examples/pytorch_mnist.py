@@ -4,6 +4,8 @@ examples/pytorch/pytorch_mnist.py).  Synthetic data (zero-egress env).
     python -m horovod_tpu.runner -np 2 python examples/pytorch_mnist.py
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import numpy as np
 import torch
 import torch.nn.functional as F

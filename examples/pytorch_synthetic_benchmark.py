@@ -6,6 +6,8 @@ data, img/sec per iteration over the multi-process world.
         python examples/pytorch_synthetic_benchmark.py
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import argparse
 import time
 

@@ -5,6 +5,7 @@ across Ray actor workers, one collective world.  Requires ray
 (``pip install ray``); shown here with the elastic variant too.
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
 
 def train_fn():
     import horovod_tpu.torch as hvd

@@ -7,6 +7,8 @@ tasks; without it, this example uses the LocalBackend (the launcher's
 real multi-process world), so it runs anywhere.
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import numpy as np
 import pandas as pd
 import torch

@@ -9,6 +9,8 @@ Run: ``python -m horovod_tpu.runner -np 2 python
 examples/tensorflow2_keras_mnist.py``
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import numpy as np
 import keras
 

@@ -10,6 +10,8 @@ Run single-process (size-1 world), or through the launcher::
     python -m horovod_tpu.runner -np 2 python examples/tensorflow2_mnist.py
 """
 
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
 import numpy as np
 import tensorflow as tf
 
