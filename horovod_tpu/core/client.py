@@ -156,9 +156,14 @@ class TcpHandle:
             if rc != 0:
                 from ..ops.engine import HorovodInternalError
                 raise HorovodInternalError("result copy failed")
-        splits = (ctypes.c_longlong * 1024)()
-        nsp = lib.hvd_tcp_recv_splits(self._h, splits)
-        recv_splits = [int(splits[i]) for i in range(max(nsp, 0))]
+        # Count query first (null buffer), then an exact-size fetch —
+        # no fixed cap, so pod-scale worlds can't silently truncate.
+        nsp = lib.hvd_tcp_recv_splits(self._h, None)
+        recv_splits: List[int] = []
+        if nsp > 0:
+            splits = (ctypes.c_longlong * nsp)()
+            lib.hvd_tcp_recv_splits(self._h, splits)
+            recv_splits = [int(splits[i]) for i in range(nsp)]
         lib.hvd_tcp_release(self._h)
         return (out, recv_splits) if recv_splits else out
 

@@ -79,11 +79,16 @@ void hvd_tcp_result_dims(int handle, long long* dims) {
     dims[i] = e->output_dims[i];
 }
 
+// With a null `splits` this is a pure count query: the client sizes its
+// buffer from the return value first, so worlds past any fixed cap (pod
+// scale) never truncate.
 int hvd_tcp_recv_splits(int handle, long long* splits) {
   auto e = CoreState::Get().GetEntry(handle);
   if (!e) return -1;
-  for (size_t i = 0; i < e->recv_splits.size(); ++i)
-    splits[i] = e->recv_splits[i];
+  if (splits) {
+    for (size_t i = 0; i < e->recv_splits.size(); ++i)
+      splits[i] = e->recv_splits[i];
+  }
   return static_cast<int>(e->recv_splits.size());
 }
 

@@ -81,6 +81,12 @@ Status CoreState::Initialize(int rank, int size,
   // on localhost with it).
   hierarchical_ = EnvBool("HVD_TPU_HIERARCHICAL_ALLREDUCE",
                           "HOROVOD_HIERARCHICAL_ALLREDUCE", false);
+  // Allgather has its own knob (reference HOROVOD_HIERARCHICAL_ALLGATHER)
+  // defaulting to the allreduce setting, so enabling hierarchical
+  // allreduce alone no longer silently switches the allgather algorithm.
+  hierarchical_allgather_ =
+      EnvBool("HVD_TPU_HIERARCHICAL_ALLGATHER",
+              "HOROVOD_HIERARCHICAL_ALLGATHER", hierarchical_);
   host_of_.assign(static_cast<size_t>(size), 0);
   const char* fake_topo = EnvStr("HVD_TPU_HOST_OF_RANK",
                                  "HOROVOD_HOST_OF_RANK");
@@ -447,7 +453,7 @@ void CoreState::PerformOperation(const Response& r) {
       std::vector<uint8_t> out(static_cast<size_t>(
           total_rows * row_elems * static_cast<int64_t>(esize)));
       Status s;
-      if (hierarchical_)
+      if (hierarchical_allgather_)
         s = HierarchicalAllgatherV(
             mesh_, members, host_of_, rank_,
             e ? e->input.data() : nullptr, out.data(), block_bytes);

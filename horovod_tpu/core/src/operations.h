@@ -86,6 +86,7 @@ class CoreState {
   ParameterManager params_;
   std::unique_ptr<ThreadPool> pool_;  // created in Initialize
   bool hierarchical_ = false;
+  bool hierarchical_allgather_ = false;
   std::vector<int32_t> host_of_;  // world rank -> host-group id
 
   std::mutex handles_mu_;

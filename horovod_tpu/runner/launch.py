@@ -215,17 +215,17 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         hosts = util.parse_hosts(args.hosts)
     else:
         # no explicit hosts: a batch scheduler allocation (LSF/Slurm)
-        # supplies them — but only if it can satisfy -np; a smaller
-        # allocation falls back to the historical localhost behavior
-        # (with a warning) instead of hard-failing slot assignment
+        # supplies them.  An allocation too small for -np is a hard
+        # error (reference launcher behavior): silently oversubscribing
+        # the login/batch node would hide the misconfiguration in batch
+        # logs.
         hosts = util.scheduler_hosts()
         if hosts and args.np and util.total_slots(hosts) < args.np:
-            import sys
-            print("[launcher] WARNING: scheduler allocation has %d "
-                  "slots < -np %d; launching %d local workers instead"
-                  % (util.total_slots(hosts), args.np, args.np),
-                  file=sys.stderr)
-            hosts = []
+            raise SystemExit(
+                "[launcher] scheduler allocation has %d slots < -np %d; "
+                "shrink -np or grow the allocation (or pass -H/"
+                "--hostfile to override)"
+                % (util.total_slots(hosts), args.np))
         hosts = hosts or [util.HostInfo("localhost", args.np or 1)]
     if args.host_discovery_script or (args.min_np or args.max_np):
         from ..elastic.driver import elastic_run

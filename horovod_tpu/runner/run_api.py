@@ -33,9 +33,12 @@ with open(os.path.join(out_dir, "result.%s.pkl" % rank), "wb") as fh:
 
 def run(fn, args=(), kwargs=None, np: int = 1,
         hosts: Optional[str] = None, verbose: bool = False,
-        extra_cli: Optional[List[str]] = None) -> List[Any]:
+        extra_cli: Optional[List[str]] = None,
+        env: Optional[dict] = None) -> List[Any]:
     """Execute ``fn(*args, **kwargs)`` on np workers; returns the list of
-    per-rank results (rank order)."""
+    per-rank results (rank order).  ``env`` overlays extra variables on
+    the workers' environment for this run only (the caller's environment
+    is untouched)."""
     kwargs = kwargs or {}
     payload = util.dumps_base64((fn, tuple(args), kwargs))
     with tempfile.TemporaryDirectory() as out_dir:
@@ -47,12 +50,13 @@ def run(fn, args=(), kwargs=None, np: int = 1,
         cli += extra_cli or []
         cli += [sys.executable, "-c", _STUB]
         parsed = parse_args(cli)
-        env = dict(os.environ)
-        env["HVD_TPU_RUN_PAYLOAD"] = payload
-        env["HVD_TPU_RUN_OUT"] = out_dir
+        worker_env = dict(os.environ)
+        worker_env.update(env or {})
+        worker_env["HVD_TPU_RUN_PAYLOAD"] = payload
+        worker_env["HVD_TPU_RUN_OUT"] = out_dir
         host_list = (util.parse_hosts(hosts) if hosts
                      else [util.HostInfo("localhost", np)])
-        rc = gloo_run(parsed, host_list, env=env)
+        rc = gloo_run(parsed, host_list, env=worker_env)
         if rc != 0:
             raise RuntimeError("horovod_tpu.runner.run failed (rc=%d)" % rc)
         import pickle

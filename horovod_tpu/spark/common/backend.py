@@ -72,14 +72,12 @@ class LocalBackend(Backend):
 
     def run(self, fn, args=(), kwargs=None, env=None):
         from ...runner.run_api import run as launcher_run
-        import os
-        extra = []
-        if env:
-            # the launcher forwards the parent env; overlay the extras
-            os.environ.update(env)
+        # env rides the launcher's per-run overlay — the driver process
+        # environment is never mutated, so overlays cannot leak into
+        # later runs.
         return launcher_run(fn, args=args, kwargs=kwargs or {},
                             np=self._num_proc, verbose=self._verbose,
-                            extra_cli=extra)
+                            env=env)
 
     def num_processes(self) -> int:
         return self._num_proc

@@ -64,6 +64,13 @@ def _ps_rank(process_set) -> int:
     return basics.rank()
 
 
+def _ps_size(process_set) -> int:
+    if process_set is not None:
+        return process_set.size()
+    from ..common import basics
+    return basics.size()
+
+
 class TFHandle:
     """Async handle returning tf tensors (reference: the AsyncOpKernel
     completion callback in mpi_ops.cc)."""
@@ -275,6 +282,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
         if isinstance(splits, tf.Tensor):
             splits = splits.numpy().tolist()
     out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    n_local = tensor.shape[0]
     rcell = {}
 
     @tf.custom_gradient
@@ -290,8 +298,33 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
 
         def grad(dy):
             def _bwd(v):
-                rs = rcell.get("recv_splits")
-                rs = list(rs) if rs is not None else None
+                if splits is not None:
+                    # Eager-only path (explicit splits are rejected in
+                    # tf.function above): rcell is private to this one
+                    # call and its forward already ran, so the recorded
+                    # recv_splits cannot be overwritten by a later
+                    # forward.
+                    rs = list(rcell["recv_splits"])
+                else:
+                    # splits=None still permits UNEVEN receives (each
+                    # rank splits its OWN rows evenly, but peers may
+                    # contribute different totals), and in tf.function
+                    # one trace serves many executions — recorded state
+                    # from the forward is not per-execution-safe.
+                    # Re-derive the reverse routing here instead: peer
+                    # j sent n_j // set_size rows, so allgather every
+                    # rank's send-count at backward time.
+                    if n_local is None:
+                        raise NotImplementedError(
+                            "alltoall gradient needs a static first "
+                            "dimension")
+                    gname = (None if name is None
+                             else name + "_grad_sizes")
+                    per_peer = int(n_local) // _ps_size(process_set)
+                    sizes = np.asarray(_api.allgather(
+                        np.asarray([per_peer], np.int64), name=gname,
+                        process_set=process_set))
+                    rs = [int(s) for s in sizes.reshape(-1)]
                 res = TFHandle(_api.alltoall_async(
                     _np_view(v), rs,
                     None if name is None else name + "_grad",
@@ -326,12 +359,20 @@ def reducescatter(tensor, op=SUM, name: Optional[str] = None,
             x, out_shape=out_shape)
 
         def grad(dy):
-            return _run_op(
-                lambda v: TFHandle(_api.allgather_async(
+            def _g(v):
+                g = TFHandle(_api.allgather_async(
                     _np_view(v),
                     None if name is None else name + "_grad",
-                    process_set), like=v).wait(),
-                dy, out_shape=x.shape)
+                    process_set), like=v).wait()
+                if op == AVERAGE:
+                    # The forward divides the reduction by the set size;
+                    # the backward must scale the allgathered grad the
+                    # same way or gradients come out size() times too
+                    # large.
+                    g = g / tf.cast(_ps_size(process_set), g.dtype)
+                return g
+
+            return _run_op(_g, dy, out_shape=x.shape)
 
         return y, grad
 

@@ -229,3 +229,29 @@ def test_scheduler_hosts_warns_on_malformed(monkeypatch, capsys):
         monkeypatch.delenv(var, raising=False)
     assert util.scheduler_hosts() == []
     assert "LSF detected but unusable" in capsys.readouterr().err
+
+
+def test_undersized_scheduler_allocation_hard_fails(monkeypatch):
+    # A Slurm/LSF allocation smaller than -np must abort (reference
+    # launcher behavior), not silently oversubscribe the batch node.
+    import pytest
+    from horovod_tpu.runner import launch
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "node01")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "2")
+    with pytest.raises(SystemExit, match="2 slots < -np 4"):
+        launch.run_commandline(["-np", "4", "true"])
+
+
+def test_programmatic_run_env_overlay_does_not_leak():
+    # run(env=...) reaches the workers but never mutates the caller env.
+    import os
+    from horovod_tpu.runner.run_api import run
+    assert "HVD_TPU_TEST_OVERLAY" not in os.environ
+    out = run(_echo_overlay, np=2, env={"HVD_TPU_TEST_OVERLAY": "yes"})
+    assert out == ["yes", "yes"]
+    assert "HVD_TPU_TEST_OVERLAY" not in os.environ
+
+
+def _echo_overlay():
+    import os
+    return os.environ.get("HVD_TPU_TEST_OVERLAY")

@@ -146,3 +146,13 @@ def test_tcp_hierarchical_big_allgather():
         "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
         "HVD_TPU_HOST_OF_RANK": "0,0,1,1",
     }, timeout=180))
+
+
+def test_tcp_hierarchical_allgather_own_knob():
+    # HOROVOD_HIERARCHICAL_ALLGATHER selects the allgather algorithm
+    # independently of the allreduce knob (reference exposes both).
+    _assert_ok(_spawn_world(4, "big_allgather", extra_env={
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "0",
+        "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+        "HVD_TPU_HOST_OF_RANK": "0,0,1,1",
+    }, timeout=180))
