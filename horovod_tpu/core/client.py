@@ -110,8 +110,89 @@ def load_library():
     lib.hvd_tcp_join.restype = ctypes.c_int
     lib.hvd_tcp_cache_hits.restype = ctypes.c_longlong
     lib.hvd_tcp_cache_misses.restype = ctypes.c_longlong
+    lib.hvd_tcp_enqueue_external.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint, ctypes.c_double,
+        ctypes.c_double, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+    lib.hvd_tcp_enqueue_external.restype = ctypes.c_int
+    lib.hvd_tcp_next_negotiated.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_tcp_next_negotiated.restype = ctypes.c_int
+    lib.hvd_tcp_external_done.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_char_p]
     _lib = lib
     return lib
+
+
+_OP_NAMES = {v: k for k, v in _OP_TYPES.items()}
+_RED_NAMES = {v: k for k, v in _RED_OPS.items()}
+_DTYPE_BY_ID = {v: k for k, v in _DTYPES.items()}
+
+
+def parse_negotiated_record(rec: bytes) -> dict:
+    """Decode one negotiated-group record emitted by the core's
+    external-payload path (operations.cc CoreState::PerformOperation):
+    op/dtype/reduce-op/root/process-set/scales + response aux sizes +
+    (name, handle) per member entry, in fused order."""
+    import struct
+    off = 0
+
+    def u8():
+        nonlocal off
+        v = rec[off]
+        off += 1
+        return v
+
+    def u32():
+        nonlocal off
+        v = struct.unpack_from("<I", rec, off)[0]
+        off += 4
+        return v
+
+    def i64():
+        nonlocal off
+        v = struct.unpack_from("<q", rec, off)[0]
+        off += 8
+        return v
+
+    def f64():
+        nonlocal off
+        v = struct.unpack_from("<d", rec, off)[0]
+        off += 8
+        return v
+
+    def s():
+        nonlocal off
+        n = u32()
+        v = rec[off:off + n].decode()
+        off += n
+        return v
+
+    g = {
+        "op_type": _OP_NAMES[u8()],
+        "dtype": _DTYPE_BY_ID[u8()],
+        "red_op": _RED_NAMES[u8()],
+        "root_rank": u32(),
+        "process_set_id": u32(),
+        "prescale": f64(),
+        "postscale": f64(),
+    }
+    g["aux_sizes"] = [i64() for _ in range(u32())]
+    g["entries"] = [{"name": s(), "handle": i64()} for _ in range(u32())]
+    return g
+
+
+def _marshal_dims(shape: Sequence[int]):
+    shape = tuple(int(d) for d in shape)
+    return ((ctypes.c_longlong * max(len(shape), 1))(*(shape or (0,))),
+            len(shape))
+
+
+def _marshal_splits(splits):
+    if splits is None:
+        return None, 0
+    return ((ctypes.c_longlong * len(splits))(*[int(s) for s in splits]),
+            len(splits))
 
 
 class TcpHandle:
@@ -176,6 +257,10 @@ class TcpCore:
         self.topology = topology
         self.config = config
         self._lib = None
+        # process-set id -> member count (id 0 is the world); used to
+        # split uniform alltoalls by the SET size, not the world size
+        self._ps_sizes = {0: topology.size}
+        self._poll_buf = None  # reusable next_negotiated buffer
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -225,24 +310,16 @@ class TcpCore:
                  prescale=1.0, postscale=1.0, splits=None) -> TcpHandle:
         if arr is not None:
             arr = np.ascontiguousarray(arr)
-            dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
-            ndim = arr.ndim
+            dims, ndim = _marshal_dims(arr.shape)
             data = arr.ctypes.data_as(ctypes.c_void_p)
             dtype_id = _DTYPES[arr.dtype]
             dtype = arr.dtype
         else:
-            dims = (ctypes.c_longlong * 1)(0)
-            ndim = 0
+            dims, ndim = _marshal_dims(())
             data = None
             dtype_id = 0
             dtype = np.dtype("uint8")
-        if splits is not None:
-            sp = (ctypes.c_longlong * len(splits))(*[int(s)
-                                                     for s in splits])
-            nsp = len(splits)
-        else:
-            sp = None
-            nsp = 0
+        sp, nsp = _marshal_splits(splits)
         h = self._lib.hvd_tcp_enqueue(
             name.encode(), _OP_TYPES[op_type], data, dims, ndim, dtype_id,
             _RED_OPS[red_op], root_rank, process_set_id, prescale,
@@ -267,9 +344,11 @@ class TcpCore:
 
     def alltoall_async(self, arr, name, splits=None, process_set_id=0):
         if splits is None:
-            n = self.topology.size
+            n = self._ps_sizes.get(process_set_id, self.topology.size)
             if arr.shape[0] % n:
-                raise ValueError("uniform alltoall needs dim0 % size == 0")
+                raise ValueError(
+                    "uniform alltoall needs dim0 %% set size (%d) == 0"
+                    % n)
             splits = [arr.shape[0] // n] * n
         return self._enqueue(name, "alltoall", arr, splits=splits,
                              process_set_id=process_set_id)
@@ -277,6 +356,45 @@ class TcpCore:
     def reducescatter_async(self, arr, name, op="Sum", process_set_id=0):
         return self._enqueue(name, "reducescatter", arr, red_op=op,
                              process_set_id=process_set_id)
+
+    # -- external-payload (device collective) protocol ---------------------
+
+    def enqueue_external(self, name, op_type, shape, dtype, red_op="Sum",
+                         root_rank=0, process_set_id=0, prescale=1.0,
+                         postscale=1.0, splits=None) -> TcpHandle:
+        """Negotiate order/readiness only; the payload executes as an XLA
+        collective driven by the multihost engine (``ops/multihost.py``)."""
+        dims, ndim = _marshal_dims(shape)
+        sp, nsp = _marshal_splits(splits)
+        h = self._lib.hvd_tcp_enqueue_external(
+            name.encode(), _OP_TYPES[op_type], dims, ndim,
+            _DTYPES[np.dtype(dtype)], _RED_OPS[red_op], root_rank,
+            process_set_id, prescale, postscale, sp, nsp)
+        if h < 0:
+            raise RuntimeError("external enqueue failed for %r" % name)
+        return TcpHandle(self._lib, h, np.dtype(dtype), name)
+
+    def next_negotiated(self) -> Optional[bytes]:
+        """Pop the next negotiated device-payload group record (response
+        order — identical on every rank), or None when none is pending."""
+        # One reusable buffer: the executor polls this in a tight loop
+        # where the common answer is "nothing pending".
+        if self._poll_buf is None:
+            self._poll_buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.hvd_tcp_next_negotiated(self._poll_buf,
+                                              len(self._poll_buf))
+        if n < 0:  # record larger than the buffer: grow and retry
+            self._poll_buf = ctypes.create_string_buffer(-n)
+            n = self._lib.hvd_tcp_next_negotiated(self._poll_buf,
+                                                  len(self._poll_buf))
+        if n <= 0:
+            return None
+        return self._poll_buf.raw[:n]
+
+    def external_done(self, handle: int, ok: bool = True,
+                      error: str = ""):
+        self._lib.hvd_tcp_external_done(handle, 1 if ok else 0,
+                                        error.encode())
 
     def barrier(self, name=None, process_set_id=0):
         h = self._enqueue(name or "barrier.%f" % time.monotonic(),
@@ -323,7 +441,9 @@ class TcpCore:
 
     def add_process_set(self, ranks: Sequence[int]) -> int:
         arr = (ctypes.c_int * len(ranks))(*[int(r) for r in ranks])
-        return int(self._lib.hvd_tcp_add_process_set(arr, len(ranks)))
+        ps_id = int(self._lib.hvd_tcp_add_process_set(arr, len(ranks)))
+        self._ps_sizes[ps_id] = len(ranks)
+        return ps_id
 
     def register_group(self, names: Sequence[str]) -> int:
         arr = (ctypes.c_char_p * len(names))(

@@ -36,12 +36,13 @@ int hvd_tcp_is_initialized() {
 void hvd_tcp_request_shutdown() { CoreState::Get().RequestShutdown(); }
 void hvd_tcp_wait_shutdown() { CoreState::Get().WaitShutdown(); }
 
-// op_type/dtype/red_op: enum ints matching common.h.
-int hvd_tcp_enqueue(const char* name, int op_type, const void* data,
-                    const long long* dims, int ndim, int dtype, int red_op,
-                    int root_rank, unsigned int process_set_id,
-                    double prescale, double postscale,
-                    const long long* splits, int nsplits) {
+namespace {
+// Shared Request marshaling for both enqueue entry points.
+Request BuildRequest(const char* name, int op_type, const long long* dims,
+                     int ndim, int dtype, int red_op, int root_rank,
+                     unsigned int process_set_id, double prescale,
+                     double postscale, const long long* splits,
+                     int nsplits, bool external) {
   Request q;
   q.op_type = static_cast<OpType>(op_type);
   q.dtype = static_cast<DataType>(dtype);
@@ -51,14 +52,56 @@ int hvd_tcp_enqueue(const char* name, int op_type, const void* data,
   q.prescale = prescale;
   q.postscale = postscale;
   q.name = name ? name : "";
+  q.external_payload = external;
   for (int i = 0; i < ndim; ++i) q.shape.dims.push_back(dims[i]);
   for (int i = 0; i < nsplits; ++i) q.splits.push_back(splits[i]);
+  return q;
+}
+}  // namespace
+
+// op_type/dtype/red_op: enum ints matching common.h.
+int hvd_tcp_enqueue(const char* name, int op_type, const void* data,
+                    const long long* dims, int ndim, int dtype, int red_op,
+                    int root_rank, unsigned int process_set_id,
+                    double prescale, double postscale,
+                    const long long* splits, int nsplits) {
+  Request q = BuildRequest(name, op_type, dims, ndim, dtype, red_op,
+                           root_rank, process_set_id, prescale, postscale,
+                           splits, nsplits, /*external=*/false);
   int64_t nbytes = q.shape.num_elements() *
                    static_cast<int64_t>(DataTypeSize(q.dtype));
   return CoreState::Get().Enqueue(std::move(q), data, nbytes);
 }
 
 int hvd_tcp_join() { return CoreState::Get().EnqueueJoin(); }
+
+// Device-payload enqueue (multihost SPMD mode): negotiation/order only;
+// the XLA executor moves the bytes.  No data pointer — the tensor lives
+// on device.
+int hvd_tcp_enqueue_external(const char* name, int op_type,
+                             const long long* dims, int ndim, int dtype,
+                             int red_op, int root_rank,
+                             unsigned int process_set_id, double prescale,
+                             double postscale, const long long* splits,
+                             int nsplits) {
+  Request q = BuildRequest(name, op_type, dims, ndim, dtype, red_op,
+                           root_rank, process_set_id, prescale, postscale,
+                           splits, nsplits, /*external=*/true);
+  return CoreState::Get().Enqueue(std::move(q), nullptr, 0);
+}
+
+// Pop the next negotiated device-payload group record (response order,
+// identical across ranks).  Returns record length, 0 when none pending,
+// or -needed when buflen is too small.
+int hvd_tcp_next_negotiated(unsigned char* buf, int buflen) {
+  return CoreState::Get().NextNegotiated(buf, buflen);
+}
+
+void hvd_tcp_external_done(int handle, int ok, const char* err) {
+  CoreState::Get().ExternalDone(
+      handle, ok ? Status::OK()
+                 : Status::UnknownError(err ? err : "external op failed"));
+}
 
 int hvd_tcp_poll(int handle) { return CoreState::Get().Poll(handle); }
 

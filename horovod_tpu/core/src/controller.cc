@@ -105,11 +105,13 @@ void Controller::Absorb(const CycleRequest& req) {
     const Request& c = p.request;
     if (q.op_type != c.op_type || q.dtype != c.dtype ||
         q.red_op != c.red_op || q.process_set_id != c.process_set_id ||
-        q.root_rank != c.root_rank) {
+        q.root_rank != c.root_rank ||
+        q.external_payload != c.external_payload) {
       p.error = true;
       p.error_message =
           "Mismatched collective for tensor '" + q.name +
-          "': ranks disagree on op/dtype/reduce-op/process-set/root.";
+          "': ranks disagree on op/dtype/reduce-op/process-set/root/"
+          "payload plane.";
     } else if (q.op_type == OpType::ALLREDUCE ||
                q.op_type == OpType::REDUCESCATTER ||
                q.op_type == OpType::BROADCAST) {
@@ -143,6 +145,7 @@ Response Controller::BuildResponse(const Request& q) {
   r.prescale = q.prescale;
   r.postscale = q.postscale;
   r.tensor_names = {q.name};
+  r.external = q.external_payload;
   if (q.op_type == OpType::ALLREDUCE)
     r.aux_sizes = {q.shape.num_elements()};
   return r;
@@ -278,7 +281,8 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
                       std::to_string(static_cast<int>(r.dtype)) + "|" +
                       std::to_string(static_cast<int>(r.red_op)) + "|" +
                       std::to_string(r.prescale) + "|" +
-                      std::to_string(r.postscale);
+                      std::to_string(r.postscale) + "|" +
+                      (r.external ? "x" : "h");
     uint64_t bytes = 0;
     auto sit = tensor_bytes_.find(r.tensor_names[0]);
     if (sit != tensor_bytes_.end()) bytes = sit->second;

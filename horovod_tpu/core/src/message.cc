@@ -75,6 +75,7 @@ void Request::Serialize(Writer& w) const {
   for (auto d : shape.dims) w.i64(d);
   w.u32(static_cast<uint32_t>(splits.size()));
   for (auto s : splits) w.i64(s);
+  w.u8(external_payload ? 1 : 0);
 }
 
 Request Request::Deserialize(Reader& r) {
@@ -91,6 +92,7 @@ Request Request::Deserialize(Reader& r) {
   for (int i = 0; i < nd; ++i) q.shape.dims.push_back(r.i64());
   uint32_t ns = r.u32();
   for (uint32_t i = 0; i < ns; ++i) q.splits.push_back(r.i64());
+  q.external_payload = r.u8() != 0;
   return q;
 }
 
@@ -109,6 +111,7 @@ void Response::Serialize(Writer& w) const {
   w.u32(static_cast<uint32_t>(aux_sizes.size()));
   for (auto v : aux_sizes) w.i64(v);
   w.u32(static_cast<uint32_t>(last_joined));
+  w.u8(external ? 1 : 0);
 }
 
 Response Response::Deserialize(Reader& r) {
@@ -127,6 +130,7 @@ Response Response::Deserialize(Reader& r) {
   uint32_t na = r.u32();
   for (uint32_t i = 0; i < na; ++i) p.aux_sizes.push_back(r.i64());
   p.last_joined = static_cast<int32_t>(r.u32());
+  p.external = r.u8() != 0;
   return p;
 }
 

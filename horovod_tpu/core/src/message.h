@@ -53,6 +53,11 @@ struct Request {
   std::string name;
   TensorShape shape;
   std::vector<int64_t> splits;  // alltoall send splits
+  // Device-payload op (multihost SPMD mode): the core negotiates
+  // readiness and ordering only; the payload executes as an XLA
+  // collective over ICI/DCN, driven by the Python executor (the
+  // MPI-control/NCCL-payload split of the reference, SURVEY §2.6).
+  bool external_payload = false;
 
   void Serialize(Writer& w) const;
   static Request Deserialize(Reader& r);
@@ -71,6 +76,7 @@ struct Response {
   // allgather: first-dims per (tensor, rank); alltoall: recv splits.
   std::vector<int64_t> aux_sizes;
   int32_t last_joined = -1;  // join result
+  bool external = false;  // payload executes on-device (XLA), not here
 
   void Serialize(Writer& w) const;
   static Response Deserialize(Reader& r);

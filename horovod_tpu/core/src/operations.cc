@@ -145,6 +145,10 @@ Status CoreState::Initialize(int rank, int size,
   shutdown_requested_ = false;
   join_requested_ = false;
   {
+    std::lock_guard<std::mutex> lk(negotiated_mu_);
+    negotiated_groups_.clear();
+  }
+  {
     std::lock_guard<std::mutex> lk(handles_mu_);
     join_entry_ = nullptr;
   }
@@ -175,10 +179,11 @@ int32_t CoreState::Enqueue(Request req, const void* data, int64_t nbytes) {
                           std::string("NEGOTIATE_") +
                               OpTypeName(entry->request.op_type));
   if (!queue_.Add(entry)) {
+    entry->BeginComplete();
     entry->status = Status::InvalidArgument(
         "A collective for tensor '" + entry->request.name +
         "' is already pending; names must be unique among in-flight ops");
-    entry->done = true;
+    entry->PublishDone();
   }
   std::lock_guard<std::mutex> lk(handles_mu_);
   int32_t h = next_handle_++;
@@ -221,10 +226,28 @@ void CoreState::Release(int32_t handle) {
   handles_.erase(handle);
 }
 
+int CoreState::NextNegotiated(uint8_t* buf, int buflen) {
+  std::lock_guard<std::mutex> lk(negotiated_mu_);
+  if (negotiated_groups_.empty()) return 0;
+  auto& rec = negotiated_groups_.front();
+  int n = static_cast<int>(rec.size());
+  if (n > buflen) return -n;
+  std::memcpy(buf, rec.data(), rec.size());
+  negotiated_groups_.pop_front();
+  return n;
+}
+
+void CoreState::ExternalDone(int32_t handle, const Status& s) {
+  auto e = GetEntry(handle);
+  if (!e) return;
+  CompleteEntry(e, s);
+}
+
 void CoreState::CompleteEntry(const std::shared_ptr<TensorTableEntry>& e,
                               const Status& s) {
+  if (!e->BeginComplete()) return;  // an abort path already completed it
   e->status = s;
-  e->done = true;
+  e->PublishDone();
   timeline_.ActivityEnd(e->request.name);
   queue_.Remove(e->request.name);
   // Transient grouped-collective record: drop with its last member.
@@ -264,9 +287,9 @@ void CoreState::BackgroundLoop() {
       queue_.AbortAll(s);
       std::lock_guard<std::mutex> lk(handles_mu_);
       for (auto& kv : handles_)
-        if (!kv.second->done) {
+        if (kv.second->BeginComplete()) {
           kv.second->status = s;
-          kv.second->done = true;
+          kv.second->PublishDone();
         }
       stopped_ = true;
       return;
@@ -290,6 +313,7 @@ void CoreState::BackgroundLoop() {
             q.root_rank = r.root_rank;
             q.prescale = r.prescale;
             q.postscale = r.postscale;
+            q.external_payload = r.external;
             q.name = r.tensor_names[i];
             if (i < r.aux_sizes.size())
               q.shape.dims = {r.aux_sizes[i]};
@@ -328,9 +352,9 @@ void CoreState::BackgroundLoop() {
         // A join in flight lives only in handles_/join_entry_ (not the
         // queue); abort it too or its poller spins forever.
         std::lock_guard<std::mutex> lk(handles_mu_);
-        if (join_entry_ && !join_entry_->done) {
+        if (join_entry_ && join_entry_->BeginComplete()) {
           join_entry_->status = abort;
-          join_entry_->done = true;
+          join_entry_->PublishDone();
         }
         join_entry_ = nullptr;
       }
@@ -361,6 +385,34 @@ void CoreState::PerformOperation(const Response& r) {
     return;
   }
   if (my_idx < 0) return;  // not a member of this process set
+
+  if (r.external) {
+    // Device-payload op: negotiation decided the cross-rank execution
+    // order; hand the (possibly fused) group to the XLA executor
+    // instead of moving bytes here.  The record is self-describing so
+    // a joined rank with no local entries can still participate with a
+    // zero contribution.
+    Writer w;
+    w.u8(static_cast<uint8_t>(r.op_type));
+    w.u8(static_cast<uint8_t>(r.dtype));
+    w.u8(static_cast<uint8_t>(r.red_op));
+    w.u32(static_cast<uint32_t>(r.root_rank));
+    w.u32(r.process_set_id);
+    w.f64(r.prescale);
+    w.f64(r.postscale);
+    w.u32(static_cast<uint32_t>(r.aux_sizes.size()));
+    for (auto v : r.aux_sizes) w.i64(v);
+    w.u32(static_cast<uint32_t>(entries.size()));
+    for (size_t i = 0; i < entries.size(); ++i) {
+      w.str(r.tensor_names[i]);
+      w.i64(entries[i] ? entries[i]->handle : -1);
+      if (entries[i])
+        timeline_.ActivityStart(r.tensor_names[i], "EXEC_EXTERNAL");
+    }
+    std::lock_guard<std::mutex> lk(negotiated_mu_);
+    negotiated_groups_.push_back(std::move(w.buf));
+    return;
+  }
 
   switch (r.op_type) {
     case OpType::ALLREDUCE: {
@@ -565,13 +617,13 @@ void CoreState::PerformOperation(const Response& r) {
         join_entry_ = nullptr;
       }
       join_requested_ = false;
-      if (je) {
+      if (je && je->BeginComplete()) {
         int64_t last = r.last_joined;
         je->output.resize(8);
         std::memcpy(je->output.data(), &last, 8);
         je->output_dims = {1};
         je->status = Status::OK();
-        je->done = true;
+        je->PublishDone();
       }
       break;
     }

@@ -9,6 +9,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -54,6 +55,14 @@ class CoreState {
   std::shared_ptr<TensorTableEntry> GetEntry(int32_t handle);
   void Release(int32_t handle);
 
+  // External-payload (device collective) protocol: negotiated groups
+  // are queued in response order — identical on every rank — for the
+  // XLA executor to run; ExternalDone completes the member entries.
+  // NextNegotiated copies one serialized group record into buf and
+  // returns its length; 0 = none pending; -needed if buflen too small.
+  int NextNegotiated(uint8_t* buf, int buflen);
+  void ExternalDone(int32_t handle, const Status& s);
+
   uint32_t RegisterProcessSet(const std::vector<int32_t>& ranks) {
     return process_sets_.Register(ranks);
   }
@@ -93,6 +102,9 @@ class CoreState {
   std::map<int32_t, std::shared_ptr<TensorTableEntry>> handles_;
   int32_t next_handle_ = 0;
   std::shared_ptr<TensorTableEntry> join_entry_;
+
+  std::mutex negotiated_mu_;
+  std::deque<std::vector<uint8_t>> negotiated_groups_;
 
   std::thread background_;
   std::atomic<bool> shutdown_requested_{false};

@@ -20,6 +20,10 @@ std::string ResponseCache::Key(const Request& q) {
   k += std::to_string(q.prescale);
   k += '|';
   k += std::to_string(q.postscale);
+  // Payload plane is part of identity: a host-payload negotiation must
+  // never replay as a device-payload one (or vice versa).
+  k += '|';
+  k += q.external_payload ? 'x' : 'h';
   return k;
 }
 

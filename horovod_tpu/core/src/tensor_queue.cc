@@ -37,9 +37,9 @@ void TensorQueue::Remove(const std::string& name) {
 void TensorQueue::AbortAll(const Status& reason) {
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& kv : table_) {
-    if (!kv.second->done) {
+    if (kv.second->BeginComplete()) {
       kv.second->status = reason;
-      kv.second->done = true;
+      kv.second->PublishDone();
     }
   }
   table_.clear();

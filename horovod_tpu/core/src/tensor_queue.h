@@ -4,6 +4,7 @@
 #ifndef HVD_TPU_TENSOR_QUEUE_H
 #define HVD_TPU_TENSOR_QUEUE_H
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -26,7 +27,15 @@ struct TensorTableEntry {
   std::vector<int64_t> output_dims;
   std::vector<int64_t> recv_splits;  // alltoall
   Status status = Status::InProgress();
-  bool done = false;
+  // Completion has multiple potential writers (background loop, the
+  // external-payload executor thread, abort paths): BeginComplete
+  // elects exactly one, which writes status/output BEFORE publishing
+  // through `done` (release); pollers read `done` (acquire) and only
+  // then touch status/output.
+  std::atomic<bool> completing{false};
+  std::atomic<bool> done{false};
+  bool BeginComplete() { return !completing.exchange(true); }
+  void PublishDone() { done.store(true, std::memory_order_release); }
 };
 
 class TensorQueue {

@@ -156,3 +156,48 @@ def test_tcp_hierarchical_allgather_own_knob():
         "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
         "HVD_TPU_HOST_OF_RANK": "0,0,1,1",
     }, timeout=180))
+
+
+EXTERNAL_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "utils", "external_worker.py")
+
+
+def _spawn_external_world(size, scenario, timeout=120):
+    _port_base[0] += size + 3
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_PORT_BASE": str(_port_base[0]),
+            "TEST_SCENARIO": scenario,
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, EXTERNAL_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out.decode(), err.decode()))
+    return outs
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_external_payload_negotiation_order(size):
+    # Device-payload ops: negotiation must deliver one identical
+    # execution order on every rank (verified cross-rank by the worker).
+    _assert_ok(_spawn_external_world(size, "order"))
+
+
+def test_external_payload_mixed_with_host_ops():
+    # External and host ops interleave; external never fuses with host,
+    # executor failures surface through the handle.
+    _assert_ok(_spawn_external_world(2, "mixed"))

@@ -1,0 +1,125 @@
+"""Worker exercising the core's external-payload (device collective)
+protocol: enqueue negotiation-only ops, drain negotiated group records,
+and check every rank observes the SAME execution order — the contract the
+multihost XLA executor depends on (reference analog: the MPI-control /
+NCCL-payload split, SURVEY.md §2.6)."""
+
+import ctypes
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+from horovod_tpu.common.topology import multiprocess_topology
+from horovod_tpu.common.config import Config
+from horovod_tpu.core.client import TcpCore, parse_negotiated_record
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    topo = multiprocess_topology(rank, size)
+    core = TcpCore(topo, Config.from_env())
+    core.initialize()
+    try:
+        scenario = os.environ.get("TEST_SCENARIO", "order")
+        if scenario == "order":
+            run_order(core, rank, size)
+        elif scenario == "mixed":
+            run_mixed(core, rank, size)
+    finally:
+        core.shutdown()
+
+
+def drain_groups(core, expect_entries, timeout=30.0):
+    """Collect negotiated group records until expect_entries handles seen."""
+    import time
+    groups = []
+    seen = 0
+    deadline = time.monotonic() + timeout
+    while seen < expect_entries:
+        rec = core.next_negotiated()
+        if rec is None:
+            assert time.monotonic() < deadline, \
+                "timed out draining negotiated groups (%d/%d)" % (
+                    seen, expect_entries)
+            time.sleep(0.002)
+            continue
+        g = parse_negotiated_record(rec)
+        groups.append(g)
+        seen += len(g["entries"])
+    return groups
+
+
+def run_order(core, rank, size):
+    # Enqueue external allreduces in rank-dependent wall order (rank r
+    # delays differently) — negotiation must still deliver ONE global
+    # order, identical across ranks.
+    import time
+    handles = {}
+    names = ["x.%d" % i for i in range(6)]
+    for i, n in enumerate(names):
+        if rank % 2 == 1:
+            time.sleep(0.01 * (6 - i))
+        h = core.enqueue_external(
+            n, "allreduce", shape=(4 + i,), dtype=np.float32)
+        handles[n] = h
+    groups = drain_groups(core, len(names))
+    flat = [e["name"] for g in groups for e in g["entries"]]
+    assert sorted(flat) == sorted(names), flat
+    # Report the observed order through a REAL collective so ranks can
+    # cross-check: allgather the order string and compare.
+    order_blob = np.frombuffer(",".join(flat).encode(), dtype=np.uint8)
+    gathered = core.allgather_async(order_blob, "order_check").wait(30)
+    text = bytes(np.asarray(gathered).tobytes()).decode()
+    mine = ",".join(flat)
+    assert text == mine * size, (mine, text)
+    # Groups carry metadata + handles; complete them.
+    for g in groups:
+        assert g["op_type"] == "allreduce"
+        assert g["dtype"] == np.dtype("float32")
+        for e in g["entries"]:
+            assert e["handle"] == handles[e["name"]]._h
+            core.external_done(e["handle"], ok=True)
+    for n in names:
+        # Completes without error; external ops carry no host payload
+        # (the device result lives with the executor).
+        handles[n].wait(timeout=30)
+    print("ORDER_OK", rank)
+
+
+def run_mixed(core, rank, size):
+    # External and host-payload allreduces interleave but never fuse
+    # together; host ops still move bytes through the CPU rings.
+    hx = core.enqueue_external("dev.a", "allreduce", shape=(8,),
+                               dtype=np.float32)
+    arr = np.full((8,), float(rank + 1), np.float32)
+    hh = core.allreduce_async(arr, "host.a")
+    groups = drain_groups(core, 1)
+    (g,) = groups
+    assert [e["name"] for e in g["entries"]] == ["dev.a"]
+    core.external_done(g["entries"][0]["handle"], ok=True)
+    hx.wait(30)
+    out = hh.wait(30)
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    # An external op can also FAIL from the executor; the error must
+    # surface through the handle.
+    hx2 = core.enqueue_external("dev.fail", "allreduce", shape=(2,),
+                                dtype=np.float32)
+    (g2,) = drain_groups(core, 1)
+    core.external_done(g2["entries"][0]["handle"], ok=False,
+                       error="device exploded")
+    try:
+        hx2.wait(30)
+        raise AssertionError("expected HorovodInternalError")
+    except Exception as e:  # HorovodInternalError
+        assert "device exploded" in str(e)
+    print("MIXED_OK", rank)
+
+
+if __name__ == "__main__":
+    main()
