@@ -17,6 +17,13 @@ Controller modes (reference: MPI vs Gloo controller selection):
   (``horovod_tpu/core``), bootstrap via the rendezvous KV server.  The
   Gloo-equivalent.  Selected automatically when the launcher exported
   ``HOROVOD_RANK``/``HOROVOD_SIZE``.
+* ``multihost`` — one process per host, every process joined into one
+  global JAX runtime (``jax.distributed``): the native core carries the
+  control plane (negotiation/stall/elastic) while payloads execute as
+  XLA collectives over the global mesh — ICI/DCN on pods.  The
+  reference's MPI-control/NCCL-payload split (SURVEY §2.6), TPU-native.
+  Select with ``--multihost`` on the launcher or
+  ``HOROVOD_CONTROLLER=multihost``.
 """
 
 from __future__ import annotations
@@ -47,7 +54,8 @@ class _GlobalState:
         self.config: Optional[Config] = None
         self.topology: Optional[Topology] = None
         self.engine = None          # CollectiveEngine (inprocess mode)
-        self.tcp_core = None        # native core handle (tcp mode)
+        self.tcp_core = None        # native core handle (tcp/multihost)
+        self.mh_engine = None       # MultihostEngine (multihost mode)
         self.controller_mode = "inprocess"
         self.lock = threading.Lock()
 
@@ -115,12 +123,18 @@ def init(devices: Optional[Sequence] = None,
                     log_path=config.autotune_log,
                     warmup=config.autotune_warmup_samples,
                     steps_per_sample=config.autotune_steps_per_sample)
-        elif mode == "tcp":
+        elif mode in ("tcp", "multihost"):
             from ..core.client import TcpCore
             _state.topology = multiprocess_topology(
                 config.rank or 0, config.size or 1,
                 config.local_rank, config.local_size,
                 config.cross_rank, config.cross_size)
+            if mode == "multihost":
+                # Payload plane first: join the global JAX runtime so
+                # jax.devices() spans the world before any mesh builds.
+                from .multihost import init_jax_distributed
+                init_jax_distributed(config, _state.topology.rank,
+                                     _state.topology.size)
             _state.tcp_core = TcpCore(_state.topology, config)
             try:
                 _state.tcp_core.initialize()
@@ -133,14 +147,23 @@ def init(devices: Optional[Sequence] = None,
                     pass
                 _state.tcp_core = None
                 raise
+            if mode == "multihost":
+                from ..ops.multihost import MultihostEngine
+                _state.mh_engine = MultihostEngine(
+                    _state.tcp_core, config, timeline,
+                    _resolve_process_set_ranks)
         else:
             raise ValueError("unknown controller mode %r" % mode)
 
         _ps.reset_registry()
+        # Mark initialized BEFORE registering init-time process sets:
+        # registration mirrors each set into the native core (tcp /
+        # multihost modes), which the registry only does for an
+        # initialized runtime.
+        _state.initialized = True
         if process_sets:
             for ps in process_sets:
                 _ps.add_process_set(ps)
-        _state.initialized = True
         atexit.register(shutdown)
 
 
@@ -152,9 +175,17 @@ def shutdown():
         if _state.engine is not None:
             _state.engine.shutdown()
             _state.engine = None
+        if _state.mh_engine is not None:
+            _state.mh_engine.shutdown()
+            _state.mh_engine = None
         if _state.tcp_core is not None:
             _state.tcp_core.shutdown()
             _state.tcp_core = None
+        if _state.controller_mode == "multihost":
+            # Leave the global JAX runtime so an elastic re-init can
+            # rejoin a (possibly resized) world cleanly.
+            from .multihost import shutdown_jax_distributed
+            shutdown_jax_distributed()
         get_timeline().shutdown()
         _ps.reset_registry()
         _state.initialized = False
@@ -186,6 +217,17 @@ def _get_engine():
 def _get_tcp_core():
     _require_init()
     return _state.tcp_core
+
+
+def _get_mh_engine():
+    _require_init()
+    if _state.mh_engine is None:
+        raise RuntimeError("not in multihost mode")
+    return _state.mh_engine
+
+
+def _controller_mode() -> str:
+    return _state.controller_mode
 
 
 def _get_config() -> Config:

@@ -88,7 +88,7 @@ class Config:
     log_timestamp: bool = True
 
     # --- distributed / controller selection ---
-    controller: str = "auto"  # auto | inprocess | tcp
+    controller: str = "auto"  # auto | inprocess | tcp | multihost
     rank: Optional[int] = None
     size: Optional[int] = None
     local_rank: Optional[int] = None
@@ -97,6 +97,7 @@ class Config:
     cross_size: Optional[int] = None
     rendezvous_addr: Optional[str] = None  # host:port of the KV server
     secret_key: Optional[str] = None
+    coordinator_addr: Optional[str] = None  # jax.distributed coordinator
 
     # --- misc parity knobs ---
     dynamic_process_sets: bool = False
@@ -138,6 +139,7 @@ class Config:
             cross_size=opt_int("CROSS_SIZE"),
             rendezvous_addr=_env("RENDEZVOUS_ADDR"),
             secret_key=_env("SECRET_KEY"),
+            coordinator_addr=_env("COORDINATOR_ADDR"),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             num_streams=_env_int("NUM_STREAMS", 1),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),

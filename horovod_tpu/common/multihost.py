@@ -1,0 +1,88 @@
+"""Multihost bootstrap: join every worker process into one global JAX
+runtime.
+
+TPU-native counterpart of the reference's MPI bootstrap
+(``horovod/common/mpi/mpi_context.cc`` ``MPI_Init`` rank assignment,
+SURVEY.md §2.6): on TPU pods the coordination service behind
+``jax.distributed.initialize`` plays MPI's role — it wires one process
+per host into a runtime where ``jax.devices()`` spans the pod and XLA
+collectives ride ICI/DCN.  The coordinator address travels the same way
+Gloo's rendezvous does in the reference: rank 0 advertises it through
+the launcher's HTTP KV store.
+
+On the CPU test world (``JAX_PLATFORMS=cpu`` with
+``--xla_force_host_platform_device_count=N``) the same code path forms
+an n-process × N-device global mesh with gloo carrying the cross-process
+collectives — the Gloo-on-localhost test strategy of the reference
+(SURVEY.md §4) applied to the payload plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from typing import Optional
+
+LOG = logging.getLogger("horovod_tpu")
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def resolve_coordinator(config, rank: int, size: int) -> str:
+    """Coordinator address: explicit env/config, the rendezvous KV, or a
+    deterministic localhost port for single-host worlds."""
+    if config.coordinator_addr:
+        return config.coordinator_addr
+    if config.rendezvous_addr:
+        from ..runner.http_client import RendezvousClient
+        client = RendezvousClient(config.rendezvous_addr,
+                                  secret=config.secret_key)
+        if rank == 0:
+            host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+            addr = "%s:%d" % (host, _free_port())
+            client.put("jax_coordinator", addr)
+            return addr
+        return client.get_blocking("jax_coordinator", timeout=120.0)
+    # Single-host default: a port derived from the launcher's port base,
+    # clear of the tcp-core range [base, base+size).
+    base = int(os.environ.get("HOROVOD_PORT_BASE", "29600"))
+    return "127.0.0.1:%d" % (base + size + 101)
+
+
+def init_jax_distributed(config, rank: int, size: int):
+    """Join the global JAX runtime (idempotent per process)."""
+    import jax
+
+    if getattr(init_jax_distributed, "_done", False):
+        return
+    # CPU test world: cross-process collectives need the gloo
+    # implementation; on TPU the flag only affects the auxiliary CPU
+    # backend, so gate on the configured platform.
+    platforms = (os.environ.get("JAX_PLATFORMS", "")
+                 or str(jax.config.jax_platforms or ""))
+    if "cpu" in platforms.split(","):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    coordinator = resolve_coordinator(config, rank, size)
+    LOG.info("multihost: joining jax.distributed at %s as %d/%d",
+             coordinator, rank, size)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=size, process_id=rank)
+    init_jax_distributed._done = True
+
+
+def shutdown_jax_distributed():
+    import jax
+
+    if getattr(init_jax_distributed, "_done", False):
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        init_jax_distributed._done = False

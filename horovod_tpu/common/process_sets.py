@@ -103,14 +103,39 @@ class ProcessSetTable:
             ps.process_set_id = self._next_id
             self._by_id[ps.process_set_id] = ps
             self._next_id += 1
+            # Multi-process modes: mirror the registration into the
+            # native core so the controller can scope negotiation to
+            # the set.  Every rank registers in the same order (the
+            # reference's contract), so ids agree across the world.
+            if (ps.ranks is not None and basics.is_initialized()
+                    and not basics._controller_is_spmd()):
+                core = basics._get_tcp_core()
+                core_id = core.add_process_set(ps.ranks)
+                if core_id != ps.process_set_id:
+                    raise RuntimeError(
+                        "process-set id mismatch between the Python "
+                        "registry (%d) and the native core (%d); "
+                        "register sets in the same order on every rank"
+                        % (ps.process_set_id, core_id))
             return ps.process_set_id
 
     def remove(self, ps: ProcessSet):
+        from . import basics
         with self._lock:
             if ps.process_set_id in (None, GLOBAL_PROCESS_SET_ID):
                 raise ValueError("Cannot remove the global process set")
+            removed_id = ps.process_set_id
             self._by_id.pop(ps.process_set_id, None)
             ps.process_set_id = None
+        # Drop the set's cached mesh/executables in whichever engine is
+        # live, and deregister from the native core.
+        if basics.is_initialized():
+            for eng in (basics._state.engine, basics._state.mh_engine):
+                if eng is not None:
+                    eng.invalidate_process_set(removed_id)
+            if basics._state.tcp_core is not None:
+                basics._state.tcp_core._lib.hvd_tcp_remove_process_set(
+                    removed_id)
 
     def get(self, process_set_id: int) -> ProcessSet:
         with self._lock:

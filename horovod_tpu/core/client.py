@@ -266,6 +266,7 @@ class TcpCore:
 
     def initialize(self):
         self._lib = load_library()
+        self._ps_sizes = {0: self.topology.size}
         addrs = self._resolve_addrs()
         rc = self._lib.hvd_tcp_init(
             self.topology.rank, self.topology.size,

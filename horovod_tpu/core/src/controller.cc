@@ -173,6 +173,18 @@ CycleResponse Controller::ComputeResponseList() {
       cache_->hits++;
       tensor_bytes_[q.name] = static_cast<uint64_t>(
           q.shape.num_elements()) * DataTypeSize(q.dtype);
+      // Same joined-rank restriction as the miss path: device-payload
+      // zero-contribution exists for allreduce only.
+      bool member_joined = false;
+      for (auto m : ps->Members(size_))
+        if (joined_.count(m)) member_joined = true;
+      if (q.external_payload && member_joined &&
+          q.op_type != OpType::ALLREDUCE) {
+        resp.error = true;
+        resp.error_message =
+            "Join with device-payload collectives supports allreduce "
+            "only (tensor '" + q.name + "')";
+      }
       out.responses.push_back(resp);
       stall_->RecordDone(q.name);
       ready_cached.push_back(kv.first);
@@ -209,9 +221,23 @@ CycleResponse Controller::ComputeResponseList() {
       if (have < groups_->GroupSize(gid)) continue;
     }
     Response r = BuildResponse(q);
+    bool member_joined = false;
+    if (ps)
+      for (auto m : ps->Members(size_))
+        if (joined_.count(m)) member_joined = true;
     if (p.error) {
       r.error = true;
       r.error_message = p.error_message;
+    } else if (q.external_payload && member_joined &&
+               q.op_type != OpType::ALLREDUCE) {
+      // Device-payload zero-contribution is defined for allreduce only
+      // (a joined rank can synthesize a zero summand, but not unknown
+      // allgather/alltoall geometry); erroring here beats deadlocking
+      // the ranks that would wait in the collective.
+      r.error = true;
+      r.error_message =
+          "Join with device-payload collectives supports allreduce "
+          "only (tensor '" + q.name + "')";
     } else if (q.op_type == OpType::ALLGATHER) {
       // aux = first dims in member order.
       for (auto m : ps->Members(size_)) {

@@ -148,6 +148,7 @@ Status CoreState::Initialize(int rank, int size,
     std::lock_guard<std::mutex> lk(negotiated_mu_);
     negotiated_groups_.clear();
   }
+  process_sets_.Reset();
   {
     std::lock_guard<std::mutex> lk(handles_mu_);
     join_entry_ = nullptr;

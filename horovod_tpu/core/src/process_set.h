@@ -60,6 +60,15 @@ class ProcessSetTable {
     if (id == 0) return false;
     return table_.erase(id) > 0;
   }
+  // Elastic re-init: ids restart at 1 so they track the Python
+  // registry, which resets at every hvd.init().
+  void Reset() {
+    table_.clear();
+    ProcessSet global;
+    global.id = 0;
+    table_[0] = global;
+    next_id_ = 1;
+  }
   const ProcessSet* Get(uint32_t id) const {
     auto it = table_.find(id);
     return it == table_.end() ? nullptr : &it->second;

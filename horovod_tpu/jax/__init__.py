@@ -34,7 +34,8 @@ from .functions import (broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allgather_object)
 from .sync_batch_norm import (SyncBatchNorm, sync_batch_norm_stats,
                               sync_batch_norm_apply)
-from .data_parallel import (make_data_parallel_step, make_sharded_jit_step,
+from .data_parallel import (fetch,
+                            make_data_parallel_step, make_sharded_jit_step,
                             shard_batch, replicate, metric_average)
 from .zero import make_zero1_step
 from .mesh import create_mesh, create_hybrid_mesh

@@ -34,24 +34,78 @@ from .compression import Compression
 from .optimizer import DistributedOptimizer
 
 
+_flat_mesh_cache = {}
+
+
+def _multihost() -> bool:
+    return (basics.is_initialized()
+            and basics._controller_mode() == "multihost")
+
+
 def _world_mesh():
+    """The DP mesh: the in-process engine's device mesh, or — in
+    multihost mode — ONE flat axis over every device of every process
+    (the global mesh ``jax.distributed`` assembled), so the same step
+    builders drive a pod the way they drive a single host."""
+    if _multihost():
+        from jax.sharding import Mesh
+        devs = sorted(jax.devices(),
+                      key=lambda d: (d.process_index, d.id))
+        # Key by the device identities so an elastic re-init with a
+        # changed world never reuses a stale mesh; same-world calls
+        # keep returning the identical Mesh object for jit cache hits.
+        key = tuple((d.process_index, d.id) for d in devs)
+        mesh = _flat_mesh_cache.get(key)
+        if mesh is None:
+            _flat_mesh_cache.clear()
+            mesh = Mesh(np.asarray(devs), (spmd.DEFAULT_AXIS,))
+            _flat_mesh_cache[key] = mesh
+        return mesh
     return basics._get_engine().collectives_for(0).mesh
 
 
 def shard_batch(batch):
-    """Device-put a pytree so leaf dim 0 is sharded across the world."""
+    """Device-put a pytree so leaf dim 0 is sharded across the world.
+
+    In-process mode the argument is the full batch; in multihost mode
+    each process passes ITS shard of the global batch (reference
+    semantics: every rank loads its own data) and the pieces assemble
+    into one global array.
+    """
     mesh = _world_mesh()
     sharding = NamedSharding(mesh, P(spmd.DEFAULT_AXIS))
+    if _multihost():
+        nproc = jax.process_count()
+
+        def put(x):
+            x = np.asarray(x)
+            global_shape = (x.shape[0] * nproc,) + x.shape[1:]
+            return jax.make_array_from_process_local_data(
+                sharding, x, global_shape)
+
+        return jax.tree.map(put, batch)
     return jax.tree.map(
         lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
 
 
 def replicate(tree):
-    """Device-put a pytree fully replicated across the world."""
+    """Device-put a pytree fully replicated across the world (every
+    process must pass the same values in multihost mode)."""
     mesh = _world_mesh()
     sharding = NamedSharding(mesh, P())
     return jax.tree.map(
         lambda x: jax.device_put(jnp.asarray(x), sharding), tree)
+
+
+def fetch(tree):
+    """Host values of a replicated pytree (works on global arrays whose
+    shards span processes: reads this process's replica)."""
+    def get(x):
+        if hasattr(x, "addressable_shards"):
+            return np.asarray(jax.device_get(x.addressable_shards[0].data))
+        return np.asarray(x)
+
+    return jax.tree.map(get, tree)
 
 
 def make_data_parallel_step(loss_fn: Callable,

@@ -79,10 +79,19 @@ def _engine():
 
 
 def _tcp_mode() -> bool:
-    """Multi-process world: collectives route through the native core and
-    each call passes THIS rank's tensor (reference semantics), not a
-    rank-major stack."""
-    return basics.is_initialized() and not basics._controller_is_spmd()
+    """Multi-process world, host payload plane: collectives route
+    through the native core and each call passes THIS rank's tensor
+    (reference semantics), not a rank-major stack."""
+    return (basics.is_initialized()
+            and basics._controller_mode() == "tcp")
+
+
+def _mh_mode() -> bool:
+    """Multi-process world, device payload plane: the native core
+    negotiates order, the multihost engine executes XLA collectives
+    over the global mesh.  Per-rank tensor semantics like tcp mode."""
+    return (basics.is_initialized()
+            and basics._controller_mode() == "multihost")
 
 
 def _np(tensor):
@@ -98,7 +107,14 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
                     ) -> CollectiveHandle:
     red_op = handle_average_backwards_compatibility(op, average)
     ps = process_set or global_process_set
-    if _tcp_mode():
+    if _mh_mode() and red_op != ADASUM:
+        return basics._get_mh_engine().enqueue_allreduce(
+            _auto_name("allreduce", name), tensor, red_op=red_op,
+            prescale=prescale_factor, postscale=postscale_factor,
+            process_set_id=_ps_id(process_set))
+    if _tcp_mode() or _mh_mode():
+        # Adasum in multihost mode rides the host plane: the native
+        # core's TreeAdasum is the projection-math implementation.
         return basics._get_tcp_core().allreduce_async(
             _np(tensor), _auto_name("allreduce", name), op=red_op,
             prescale=prescale_factor, postscale=postscale_factor,
@@ -141,7 +157,15 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
     ps = process_set or global_process_set
     base = _auto_name("grouped_allreduce", name)
     names = ["%s.%d" % (base, i) for i in range(len(tensors))]
-    if _tcp_mode():
+    if _mh_mode() and red_op != ADASUM:
+        core = basics._get_tcp_core()
+        core.register_group(names)
+        eng = basics._get_mh_engine()
+        return [eng.enqueue_allreduce(
+            n, t, red_op=red_op, prescale=prescale_factor,
+            postscale=postscale_factor, process_set_id=ps_id)
+            for t, n in zip(tensors, names)]
+    if _tcp_mode() or _mh_mode():
         core = basics._get_tcp_core()
         # Register the group so the controller negotiates/fuses it
         # atomically (reference: group_table.cc).
@@ -173,6 +197,10 @@ def allgather_async(tensor, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _mh_mode():
+        return basics._get_mh_engine().enqueue_allgather(
+            _auto_name("allgather", name), tensor,
+            process_set_id=_ps_id(process_set))
     if _tcp_mode():
         return basics._get_tcp_core().allgather_async(
             _np(tensor), _auto_name("allgather", name),
@@ -199,6 +227,10 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _mh_mode():
+        return basics._get_mh_engine().enqueue_broadcast(
+            _auto_name("broadcast", name), tensor, root_rank=root_rank,
+            process_set_id=_ps_id(process_set))
     if _tcp_mode():
         return basics._get_tcp_core().broadcast_async(
             _np(tensor), _auto_name("broadcast", name),
@@ -220,6 +252,11 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None
                    ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _mh_mode():
+        return basics._get_mh_engine().enqueue_alltoall(
+            _auto_name("alltoall", name), tensor,
+            splits=None if splits is None else list(np.asarray(splits)),
+            process_set_id=_ps_id(process_set))
     if _tcp_mode():
         return basics._get_tcp_core().alltoall_async(
             _np(tensor), _auto_name("alltoall", name),
@@ -255,6 +292,10 @@ def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
                         process_set: Optional[ProcessSet] = None
                         ) -> CollectiveHandle:
     ps = process_set or global_process_set
+    if _mh_mode():
+        return basics._get_mh_engine().enqueue_reducescatter(
+            _auto_name("reducescatter", name), tensor, red_op=op,
+            process_set_id=_ps_id(process_set))
     if _tcp_mode():
         return basics._get_tcp_core().reducescatter_async(
             _np(tensor), _auto_name("reducescatter", name), op=op,
@@ -275,8 +316,13 @@ def reducescatter(tensor, op=SUM, name=None,
 def barrier(process_set: Optional[ProcessSet] = None):
     """Block until all ranks (and all previously enqueued collectives on
     this process set) have arrived (reference BarrierOp)."""
-    if _tcp_mode():
+    if _tcp_mode() or _mh_mode():
+        # Control-plane sync: negotiation itself is the barrier, so the
+        # host path serves both multi-process modes.  The name must be
+        # the deterministic sequence name — a per-rank unique default
+        # would never match across ranks.
         return basics._get_tcp_core().barrier(
+            name=_auto_name("barrier", None),
             process_set_id=_ps_id(process_set))
     return _engine().enqueue_barrier(
         _auto_name("barrier", None), _ps_id(process_set)).wait()

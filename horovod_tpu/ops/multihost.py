@@ -1,0 +1,416 @@
+"""Multihost (multi-controller SPMD) collective execution.
+
+The TPU-native realisation of the reference's MPI-control/NCCL-payload
+split (``horovod/common/ops/nccl_operations.cc`` executing payloads while
+the MPI/Gloo controller negotiates, SURVEY.md §2.6): one process per
+host, every process a member of one global ``jax`` runtime
+(``jax.distributed.initialize``).  The native TCP core negotiates
+readiness and a single cross-rank execution order; this module's
+executor drains the negotiated group records and runs each collective as
+a compiled XLA program over the GLOBAL device mesh — ICI/DCN on TPU
+pods, gloo on the CPU test world.
+
+Rank semantics: one Horovod rank per process (host), exactly the
+reference's model.  A process's collective input is ITS tensor; the
+global mesh carries one leading "proc" axis (one row per member process)
+and a "local" axis over each process's addressable devices, on which
+contributions are replicated.
+
+Ordering contract: all member processes must issue the same global
+collective programs in the same order or the runtime deadlocks — that is
+precisely what the control plane guarantees, and why eager collectives
+may ONLY be executed by this engine's single executor thread (the role
+the reference's background thread plays for NCCL kernels).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.config import Config
+from ..utils.timeline import Timeline
+from . import xla_ops
+from .engine import CollectiveHandle, HorovodInternalError
+from .xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
+
+LOG = logging.getLogger("horovod_tpu")
+
+
+def _uneven_chunks(total_rows: int, n: int):
+    """Reference ReducescatterOp chunk math: earlier members take the
+    larger shards (cpu_ops.cc uses the same base/remainder split)."""
+    base, rem = divmod(total_rows, n)
+    rows = [base + (1 if i < rem else 0) for i in range(n)]
+    offs = [sum(rows[:i]) for i in range(n)]
+    return rows, offs
+
+
+class GlobalMeshCollectives:
+    """Compiled XLA collectives over the global (all-process) mesh.
+
+    Every method is a *collective program*: all member processes must
+    call it with consistent arguments (guaranteed by negotiation).
+    Executables are cached per (op, dtype, shape, params) so steady
+    state dispatches without retracing.
+    """
+
+    def __init__(self, member_procs: Optional[Sequence[int]] = None,
+                 name: str = "global"):
+        import jax
+        from jax.sharding import Mesh
+
+        all_procs = sorted({d.process_index for d in jax.devices()})
+        self.procs = (list(member_procs) if member_procs is not None
+                      else all_procs)
+        self.size = len(self.procs)
+        self.name = name
+        self.my_idx = (self.procs.index(jax.process_index())
+                       if jax.process_index() in self.procs else -1)
+        devs = sorted((d for d in jax.devices()
+                       if d.process_index in set(self.procs)),
+                      key=lambda d: (self.procs.index(d.process_index),
+                                     d.id))
+        n_local = len(devs) // self.size
+        self.mesh = Mesh(
+            np.asarray(devs).reshape(self.size, n_local),
+            ("proc", "local"))
+        self._fns: Dict[tuple, object] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _sharding(self, spec):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, spec)
+
+    def _global(self, local: np.ndarray):
+        """Stage this process's block [1, ...] into a global array
+        [size, ...] sharded over the proc axis (replicated over local
+        devices within each process)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        global_shape = (self.size,) + tuple(local.shape[1:])
+        return jax.make_array_from_process_local_data(
+            self._sharding(P("proc")), local, global_shape)
+
+    def _fetch(self, arr) -> np.ndarray:
+        """Host value of a replicated global array."""
+        import jax
+        shard = arr.addressable_shards[0].data
+        return np.asarray(jax.device_get(shard))
+
+    def _compiled(self, key, build):
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = build()
+            self._fns[key] = fn
+        return fn
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, local_flat: np.ndarray, red_op: str = SUM,
+                  prescale: float = 1.0, postscale: float = 1.0
+                  ) -> np.ndarray:
+        """Reduce one flat [n] contribution per process -> [n]."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        x = np.asarray(local_flat)[None]  # [1, n]
+        size = self.size
+        key = ("allreduce", str(x.dtype), x.shape, red_op,
+               float(prescale), float(postscale))
+
+        def build():
+            def fn(g):
+                v = g * np.asarray(prescale, g.dtype) \
+                    if prescale != 1.0 else g
+                if red_op in (SUM, AVERAGE, ADASUM):
+                    r = jnp.sum(v, axis=0)
+                    if red_op == AVERAGE:
+                        r = (r / size).astype(v.dtype) if \
+                            jnp.issubdtype(v.dtype, jnp.floating) \
+                            else r // size
+                elif red_op == MIN:
+                    r = jnp.min(v, axis=0)
+                elif red_op == MAX:
+                    r = jnp.max(v, axis=0)
+                elif red_op == PRODUCT:
+                    r = jnp.prod(v, axis=0)
+                else:
+                    raise NotImplementedError(red_op)
+                if postscale != 1.0:
+                    r = r * np.asarray(postscale, r.dtype)
+                return r
+
+            return jax.jit(fn, out_shardings=self._sharding(P()))
+
+        return self._fetch(self._compiled(key, build)(self._global(x)))
+
+    def broadcast(self, local: np.ndarray, root_idx: int) -> np.ndarray:
+        """Member ``root_idx``'s tensor to every process."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        x = np.asarray(local)[None]
+        key = ("broadcast", str(x.dtype), x.shape, int(root_idx))
+
+        def build():
+            return jax.jit(lambda g: g[root_idx],
+                           out_shardings=self._sharding(P()))
+
+        return self._fetch(self._compiled(key, build)(self._global(x)))
+
+    def allgather(self, local: np.ndarray,
+                  rows_per_member: Sequence[int]) -> np.ndarray:
+        """Concat dim-0-ragged per-process tensors (reference
+        AllgatherOp): pad to the max row count, one XLA all-gather,
+        slice the valid segments back out."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        rows = [int(r) for r in rows_per_member]
+        max_rows = max(rows) if rows else 0
+        x = np.asarray(local)
+        pad = max_rows - x.shape[0]
+        if pad:
+            x = np.concatenate(
+                [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        x = x[None]
+        key = ("allgather", str(x.dtype), x.shape, tuple(rows))
+
+        def build():
+            return jax.jit(lambda g: g,
+                           out_shardings=self._sharding(P()))
+
+        full = self._fetch(self._compiled(key, build)(self._global(x)))
+        return np.concatenate(
+            [full[j, :rows[j]] for j in range(self.size)])
+
+    def alltoall(self, local: np.ndarray, splits_matrix: np.ndarray):
+        """Member-major splits matrix routing (reference AlltoallOp).
+
+        v1 moves the exchange as one padded all-gather then local
+        slicing — correct on any mesh; a `lax.all_to_all` fast path for
+        the uniform case is a recorded follow-up.
+        Returns (my_received_rows, recv_splits).
+        """
+        sm = np.asarray(splits_matrix).reshape(self.size, self.size)
+        send_rows = [int(sm[j].sum()) for j in range(self.size)]
+        gathered = self.allgather(local, send_rows)
+        # Segment offsets inside each sender's block.
+        out = []
+        base = 0
+        recv_splits = []
+        for j in range(self.size):  # sender
+            off = int(sm[j, :self.my_idx].sum())
+            cnt = int(sm[j, self.my_idx])
+            out.append(gathered[base + off: base + off + cnt])
+            recv_splits.append(cnt)
+            base += send_rows[j]
+        return np.concatenate(out) if out else gathered[:0], recv_splits
+
+    def reducescatter(self, local: np.ndarray, red_op: str = SUM
+                      ) -> np.ndarray:
+        """Reduce then take this member's dim-0 shard (uneven chunks
+        follow the reference's earlier-ranks-larger split)."""
+        reduced = self.allreduce(
+            np.asarray(local).reshape(-1), red_op).reshape(local.shape)
+        rows, offs = _uneven_chunks(local.shape[0], self.size)
+        i = self.my_idx
+        return reduced[offs[i]: offs[i] + rows[i]]
+
+
+class MultihostEngine:
+    """Single executor thread draining the core's negotiated groups.
+
+    Enqueue side: ops are registered with the control plane
+    (``TcpCore.enqueue_external``) and the local payload parked here.
+    Executor side: for each negotiated group (one fused Response), run
+    the XLA collective over the global mesh in negotiation order, then
+    complete both the Python handles and the core entries.
+    """
+
+    def __init__(self, core, config: Config, timeline: Timeline,
+                 process_set_resolver):
+        self.core = core
+        self.config = config
+        self.timeline = timeline
+        self._resolve_process_set = process_set_resolver
+        self._collectives: Dict[int, GlobalMeshCollectives] = {}
+        self._lock = threading.Lock()
+        # core handle -> (py handle, local payload ndarray, orig shape)
+        self._pending: Dict[int, tuple] = {}
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-tpu-multihost-exec", daemon=True)
+        self._thread.start()
+
+    # -- process-set meshes ------------------------------------------------
+
+    def collectives_for(self, process_set_id: int) -> GlobalMeshCollectives:
+        mc = self._collectives.get(process_set_id)
+        if mc is None:
+            ranks = self._resolve_process_set(process_set_id)
+            mc = GlobalMeshCollectives(ranks, name="ps%d" % process_set_id)
+            self._collectives[process_set_id] = mc
+        return mc
+
+    def invalidate_process_set(self, process_set_id: int):
+        self._collectives.pop(process_set_id, None)
+
+    # -- enqueue API (per-rank tensor semantics) ---------------------------
+
+    def _enqueue(self, name, op_type, arr, **kw) -> CollectiveHandle:
+        py = CollectiveHandle(name)
+        # Enqueue and park ATOMICALLY w.r.t. the executor's _take: the
+        # instant enqueue_external returns, the background thread can
+        # negotiate the op and the executor can pop its record — if the
+        # payload weren't parked yet, this rank would contribute zeros
+        # and the handle would never resolve.
+        with self._lock:
+            ch = self.core.enqueue_external(
+                name, op_type, arr.shape, arr.dtype, **kw)
+            self._pending[ch._h] = (py, arr)
+        return py
+
+    def enqueue_allreduce(self, name, tensor, red_op=SUM, prescale=1.0,
+                          postscale=1.0, process_set_id=0
+                          ) -> CollectiveHandle:
+        arr = np.ascontiguousarray(np.asarray(tensor))
+        return self._enqueue(
+            name, "allreduce", arr, red_op=red_op,
+            process_set_id=process_set_id, prescale=prescale,
+            postscale=postscale)
+
+    def enqueue_allgather(self, name, tensor, process_set_id=0
+                          ) -> CollectiveHandle:
+        arr = np.ascontiguousarray(np.asarray(tensor))
+        return self._enqueue(name, "allgather", arr,
+                             process_set_id=process_set_id)
+
+    def enqueue_broadcast(self, name, tensor, root_rank=0,
+                          process_set_id=0) -> CollectiveHandle:
+        arr = np.ascontiguousarray(np.asarray(tensor))
+        return self._enqueue(name, "broadcast", arr,
+                             root_rank=root_rank,
+                             process_set_id=process_set_id)
+
+    def enqueue_alltoall(self, name, tensor, splits=None,
+                         process_set_id=0) -> CollectiveHandle:
+        arr = np.ascontiguousarray(np.asarray(tensor))
+        if splits is None:
+            n = self.collectives_for(process_set_id).size
+            if arr.shape[0] % n:
+                raise ValueError(
+                    "uniform alltoall needs dim0 %% set size (%d) == 0"
+                    % n)
+            splits = [arr.shape[0] // n] * n
+        return self._enqueue(name, "alltoall", arr, splits=list(splits),
+                             process_set_id=process_set_id)
+
+    def enqueue_reducescatter(self, name, tensor, red_op=SUM,
+                              process_set_id=0) -> CollectiveHandle:
+        arr = np.ascontiguousarray(np.asarray(tensor))
+        return self._enqueue(name, "reducescatter", arr, red_op=red_op,
+                             process_set_id=process_set_id)
+
+    # -- executor ----------------------------------------------------------
+
+    def _loop(self):
+        from ..core.client import parse_negotiated_record
+        while not self._shutdown:
+            rec = self.core.next_negotiated()
+            if rec is None:
+                time.sleep(self.config.cycle_time_ms / 2e3)
+                continue
+            try:
+                self._execute(parse_negotiated_record(rec))
+            except Exception as exc:  # noqa: BLE001 - keep draining
+                LOG.error("multihost executor: %s", exc)
+
+    def _take(self, handle: int):
+        with self._lock:
+            return self._pending.pop(handle, (None, None))
+
+    def _execute(self, g: dict):
+        mc = self.collectives_for(g["process_set_id"])
+        entries = g["entries"]
+        taken = [self._take(e["handle"]) if e["handle"] >= 0
+                 else (None, None) for e in entries]
+        try:
+            results = self._run_group(g, mc, taken)
+            for (py, _), res, e in zip(taken, results, entries):
+                if e["handle"] >= 0:
+                    self.core.external_done(e["handle"], ok=True)
+                    self.core._lib.hvd_tcp_release(e["handle"])
+                if py is not None:
+                    py._set_result(res)
+        except Exception as exc:  # noqa: BLE001
+            LOG.error("multihost %s failed: %s", g["op_type"], exc)
+            for (py, _), e in zip(taken, entries):
+                if e["handle"] >= 0:
+                    self.core.external_done(e["handle"], ok=False,
+                                            error=str(exc))
+                    self.core._lib.hvd_tcp_release(e["handle"])
+                if py is not None:
+                    py._set_error(exc)
+
+    def _run_group(self, g: dict, mc: GlobalMeshCollectives,
+                   taken: List[tuple]) -> List:
+        op = g["op_type"]
+        dtype = g["dtype"]
+        if op == "allreduce":
+            # Fused group: concat flats in negotiated order (missing =
+            # joined rank -> zero contribution), one collective, split.
+            lengths = [int(n) for n in g["aux_sizes"]]
+            flats, shapes = [], []
+            for (py, arr), ln in zip(taken, lengths):
+                if arr is None:
+                    flats.append(np.zeros((ln,), dtype))
+                    shapes.append((ln,))
+                else:
+                    flats.append(arr.reshape(-1))
+                    shapes.append(arr.shape)
+            fused = np.concatenate(flats) if len(flats) > 1 else flats[0]
+            out = mc.allreduce(fused, g["red_op"], g["prescale"],
+                               g["postscale"])
+            results, off = [], 0
+            for ln, shape in zip(lengths, shapes):
+                results.append(out[off:off + ln].reshape(shape))
+                off += ln
+            return results
+        (py, arr) = taken[0]
+        if op == "allgather":
+            rows = g["aux_sizes"]
+            return [mc.allgather(arr, rows)]
+        if op == "broadcast":
+            # root_rank is a GLOBAL rank; map to member index.
+            ranks = self._resolve_process_set(g["process_set_id"])
+            members = ranks if ranks is not None else list(
+                range(mc.size))
+            root_idx = members.index(g["root_rank"])
+            return [mc.broadcast(arr, root_idx)]
+        if op == "alltoall":
+            out, recv = mc.alltoall(arr, np.asarray(g["aux_sizes"]))
+            return [(out, recv)]
+        if op == "reducescatter":
+            return [mc.reducescatter(arr, g["red_op"])]
+        raise NotImplementedError("multihost op %r" % op)
+
+    # -- shutdown ----------------------------------------------------------
+
+    def shutdown(self):
+        self._shutdown = True
+        self._thread.join(timeout=10.0)
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for py, _ in pending.values():
+            if not py.poll():
+                py._set_error(
+                    HorovodInternalError("engine shut down"))

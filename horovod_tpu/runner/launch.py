@@ -50,6 +50,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--autotune-log-file", default=None)
     p.add_argument("--stall-check-time", type=float, default=None)
     p.add_argument("--stall-shutdown-time", type=float, default=None)
+    # Multihost SPMD mode: workers join one global JAX runtime; the
+    # native core carries only the control plane while payloads run as
+    # XLA collectives over ICI/DCN (HOROVOD_CONTROLLER=multihost).
+    p.add_argument("--multihost", action="store_true",
+                   help="device-payload collectives over the global "
+                        "jax.distributed mesh")
     # Elastic flags (reference: elastic launch surface).
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -84,6 +90,11 @@ def build_common_env(args, base_env: Optional[Dict[str, str]] = None
     setif("HOROVOD_AUTOTUNE_LOG", args.autotune_log_file)
     setif("HOROVOD_STALL_CHECK_TIME_SECONDS", args.stall_check_time)
     setif("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", args.stall_shutdown_time)
+    # Always pin the controller: a stray HOROVOD_CONTROLLER inherited
+    # from the launching shell must not silently detach the workers
+    # from the multi-process world.
+    env["HOROVOD_CONTROLLER"] = (
+        "multihost" if getattr(args, "multihost", False) else "tcp")
     return env
 
 
@@ -102,7 +113,7 @@ def worker_env(common: Dict[str, str], rank: int, size: int,
         "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr,
         "HOROVOD_SECRET_KEY": secret,
         "HOROVOD_PORT_BASE": str(port_base),
-        "HOROVOD_CONTROLLER": "tcp",
+        "HOROVOD_CONTROLLER": common.get("HOROVOD_CONTROLLER", "tcp"),
     })
     return env
 

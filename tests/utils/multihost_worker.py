@@ -1,0 +1,123 @@
+"""Worker for multihost-mode tests: N real processes, each with forced
+CPU devices, joined into ONE global JAX runtime — the control plane rides
+the native core, payloads execute as XLA collectives over the global
+mesh (gloo carries the cross-process legs on the CPU test world)."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("TEST_LOCAL_DEVICES", "4")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    n_local = int(os.environ.get("TEST_LOCAL_DEVICES", "4"))
+    assert jax.process_count() == n, jax.process_count()
+    assert len(jax.devices()) == n * n_local, len(jax.devices())
+    assert len(jax.local_devices()) == n_local
+
+    # allreduce: average with prescale, sum, min/max/product, fusion.
+    out = hvd.allreduce(np.full((5,), float(r + 1), np.float32),
+                        op=hvd.Average, name="avg", prescale_factor=2.0)
+    np.testing.assert_allclose(
+        np.asarray(out), 2.0 * np.mean([i + 1.0 for i in range(n)]))
+
+    hs = [hvd.allreduce_async(
+        np.full((3,), float(r) * (i + 1), np.float32),
+        op=hvd.Sum, name="fuse.%d" % i) for i in range(4)]
+    for i, h in enumerate(hs):
+        np.testing.assert_allclose(
+            np.asarray(h.wait(30)),
+            (i + 1.0) * sum(range(n)))
+
+    x = np.array([r + 1], dtype=np.int32)
+    assert int(np.asarray(hvd.allreduce(x, op=hvd.Min, name="mn"))[0]) == 1
+    assert int(np.asarray(hvd.allreduce(x, op=hvd.Max, name="mx"))[0]) == n
+    prod = hvd.allreduce(np.array([2.0], np.float32), op=hvd.Product,
+                         name="pd")
+    np.testing.assert_allclose(np.asarray(prod), [2.0 ** n])
+
+    # grouped allreduce: negotiated atomically, fused on the device.
+    outs = hvd.grouped_allreduce(
+        [np.full((2,), float(r), np.float32),
+         np.full((7,), float(r + 1), np.float32)], op=hvd.Sum,
+        name="grp")
+    np.testing.assert_allclose(np.asarray(outs[0]), sum(range(n)))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               sum(i + 1 for i in range(n)))
+
+    # broadcast from root 1.
+    x = (np.arange(6, dtype=np.float32).reshape(2, 3) if r == 1
+         else np.zeros((2, 3), np.float32))
+    out = hvd.broadcast(x, root_rank=1, name="bc")
+    np.testing.assert_allclose(
+        np.asarray(out), np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    # allgather, ragged: rank r contributes r+1 rows.
+    x = np.full((r + 1, 2), float(r), np.float32)
+    out = np.asarray(hvd.allgather(x, name="ag"))
+    expected = np.concatenate(
+        [np.full((j + 1, 2), float(j), np.float32) for j in range(n)])
+    np.testing.assert_allclose(out, expected)
+
+    # alltoall with ragged splits: rank r sends (j+1) rows to rank j.
+    splits = [j + 1 for j in range(n)]
+    x = np.full((sum(splits), 2), float(r), np.float32)
+    out, recv_splits = hvd.alltoall(x, splits=splits, name="a2a")
+    assert list(recv_splits) == [r + 1] * n, recv_splits
+    out = np.asarray(out)
+    assert out.shape == ((r + 1) * n, 2)
+    np.testing.assert_allclose(
+        out[:, 0], np.repeat(np.arange(n, dtype=np.float32), r + 1))
+
+    # reducescatter, uneven rows (n*2+1): reference chunk math.
+    d0 = n * 2 + 1
+    x = np.tile(np.arange(d0, dtype=np.float32)[:, None], (1, 3))
+    out = np.asarray(hvd.reducescatter(x, op=hvd.Sum, name="rs"))
+    base, rem = divmod(d0, n)
+    my_rows = base + (1 if r < rem else 0)
+    start = r * base + min(r, rem)
+    assert out.shape == (my_rows, 3), out.shape
+    np.testing.assert_allclose(
+        out, n * np.tile(np.arange(start, start + my_rows,
+                                   dtype=np.float32)[:, None], (1, 3)))
+
+    # barrier + process-set-scoped collective on even ranks.
+    hvd.barrier()
+    ps = hvd.add_process_set([i for i in range(0, n, 2)])
+    if r % 2 == 0:
+        out = hvd.allreduce(np.full((3,), float(r), np.float32),
+                            op=hvd.Sum, name="ps_ar", process_set=ps)
+        np.testing.assert_allclose(
+            np.asarray(out), sum(float(i) for i in range(0, n, 2)))
+    hvd.barrier()
+
+    # join with uneven data: rank r runs r+1 steps then joins; device
+    # allreduces keep flowing with joined ranks contributing zeros.
+    for step in range(r + 1):
+        hvd.allreduce_async(np.full((4,), 1.0, np.float32),
+                            op=hvd.Sum, name="j.%d.%d" % (r, step))
+    last = hvd.join()
+    assert 0 <= last < n
+
+    print("MULTIHOST_OK", r, flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
