@@ -5,6 +5,7 @@ plane) — the reference's MPI-control/NCCL-payload split re-based on
 ``jax.distributed`` (SURVEY.md §2.6)."""
 
 import os
+import socket
 import subprocess
 import sys
 
@@ -16,9 +17,34 @@ WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "utils",
 _port_base = [31700]
 
 
+def _free_block(size):
+    """A port base whose tcp-core range [base, base+size) AND the derived
+    jax coordinator port (base+size+101) are currently bindable.  Earlier
+    suite tests spawn and kill real worker processes; a lingering socket
+    on a deterministically-derived port hangs the rendezvous instead of
+    failing fast, so probe before committing to a base."""
+    for _ in range(200):
+        _port_base[0] += size + 120
+        base = _port_base[0]
+        socks = []
+        try:
+            for port in list(range(base, base + size)) + [base + size + 101]:
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", port))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
 def _spawn_multihost(size, local_devices=4, extra_env=None, timeout=240,
-                     worker=WORKER):
-    _port_base[0] += size + 120  # tcp core ports + jax coordinator port
+                     worker=WORKER, _retry=True):
+    base = _free_block(size)
     procs = []
     for rank in range(size):
         env = dict(os.environ)
@@ -27,7 +53,7 @@ def _spawn_multihost(size, local_devices=4, extra_env=None, timeout=240,
         env.update({
             "HOROVOD_RANK": str(rank),
             "HOROVOD_SIZE": str(size),
-            "HOROVOD_PORT_BASE": str(_port_base[0]),
+            "HOROVOD_PORT_BASE": str(base),
             "HOROVOD_CONTROLLER": "multihost",
             "TEST_LOCAL_DEVICES": str(local_devices),
             "HOROVOD_CYCLE_TIME": "1",
@@ -43,6 +69,16 @@ def _spawn_multihost(size, local_devices=4, extra_env=None, timeout=240,
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
+            for q in procs:
+                try:
+                    q.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+            if _retry:
+                # One retry on a fresh port block: multi-process rendezvous
+                # can wedge on transient socket conditions under suite load.
+                return _spawn_multihost(size, local_devices, extra_env,
+                                        timeout, worker, _retry=False)
             raise
         outs.append((p.returncode, out.decode(), err.decode()))
     return outs
