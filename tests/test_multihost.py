@@ -5,90 +5,29 @@ plane) — the reference's MPI-control/NCCL-payload split re-based on
 ``jax.distributed`` (SURVEY.md §2.6)."""
 
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
+
+from tests.utils.spawn import assert_world_ok, spawn_world
 
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "utils",
                       "multihost_worker.py")
 
-_port_base = [31700]
-
-
-def _free_block(size):
-    """A port base whose tcp-core range [base, base+size) AND the derived
-    jax coordinator port (base+size+101) are currently bindable.  Earlier
-    suite tests spawn and kill real worker processes; a lingering socket
-    on a deterministically-derived port hangs the rendezvous instead of
-    failing fast, so probe before committing to a base."""
-    for _ in range(200):
-        _port_base[0] += size + 120
-        base = _port_base[0]
-        socks = []
-        try:
-            for port in list(range(base, base + size)) + [base + size + 101]:
-                s = socket.socket()
-                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-                s.bind(("127.0.0.1", port))
-                socks.append(s)
-            return base
-        except OSError:
-            continue
-        finally:
-            for s in socks:
-                s.close()
-    raise RuntimeError("no free port block found")
-
 
 def _spawn_multihost(size, local_devices=4, extra_env=None, timeout=240,
-                     worker=WORKER, _retry=True):
-    base = _free_block(size)
-    procs = []
-    for rank in range(size):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.pop("XLA_FLAGS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_PORT_BASE": str(base),
-            "HOROVOD_CONTROLLER": "multihost",
-            "TEST_LOCAL_DEVICES": str(local_devices),
-            "HOROVOD_CYCLE_TIME": "1",
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            for q in procs:
-                try:
-                    q.communicate(timeout=10)
-                except Exception:  # noqa: BLE001 - best-effort reap
-                    pass
-            if _retry:
-                # One retry on a fresh port block: multi-process rendezvous
-                # can wedge on transient socket conditions under suite load.
-                return _spawn_multihost(size, local_devices, extra_env,
-                                        timeout, worker, _retry=False)
-            raise
-        outs.append((p.returncode, out.decode(), err.decode()))
-    return outs
+                     worker=WORKER):
+    env = {"HOROVOD_CONTROLLER": "multihost",
+           "TEST_LOCAL_DEVICES": str(local_devices)}
+    env.update(extra_env or {})
+    # base+size+101 is the derived jax coordinator port
+    # (common/multihost.py); probe it free along with the tcp-core range.
+    return spawn_world(worker, size, extra_env=env, timeout=timeout,
+                       extra_port_offsets=(size + 101,),
+                       pop_env=("XLA_FLAGS",))
 
 
 def _assert_ok(outs, marker="MULTIHOST_OK"):
-    for rank, (rc, out, err) in enumerate(outs):
-        assert rc == 0, "rank %d failed (rc=%d):\n%s\n%s" % (rank, rc,
-                                                             out, err)
-        assert "%s %d" % (marker, rank) in out, out
+    assert_world_ok(outs, marker)
 
 
 @pytest.mark.parametrize("size", [2, 3])

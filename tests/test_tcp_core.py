@@ -3,50 +3,23 @@
 SURVEY.md §4)."""
 
 import os
-import subprocess
-import sys
 
 import pytest
+
+from tests.utils.spawn import assert_world_ok, spawn_world
 
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "utils",
                       "tcp_worker.py")
 
-_port_base = [29700]
-
 
 def _spawn_world(size, scenario, extra_env=None, timeout=120):
-    _port_base[0] += size + 3  # fresh ports per world
-    procs = []
-    for rank in range(size):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_PORT_BASE": str(_port_base[0]),
-            "TEST_SCENARIO": scenario,
-            "HOROVOD_CYCLE_TIME": "1",
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out.decode(), err.decode()))
-    return outs
+    env = {"TEST_SCENARIO": scenario}
+    env.update(extra_env or {})
+    return spawn_world(WORKER, size, extra_env=env, timeout=timeout)
 
 
 def _assert_ok(outs):
-    for rank, (rc, out, err) in enumerate(outs):
-        assert rc == 0, "rank %d failed (rc=%d):\n%s\n%s" % (rank, rc,
-                                                             out, err)
+    assert_world_ok(outs)
 
 
 @pytest.mark.parametrize("size", [2, 4])
@@ -163,31 +136,9 @@ EXTERNAL_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _spawn_external_world(size, scenario, timeout=120):
-    _port_base[0] += size + 3
-    procs = []
-    for rank in range(size):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_PORT_BASE": str(_port_base[0]),
-            "TEST_SCENARIO": scenario,
-            "HOROVOD_CYCLE_TIME": "1",
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, EXTERNAL_WORKER], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out.decode(), err.decode()))
-    return outs
+    return spawn_world(EXTERNAL_WORKER, size,
+                       extra_env={"TEST_SCENARIO": scenario},
+                       timeout=timeout)
 
 
 @pytest.mark.parametrize("size", [2, 3])

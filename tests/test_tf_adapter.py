@@ -209,3 +209,16 @@ def test_collectives_inside_tf_function(hvd):
     out = step(x)
     # size-1 world: every collective is identity → 1+1+1+2 = 5x.
     assert np.allclose(out.numpy(), [[5.0, 10.0]])
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_multirank_tape_optimizer_broadcast_compression(size):
+    # Real N-process world: DistributedGradientTape averaging,
+    # broadcast_variables/broadcast_object, the Keras
+    # DistributedOptimizer update, and fp16 wire compression. Closes the
+    # round-1 gap of adapters only being wire-tested at size 1.
+    import os
+    from tests.utils.spawn import spawn_world, assert_world_ok
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "utils", "tf_adapter_worker.py")
+    assert_world_ok(spawn_world(worker, size), "TF_ADAPTER_OK")

@@ -175,3 +175,16 @@ hvd.shutdown()
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "TORCH_OK 0" in proc.stdout
     assert "TORCH_OK 1" in proc.stdout
+
+
+WORKER = os.path.join(REPO, "tests", "utils", "torch_adapter_worker.py")
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_multirank_optimizer_broadcast_compression(size):
+    # Real N-process world: DistributedOptimizer averaging (differs from
+    # local grads, matches a recomputed world mean), parameter/optimizer
+    # state broadcast, and fp16 wire compression. Closes the round-1 gap
+    # of adapters only being wire-tested at size 1.
+    from tests.utils.spawn import spawn_world, assert_world_ok
+    assert_world_ok(spawn_world(WORKER, size), "TORCH_ADAPTER_OK")

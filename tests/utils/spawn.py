@@ -1,0 +1,87 @@
+"""Shared multi-process world spawner for adapter tests.
+
+Real subprocess worlds over localhost TCP rendezvous — the reference's
+``horovodrun -np N pytest`` strategy (SURVEY.md §4) without the
+launcher wrapper.  Ports are probed for bindability before committing
+to a base (earlier suite tests leave lingering sockets; a collision
+hangs the rendezvous rather than failing fast).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_port_base = [27100]
+
+
+def free_port_block(size, extra_offsets=()):
+    """A base where [base, base+size) plus any extra offsets bind."""
+    for _ in range(200):
+        _port_base[0] += size + 30
+        base = _port_base[0]
+        socks = []
+        try:
+            for port in ([base + i for i in range(size)]
+                         + [base + o for o in extra_offsets]):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", port))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
+def spawn_world(worker, size, extra_env=None, timeout=240, retry=True,
+                extra_port_offsets=(), pop_env=()):
+    """Run `worker` as `size` rank processes; returns [(rc, out, err)]."""
+    base = free_port_block(size, extra_port_offsets)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        for key in pop_env:
+            env.pop(key, None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_PORT_BASE": str(base),
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            for q in procs:
+                try:
+                    q.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+            if retry:
+                return spawn_world(worker, size, extra_env, timeout,
+                                   retry=False,
+                                   extra_port_offsets=extra_port_offsets,
+                                   pop_env=pop_env)
+            raise
+        outs.append((p.returncode, out.decode(), err.decode()))
+    return outs
+
+
+def assert_world_ok(outs, marker=None):
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, "rank %d failed (rc=%d):\n%s\n%s" % (rank, rc,
+                                                             out, err)
+        if marker is not None:
+            assert "%s %d" % (marker, rank) in out, out

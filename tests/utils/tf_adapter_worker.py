@@ -1,0 +1,122 @@
+"""Worker for multi-rank TensorFlow adapter tests (real subprocess
+world spawned by test_tf_adapter.py — the reference runs its TF suite
+under ``horovodrun -np 2 pytest``, SURVEY.md §4).
+
+Rank data is a deterministic function of rank, so every rank can
+recompute the whole world's gradients locally and compare.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def rank_x(rank, n=8, d=4):
+    g = np.random.RandomState(2000 + rank)
+    return tf.constant(g.randn(n, d), dtype=tf.float32)
+
+
+def make_weights(seed):
+    g = np.random.RandomState(seed)
+    return (tf.Variable(g.randn(4, 3).astype(np.float32)),
+            tf.Variable(g.randn(3).astype(np.float32)))
+
+
+def local_grads_np(w, b, x):
+    """d/dw, d/db of mean((x @ w + b)^2), computed in numpy."""
+    xn, wn, bn = x.numpy(), w.numpy(), b.numpy()
+    y = xn @ wn + bn
+    dy = 2.0 * y / y.size
+    return xn.T @ dy, dy.sum(axis=0)
+
+
+def run_tape(rank, size):
+    w, b = make_weights(seed=7)
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_mean(tf.square(rank_x(rank) @ w + b))
+    gw, gb = tape.gradient(loss, [w, b])
+
+    per_rank = [local_grads_np(w, b, rank_x(r)) for r in range(size)]
+    exp_w = np.mean([g[0] for g in per_rank], axis=0)
+    exp_b = np.mean([g[1] for g in per_rank], axis=0)
+    mine_w = local_grads_np(w, b, rank_x(rank))[0]
+    assert np.allclose(gw.numpy(), exp_w, atol=1e-5), \
+        "rank %d: tape grads do not match world mean" % rank
+    assert np.allclose(gb.numpy(), exp_b, atol=1e-5)
+    if size > 1:
+        assert not np.allclose(gw.numpy(), mine_w, atol=1e-7), \
+            "rank %d: tape grads identical to local grads" % rank
+
+
+def run_broadcast(rank, size):
+    w, b = make_weights(seed=300 + rank)
+    hvd.broadcast_variables([w, b], root_rank=0)
+    ref_w, ref_b = make_weights(seed=300)
+    assert np.allclose(w.numpy(), ref_w.numpy()), \
+        "rank %d: broadcast_variables did not sync to root" % rank
+    assert np.allclose(b.numpy(), ref_b.numpy())
+
+    obj = hvd.broadcast_object({"epoch": 3, "rank": rank}
+                               if rank == 0 else None, root_rank=0)
+    assert obj == {"epoch": 3, "rank": 0}, \
+        "rank %d: broadcast_object mismatch" % rank
+
+
+def run_optimizer(rank, size):
+    # Keras DistributedOptimizer: one apply_gradients must produce the
+    # full-world-averaged update, identical on every rank.
+    import keras
+    w, b = make_weights(seed=12)
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.1))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean(tf.square(rank_x(rank) @ w + b))
+    grads = tape.gradient(loss, [w, b])
+    opt.apply_gradients(zip(grads, [w, b]))
+
+    per_rank = [local_grads_np(*make_weights(seed=12), x=rank_x(r))
+                for r in range(size)]
+    exp_w = np.mean([g[0] for g in per_rank], axis=0)
+    ref_w, _ = make_weights(seed=12)
+    assert np.allclose(w.numpy(), ref_w.numpy() - 0.1 * exp_w,
+                       atol=1e-5), \
+        "rank %d: optimizer update does not match world mean" % rank
+
+
+def run_compression(rank, size):
+    t = tf.constant([0.5 + rank, -1.25, 2.0], dtype=tf.float32)
+    comp, ctx = hvd.Compression.fp16.compress(t)
+    assert comp.dtype == tf.float16
+    out = hvd.Compression.fp16.decompress(
+        hvd.allreduce(comp, op=hvd.Average, name="tf_comp"), ctx)
+    payloads = [np.array([0.5 + r, -1.25, 2.0], np.float16)
+                for r in range(size)]
+    expected = np.mean([p.astype(np.float32) for p in payloads], axis=0)
+    assert out.dtype == tf.float32
+    assert np.allclose(out.numpy(), expected, atol=1e-3), \
+        "rank %d: fp16-compressed allreduce mismatch" % rank
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    try:
+        assert hvd.rank() == rank and hvd.size() == size
+        run_tape(rank, size)
+        run_broadcast(rank, size)
+        run_optimizer(rank, size)
+        run_compression(rank, size)
+        print("TF_ADAPTER_OK %d" % rank)
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Worker for multi-rank torch adapter tests (run as a real subprocess
+world by test_torch_adapter.py, the way the reference runs its torch
+suite under ``horovodrun -np 2 pytest`` — SURVEY.md §4).
+
+Every check is against a locally recomputed cross-rank reference:
+the data each rank feeds is a deterministic function of its rank, so
+any rank can simulate the whole world in-process and compare.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def rank_data(rank, n=8, d=4):
+    g = np.random.RandomState(1000 + rank)
+    return torch.tensor(g.randn(n, d), dtype=torch.float32)
+
+
+def make_model(seed):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(torch.nn.Linear(4, 3), torch.nn.ReLU(),
+                               torch.nn.Linear(3, 2))
+
+
+def local_grads(model, x):
+    """Gradients of the mean-squared output on x, without mutating
+    model.grad state."""
+    params = [p for p in model.parameters()]
+    loss = model(x).pow(2).mean()
+    return torch.autograd.grad(loss, params)
+
+
+def run_optimizer(rank, size):
+    # All ranks start from identical weights; each feeds its own shard.
+    model = make_model(seed=7)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+
+    # The expected global gradient: mean over every rank's local grad,
+    # recomputed here from scratch (any rank can simulate the world).
+    ref_model = make_model(seed=7)
+    per_rank = [local_grads(ref_model, rank_data(r)) for r in range(size)]
+    expected = [torch.stack([g[i] for g in per_rank]).mean(0)
+                for i in range(len(per_rank[0]))]
+    mine = local_grads(ref_model, rank_data(rank))
+
+    loss = model(rank_data(rank)).pow(2).mean()
+    loss.backward()
+    opt.synchronize()
+    got = [p.grad.detach().clone() for p in model.parameters()]
+    for g, e, m in zip(got, expected, mine):
+        assert torch.allclose(g, e, atol=1e-5), \
+            "rank %d: averaged grad does not match world mean" % rank
+        if size > 1:
+            assert not torch.allclose(g, m, atol=1e-7), \
+                "rank %d: averaged grad identical to local grad" % rank
+
+    with opt.skip_synchronize():
+        opt.step()
+    # After one SGD step every rank must hold identical weights equal to
+    # the reference full-world update.
+    ref_opt = torch.optim.SGD(ref_model.parameters(), lr=0.1)
+    for p, e in zip(ref_model.parameters(), expected):
+        p.grad = e.clone()
+    ref_opt.step()
+    for p, rp in zip(model.parameters(), ref_model.parameters()):
+        assert torch.allclose(p, rp, atol=1e-6), \
+            "rank %d: post-step weights diverge from reference" % rank
+
+
+def run_broadcast(rank, size):
+    # Rank-dependent init; after broadcast all ranks match rank 0's
+    # deterministic weights (recomputable anywhere from the seed).
+    model = make_model(seed=500 + rank)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    ref = make_model(seed=500)
+    for p, rp in zip(model.state_dict().values(), ref.state_dict().values()):
+        assert torch.allclose(p, rp), \
+            "rank %d: broadcast_parameters did not sync to root" % rank
+
+    # broadcast_optimizer_state: rank-dependent momentum buffers.
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    loss = model(rank_data(rank)).pow(2).mean()
+    loss.backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1, momentum=0.9)
+    ref_loss = ref(rank_data(0)).pow(2).mean()
+    ref_loss.backward()
+    ref_opt.step()
+    state = opt.state_dict()["state"]
+    ref_state = ref_opt.state_dict()["state"]
+    for k in ref_state:
+        for field, val in ref_state[k].items():
+            if isinstance(val, torch.Tensor):
+                assert torch.allclose(state[k][field], val, atol=1e-6), \
+                    "rank %d: optimizer state %s/%s not synced" % (
+                        rank, k, field)
+
+
+def run_compression(rank, size):
+    # fp16 wire compression round trip: compress -> allreduce the fp16
+    # payload over the wire -> decompress back to fp32.
+    t = torch.tensor([0.1 + rank, 1.5, -2.25, 3.0 + rank],
+                     dtype=torch.float32)
+    comp, ctx = hvd.Compression.fp16.compress(t)
+    assert comp.dtype == torch.float16
+    out = hvd.Compression.fp16.decompress(
+        hvd.allreduce(comp, op=hvd.Average, name="comp"), ctx)
+    payloads = [torch.tensor([0.1 + r, 1.5, -2.25, 3.0 + r]).half()
+                for r in range(size)]
+    expected = torch.stack([p.float() for p in payloads]).mean(0)
+    assert torch.allclose(out, expected, atol=1e-3), \
+        "rank %d: fp16-compressed allreduce mismatch" % rank
+    assert out.dtype == torch.float32
+
+    # And through the optimizer: grads ride the wire in fp16.
+    model = make_model(seed=11)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        compression=hvd.Compression.fp16)
+    loss = model(rank_data(rank)).pow(2).mean()
+    loss.backward()
+    opt.synchronize()
+    ref_model = make_model(seed=11)
+    per_rank = [local_grads(ref_model, rank_data(r)) for r in range(size)]
+    expected = [torch.stack([g[i] for g in per_rank]).mean(0)
+                for i in range(len(per_rank[0]))]
+    for p, e in zip(model.parameters(), expected):
+        assert torch.allclose(p.grad, e, atol=2e-3), \
+            "rank %d: fp16-compressed optimizer grads mismatch" % rank
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    try:
+        assert hvd.rank() == rank and hvd.size() == size
+        run_optimizer(rank, size)
+        run_broadcast(rank, size)
+        run_compression(rank, size)
+        print("TORCH_ADAPTER_OK %d" % rank)
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
