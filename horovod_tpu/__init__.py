@@ -20,7 +20,8 @@ from .common.basics import (init, shutdown, is_initialized, rank, size,
                             stop_timeline, xla_built, tcp_built, gloo_built,
                             mpi_built, nccl_built, ccl_built, ddl_built,
                             cuda_built, rocm_built, mpi_enabled,
-                            mpi_threads_supported)
+                            mpi_threads_supported, register_backend)
+from .ops.op_manager import CollectiveBackend, OpRequest
 from .common.process_sets import (ProcessSet, global_process_set,
                                   add_process_set, remove_process_set,
                                   process_set_by_id, process_set_ids)

@@ -56,6 +56,7 @@ class _GlobalState:
         self.engine = None          # CollectiveEngine (inprocess mode)
         self.tcp_core = None        # native core handle (tcp/multihost)
         self.mh_engine = None       # MultihostEngine (multihost mode)
+        self.op_manager = None      # backend priority walk (op manager)
         self.controller_mode = "inprocess"
         self.lock = threading.Lock()
 
@@ -155,6 +156,25 @@ def init(devices: Optional[Sequence] = None,
         else:
             raise ValueError("unknown controller mode %r" % mode)
 
+        # Backend registry (reference operation_manager.cc): the walk
+        # order per mode, overridable by env, extensible at runtime via
+        # register_backend().
+        from ..ops.op_manager import (HostTcpBackend, InProcessIciBackend,
+                                      MultihostIciBackend, OpManager,
+                                      order_from_env)
+        if mode == "inprocess":
+            backends = [InProcessIciBackend(_get_engine)]
+        elif mode == "tcp":
+            backends = [HostTcpBackend(_get_tcp_core)]
+        else:  # multihost: device plane first, host plane fallback
+            backends = [MultihostIciBackend(_get_mh_engine, _get_tcp_core),
+                        HostTcpBackend(_get_tcp_core)]
+        env_order = (os.environ.get("HVD_TPU_BACKENDS")
+                     or os.environ.get("HOROVOD_BACKENDS"))
+        if env_order:
+            backends = order_from_env(backends, env_order)
+        _state.op_manager = OpManager(backends)
+
         _ps.reset_registry()
         # Mark initialized BEFORE registering init-time process sets:
         # registration mirrors each set into the native core (tcp /
@@ -181,6 +201,7 @@ def shutdown():
         if _state.tcp_core is not None:
             _state.tcp_core.shutdown()
             _state.tcp_core = None
+        _state.op_manager = None
         if _state.controller_mode == "multihost":
             # Leave the global JAX runtime so an elastic re-init can
             # rejoin a (possibly resized) world cleanly.
@@ -228,6 +249,21 @@ def _get_mh_engine():
 
 def _controller_mode() -> str:
     return _state.controller_mode
+
+
+def _get_op_manager():
+    _require_init()
+    return _state.op_manager
+
+
+def register_backend(backend, index: int = 0):
+    """Insert a custom collective backend at priority ``index`` in the
+    op-manager walk (reference: adding an entry to
+    ``operation_manager.cc``'s priority list).  The backend sees every
+    eager collective as an ``OpRequest`` and may accept or decline
+    per-tensor via ``enabled()``."""
+    _require_init()
+    _state.op_manager.register(backend, index)
 
 
 def _get_config() -> Config:

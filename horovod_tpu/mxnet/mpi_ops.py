@@ -76,6 +76,13 @@ class MXHandle:
         splits = None
         if isinstance(res, tuple):
             res, splits = res
+        if isinstance(res, list):
+            # Ragged result (in-process uneven reducescatter, or
+            # alltoall with per-rank shapes): one array per rank; no
+            # in-place target applies.  Keep (output, recv_splits).
+            converted = [_from_np(np.ascontiguousarray(np.asarray(r)),
+                                  self._like) for r in res]
+            return (converted, splits) if splits is not None else converted
         arr = np.ascontiguousarray(np.asarray(res))
         if self._out is not None:
             t = _write_inplace(self._out, arr)

@@ -22,13 +22,10 @@ import collections
 import itertools
 from typing import List, Optional, Sequence
 
-import jax.numpy as jnp
-import numpy as np
-
 from ..common import basics
 from ..common.process_sets import ProcessSet, global_process_set
 from . import xla_ops
-from .engine import CollectiveHandle
+from .engine import CollectiveHandle, HorovodInternalError
 from .xla_ops import (ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM,
                       handle_average_backwards_compatibility)
 
@@ -61,19 +58,6 @@ def _ps_id(process_set: Optional[ProcessSet]) -> int:
     return ps.process_set_id
 
 
-def _stack(tensor, ps_size: int):
-    """Accept a rank-major stacked array or a list of per-rank tensors."""
-    if isinstance(tensor, (list, tuple)):
-        arr = jnp.stack([jnp.asarray(t) for t in tensor])
-    else:
-        arr = jnp.asarray(tensor)
-    if arr.shape[0] != ps_size:
-        raise ValueError(
-            "expected rank-major stacked input with leading dim %d (one "
-            "slice per rank), got shape %s" % (ps_size, arr.shape))
-    return arr
-
-
 def _engine():
     return basics._get_engine()
 
@@ -94,8 +78,16 @@ def _mh_mode() -> bool:
             and basics._controller_mode() == "multihost")
 
 
-def _np(tensor):
-    return np.ascontiguousarray(np.asarray(tensor))
+def _submit(op_type, tensors, names, process_set, **kw):
+    """Route through the op-manager's backend priority walk
+    (reference operation_manager.cc); marshaling (stacking, numpy
+    copies) lives in each backend."""
+    from .op_manager import OpRequest
+    ps = process_set or global_process_set
+    req = OpRequest(op_type, tensors, names,
+                    process_set_id=_ps_id(process_set),
+                    ps_size=ps.size(), **kw)
+    return basics._get_op_manager().submit(req)
 
 
 # -- allreduce -------------------------------------------------------------
@@ -106,32 +98,10 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
     red_op = handle_average_backwards_compatibility(op, average)
-    ps = process_set or global_process_set
-    if _mh_mode() and red_op != ADASUM:
-        return basics._get_mh_engine().enqueue_allreduce(
-            _auto_name("allreduce", name), tensor, red_op=red_op,
-            prescale=prescale_factor, postscale=postscale_factor,
-            process_set_id=_ps_id(process_set))
-    if _tcp_mode() or _mh_mode():
-        # Adasum in multihost mode rides the host plane: the native
-        # core's TreeAdasum is the projection-math implementation.
-        return basics._get_tcp_core().allreduce_async(
-            _np(tensor), _auto_name("allreduce", name), op=red_op,
-            prescale=prescale_factor, postscale=postscale_factor,
-            process_set_id=_ps_id(process_set))
-    if red_op == ADASUM:
-        from ..utils.adasum import adasum_reduce_stacked
-        stacked = _stack(tensor, ps.size())
-        h = CollectiveHandle(_auto_name("allreduce", name))
-        try:
-            h._set_result(adasum_reduce_stacked(stacked))
-        except Exception as exc:  # noqa: BLE001
-            h._set_error(exc)
-        return h
-    stacked = _stack(tensor, ps.size())
-    return _engine().enqueue_allreduce(
-        _auto_name("allreduce", name), stacked, red_op,
-        prescale_factor, postscale_factor, _ps_id(process_set))
+    return _submit("allreduce", [tensor],
+                   [_auto_name("allreduce", name)], process_set,
+                   red_op=red_op, prescale=prescale_factor,
+                   postscale=postscale_factor)
 
 
 def allreduce(tensor, average=None, name=None, op=None,
@@ -153,33 +123,11 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
     """Enqueue a group atomically so fusion packs them together
     (reference: group_table.cc / hvd.grouped_allreduce)."""
     red_op = handle_average_backwards_compatibility(op, average)
-    ps_id = _ps_id(process_set)
-    ps = process_set or global_process_set
     base = _auto_name("grouped_allreduce", name)
     names = ["%s.%d" % (base, i) for i in range(len(tensors))]
-    if _mh_mode() and red_op != ADASUM:
-        core = basics._get_tcp_core()
-        core.register_group(names)
-        eng = basics._get_mh_engine()
-        return [eng.enqueue_allreduce(
-            n, t, red_op=red_op, prescale=prescale_factor,
-            postscale=postscale_factor, process_set_id=ps_id)
-            for t, n in zip(tensors, names)]
-    if _tcp_mode() or _mh_mode():
-        core = basics._get_tcp_core()
-        # Register the group so the controller negotiates/fuses it
-        # atomically (reference: group_table.cc).
-        core.register_group(names)
-        return [core.allreduce_async(
-            _np(t), n, op=red_op, prescale=prescale_factor,
-            postscale=postscale_factor, process_set_id=ps_id)
-            for t, n in zip(tensors, names)]
-    handles = []
-    for t, n in zip(tensors, names):
-        handles.append(_engine().enqueue_allreduce(
-            n, _stack(t, ps.size()), red_op,
-            prescale_factor, postscale_factor, ps_id))
-    return handles
+    return _submit("allreduce", list(tensors), names, process_set,
+                   red_op=red_op, prescale=prescale_factor,
+                   postscale=postscale_factor, is_group=True)
 
 
 def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
@@ -196,24 +144,8 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
 def allgather_async(tensor, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
-    ps = process_set or global_process_set
-    if _mh_mode():
-        return basics._get_mh_engine().enqueue_allgather(
-            _auto_name("allgather", name), tensor,
-            process_set_id=_ps_id(process_set))
-    if _tcp_mode():
-        return basics._get_tcp_core().allgather_async(
-            _np(tensor), _auto_name("allgather", name),
-            process_set_id=_ps_id(process_set))
-    if isinstance(tensor, (list, tuple)):
-        per_rank = [jnp.asarray(t) for t in tensor]
-        if len(per_rank) != ps.size():
-            raise ValueError("need one tensor per rank")
-    else:
-        arr = jnp.asarray(tensor)
-        per_rank = [arr[r] for r in range(ps.size())]
-    return _engine().enqueue_allgather(
-        _auto_name("allgather", name), per_rank, _ps_id(process_set))
+    return _submit("allgather", [tensor],
+                   [_auto_name("allgather", name)], process_set)
 
 
 def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
@@ -226,18 +158,9 @@ def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None
                     ) -> CollectiveHandle:
-    ps = process_set or global_process_set
-    if _mh_mode():
-        return basics._get_mh_engine().enqueue_broadcast(
-            _auto_name("broadcast", name), tensor, root_rank=root_rank,
-            process_set_id=_ps_id(process_set))
-    if _tcp_mode():
-        return basics._get_tcp_core().broadcast_async(
-            _np(tensor), _auto_name("broadcast", name),
-            root_rank=root_rank, process_set_id=_ps_id(process_set))
-    return _engine().enqueue_broadcast(
-        _auto_name("broadcast", name), _stack(tensor, ps.size()),
-        root_rank, _ps_id(process_set))
+    return _submit("broadcast", [tensor],
+                   [_auto_name("broadcast", name)], process_set,
+                   root_rank=root_rank)
 
 
 def broadcast(tensor, root_rank: int, name=None,
@@ -251,27 +174,9 @@ def broadcast(tensor, root_rank: int, name=None,
 def alltoall_async(tensor, splits=None, name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None
                    ) -> CollectiveHandle:
-    ps = process_set or global_process_set
-    if _mh_mode():
-        return basics._get_mh_engine().enqueue_alltoall(
-            _auto_name("alltoall", name), tensor,
-            splits=None if splits is None else list(np.asarray(splits)),
-            process_set_id=_ps_id(process_set))
-    if _tcp_mode():
-        return basics._get_tcp_core().alltoall_async(
-            _np(tensor), _auto_name("alltoall", name),
-            splits=None if splits is None else list(np.asarray(splits)),
-            process_set_id=_ps_id(process_set))
-    if isinstance(tensor, (list, tuple)):
-        tensor = jnp.stack([jnp.asarray(t) for t in tensor]) \
-            if splits is None else [jnp.asarray(t) for t in tensor]
-    if splits is not None:
-        splits = np.asarray(splits)
-        if isinstance(tensor, list):
-            tensor = jnp.stack(tensor) if len(
-                {t.shape for t in tensor}) == 1 else tensor
-    return _engine().enqueue_alltoall(
-        _auto_name("alltoall", name), tensor, splits, _ps_id(process_set))
+    return _submit("alltoall", [tensor],
+                   [_auto_name("alltoall", name)], process_set,
+                   splits=splits)
 
 
 def alltoall(tensor, splits=None, name=None,
@@ -291,23 +196,19 @@ def alltoall(tensor, splits=None, name=None,
 def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
                         process_set: Optional[ProcessSet] = None
                         ) -> CollectiveHandle:
-    ps = process_set or global_process_set
-    if _mh_mode():
-        return basics._get_mh_engine().enqueue_reducescatter(
-            _auto_name("reducescatter", name), tensor, red_op=op,
-            process_set_id=_ps_id(process_set))
-    if _tcp_mode():
-        return basics._get_tcp_core().reducescatter_async(
-            _np(tensor), _auto_name("reducescatter", name), op=op,
-            process_set_id=_ps_id(process_set))
-    return _engine().enqueue_reducescatter(
-        _auto_name("reducescatter", name), _stack(tensor, ps.size()),
-        op, _ps_id(process_set))
+    return _submit("reducescatter", [tensor],
+                   [_auto_name("reducescatter", name)], process_set,
+                   red_op=op)
 
 
 def reducescatter(tensor, op=SUM, name=None,
                   process_set: Optional[ProcessSet] = None):
-    """Reduce then scatter dim-0 shards; row r of the result is rank r's."""
+    """Reduce then scatter dim-0 shards; row r of the result is rank r's.
+
+    In-process mode with rows not divisible by the world size returns a
+    list of per-rank chunks (earlier ranks get the larger shards, the
+    native core's chunk layout) instead of one stacked array.
+    """
     return reducescatter_async(tensor, op, name, process_set).wait()
 
 
@@ -328,18 +229,34 @@ def barrier(process_set: Optional[ProcessSet] = None):
         _auto_name("barrier", None), _ps_id(process_set)).wait()
 
 
-def join(device=None) -> int:
-    """Signal this rank is out of data (reference JoinOp, ``hvd.join``).
+def join(device=None, ranks=None) -> int:
+    """Signal out-of-data ranks (reference JoinOp, ``hvd.join``).
 
-    Returns the last rank that joined.  In the in-process SPMD world all
-    device-ranks share one data stream, so join degenerates to a barrier
-    and returns size-1; the TCP multi-process core implements the full
-    zero-contribution protocol for uneven data.
+    Multi-process modes: the calling rank joins; returns the last rank
+    to join once everyone has (the core's zero-contribution protocol,
+    ``operations.cc`` JoinOp path).
+
+    In-process SPMD mode the single controller drives every rank, so
+    ``ranks`` names which world ranks are out of data: their rows of
+    every subsequent stacked allreduce payload contribute zeros (the
+    AVERAGE divisor stays the full world size, matching the core), and
+    other collectives are rejected while any rank is joined.  A final
+    ``join()`` with no ``ranks`` ends the round: remaining ranks join
+    in rank order, the joined set clears, and the last joiner's rank is
+    returned.
     """
     if not basics._controller_is_spmd():
+        if ranks is not None:
+            raise ValueError(
+                "ranks= is the in-process (single-controller) form; in "
+                "multi-process modes each rank calls join() itself")
         return basics._get_tcp_core().join()
+    eng = _engine()
+    if ranks is not None:
+        eng.mark_joined([ranks] if isinstance(ranks, int) else ranks)
+        return -1
     barrier()
-    return basics.size() - 1
+    return eng.finalize_join()
 
 
 # -- handle helpers --------------------------------------------------------

@@ -85,10 +85,11 @@ class CollectiveHandle:
 class _Entry:
     __slots__ = ("name", "op_type", "payload", "red_op", "prescale",
                  "postscale", "root_rank", "splits", "process_set_id",
-                 "handle", "enqueue_t", "nbytes")
+                 "handle", "enqueue_t", "nbytes", "joined_idx")
 
     def __init__(self, name, op_type, payload, red_op, prescale, postscale,
-                 root_rank, splits, process_set_id, handle, nbytes):
+                 root_rank, splits, process_set_id, handle, nbytes,
+                 joined_idx=()):
         self.name = name
         self.op_type = op_type
         self.payload = payload
@@ -101,6 +102,10 @@ class _Entry:
         self.handle = handle
         self.enqueue_t = time.monotonic()
         self.nbytes = nbytes
+        # Joined-rank snapshot taken at ENQUEUE time: a later join()
+        # must not retroactively zero (or reject) ops submitted while
+        # every rank was still in-data.
+        self.joined_idx = tuple(joined_idx)
 
 
 def _bucket(n: int) -> int:
@@ -135,9 +140,49 @@ class CollectiveEngine:
             shutdown_secs=config.stall_shutdown_secs,
             enabled=not config.stall_check_disable)
         self.parameter_manager = None  # installed by basics when autotuning
+        # Ranks marked out-of-data (reference JoinOp): they contribute
+        # zeros to allreduces until every rank has joined.  Ordered so
+        # finalize can report the LAST rank to join, like the core.
+        self._joined: List[int] = []
         self._thread = threading.Thread(
             target=self._loop, name="hvd-tpu-cycle", daemon=True)
         self._thread.start()
+
+    # -- join (zero contribution, reference JoinOp) ------------------------
+
+    def mark_joined(self, ranks):
+        """Mark world ranks as out of data; their rows of every
+        subsequent stacked allreduce payload are zeroed (the reference's
+        joined ranks contribute zeros, ``operations.cc`` JoinOp path)."""
+        with self._lock:
+            for r in ranks:
+                r = int(r)
+                if not 0 <= r < self.size:
+                    raise ValueError("join rank %d outside world [0, %d)"
+                                     % (r, self.size))
+                if r not in self._joined:
+                    self._joined.append(r)
+
+    def finalize_join(self) -> int:
+        """All remaining ranks join now (in rank order); clears the
+        joined set and returns the last rank to join, like the core's
+        ``hvd_tcp_join``."""
+        with self._lock:
+            joined, self._joined = self._joined, []
+        remaining = [r for r in range(self.size) if r not in joined]
+        if remaining:
+            return remaining[-1]
+        return joined[-1] if joined else self.size - 1
+
+    def _joined_member_indices(self, process_set_id) -> List[int]:
+        with self._lock:
+            joined = list(self._joined)
+        if not joined:
+            return []
+        members = self._resolve_process_set(process_set_id)
+        if members is None:
+            members = list(range(self.size))
+        return [i for i, g in enumerate(members) if g in joined]
 
     # -- process-set meshes ------------------------------------------------
 
@@ -164,7 +209,8 @@ class CollectiveEngine:
             raise HorovodInternalError("engine is shut down")
         handle = CollectiveHandle(name)
         e = _Entry(name, op_type, payload, red_op, prescale, postscale,
-                   root_rank, splits, process_set_id, handle, nbytes)
+                   root_rank, splits, process_set_id, handle, nbytes,
+                   joined_idx=self._joined_member_indices(process_set_id))
         self.timeline.negotiate_start(name, op_type)
         self.stall_inspector.record_enqueue(name)
         with self._wake:
@@ -273,11 +319,23 @@ class CollectiveEngine:
         try:
             mc = self.collectives_for(entries[0].process_set_id)
             size = mc.size
+
+            def zero_joined(stacked, joined_idx):
+                # Joined ranks contribute zeros (reference JoinOp); the
+                # AVERAGE divisor stays the full member count, matching
+                # the core ("divides once at the end by the full world
+                # count", cpu_ops.cc).  Uses the entry's enqueue-time
+                # snapshot, so join() is never retroactive.
+                if not joined_idx:
+                    return stacked
+                return stacked.at[jnp.asarray(joined_idx)].set(0)
+
             if len(entries) == 1 and entries[0].payload.ndim >= 1:
                 e = entries[0]
                 self.timeline.activity_start(e.name, "EXEC_ALLREDUCE")
-                out = mc.allreduce(e.payload, e.red_op,
-                                   float(e.prescale), float(e.postscale))
+                out = mc.allreduce(
+                    zero_joined(e.payload, e.joined_idx), e.red_op,
+                    float(e.prescale), float(e.postscale))
                 self.timeline.activity_end(e.name)
                 self.stall_inspector.record_done(e.name)
                 e.handle._set_result(out)
@@ -285,7 +343,7 @@ class CollectiveEngine:
             self.timeline.activity_start_all(names, "MEMCPY_IN_FUSION_BUFFER")
             flats, lengths = [], []
             for e in entries:
-                f = e.payload.reshape(size, -1)
+                f = zero_joined(e.payload.reshape(size, -1), e.joined_idx)
                 lengths.append(f.shape[1])
                 flats.append(f)
             total = sum(lengths)
@@ -317,6 +375,14 @@ class CollectiveEngine:
     def _execute_single(self, e: _Entry):
         try:
             mc = self.collectives_for(e.process_set_id)
+            if e.op_type != _OP_BARRIER and e.joined_idx:
+                # Mirror the controller: only allreduce can proceed with
+                # a zero contribution from joined ranks; anything else
+                # would deadlock or silently mis-shape.
+                raise HorovodInternalError(
+                    "%s %r submitted while ranks are joined; only "
+                    "allreduce supports zero-contribution join"
+                    % (e.op_type, e.name))
             self.timeline.activity_start(e.name, "EXEC_" + e.op_type.upper())
             # xprof span (reference NVTX op range, nvtx_op_range.cc)
             with jax.profiler.TraceAnnotation("hvd.%s" % e.op_type):
@@ -327,7 +393,21 @@ class CollectiveEngine:
                 elif e.op_type == _OP_ALLTOALL:
                     out = mc.alltoall(e.payload, e.splits)
                 elif e.op_type == _OP_REDUCESCATTER:
-                    out = mc.reducescatter(e.payload, e.red_op)
+                    d0 = e.payload.shape[1]
+                    if d0 % mc.size:
+                        # Uneven rows: full reduce on the mesh, then
+                        # slice the core's chunk layout — rank j gets
+                        # d0//n + (1 if j < d0%n) rows, earlier ranks
+                        # larger (operations.cc REDUCESCATTER chunking).
+                        if e.red_op not in (xla_ops.SUM, xla_ops.AVERAGE):
+                            raise NotImplementedError(
+                                "reducescatter supports Sum/Average "
+                                "(reference parity)")
+                        red = mc.allreduce(e.payload, e.red_op)
+                        rows, offs = xla_ops.uneven_chunks(d0, mc.size)
+                        out = [red[o:o + c] for c, o in zip(rows, offs)]
+                    else:
+                        out = mc.reducescatter(e.payload, e.red_op)
                 elif e.op_type == _OP_BARRIER:
                     out = mc.barrier()
                 else:

@@ -41,13 +41,7 @@ from .xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
 LOG = logging.getLogger("horovod_tpu")
 
 
-def _uneven_chunks(total_rows: int, n: int):
-    """Reference ReducescatterOp chunk math: earlier members take the
-    larger shards (cpu_ops.cc uses the same base/remainder split)."""
-    base, rem = divmod(total_rows, n)
-    rows = [base + (1 if i < rem else 0) for i in range(n)]
-    offs = [sum(rows[:i]) for i in range(n)]
-    return rows, offs
+from .xla_ops import uneven_chunks as _uneven_chunks
 
 
 class GlobalMeshCollectives:

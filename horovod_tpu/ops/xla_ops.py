@@ -41,6 +41,17 @@ ADASUM = "Adasum"
 _REDUCE_OPS = (SUM, AVERAGE, MIN, MAX, PRODUCT, ADASUM)
 
 
+def uneven_chunks(total_rows: int, n: int):
+    """Reference ReducescatterOp chunk math: earlier members take the
+    larger shards (cpu_ops.cc uses the same base/remainder split).
+    Shared by the in-process engine and multihost mode so the shard
+    boundaries can never desynchronize."""
+    base, rem = divmod(total_rows, n)
+    rows = [base + (1 if i < rem else 0) for i in range(n)]
+    offs = [sum(rows[:i]) for i in range(n)]
+    return rows, offs
+
+
 def handle_average_backwards_compatibility(op, average):
     """Reconcile the legacy ``average=`` kwarg with ``op=`` (reference:
     horovod/common/util.py check_num_rank_power_of_2 /
@@ -225,6 +236,9 @@ class MeshCollectives:
 
     # -- reducescatter -----------------------------------------------------
 
+    # (uneven chunk layout shared with the engine and multihost mode
+    # lives in module scope: uneven_chunks below)
+
     def _build_reducescatter(self, red_op: str):
         size = self.size
 
@@ -241,8 +255,10 @@ class MeshCollectives:
 
     def reducescatter(self, stacked, red_op: str = SUM):
         """[size, N, ...] -> [size, N/size, ...]: row r is rank r's reduced
-        shard.  Uneven N is handled by the engine via padding (reference
-        ReducescatterOp gives earlier ranks the larger shards)."""
+        shard.  Requires N % size == 0; the engine routes uneven N
+        through a full reduce + chunk slicing that matches the native
+        core's layout (reference ReducescatterOp gives earlier ranks
+        the larger shards)."""
         if red_op not in (SUM, AVERAGE):
             raise NotImplementedError(
                 "reducescatter supports Sum/Average (reference parity)")

@@ -59,6 +59,20 @@ class TorchHandle:
         splits = None
         if isinstance(res, tuple):
             res, splits = res
+        if isinstance(res, list):
+            # Ragged result (in-process uneven reducescatter, or
+            # alltoall with per-rank shapes): one tensor per rank; no
+            # in-place target applies.  Keep the (output, recv_splits)
+            # contract when splits rode along.
+            converted = [self._convert(r) for r in res]
+            return (converted, splits) if splits is not None else converted
+        t = self._convert(res)
+        if self._out is not None:
+            self._out.data.copy_(t.reshape(self._out.shape))
+            t = self._out
+        return (t, splits) if splits is not None else t
+
+    def _convert(self, res) -> torch.Tensor:
         arr = np.ascontiguousarray(np.asarray(res))
         if arr.dtype.name == "bfloat16":
             t = torch.from_numpy(arr.view(np.uint16)) \
@@ -67,10 +81,7 @@ class TorchHandle:
             t = torch.from_numpy(arr)
         if self._like is not None and t.dtype != self._like.dtype:
             t = t.to(self._like.dtype)
-        if self._out is not None:
-            self._out.data.copy_(t.reshape(self._out.shape))
-            t = self._out
-        return (t, splits) if splits is not None else t
+        return t
 
 
 def synchronize(handle: TorchHandle):

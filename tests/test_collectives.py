@@ -144,6 +144,84 @@ def test_barrier_and_join(hvd_world):
     assert hvd.join() == SIZE - 1
 
 
+def test_reducescatter_uneven(hvd_world):
+    # 11 rows over 8 ranks: chunks [2,2,2,1,1,1,1,1] — the native core's
+    # layout (operations.cc REDUCESCATTER: rank j gets d0//n + (1 if
+    # j < d0%n) rows, earlier ranks larger).  Integer-valued floats so
+    # any summation order gives the exact same bits as the core's ring.
+    d0 = 11
+    x = np.arange(SIZE * d0 * 2, dtype=np.float32).reshape(SIZE, d0, 2)
+    out = hvd.reducescatter(x, op=hvd.Sum)
+    full = x.sum(axis=0)
+    base, rem = divmod(d0, SIZE)
+    off = 0
+    assert len(out) == SIZE
+    for j in range(SIZE):
+        c = base + (1 if j < rem else 0)
+        np.testing.assert_array_equal(np.asarray(out[j]),
+                                      full[off:off + c])
+        off += c
+
+    # Average divides by the full world count, like the core.
+    out = hvd.reducescatter(x, op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               full[:2] / SIZE, rtol=1e-6)
+
+
+def test_join_zero_contribution(hvd_world):
+    # Ranks 2 and 5 are out of data: their rows contribute zeros, the
+    # AVERAGE divisor stays the full world size (core semantics:
+    # "divides once at the end by the full world count").
+    x = np.ones((SIZE, 4), np.float32) * (np.arange(SIZE, dtype=np.float32)
+                                          + 1.0)[:, None]
+    assert hvd.join(ranks=[2, 5]) == -1
+    live = x.copy()
+    live[[2, 5]] = 0.0
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_array_equal(np.asarray(out), live.sum(axis=0))
+    out = hvd.allreduce(x, op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out), live.sum(axis=0) / SIZE,
+                               rtol=1e-6)
+
+    # Fused path: several small allreduces in one cycle, still zeroed.
+    hs = [hvd.allreduce_async(x, name="join_f%d" % i, op=hvd.Sum)
+          for i in range(3)]
+    for h in hs:
+        np.testing.assert_array_equal(np.asarray(h.wait()),
+                                      live.sum(axis=0))
+
+    # Non-allreduce collectives are rejected while ranks are joined
+    # (mirrors the controller's multihost rule), as is Adasum (zero is
+    # not a neutral element for its dot-product combine).
+    with pytest.raises(Exception, match="joined"):
+        hvd.allgather(x)
+    with pytest.raises(Exception, match="joined"):
+        hvd.allreduce(x, op=hvd.Adasum)
+
+    # Finalize: remaining ranks join in rank order; last is rank 7.
+    assert hvd.join() == SIZE - 1
+
+    # The joined set cleared: full-world allreduce again.
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_array_equal(np.asarray(out), x.sum(axis=0))
+
+
+def test_join_not_retroactive(hvd_world):
+    # join() marks are snapshot at ENQUEUE: an allreduce submitted while
+    # every rank was in-data keeps rank 4's contribution even if rank 4
+    # joins before the background cycle executes it.
+    x = np.ones((SIZE, 3), np.float32)
+    h = hvd.allreduce_async(x, name="pre_join", op=hvd.Sum)
+    hvd.join(ranks=[4])
+    np.testing.assert_array_equal(np.asarray(h.wait()),
+                                  np.full(3, float(SIZE), np.float32))
+    # ...and one enqueued after the mark drops it.
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.full(3, float(SIZE - 1), np.float32))
+    assert hvd.join() == SIZE - 1
+
+
 def test_process_set_collective(hvd_world):
     ps = hvd.add_process_set([0, 2, 4])
     x = np.ones((3, 5), dtype=np.float32) * np.arange(3)[:, None]

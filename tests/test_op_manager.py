@@ -1,0 +1,92 @@
+"""Op-manager backend registry tests (reference:
+``operation_manager.cc`` — priority walk, first Enabled() wins)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.ops.engine import CollectiveHandle
+from horovod_tpu.ops.op_manager import OpRequest, order_from_env
+
+SIZE = 8
+
+
+class _FakeBackend(hvd.CollectiveBackend):
+    """Accepts only allreduces whose name carries a marker prefix —
+    per-tensor selection, like a DCN backend claiming big payloads."""
+
+    name = "fake_dcn"
+
+    def __init__(self):
+        self.seen = []
+
+    def enabled(self, req):
+        return (req.op_type == "allreduce"
+                and all(n.startswith("dcn.") for n in req.names))
+
+    def submit(self, req):
+        self.seen.append(list(req.names))
+        hs = []
+        for t, n in zip(req.tensors, req.names):
+            h = CollectiveHandle(n)
+            h._set_result("fake:%s" % n)
+            hs.append(h)
+        return hs if req.is_group else hs[0]
+
+
+def test_priority_walk_and_per_tensor_selection(hvd_world):
+    mgr = basics._get_op_manager()
+    assert [b.name for b in mgr.backends] == ["inprocess_ici"]
+
+    fake = _FakeBackend()
+    hvd.register_backend(fake, index=0)
+    try:
+        # Marked tensors go to the fake backend...
+        out = hvd.allreduce(np.ones((SIZE, 3), np.float32),
+                            name="dcn.big")
+        assert out == "fake:dcn.big"
+        assert fake.seen == [["dcn.big"]]
+        # ...unmarked ones fall through to the engine and really reduce.
+        out = hvd.allreduce(np.ones((SIZE, 3), np.float32), name="plain",
+                            op=hvd.Sum)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.full(3, float(SIZE), np.float32))
+        assert fake.seen == [["dcn.big"]]
+
+        # Introspection names the winner without executing.
+        req = OpRequest("allreduce", [None], ["dcn.x"], red_op=hvd.Sum)
+        assert mgr.backend_for(req) == "fake_dcn"
+        req = OpRequest("allgather", [None], ["dcn.x"])
+        assert mgr.backend_for(req) == "inprocess_ici"
+    finally:
+        mgr.backends.remove(fake)
+
+
+def test_group_routes_through_one_backend(hvd_world):
+    fake = _FakeBackend()
+    hvd.register_backend(fake, index=0)
+    mgr = basics._get_op_manager()
+    try:
+        outs = hvd.grouped_allreduce(
+            [np.ones((SIZE, 2)), np.ones((SIZE, 2))], name="dcn.grp")
+        assert outs == ["fake:dcn.grp.0", "fake:dcn.grp.1"]
+        assert fake.seen == [["dcn.grp.0", "dcn.grp.1"]]
+    finally:
+        mgr.backends.remove(fake)
+
+
+def test_order_from_env_validates_names(hvd_world):
+    mgr = basics._get_op_manager()
+    assert [b.name for b in order_from_env(mgr.backends,
+                                           "inprocess_ici")] \
+        == ["inprocess_ici"]
+    with pytest.raises(ValueError, match="unknown backend"):
+        order_from_env(mgr.backends, "nccl")
+
+
+def test_no_backend_raises(hvd_world):
+    mgr = basics._get_op_manager()
+    req = OpRequest("bogus_op", [None], ["x"])
+    with pytest.raises(Exception, match="no enabled backend"):
+        mgr.submit(req)

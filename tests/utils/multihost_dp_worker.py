@@ -68,6 +68,10 @@ def main():
                                ref_loss, rtol=1e-5)
     print("MH_DP_OK", r, flush=True)
     hvd.shutdown()
+    # Skip the jax gloo runtime's own atexit teardown, which can
+    # SIGABRT on a 1-core box after all work completed (see
+    # multihost_worker.py).
+    os._exit(0)
 
 
 if __name__ == "__main__":
