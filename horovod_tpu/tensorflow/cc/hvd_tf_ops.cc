@@ -1,0 +1,291 @@
+// TensorFlow custom op + XLA custom-call lowering for hvd allreduce.
+//
+// Reference parity: horovod/tensorflow/xla_mpi_ops.cc — the piece that
+// lets hvd.allreduce live INSIDE tf.function(jit_compile=True): a
+// registered XLA kernel lowers the op to a host custom-call whose
+// callback enqueues into the native core (negotiation + wire move) and
+// blocks until the result lands, exactly like the reference's
+// HVDAllreduceOp custom call enqueues to the Horovod background thread.
+// The reference only implements allreduce in its XLA path; so do we.
+//
+// The core is the SAME singleton the Python runtime initialized: this
+// library dlopens libhvdtpu_core.so, which the dynamic loader resolves
+// to the already-loaded instance.
+//
+// Scope: CPU JIT (XLA_CPU_JIT). On TPU the compiled path is JAX/XLA
+// collectives over ICI (ops/xla_ops.py); a "Host" custom-call target
+// does not exist inside a TPU executable, so the op is intentionally
+// not registered for XLA_TPU_JIT (see docs/adapters.md).
+
+#include <dlfcn.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tensorflow/compiler/tf2xla/xla_op_kernel.h"
+#include "tensorflow/compiler/tf2xla/xla_op_registry.h"
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+#include "xla/hlo/builder/xla_builder.h"
+#include "xla/service/custom_call_target_registry.h"
+
+namespace {
+
+// ---- native core C API (resolved from the already-loaded .so) ----------
+
+typedef int (*enqueue_fn)(const char*, int, const void*, const long long*,
+                          int, int, int, int, unsigned int, double, double,
+                          const long long*, int);
+typedef int (*poll_fn)(int);
+typedef int (*copy_fn)(int, void*);
+typedef int (*err_fn)(int, char*, int);
+typedef void (*release_fn)(int);
+typedef int (*init_q_fn)();
+
+struct CoreApi {
+  enqueue_fn enqueue = nullptr;
+  poll_fn poll = nullptr;
+  copy_fn copy_result = nullptr;
+  err_fn error_string = nullptr;
+  release_fn release = nullptr;
+  init_q_fn is_initialized = nullptr;
+  bool ok = false;
+};
+
+CoreApi& core() {
+  static CoreApi api = [] {
+    CoreApi a;
+    const char* path = std::getenv("HVD_TPU_CORE_LIB");
+    void* h = dlopen(path ? path : "libhvdtpu_core.so",
+                     RTLD_NOW | RTLD_GLOBAL);
+    if (!h) {
+      std::fprintf(stderr, "hvd_tf_ops: cannot dlopen core (%s): %s\n",
+                   path ? path : "libhvdtpu_core.so", dlerror());
+      return a;
+    }
+    a.enqueue = reinterpret_cast<enqueue_fn>(dlsym(h, "hvd_tcp_enqueue"));
+    a.poll = reinterpret_cast<poll_fn>(dlsym(h, "hvd_tcp_poll"));
+    a.copy_result = reinterpret_cast<copy_fn>(
+        dlsym(h, "hvd_tcp_copy_result"));
+    a.error_string = reinterpret_cast<err_fn>(
+        dlsym(h, "hvd_tcp_error_string"));
+    a.release = reinterpret_cast<release_fn>(dlsym(h, "hvd_tcp_release"));
+    a.is_initialized = reinterpret_cast<init_q_fn>(
+        dlsym(h, "hvd_tcp_is_initialized"));
+    a.ok = a.enqueue && a.poll && a.copy_result && a.error_string &&
+           a.release && a.is_initialized;
+    return a;
+  }();
+  return api;
+}
+
+// Core wire dtype codes (core/client.py _DTYPES).
+int CoreDtype(tensorflow::DataType dt) {
+  switch (dt) {
+    case tensorflow::DT_UINT8: return 0;
+    case tensorflow::DT_INT8: return 1;
+    case tensorflow::DT_UINT16: return 2;
+    case tensorflow::DT_INT16: return 3;
+    case tensorflow::DT_INT32: return 4;
+    case tensorflow::DT_INT64: return 5;
+    case tensorflow::DT_HALF: return 6;
+    case tensorflow::DT_FLOAT: return 7;
+    case tensorflow::DT_DOUBLE: return 8;
+    case tensorflow::DT_BOOL: return 9;
+    case tensorflow::DT_BFLOAT16: return 10;
+    default: return -1;
+  }
+}
+
+// Blocking allreduce through the core; returns empty string on success,
+// error text on failure.
+std::string RunAllreduce(const std::string& name, const void* data,
+                         const long long* dims, int ndim, int dtype,
+                         int red_op, unsigned int ps_id, double prescale,
+                         double postscale, void* out) {
+  CoreApi& c = core();
+  if (!c.ok) return "native core library not loadable";
+  if (!c.is_initialized())
+    return "native core not initialized (call hvd.init() first; the "
+           "XLA op path needs a tcp/multihost world)";
+  int h = c.enqueue(name.c_str(), /*op_type=allreduce*/ 0, data, dims,
+                    ndim, dtype, red_op, /*root_rank=*/0, ps_id, prescale,
+                    postscale, nullptr, 0);
+  if (h < 0) return "enqueue failed for " + name;
+  for (;;) {
+    int st = c.poll(h);
+    if (st == 1) break;
+    if (st == 2) {
+      char buf[4096];
+      c.error_string(h, buf, sizeof(buf));
+      c.release(h);
+      return std::string(buf);
+    }
+    usleep(200);
+  }
+  int rc = c.copy_result(h, out);
+  c.release(h);
+  if (rc != 0) return "result copy failed for " + name;
+  return "";
+}
+
+// ---- XLA host custom-call ----------------------------------------------
+//
+// Metadata rides constant operands (the ORIGINAL custom-call ABI passes
+// no opaque on CPU):
+//   ins[0]: i64 params  [name_len, red_op, dtype, ps_id,
+//                        prescale_bits, postscale_bits, ndim,
+//                        dims[0..ndim)]
+//   ins[1]: u8  name bytes
+//   ins[2]: payload
+void HvdAllreduceHostCallback(void* out, const void** ins) {
+  const int64_t* p = static_cast<const int64_t*>(ins[0]);
+  const char* nm = static_cast<const char*>(ins[1]);
+  std::string name(nm, static_cast<size_t>(p[0]));
+  double prescale, postscale;
+  std::memcpy(&prescale, &p[4], sizeof(double));
+  std::memcpy(&postscale, &p[5], sizeof(double));
+  int ndim = static_cast<int>(p[6]);
+  std::vector<long long> dims(p + 7, p + 7 + ndim);
+  std::string err = RunAllreduce(
+      name, ins[2], dims.data(), ndim, static_cast<int>(p[2]),
+      static_cast<int>(p[1]), static_cast<unsigned int>(p[3]), prescale,
+      postscale, out);
+  if (!err.empty()) {
+    // The ORIGINAL custom-call ABI has no failure channel; a silently
+    // wrong collective is worse than a loud stop (the reference's NCCL
+    // ops abort the same way on comm failure).
+    std::fprintf(stderr, "hvd_tf_ops: allreduce %s failed: %s\n",
+                 name.c_str(), err.c_str());
+    std::abort();
+  }
+}
+
+XLA_REGISTER_CUSTOM_CALL_TARGET_WITH_SYM(
+    "hvd_tpu_allreduce_host",
+    reinterpret_cast<void*>(&HvdAllreduceHostCallback), "Host");
+
+}  // namespace
+
+// ---- TF op + kernels ----------------------------------------------------
+
+REGISTER_OP("HvdTpuAllreduce")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, uint16, int16, int32, int64, half, float, "
+          "double, bfloat16}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int = 0")
+    .Attr("prescale: float = 1.0")
+    .Attr("postscale: float = 1.0")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
+
+namespace {
+
+using tensorflow::OpKernel;
+using tensorflow::OpKernelConstruction;
+using tensorflow::OpKernelContext;
+using tensorflow::Tensor;
+
+class HvdTpuAllreduceOp : public OpKernel {
+ public:
+  explicit HvdTpuAllreduceOp(OpKernelConstruction* ctx) : OpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("reduce_op", &red_op_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("process_set_id", &ps_id_));
+  }
+
+  void Compute(OpKernelContext* ctx) override {
+    const Tensor& in = ctx->input(0);
+    Tensor* out = nullptr;
+    OP_REQUIRES_OK(ctx, ctx->allocate_output(0, in.shape(), &out));
+    int dtype = CoreDtype(in.dtype());
+    OP_REQUIRES(ctx, dtype >= 0,
+                tensorflow::errors::InvalidArgument(
+                    "unsupported dtype for hvd allreduce"));
+    std::vector<long long> dims;
+    for (int i = 0; i < in.dims(); ++i) dims.push_back(in.dim_size(i));
+    std::string err = RunAllreduce(
+        name_, in.tensor_data().data(), dims.data(),
+        static_cast<int>(dims.size()), dtype, red_op_,
+        static_cast<unsigned int>(ps_id_), prescale_, postscale_,
+        const_cast<char*>(out->tensor_data().data()));
+    OP_REQUIRES(ctx, err.empty(),
+                tensorflow::errors::Internal("hvd allreduce ", name_,
+                                             ": ", err));
+  }
+
+ private:
+  std::string name_;
+  int red_op_ = 0;
+  float prescale_ = 1.0f;
+  float postscale_ = 1.0f;
+  int ps_id_ = 0;
+};
+
+REGISTER_KERNEL_BUILDER(
+    Name("HvdTpuAllreduce").Device(tensorflow::DEVICE_CPU),
+    HvdTpuAllreduceOp);
+
+using tensorflow::XlaOpKernel;
+using tensorflow::XlaOpKernelContext;
+
+class HvdTpuAllreduceXlaOp : public XlaOpKernel {
+ public:
+  explicit HvdTpuAllreduceXlaOp(OpKernelConstruction* ctx)
+      : XlaOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("reduce_op", &red_op_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("process_set_id", &ps_id_));
+  }
+
+  void Compile(XlaOpKernelContext* ctx) override {
+    auto shape_or = ctx->InputXlaShape(0);
+    OP_REQUIRES_OK(ctx, shape_or.status());
+    xla::Shape shape = shape_or.value();
+    int dtype = CoreDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, dtype >= 0,
+                tensorflow::errors::InvalidArgument(
+                    "unsupported dtype for hvd allreduce"));
+    double pre = prescale_, post = postscale_;
+    int64_t pre_bits, post_bits;
+    std::memcpy(&pre_bits, &pre, sizeof(int64_t));
+    std::memcpy(&post_bits, &post, sizeof(int64_t));
+    std::vector<int64_t> params = {
+        static_cast<int64_t>(name_.size()), red_op_, dtype, ps_id_,
+        pre_bits, post_bits, shape.dimensions().size()};
+    for (auto d : shape.dimensions()) params.push_back(d);
+    std::vector<uint8_t> name_bytes(name_.begin(), name_.end());
+    xla::XlaBuilder* b = ctx->builder();
+    xla::XlaOp out = xla::CustomCall(
+        b, "hvd_tpu_allreduce_host",
+        {xla::ConstantR1<int64_t>(b, params),
+         xla::ConstantR1<uint8_t>(b, name_bytes), ctx->Input(0)},
+        shape);
+    ctx->SetOutput(0, out);
+  }
+
+ private:
+  std::string name_;
+  int red_op_ = 0;
+  float prescale_ = 1.0f;
+  float postscale_ = 1.0f;
+  int ps_id_ = 0;
+};
+
+REGISTER_XLA_OP(
+    Name("HvdTpuAllreduce").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+    HvdTpuAllreduceXlaOp);
+
+}  // namespace

@@ -87,6 +87,12 @@ class TFHandle:
         splits = None
         if isinstance(res, tuple):
             res, splits = res
+        if isinstance(res, list):
+            # Ragged result (in-process uneven reducescatter, or
+            # alltoall with per-rank shapes): one tensor per rank.
+            # Keep the (output, recv_splits) contract.
+            converted = [_to_tf(r, like=self._like) for r in res]
+            return (converted, splits) if splits is not None else converted
         t = _to_tf(res, like=self._like)
         return (t, splits) if splits is not None else t
 
@@ -118,8 +124,31 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
     """Sum/average ``tensor`` over all ranks.  Differentiable: the
     gradient of an allreduce is the allreduce of the gradient
     (reference: the ``HorovodAllreduce`` gradient registration in
-    ``horovod/tensorflow/mpi_ops.py``)."""
+    ``horovod/tensorflow/mpi_ops.py``).
+
+    With ``HOROVOD_ENABLE_XLA_OPS=1`` (reference knob) in a
+    tcp/multihost world, the call routes through the native
+    ``HvdTpuAllreduce`` op, which also works inside
+    ``tf.function(jit_compile=True)`` (reference ``xla_mpi_ops.cc``)."""
     tensor = tf.convert_to_tensor(tensor)
+    from . import xla_ops as _xla
+    if _xla.enabled() and not tf.executing_eagerly():
+        # Symbolic tracing only (tf.function / jit_compile): eager
+        # calls keep their mode's payload plane (multihost ICI stays
+        # ICI) and the op-manager backend walk; the native op exists
+        # for graphs where py_function cannot (reference
+        # xla_mpi_ops.cc).
+        from ..common import basics
+        from ..ops.xla_ops import handle_average_backwards_compatibility
+        red_op = handle_average_backwards_compatibility(op, average)
+        if (basics.is_initialized()
+                and not basics._controller_is_spmd()
+                and red_op in _xla._RED_OPS
+                and _xla.load() is not None):
+            return _xla.allreduce(
+                tensor, _api._auto_name("allreduce", name), red_op,
+                prescale_factor, postscale_factor,
+                _api._ps_id(process_set))
 
     @tf.custom_gradient
     def _op(x):

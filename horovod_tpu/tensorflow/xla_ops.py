@@ -1,0 +1,130 @@
+"""Native TF op: hvd allreduce inside ``tf.function(jit_compile=True)``.
+
+Reference parity: ``horovod/tensorflow/xla_mpi_ops.cc`` — the XLA
+custom-call path that lets collectives live inside a jit-compiled TF
+function (the reference's ``HOROVOD_ENABLE_XLA_OPS`` feature; like the
+reference, only allreduce is implemented in the XLA path).
+
+The op library is compiled on demand against the installed wheel's
+headers/libs (same pattern as the core's ``core/client.py`` build) and
+registers:
+
+* ``HvdTpuAllreduce`` CPU kernel — graph/eager execution,
+* an ``XLA_CPU_JIT`` kernel lowering to a host custom-call whose
+  callback drives the native core (negotiation + wire move) and blocks
+  for the result.
+
+Known constraints: the wheel exports no XLA FFI registration symbols,
+so the custom call uses the legacy ORIGINAL ABI (XLA:CPU logs a
+deprecation notice but executes it); and a "Host" custom-call target
+cannot exist inside a TPU executable, so the op is registered for
+``XLA_CPU_JIT`` only — on TPU the compiled collective path is
+JAX/XLA over ICI (``ops/xla_ops.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+
+LOG = logging.getLogger("horovod_tpu")
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
+_SRC = os.path.join(_DIR, "hvd_tf_ops.cc")
+_LIB = os.path.join(_DIR, "_hvd_tf_ops.so")
+
+_lock = threading.Lock()
+_module = None
+_load_error: Exception | None = None
+
+
+def _build():
+    import tensorflow as tf
+    tf_dir = os.path.dirname(os.path.abspath(tf.__file__))
+    inc = tf.sysconfig.get_include()
+    # Build to a per-pid temp then atomically rename: concurrent ranks
+    # on one host may build simultaneously.
+    tmp = "%s.%d" % (_LIB, os.getpid())
+    cmd = ["g++", "-shared", "-fPIC", "-O2", "-w",
+           *tf.sysconfig.get_compile_flags(),
+           "-I%s/external/highwayhash" % inc,
+           "-I%s/external/farmhash_archive/src" % inc,
+           _SRC, "-o", tmp,
+           "-L%s" % tf_dir,
+           "-l:libtensorflow_framework.so.2",
+           "-l:libtensorflow_cc.so.2",
+           "-Wl,-rpath,%s" % tf_dir, "-ldl"]
+    LOG.info("building hvd tf ops: %s", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def load():
+    """Build (if stale) and load the op library; returns the module or
+    None when the toolchain/runtime cannot support it."""
+    global _module, _load_error
+    with _lock:
+        if _module is not None or _load_error is not None:
+            return _module
+        try:
+            import tensorflow as tf
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+                _build()
+            # The custom-call callback reaches the SAME core singleton
+            # the Python runtime initialized: point dlopen at it.
+            from ..core.client import _LIB_PATH as core_lib
+            os.environ.setdefault("HVD_TPU_CORE_LIB", core_lib)
+            _module = tf.load_op_library(_LIB)
+            _register_gradient()
+        except Exception as exc:  # noqa: BLE001 - optional native path
+            _load_error = exc
+            LOG.warning("hvd tf xla ops unavailable: %s", exc)
+        return _module
+
+
+def _register_gradient():
+    from tensorflow.python.framework import ops as tf_ops
+
+    @tf_ops.RegisterGradient("HvdTpuAllreduce")
+    def _grad(op, dy):  # noqa: ANN001 - TF registration signature
+        # The gradient of an allreduce is the allreduce of the gradient
+        # with the same reduce op (reference gradient registration).
+        return _module.hvd_tpu_allreduce(
+            dy,
+            tensor_name=op.get_attr("tensor_name").decode() + "_grad",
+            reduce_op=op.get_attr("reduce_op"),
+            prescale=op.get_attr("prescale"),
+            postscale=op.get_attr("postscale"),
+            process_set_id=op.get_attr("process_set_id"))
+
+
+_RED_OPS = {"Sum": 0, "Average": 1, "Min": 2, "Max": 3, "Product": 4}
+
+
+def allreduce(tensor, name: str, op: str = "Sum",
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set_id: int = 0):
+    """The native-op allreduce (usable inside
+    ``tf.function(jit_compile=True)``).  Requires a tcp/multihost world
+    (the callback drives the native core)."""
+    mod = load()
+    if mod is None:
+        raise RuntimeError(
+            "hvd tf xla ops unavailable: %s" % _load_error)
+    return mod.hvd_tpu_allreduce(
+        tensor, tensor_name=name, reduce_op=_RED_OPS[op],
+        prescale=prescale_factor, postscale=postscale_factor,
+        process_set_id=process_set_id)
+
+
+def enabled() -> bool:
+    """The reference's HOROVOD_ENABLE_XLA_OPS knob."""
+    return os.environ.get("HOROVOD_ENABLE_XLA_OPS", "0").lower() in (
+        "1", "true", "yes")

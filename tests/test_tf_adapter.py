@@ -211,6 +211,20 @@ def test_collectives_inside_tf_function(hvd):
     assert np.allclose(out.numpy(), [[5.0, 10.0]])
 
 
+def test_multirank_native_op_jit_compile():
+    # HOROVOD_ENABLE_XLA_OPS=1: allreduce inside
+    # tf.function(jit_compile=True) via the native op's XLA custom-call
+    # (reference xla_mpi_ops.cc), at world size 2 over the real core.
+    import os
+    from tests.utils.spawn import spawn_world, assert_world_ok
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "utils", "tf_adapter_worker.py")
+    assert_world_ok(
+        spawn_world(worker, 2,
+                    extra_env={"HOROVOD_ENABLE_XLA_OPS": "1"}),
+        "TF_ADAPTER_OK")
+
+
 @pytest.mark.parametrize("size", [2, 4])
 def test_multirank_tape_optimizer_broadcast_compression(size):
     # Real N-process world: DistributedGradientTape averaging,
