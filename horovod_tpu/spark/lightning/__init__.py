@@ -1,42 +1,112 @@
 """Spark Lightning estimator.
 
 Reference parity: ``horovod/spark/lightning/__init__.py``
-(``TorchEstimator`` over PyTorch Lightning modules).  Lightning is not
-installed in this environment; the estimator accepts a
-``LightningModule``-style object (anything exposing
-``training_step``/``configure_optimizers``) and falls back to an
-informative ImportError when the lightning runtime itself is required.
+(``TorchEstimator`` over PyTorch Lightning modules).  The estimator is
+duck-typed: it drives anything exposing the LightningModule training
+contract — ``configure_optimizers()`` supplies the optimizer(s) and
+``training_step(batch, batch_idx)`` the loss — so it works both with
+real ``lightning``/``pytorch_lightning`` modules and, in environments
+without the package, with any ``torch.nn.Module`` implementing those
+two methods (the pattern this repo uses for optional frameworks).
+
+Everything except optimizer sourcing and the per-batch step is shared
+with the plain torch estimator (``..torch.run_training``).
 """
 
 from __future__ import annotations
 
-__all__ = ["TorchEstimator"]
+from ..common.serialization import deserialize_torch_model
+from ..torch import TorchEstimator as _TorchEstimator
+from ..torch import TorchModel, run_training
 
-try:  # optional dependency
-    import lightning  # type: ignore # noqa: F401
-    _HAVE_LIGHTNING = True
-except ImportError:
+__all__ = ["TorchEstimator", "LightningModel"]
+
+_CONTRACT_ERR = (
+    "lightning TorchEstimator needs a module with "
+    "training_step(batch, batch_idx) and configure_optimizers(); "
+    "got %r — use horovod_tpu.spark.torch.TorchEstimator for plain "
+    "modules")
+
+
+def _has_contract(module) -> bool:
+    return (callable(getattr(module, "training_step", None))
+            and callable(getattr(module, "configure_optimizers", None)))
+
+
+def _first_optimizer(configured):
+    """``configure_optimizers`` may return one optimizer, a list, or
+    the lightning ``(optimizers, schedulers)`` tuple; DP training
+    drives the first optimizer (the reference lightning estimator's
+    single-optimizer path does the same)."""
+    if isinstance(configured, tuple) and len(configured) == 2 and \
+            isinstance(configured[0], (list, tuple)):
+        opts = list(configured[0])
+    elif isinstance(configured, (list, tuple)):
+        opts = list(configured)
+    else:
+        opts = [configured]
+    if not opts:
+        raise ValueError("configure_optimizers() returned no optimizer")
+    return opts[0]
+
+
+def _step_loss(result):
+    """``training_step`` may return the loss tensor or a dict with a
+    'loss' entry (both are lightning contracts)."""
+    if isinstance(result, dict):
+        result = result["loss"]
+    return result
+
+
+def _lightning_train_fn(payload):
+    """Per-rank training body (top-level: must be picklable)."""
+    import horovod_tpu.torch as hvd
+    hvd.init()
     try:
-        import pytorch_lightning  # type: ignore # noqa: F401
-        _HAVE_LIGHTNING = True
-    except ImportError:
-        _HAVE_LIGHTNING = False
+        module = deserialize_torch_model(payload["model"])
+        if not _has_contract(module):
+            # Defense for deserialization drift; fit() checks first.
+            raise TypeError(_CONTRACT_ERR % type(module).__name__)
+
+        def make_optimizer(m):
+            return _first_optimizer(m.configure_optimizers())
+
+        def step_fn(m, xb, yb, batch_idx):
+            return _step_loss(m.training_step((xb, yb), batch_idx))
+
+        return run_training(payload, module, make_optimizer, step_fn,
+                            "LightningEstimator")
+    finally:
+        hvd.shutdown()
 
 
-if _HAVE_LIGHTNING:  # pragma: no cover - lightning not in this env
-    from ..torch import TorchEstimator as _Base
+class LightningModel(TorchModel):
+    """Fitted transformer (reference lightning ``TorchModel``);
+    inherits ``transform``/``predict``/``getModel``."""
 
-    class TorchEstimator(_Base):
-        """Lightning-module estimator: the module's
-        ``configure_optimizers`` supplies the optimizer and
-        ``training_step`` the loss (reference
-        ``horovod/spark/lightning``)."""
 
-else:
+class TorchEstimator(_TorchEstimator):
+    """Trains a LightningModule-style module over a DataFrame
+    (reference ``horovod.spark.lightning.TorchEstimator``): the
+    module's ``configure_optimizers`` supplies the optimizer and
+    ``training_step`` the loss; gradients ride the framework's
+    ``DistributedOptimizer`` hooks."""
 
-    class TorchEstimator:  # type: ignore[no-redef]
-        def __init__(self, *args, **kwargs):
-            raise ImportError(
-                "horovod_tpu.spark.lightning requires lightning / "
-                "pytorch_lightning, which is not installed; use "
-                "horovod_tpu.spark.torch.TorchEstimator instead.")
+    _run_prefix = "lightning_"
+
+    @staticmethod
+    def _train_fn(payload):
+        return _lightning_train_fn(payload)
+
+    def _model_cls(self):
+        return LightningModel
+
+    def _extra_payload(self):
+        return {}
+
+    def fit(self, df=None) -> "LightningModel":
+        self._check_params()
+        if not _has_contract(self.model):
+            # Fail on the driver, before any workers launch.
+            raise TypeError(_CONTRACT_ERR % type(self.model).__name__)
+        return super().fit(df)

@@ -24,6 +24,52 @@ from ..common.util import (check_validation, materialize_dataframe,
 __all__ = ["TorchEstimator", "TorchModel"]
 
 
+def run_training(payload, model, make_optimizer, step_fn, loss_prefix):
+    """The shared per-rank DP training loop: DistributedOptimizer
+    hooks, parameter/optimizer broadcast, parquet shard read, epoch
+    loop, cross-rank loss averaging, rank-0 model serialization.
+    ``make_optimizer(model)`` sources the optimizer; ``step_fn(model,
+    xb, yb, batch_idx)`` returns the batch loss.  Used by the torch
+    and lightning estimators (only those two hooks differ)."""
+    import torch
+    import horovod_tpu.torch as hvd
+    optimizer = hvd.DistributedOptimizer(
+        make_optimizer(model), named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    x, y = read_parquet_shard(
+        payload["train_path"], hvd.rank(), hvd.size(),
+        payload["feature_cols"], payload["label_cols"])
+    x = torch.from_numpy(np.ascontiguousarray(x))
+    y = torch.from_numpy(np.ascontiguousarray(y))
+    bs = payload["batch_size"]
+    history = []
+    for epoch in range(payload["epochs"]):
+        perm = (torch.randperm(len(x)) if payload["shuffle"]
+                else torch.arange(len(x)))
+        epoch_loss, batches = 0.0, 0
+        for batch_idx, i in enumerate(range(0, len(x), bs)):
+            idx = perm[i:i + bs]
+            optimizer.zero_grad()
+            loss = step_fn(model, x[idx], y[idx], batch_idx)
+            loss.backward()
+            optimizer.step()
+            epoch_loss += float(loss.detach())
+            batches += 1
+        avg = epoch_loss / max(1, batches)
+        avg = float(hvd.allreduce(
+            torch.tensor(avg), op=hvd.Average,
+            name="%s.epoch_loss.%d" % (loss_prefix, epoch)))
+        history.append({"epoch": epoch, "loss": avg})
+        if payload["verbose"] and hvd.rank() == 0:
+            print("epoch %d loss %.6f" % (epoch, avg))
+    out = {"history": history, "model": None}
+    if hvd.rank() == 0:
+        out["model"] = serialize_torch_model(model)
+    return out
+
+
 def _torch_train_fn(payload):
     """Per-rank training body (top-level: must be picklable)."""
     import torch
@@ -33,44 +79,16 @@ def _torch_train_fn(payload):
         model = deserialize_torch_model(payload["model"])
         loss_fn = payload["loss"] or torch.nn.functional.mse_loss
         opt_factory = payload["optimizer"]
-        optimizer = (opt_factory(model.parameters()) if opt_factory
-                     else torch.optim.SGD(model.parameters(), lr=0.01))
-        optimizer = hvd.DistributedOptimizer(
-            optimizer, named_parameters=model.named_parameters())
-        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
-        hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
-        x, y = read_parquet_shard(
-            payload["train_path"], hvd.rank(), hvd.size(),
-            payload["feature_cols"], payload["label_cols"])
-        x = torch.from_numpy(np.ascontiguousarray(x))
-        y = torch.from_numpy(np.ascontiguousarray(y))
-        bs = payload["batch_size"]
-        history = []
-        for epoch in range(payload["epochs"]):
-            perm = (torch.randperm(len(x)) if payload["shuffle"]
-                    else torch.arange(len(x)))
-            epoch_loss, batches = 0.0, 0
-            for i in range(0, len(x), bs):
-                idx = perm[i:i + bs]
-                optimizer.zero_grad()
-                out = model(x[idx])
-                loss = loss_fn(out.squeeze(-1), y[idx].squeeze(-1))
-                loss.backward()
-                optimizer.step()
-                epoch_loss += float(loss.detach())
-                batches += 1
-            avg = epoch_loss / max(1, batches)
-            avg = float(hvd.allreduce(
-                torch.tensor(avg), op=hvd.Average,
-                name="TorchEstimator.epoch_loss.%d" % epoch))
-            history.append({"epoch": epoch, "loss": avg})
-            if payload["verbose"] and hvd.rank() == 0:
-                print("epoch %d loss %.6f" % (epoch, avg))
-        out = {"history": history, "model": None}
-        if hvd.rank() == 0:
-            out["model"] = serialize_torch_model(model)
-        return out
+        def make_optimizer(m):
+            return (opt_factory(m.parameters()) if opt_factory
+                    else torch.optim.SGD(m.parameters(), lr=0.01))
+
+        def step_fn(m, xb, yb, batch_idx):
+            return loss_fn(m(xb).squeeze(-1), yb.squeeze(-1))
+
+        return run_training(payload, model, make_optimizer, step_fn,
+                            "TorchEstimator")
     finally:
         hvd.shutdown()
 
@@ -82,20 +100,31 @@ class TorchEstimator(EstimatorParams):
     function or ``functools.partial``); ``loss`` a picklable callable.
     """
 
+    # Subclass hooks (the lightning estimator overrides these).
+    _run_prefix = "torch_"
+
+    @staticmethod
+    def _train_fn(payload):
+        return _torch_train_fn(payload)
+
+    def _model_cls(self):
+        return TorchModel
+
+    def _extra_payload(self):
+        return {"optimizer": self.optimizer, "loss": self.loss}
+
     def fit(self, df=None) -> "TorchModel":
         self._check_params()
         check_validation(self.validation)
         backend = self.backend or (
             SparkBackend(self.num_proc) if has_active_spark()
             else LocalBackend(self.num_proc or 1))
-        run_id = self.run_id or ("torch_" + uuid.uuid4().hex[:8])
+        run_id = self.run_id or (self._run_prefix + uuid.uuid4().hex[:8])
         train_path = self.store.get_train_data_path()
         if df is not None:
             materialize_dataframe(df, train_path, self.store)
         payload = {
             "model": serialize_torch_model(self.model),
-            "optimizer": self.optimizer,
-            "loss": self.loss,
             "train_path": train_path,
             "feature_cols": list(self.feature_cols),
             "label_cols": list(self.label_cols),
@@ -104,15 +133,16 @@ class TorchEstimator(EstimatorParams):
             "verbose": self.verbose,
             "shuffle": self.shuffle,
         }
-        results = backend.run(_torch_train_fn, args=(payload,))
+        payload.update(self._extra_payload())
+        results = backend.run(type(self)._train_fn, args=(payload,))
         rank0 = results[0]
         model = deserialize_torch_model(rank0["model"])
         ckpt = self.store.get_checkpoint_path(run_id)
         self.store.write(ckpt, rank0["model"])
-        return TorchModel(model=model,
-                          feature_cols=list(self.feature_cols),
-                          label_cols=list(self.label_cols),
-                          history=rank0["history"], run_id=run_id)
+        return self._model_cls()(
+            model=model, feature_cols=list(self.feature_cols),
+            label_cols=list(self.label_cols),
+            history=rank0["history"], run_id=run_id)
 
 
 class TorchModel:

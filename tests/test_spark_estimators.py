@@ -14,6 +14,7 @@ import sys
 import numpy as np
 import pandas as pd
 import pytest
+import torch
 
 from horovod_tpu.spark.common import (EstimatorParams, LocalBackend,
                                       LocalStore, Store)
@@ -124,7 +125,116 @@ def test_keras_estimator_end_to_end(tmp_path):
     assert pred.shape[0] == 2
 
 
-def test_lightning_estimator_gated():
+class DuckModule(torch.nn.Module):
+    """LightningModule training contract without lightning installed
+    (the estimator is duck-typed).  Top-level: ``torch.save`` pickles
+    the class by reference, so workers must import it by name."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = torch.nn.Linear(4, 1)
+
+    def forward(self, x):
+        return self.lin(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        out = self(x).squeeze(-1)
+        return {"loss": torch.nn.functional.mse_loss(out, y.squeeze(-1))}
+
+    def configure_optimizers(self):
+        return ([torch.optim.SGD(self.parameters(), lr=0.05)], [])
+
+
+def test_lightning_estimator_end_to_end(tmp_path):
     from horovod_tpu.spark.lightning import TorchEstimator
-    with pytest.raises(ImportError):
-        TorchEstimator()
+    store = LocalStore(str(tmp_path))
+    est = TorchEstimator(model=DuckModule(), store=store,
+                         epochs=2, batch_size=8, verbose=0,
+                         backend=LocalBackend(num_proc=2))
+    fitted = est.fit(_df(32))
+    assert len(fitted.history) == 2
+    assert fitted.history[1]["loss"] <= fitted.history[0]["loss"] * 2
+    pred = fitted.predict(np.zeros((3, 4), np.float32))
+    assert pred.shape[0] == 3
+    assert store.exists(store.get_checkpoint_path(fitted.run_id))
+
+
+def test_lightning_estimator_rejects_plain_module(tmp_path):
+    import torch
+    from horovod_tpu.spark.lightning import TorchEstimator
+    store = LocalStore(str(tmp_path))
+    est = TorchEstimator(model=torch.nn.Linear(4, 1), store=store,
+                         epochs=1, batch_size=8, verbose=0,
+                         backend=LocalBackend(num_proc=1))
+    with pytest.raises(Exception, match="training_step"):
+        est.fit(_df(8))
+
+
+def _mapper_fn():
+    # Runs inside the mapper body: a real size-1 tcp world bootstrapped
+    # through the rendezvous env the mapper installs.
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        out = hvd.allreduce(np.array([2.0, 3.0], np.float32),
+                            op=hvd.Sum, name="spark_mapper_ar")
+        return {"rank": hvd.rank(), "sum": [float(v) for v in out]}
+    finally:
+        hvd.shutdown()
+
+
+def test_spark_run_mapper_body_executes(monkeypatch):
+    """Execute _make_mapper's barrier-task body in-process under a fake
+    BarrierTaskContext: env wiring, the real rendezvous KV, fn
+    execution in a real tcp world, the barrier call, and the (rank,
+    result) yield are all covered without a Spark cluster."""
+    import types
+
+    from horovod_tpu.runner import util as runner_util
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.spark import _make_mapper
+
+    barrier_calls = []
+
+    class FakeTaskInfo:
+        def __init__(self, address):
+            self.address = address
+
+    class FakeBarrierTaskContext:
+        @staticmethod
+        def get():
+            return FakeBarrierTaskContext()
+
+        def partitionId(self):
+            return 0
+
+        def getTaskInfos(self):
+            return [FakeTaskInfo("127.0.0.1:41000")]
+
+        def barrier(self):
+            barrier_calls.append(True)
+
+    fake_pyspark = types.ModuleType("pyspark")
+    fake_pyspark.BarrierTaskContext = FakeBarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", fake_pyspark)
+
+    secret = runner_util.make_secret()
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    saved_env = dict(os.environ)
+    try:
+        mapper = _make_mapper(_mapper_fn, (), {}, 1,
+                              "127.0.0.1:%d" % port, secret,
+                              {"HOROVOD_EXTRA_MARK": "1"})
+        results = list(mapper(iter([0])))
+        assert results == [(0, {"rank": 0, "sum": [2.0, 3.0]})]
+        assert barrier_calls == [True]
+        # The mapper installed the world env (executor-side semantics).
+        assert os.environ["HOROVOD_RANK"] == "0"
+        assert os.environ["HOROVOD_EXTRA_MARK"] == "1"
+    finally:
+        server.stop()
+        os.environ.clear()
+        os.environ.update(saved_env)
