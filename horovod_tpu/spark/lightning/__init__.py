@@ -34,10 +34,11 @@ def _has_contract(module) -> bool:
 
 
 def _first_optimizer(configured):
-    """``configure_optimizers`` may return one optimizer, a list, or
-    the lightning ``(optimizers, schedulers)`` tuple; DP training
-    drives the first optimizer (the reference lightning estimator's
-    single-optimizer path does the same)."""
+    """``configure_optimizers`` may return one optimizer, a list, the
+    ``(optimizers, schedulers)`` tuple, a ``{"optimizer": ...}`` dict,
+    or a list of such dicts (all documented lightning contracts); DP
+    training drives the first optimizer (the reference lightning
+    estimator's single-optimizer path does the same)."""
     if isinstance(configured, tuple) and len(configured) == 2 and \
             isinstance(configured[0], (list, tuple)):
         opts = list(configured[0])
@@ -47,7 +48,15 @@ def _first_optimizer(configured):
         opts = [configured]
     if not opts:
         raise ValueError("configure_optimizers() returned no optimizer")
-    return opts[0]
+    first = opts[0]
+    if isinstance(first, dict):
+        try:
+            return first["optimizer"]
+        except KeyError:
+            raise ValueError(
+                "configure_optimizers() returned a dict without an "
+                "'optimizer' entry: %r" % sorted(first)) from None
+    return first
 
 
 def _step_loss(result):
@@ -105,8 +114,8 @@ class TorchEstimator(_TorchEstimator):
         return {}
 
     def fit(self, df=None) -> "LightningModel":
-        self._check_params()
         if not _has_contract(self.model):
-            # Fail on the driver, before any workers launch.
+            # Fail on the driver, before any workers launch
+            # (super().fit validates the common params).
             raise TypeError(_CONTRACT_ERR % type(self.model).__name__)
         return super().fit(df)

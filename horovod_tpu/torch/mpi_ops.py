@@ -4,8 +4,10 @@ Reference parity: ``horovod/torch/mpi_ops.py`` (+ the handle table in
 ``mpi_ops_v2.cc`` / ``handle_manager.cc``): every op has a synchronous
 form, an ``*_async`` form returning a handle resolved by
 ``synchronize``/``poll``, and (where the reference has one) an in-place
-``*_`` form.  Tensors are CPU torch tensors; the wire format is their
-zero-copy numpy view.
+``*_`` form.  CPU tensors ride as zero-copy numpy views; device
+tensors route per ``_payload`` (dlpack into jax where the runtimes
+share the device, torch_xla via host materialization) and results
+return on the input tensor's device.
 """
 
 from __future__ import annotations
@@ -29,10 +31,7 @@ __all__ = [
 
 
 def _np_view(t: torch.Tensor) -> np.ndarray:
-    if t.device.type != "cpu":
-        raise ValueError(
-            "torch adapter moves CPU tensors; device tensors belong to "
-            "the JAX adapter (got %s)" % t.device)
+    """CPU tensors as zero-copy numpy views (the wire payload)."""
     if t.dtype == torch.bfloat16:
         # numpy has no native bf16: reinterpret through uint16 onto the
         # ml_dtypes wire representation (same bits, zero copy).
@@ -40,6 +39,45 @@ def _np_view(t: torch.Tensor) -> np.ndarray:
         return t.detach().contiguous().view(torch.uint16).numpy() \
             .view(ml_dtypes.bfloat16)
     return t.detach().contiguous().numpy()
+
+
+def _device_to_jax(t: torch.Tensor):
+    """Bridge a non-CPU torch tensor into jax without a host round
+    trip where the runtimes share the device (dlpack).  The north-star
+    routing: device gradients flow through DistributedOptimizer
+    unchanged, onto the framework's device payload plane."""
+    from jax import dlpack as jdl
+    return jdl.from_dlpack(t.detach().contiguous())
+
+
+def _payload(t: torch.Tensor):
+    """Tensor -> collective payload.
+
+    * CPU tensor: zero-copy numpy view (host/wire plane).
+    * torch_xla tensor (``device.type == 'xla'``): torch_xla owns the
+      device buffer behind a lazy IR; materialize to host and ship the
+      numpy payload (documented limitation: a shared-buffer bridge
+      needs torch_xla's dlpack, which this environment cannot
+      exercise).
+    * other device tensors (e.g. cuda): dlpack into jax when a device
+      payload plane exists — in tcp mode the only backend is host-TCP,
+      which would immediately copy a bridged array back to host, so go
+      straight to the host view there; host copy is also the fallback
+      when jax lacks a matching device backend.
+    """
+    if t.device.type == "cpu":
+        return _np_view(t)
+    if t.device.type == "xla":  # pragma: no cover - needs torch_xla
+        import torch_xla.core.xla_model as xm
+        xm.mark_step()
+        return _np_view(t.cpu())
+    from ..common import basics
+    if basics.is_initialized() and basics._controller_mode() == "tcp":
+        return _np_view(t.cpu())  # pragma: no cover - needs a device
+    try:
+        return _device_to_jax(t)
+    except Exception:  # pragma: no cover - runtime-dependent bridge
+        return _np_view(t.cpu())
 
 
 class TorchHandle:
@@ -79,8 +117,12 @@ class TorchHandle:
                 .view(torch.bfloat16)
         else:
             t = torch.from_numpy(arr)
-        if self._like is not None and t.dtype != self._like.dtype:
-            t = t.to(self._like.dtype)
+        if self._like is not None:
+            if t.dtype != self._like.dtype:
+                t = t.to(self._like.dtype)
+            if self._like.device.type != "cpu":
+                # Device tensors come back on their device.
+                t = t.to(self._like.device)
         return t
 
 
@@ -99,7 +141,7 @@ def allreduce_async(tensor: torch.Tensor, average=None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
                     process_set=None) -> TorchHandle:
-    h = _api.allreduce_async(_np_view(tensor), average, name, op,
+    h = _api.allreduce_async(_payload(tensor), average, name, op,
                              prescale_factor, postscale_factor,
                              process_set)
     return TorchHandle(h, like=tensor)
@@ -111,7 +153,7 @@ def allreduce_async_(tensor: torch.Tensor, average=None,
                      postscale_factor: float = 1.0,
                      process_set=None) -> TorchHandle:
     """In-place async allreduce (reference ``hvd.allreduce_async_``)."""
-    h = _api.allreduce_async(_np_view(tensor), average, name, op,
+    h = _api.allreduce_async(_payload(tensor), average, name, op,
                              prescale_factor, postscale_factor,
                              process_set)
     return TorchHandle(h, like=tensor, out=tensor)
@@ -137,7 +179,7 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
                             postscale_factor: float = 1.0,
                             process_set=None) -> List[TorchHandle]:
     hs = _api.grouped_allreduce_async(
-        [_np_view(t) for t in tensors], average, name, op,
+        [_payload(t) for t in tensors], average, name, op,
         prescale_factor, postscale_factor, process_set)
     return [TorchHandle(h, like=t) for h, t in zip(hs, tensors)]
 
@@ -154,7 +196,7 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
                     process_set=None) -> TorchHandle:
-    h = _api.allgather_async(_np_view(tensor), name, process_set)
+    h = _api.allgather_async(_payload(tensor), name, process_set)
     return TorchHandle(h, like=tensor)
 
 
@@ -167,7 +209,7 @@ def allgather(tensor, name=None, process_set=None) -> torch.Tensor:
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
                     name: Optional[str] = None,
                     process_set=None) -> TorchHandle:
-    h = _api.broadcast_async(_np_view(tensor), root_rank, name,
+    h = _api.broadcast_async(_payload(tensor), root_rank, name,
                              process_set)
     return TorchHandle(h, like=tensor)
 
@@ -175,7 +217,7 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int,
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
                      name: Optional[str] = None,
                      process_set=None) -> TorchHandle:
-    h = _api.broadcast_async(_np_view(tensor), root_rank, name,
+    h = _api.broadcast_async(_payload(tensor), root_rank, name,
                              process_set)
     return TorchHandle(h, like=tensor, out=tensor)
 
@@ -197,7 +239,7 @@ def alltoall_async(tensor: torch.Tensor, splits=None,
                    process_set=None) -> TorchHandle:
     if splits is not None and isinstance(splits, torch.Tensor):
         splits = splits.tolist()
-    h = _api.alltoall_async(_np_view(tensor), splits, name, process_set)
+    h = _api.alltoall_async(_payload(tensor), splits, name, process_set)
     return TorchHandle(h, like=tensor)
 
 
@@ -211,7 +253,7 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
 def reducescatter_async(tensor: torch.Tensor, op=SUM,
                         name: Optional[str] = None,
                         process_set=None) -> TorchHandle:
-    h = _api.reducescatter_async(_np_view(tensor), op, name, process_set)
+    h = _api.reducescatter_async(_payload(tensor), op, name, process_set)
     return TorchHandle(h, like=tensor)
 
 

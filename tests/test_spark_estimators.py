@@ -238,3 +238,20 @@ def test_spark_run_mapper_body_executes(monkeypatch):
         server.stop()
         os.environ.clear()
         os.environ.update(saved_env)
+
+
+def test_lightning_first_optimizer_contracts():
+    # Every documented configure_optimizers return shape resolves to
+    # the first optimizer; a dict without one fails loudly.
+    from horovod_tpu.spark.lightning import _first_optimizer
+    opt = torch.optim.SGD([torch.nn.Parameter(torch.zeros(2))], lr=0.1)
+    assert _first_optimizer(opt) is opt
+    assert _first_optimizer([opt]) is opt
+    assert _first_optimizer(([opt], [])) is opt
+    assert _first_optimizer({"optimizer": opt, "lr_scheduler": None}) \
+        is opt
+    assert _first_optimizer([{"optimizer": opt}]) is opt
+    with pytest.raises(ValueError, match="optimizer"):
+        _first_optimizer({"lr_scheduler": None})
+    with pytest.raises(ValueError, match="no optimizer"):
+        _first_optimizer([])
