@@ -254,8 +254,9 @@ def _attention_block(x, lp, cfg: TransformerConfig, cos, sin, sp_size):
         attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
     elif _use_flash_attention():
         # Pallas fused attention on TPU (ops/pallas_kernels.py):
-        # O(seq) HBM forward, chunked O(block·seq) backward; measured
-        # >4x over the XLA-fused path at seq 8192 on one chip
+        # O(seq) HBM forward + Pallas backward kernels (dq, dk/dv);
+        # measured ~5x over XLA autodiff at seq 8192 on one chip
+        # (docs/benchmarks.md)
         from ..ops.pallas_kernels import flash_attention
         attn = flash_attention(q, k, v, causal=True)
     else:

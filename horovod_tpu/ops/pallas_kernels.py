@@ -42,8 +42,8 @@ def _on_tpu() -> bool:
 # flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                       acc_scr, *, block_q: int, block_k: int,
+def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr,
+                       l_scr, acc_scr, *, block_q: int, block_k: int,
                        causal: bool, scale: float):
     # grid = (bh, nq, nk): K/V stream through VMEM one block per inner
     # step (double-buffered by the Pallas pipeline); the online-softmax
@@ -91,11 +91,14 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     def _finish():
         o_ref[0] = (acc_scr[:] /
                     jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        # log-sum-exp per row: the backward recomputes softmax as
+        # exp(s - lse) without a second online pass.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _flash_attention_fwd_flat(q, k, v, *, causal: bool, block_q: int,
                               block_k: int, interpret: bool):
-    """(BH, S, D) → (BH, S, D), D already lane-padded."""
+    """(BH, S, D) → ((BH, S, D) output, (BH, S, 1) lse), D lane-padded."""
     from jax.experimental.pallas import tpu as pltpu
     bh, seq, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -111,9 +114,16 @@ def _flash_attention_fwd_flat(q, k, v, *, causal: bool, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda i, j, t: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            # unit lane dim keeps the (sublane, lane) tiling legal and
+            # broadcasts against (block_q, block_k) scores directly
+            pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -171,45 +181,201 @@ def _chunked_attention_bwd(q, k, v, g, causal: bool, block_q: int):
     return to_out(dq, q), to_out(dk, k), to_out(dv, v)
 
 
+def _plan(s: int, d: int):
+    """Block plan shared by fwd and bwd.  Large tiles amortize
+    per-grid-step overhead; MXU tiles are 128-aligned so any divisor
+    ≥64 works.  The head dim is lane-padded to 128 (zero columns add 0
+    to every dot product)."""
+    block_q = next((bq for bq in (512, 256, 128, 64) if s % bq == 0),
+                   None)
+    block_k = next((bk for bk in (1024, 512, 256, 128, 64)
+                    if s % bk == 0), None)
+    d_pad = max(128, ((d + 127) // 128) * 128)
+    scale_fix = math.sqrt(d_pad / d)  # kernels scale by 1/sqrt(d_pad)
+    return block_q, block_k, d_pad, scale_fix
+
+
+def _to_flat(x, d_pad):
+    b, s, h, d = x.shape
+    x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+    if d_pad != d:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
+    return x
+
+
+def _from_flat(x, b, h, d, like):
+    s = x.shape[1]
+    x = x[:, :, :d].reshape(b, h, s, d)
+    return jnp.swapaxes(x, 1, 2).astype(like.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_attention(q, k, v, causal):
     return _flash_attention_impl(q, k, v, causal)
 
 
 def _flash_attention_impl(q, k, v, causal):
-    b, s, h, d = q.shape
-    # large tiles amortize per-grid-step overhead; MXU tiles are
-    # 128-aligned so any divisor ≥64 works
-    block_q = next((bq for bq in (512, 256, 128, 64) if s % bq == 0),
-                   None)
-    block_k = next((bk for bk in (1024, 512, 256, 128, 64)
-                    if s % bk == 0), None)
-    if block_q is None or block_k is None:
-        return _reference_attention(q, k, v, causal)
-    # lane-pad the head dim to 128 (zero columns change nothing: they
-    # add 0 to every dot product) and fold heads into the grid axis
-    d_pad = max(128, ((d + 127) // 128) * 128)
-    scale_fix = math.sqrt(d_pad / d)  # kernel scales by 1/sqrt(d_pad)
-
-    def to_flat(x):
-        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
-        if d_pad != d:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
-        return x
-
-    out = _flash_attention_fwd_flat(
-        to_flat(q * scale_fix), to_flat(k), to_flat(v),
-        causal=causal, block_q=block_q, block_k=block_k,
-        interpret=not _on_tpu())
-    out = out[:, :, :d].reshape(b, h, s, d)
-    return jnp.swapaxes(out, 1, 2)
+    return _flash_fwd(q, k, v, causal)[0]
 
 
 def _flash_fwd(q, k, v, causal):
-    return _flash_attention_impl(q, k, v, causal), (q, k, v)
+    b, s, h, d = q.shape
+    block_q, block_k, d_pad, scale_fix = _plan(s, d)
+    if block_q is None or block_k is None:
+        out = _reference_attention(q, k, v, causal)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_attention_fwd_flat(
+        _to_flat(q * scale_fix, d_pad), _to_flat(k, d_pad),
+        _to_flat(v, d_pad), causal=causal, block_q=block_q,
+        block_k=block_k, interpret=not _on_tpu())
+    out = out[:, :, :d].reshape(b, h, s, d)
+    out = jnp.swapaxes(out, 1, 2)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, res, g):
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, block_q: int, block_k: int,
+                         causal: bool, scale: float):
+    # grid = (bh, nq, nk): K/V stream along the inner axis while this
+    # q block's dq accumulates in VMEM scratch (mirror of the fwd).
+    j = pl.program_id(1)
+    t = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(t == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    block_live = jnp.logical_or(
+        jnp.logical_not(causal),
+        t * block_k <= j * block_q + block_q - 1)
+
+    @pl.when(block_live)
+    def _update():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (BQ, BK)
+        # softmax from saved stats: p = exp(s - lse)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = t * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(cols <= rows, p, 0.0)
+        dp = jax.lax.dot_general(
+            g_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BQ, BK)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BQ, D)
+
+    @pl.when(t == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          block_q: int, block_k: int, causal: bool,
+                          scale: float):
+    # grid = (bh, nk, nq): Q/G stream along the inner axis while this
+    # k block's dk/dv accumulate in VMEM scratch.
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    block_live = jnp.logical_or(
+        jnp.logical_not(causal),
+        j * block_q + block_q - 1 >= t * block_k)
+
+    @pl.when(block_live)
+    def _update():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (BQ, BK)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = t * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(cols <= rows, p, 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(g_ref.dtype), g_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BK, D)
+        dp = jax.lax.dot_general(
+            g_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BQ, BK)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BK, D)
+
+    @pl.when(j == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_flat(q, k, v, g, lse, delta, *, causal: bool,
+                              block_q: int, block_k: int,
+                              interpret: bool):
+    """Flat (BH, S, D) backward via the two Pallas kernels above;
+    returns (dq, dk, dv) with dq still in the fwd's q scaling."""
+    from jax.experimental.pallas import tpu as pltpu
+    bh, seq, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale),
+        grid=(bh, seq // block_q, seq // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda i, j, t: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dkv grid: (bh, k block, q block) — inner axis streams q.
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda i, t, j: (i, j, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0))
+    rowspec2 = pl.BlockSpec((1, block_q, 1), lambda i, t, j: (i, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale),
+        grid=(bh, seq // block_k, seq // block_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+def _flash_bwd_chunked(causal, res, g):
     q, k, v = res
     b, s, h, _ = q.shape
     # bigger blocks = fewer scan steps (measured 23% faster at 2048 vs
@@ -228,6 +394,45 @@ def _flash_bwd(causal, res, g):
             q, k, v)
         return vjp(g)
     return _chunked_attention_bwd(q, k, v, g, causal, block)
+
+
+def _flash_bwd(causal, res, g):
+    q, k, v, o, lse = res
+    if lse is None:  # fwd fell back to plain XLA attention
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal),
+            q, k, v)
+        return vjp(g)
+    import os
+    # Read at TRACE time: under jit the choice is baked into the
+    # compiled function — set before the first train step, not between
+    # steps.  Unknown values fail loudly so a typo can't silently
+    # invalidate an A/B comparison.
+    choice = os.environ.get("HVD_TPU_FLASH_BWD", "pallas")
+    if choice not in ("pallas", "chunked"):
+        raise ValueError(
+            "HVD_TPU_FLASH_BWD must be 'pallas' or 'chunked', got %r"
+            % choice)
+    if choice == "chunked":
+        # A/B escape hatch (docs/benchmarks.md records the comparison).
+        return _flash_bwd_chunked(causal, (q, k, v), g)
+    b, s, h, d = q.shape
+    block_q, block_k, d_pad, scale_fix = _plan(s, d)
+    # delta = rowsum(g ⊙ o): the softmax-jacobian correction term,
+    # cheap in XLA (one elementwise pass).  Unit lane dim to match the
+    # lse layout.
+    delta = jnp.sum(jnp.swapaxes(g, 1, 2).astype(jnp.float32)
+                    * jnp.swapaxes(o, 1, 2).astype(jnp.float32),
+                    axis=-1).reshape(b * h, s, 1)
+    dq, dk, dv = _flash_attention_bwd_flat(
+        _to_flat(q * scale_fix, d_pad), _to_flat(k, d_pad),
+        _to_flat(v, d_pad), _to_flat(g, d_pad), lse, delta,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=not _on_tpu())
+    # fwd pre-scaled q by scale_fix, so d(loss)/d(q) = dq_flat*scale_fix
+    return (_from_flat(dq, b, h, d, q) * scale_fix,
+            _from_flat(dk, b, h, d, k),
+            _from_flat(dv, b, h, d, v))
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
