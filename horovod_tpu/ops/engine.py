@@ -261,9 +261,16 @@ class CollectiveEngine:
             self.timeline.mark_cycle(self._cycle_count)
             if batch:
                 t0 = time.monotonic()
+                misses0 = self.cache.misses
                 nbytes = sum(e.nbytes for e in batch)
                 self._run_cycle(batch)
-                if self.parameter_manager is not None:
+                # A cycle that compiled a new XLA executable measures
+                # the compiler, not communication; feeding it to the
+                # tuner would bias the early GP samples (the reference
+                # resets after HOROVOD_AUTOTUNE_WARMUP for the same
+                # reason).
+                compiled = self.cache.misses != misses0
+                if self.parameter_manager is not None and not compiled:
                     self.parameter_manager.observe(
                         nbytes, time.monotonic() - t0)
                     self.config.fusion_threshold_bytes = (
@@ -340,32 +347,25 @@ class CollectiveEngine:
                 self.stall_inspector.record_done(e.name)
                 e.handle._set_result(out)
                 return
-            self.timeline.activity_start_all(names, "MEMCPY_IN_FUSION_BUFFER")
-            flats, lengths = [], []
-            for e in entries:
-                f = zero_joined(e.payload.reshape(size, -1), e.joined_idx)
-                lengths.append(f.shape[1])
-                flats.append(f)
-            total = sum(lengths)
-            padded = _bucket(total)
-            fused = jnp.concatenate(
-                flats + [jnp.zeros((size, padded - total),
-                                   dtype=flats[0].dtype)], axis=1)
-            self.timeline.activity_end_all(names)
+            # The whole fusion cycle is ONE compiled program (flatten +
+            # zero joined rows + concat into the padded bucket + the
+            # collective + per-entry slices): XLA manages the fusion
+            # buffer as a compiler scratch instead of the engine
+            # dispatching separate concat/collective/slice programs
+            # (the reference's persistent fusion buffer, the XLA way).
             self.timeline.activity_start_all(names, "EXEC_FUSED_ALLREDUCE")
             e0 = entries[0]
-            out = mc.allreduce(fused, e0.red_op, float(e0.prescale),
-                               float(e0.postscale))
+            total = sum(
+                int(np.prod(e.payload.shape[1:], dtype=np.int64))
+                for e in entries)
+            outs = mc.fused_allreduce(
+                [e.payload for e in entries], e0.red_op,
+                float(e0.prescale), float(e0.postscale),
+                [e.joined_idx for e in entries], _bucket(total))
             self.timeline.activity_end_all(names)
-            self.timeline.activity_start_all(
-                names, "MEMCPY_OUT_FUSION_BUFFER")
-            off = 0
-            for e, ln in zip(entries, lengths):
-                shard = out[off:off + ln].reshape(e.payload.shape[1:])
-                off += ln
+            for e, out in zip(entries, outs):
                 self.stall_inspector.record_done(e.name)
-                e.handle._set_result(shard)
-            self.timeline.activity_end_all(names)
+                e.handle._set_result(out)
         except Exception as exc:  # noqa: BLE001 - propagate to handles
             LOG.error("fused allreduce failed: %s", exc)
             for e in entries:

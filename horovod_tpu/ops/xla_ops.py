@@ -83,6 +83,10 @@ class MeshCollectives:
         self.cache = cache if cache is not None else ExecutableCache()
         self._stacked_sharding = NamedSharding(self.mesh, P(AXIS))
         self._replicated_sharding = NamedSharding(self.mesh, P())
+        # Sightings per (shape, splits) / grouping key: compiled fused
+        # programs are built only for keys that repeat.
+        self._ragged_seen: dict = {}
+        self._grouping_seen: dict = {}
 
     # -- helpers -----------------------------------------------------------
 
@@ -95,7 +99,9 @@ class MeshCollectives:
 
     # -- allreduce ---------------------------------------------------------
 
-    def _build_allreduce(self, red_op: str):
+    def _allreduce_shard_fn(self, red_op: str):
+        """The unjitted shard_map collective, shared by the plain and
+        fused allreduce programs."""
         size = self.size
 
         def block_fn(x, pre, post):
@@ -120,10 +126,12 @@ class MeshCollectives:
 
         # check_vma off: the all_gather+prod product path is replicated in
         # value but not statically inferable as such.
-        fn = jax.shard_map(block_fn, mesh=self.mesh,
-                           in_specs=(P(AXIS), P(), P()),
-                           out_specs=P(), check_vma=(red_op != PRODUCT))
-        return jax.jit(fn)
+        return jax.shard_map(block_fn, mesh=self.mesh,
+                             in_specs=(P(AXIS), P(), P()),
+                             out_specs=P(), check_vma=(red_op != PRODUCT))
+
+    def _build_allreduce(self, red_op: str):
+        return jax.jit(self._allreduce_shard_fn(red_op))
 
     def allreduce(self, stacked, red_op: str = SUM,
                   prescale_factor: float = 1.0,
@@ -138,6 +146,82 @@ class MeshCollectives:
         out = fn(stacked, pre, post)
         # Block shape [1, ...] -> logical [...]
         return out[0]
+
+    def _build_fused_allreduce(self, red_op, shapes, joined_idx, bucket):
+        size = self.size
+        shard_fn = self._allreduce_shard_fn(red_op)
+        lengths = [int(np.prod(s[1:], dtype=np.int64)) for s in shapes]
+        total = sum(lengths)
+
+        def prog(pre, post, *payloads):
+            flats = []
+            for p, joined in zip(payloads, joined_idx):
+                f = p.reshape(size, -1)
+                if joined:
+                    f = f.at[jnp.asarray(list(joined))].set(0)
+                flats.append(f)
+            if bucket > total:
+                flats.append(jnp.zeros((size, bucket - total),
+                                       dtype=flats[0].dtype))
+            fused = jnp.concatenate(flats, axis=1)
+            out = shard_fn(fused, pre, post)[0]
+            outs, off = [], 0
+            for ln, s in zip(lengths, shapes):
+                outs.append(out[off:off + ln].reshape(s[1:]))
+                off += ln
+            return tuple(outs)
+
+        return jax.jit(prog)
+
+    def fused_allreduce(self, payloads, red_op: str,
+                        prescale_factor: float, postscale_factor: float,
+                        joined_idx, bucket: int):
+        """Fusion-group allreduce.
+
+        A grouping seen for the SECOND time gets one compiled program
+        (flatten + zero joined rows + concat into the padded bucket +
+        the collective + per-entry slices — XLA owns the fusion
+        buffer as compiler scratch).  A first-seen grouping takes the
+        eager path, whose big collective executable is keyed only on
+        the power-of-two bucket shape and therefore shared across
+        groupings — so shifting chunk boundaries (e.g. while the
+        autotuner moves the fusion threshold) don't compile a fresh
+        program every cycle."""
+        payloads = [self.shard_stacked(p) for p in payloads]
+        joined_idx = tuple(tuple(j) for j in joined_idx)
+        shapes = tuple(p.shape for p in payloads)
+        key = self._key("fused_allreduce", payloads[0].dtype, shapes,
+                        (red_op, joined_idx, bucket))
+        if len(self._grouping_seen) > 4096:  # bound the sighting memo
+            self._grouping_seen.clear()
+        seen = self._grouping_seen.get(key, 0)
+        self._grouping_seen[key] = seen + 1
+        pre = jnp.asarray(prescale_factor, dtype=jnp.float32)
+        post = jnp.asarray(postscale_factor, dtype=jnp.float32)
+        if seen == 0:
+            flats = []
+            for p, joined in zip(payloads, joined_idx):
+                f = p.reshape(self.size, -1)
+                if joined:
+                    f = f.at[jnp.asarray(list(joined))].set(0)
+                flats.append(f)
+            lengths = [f.shape[1] for f in flats]
+            total = sum(lengths)
+            if bucket > total:
+                flats.append(jnp.zeros((self.size, bucket - total),
+                                       dtype=flats[0].dtype))
+            fused = jnp.concatenate(flats, axis=1)
+            out = self.allreduce(fused, red_op, prescale_factor,
+                                 postscale_factor)
+            outs, off = [], 0
+            for ln, s in zip(lengths, shapes):
+                outs.append(out[off:off + ln].reshape(s[1:]))
+                off += ln
+            return tuple(outs)
+        fn = self.cache.get_or_build(
+            key, lambda: self._build_fused_allreduce(
+                red_op, shapes, joined_idx, bucket))
+        return fn(pre, post, *payloads)
 
     # -- allgather ---------------------------------------------------------
 
@@ -222,17 +306,76 @@ class MeshCollectives:
             fn = self.cache.get_or_build(key, self._build_alltoall)
             return fn(stacked), None
         # Ragged: splits[r][j] = #rows rank r sends to rank j.
+        #
+        # Output shapes depend on the exact splits matrix, so a
+        # compiled program is only worth building for splits that
+        # REPEAT (e.g. fixed-capacity MoE dispatch); per-step varying
+        # splits would recompile every step.  First sighting (or a
+        # pathologically skewed pad) takes the eager reassembly; a
+        # repeat compiles one program that fuses the pack/unpack
+        # around a single device all_to_all collective.
         splits = np.asarray(splits)
-        out_rows: List[List] = [[] for _ in range(self.size)]
-        for r in range(self.size):
-            off = 0
-            for j in range(self.size):
-                c = int(splits[r, j])
-                out_rows[j].append(stacked[r][off:off + c])
-                off += c
-        outs = [jnp.concatenate(rows, axis=0) for rows in out_rows]
-        recv_splits = splits.T.copy()
-        return outs, recv_splits
+        maxc = int(splits.max(initial=0))
+        if maxc == 0:
+            empty = stacked[:, :0] if stacked.ndim > 1 else stacked[:0]
+            return [empty[0] for _ in range(self.size)], splits.T.copy()
+        key = self._key("alltoall_ragged", stacked.dtype, stacked.shape,
+                        (splits.tobytes(),))
+        pad_blowup = (self.size * self.size * maxc
+                      > 4 * int(splits.sum()))
+        if len(self._ragged_seen) > 4096:  # bound the sighting memo
+            self._ragged_seen.clear()
+        seen = self._ragged_seen.get(key, 0)
+        self._ragged_seen[key] = seen + 1
+        if pad_blowup or seen == 0:
+            out_rows: List[List] = [[] for _ in range(self.size)]
+            for r in range(self.size):
+                off = 0
+                for j in range(self.size):
+                    c = int(splits[r, j])
+                    out_rows[j].append(stacked[r][off:off + c])
+                    off += c
+            outs = [jnp.concatenate(rows, axis=0) for rows in out_rows]
+            return outs, splits.T.copy()
+        fn = self.cache.get_or_build(
+            key, lambda: self._build_alltoall_ragged(splits))
+        return list(fn(stacked)), splits.T.copy()
+
+    def _build_alltoall_ragged(self, splits: np.ndarray):
+        size = self.size
+        maxc = int(splits.max())
+
+        def block_fn(x):
+            # x: [1, size, maxc, ...] -> row j to rank j; received rows
+            # stack in sender order.
+            y = lax.all_to_all(x[0], AXIS, split_axis=0, concat_axis=0,
+                               tiled=True)
+            return y[None]
+
+        shuffle = jax.shard_map(block_fn, mesh=self.mesh,
+                                in_specs=P(AXIS), out_specs=P(AXIS))
+
+        def prog(stacked):
+            rest_ndim = stacked.ndim - 2
+            send = []
+            for r in range(size):
+                off, chunks = 0, []
+                for j in range(size):
+                    c = int(splits[r, j])
+                    blk = stacked[r, off:off + c]
+                    off += c
+                    chunks.append(jnp.pad(
+                        blk, [(0, maxc - c)] + [(0, 0)] * rest_ndim))
+                send.append(jnp.stack(chunks))
+            recv = shuffle(jnp.stack(send))  # [recv_rank, send_rank, maxc, ...]
+            outs = []
+            for j in range(size):
+                rows = [recv[j, r, :int(splits[r, j])]
+                        for r in range(size)]
+                outs.append(jnp.concatenate(rows, axis=0))
+            return tuple(outs)
+
+        return jax.jit(prog)
 
     # -- reducescatter -----------------------------------------------------
 

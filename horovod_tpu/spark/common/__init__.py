@@ -6,5 +6,6 @@
 
 from .backend import Backend, LocalBackend, SparkBackend  # noqa: F401
 from .params import EstimatorParams  # noqa: F401
-from .store import (DBFSLocalStore, FilesystemStore, HDFSStore,  # noqa: F401
+from .store import (ArrowFsStore, DBFSLocalStore,  # noqa: F401
+                    FilesystemStore, HDFSStore,
                     LocalStore, Store)

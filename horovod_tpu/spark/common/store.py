@@ -18,8 +18,8 @@ import os
 import shutil
 from typing import Optional
 
-__all__ = ["Store", "FilesystemStore", "LocalStore", "HDFSStore",
-           "DBFSLocalStore"]
+__all__ = ["Store", "FilesystemStore", "LocalStore", "ArrowFsStore",
+           "HDFSStore", "DBFSLocalStore"]
 
 
 class Store:
@@ -174,53 +174,61 @@ class DBFSLocalStore(FilesystemStore):
         super().__init__(prefix_path)
 
 
-class HDFSStore(Store):
-    """HDFS store (reference ``HDFSStore``), via ``pyarrow.fs``.
+class ArrowFsStore(Store):
+    """Store over any ``pyarrow.fs.FileSystem``.
 
-    Requires a reachable HDFS (libhdfs); constructing one without it
-    raises with instructions, keeping the rest of the package usable.
+    The filesystem is injected, so the exact logic HDFS runs is
+    executed in tests against ``pyarrow.fs.LocalFileSystem`` — the
+    reference tests its ``HDFSStore`` the same way (a local filesystem
+    standing in for the cluster).
     """
 
-    def __init__(self, prefix_path: str, host: Optional[str] = None,
-                 port: Optional[int] = None, user: Optional[str] = None):
+    def __init__(self, prefix_path: str, filesystem):
         super().__init__(prefix_path)
-        try:
-            from pyarrow import fs as pafs
-        except ImportError as exc:  # pragma: no cover
-            raise ImportError("HDFSStore requires pyarrow") from exc
-        try:
-            self._fs = pafs.HadoopFileSystem(
-                host or "default", port or 0, user=user)
-        except Exception as exc:  # pragma: no cover - needs a cluster
-            raise RuntimeError(
-                "HDFSStore could not connect to HDFS (is libhdfs / a "
-                "cluster available?): %s" % exc) from exc
+        self._fs = filesystem
+        self._made_dirs: set = set()
 
-    def exists(self, path: str) -> bool:  # pragma: no cover - needs hdfs
+    def exists(self, path: str) -> bool:
         from pyarrow import fs as pafs
         info = self._fs.get_file_info([path])[0]
         return info.type != pafs.FileType.NotFound
 
-    def read(self, path: str) -> bytes:  # pragma: no cover
+    def read(self, path: str) -> bytes:
         with self._fs.open_input_stream(path) as f:
             return f.read()
 
-    def write(self, path: str, data: bytes):  # pragma: no cover
+    def write(self, path: str, data: bytes):
+        # One create_dir round trip per DIRECTORY, not per file: on a
+        # remote namenode, sync_fn writes many files into few dirs.
+        parent = os.path.dirname(path)
+        if parent not in self._made_dirs:
+            self.makedirs(parent)
+            self._made_dirs.add(parent)
         with self._fs.open_output_stream(path) as f:
             f.write(data)
 
-    def listdir(self, path: str):  # pragma: no cover
+    def listdir(self, path: str):
         from pyarrow import fs as pafs
         sel = pafs.FileSelector(path)
         return sorted(i.path for i in self._fs.get_file_info(sel))
 
-    def makedirs(self, path: str):  # pragma: no cover
+    def makedirs(self, path: str):
         self._fs.create_dir(path, recursive=True)
 
-    def delete(self, path: str):  # pragma: no cover
-        self._fs.delete_dir_contents(path, missing_dir_ok=True)
+    def delete(self, path: str):
+        from pyarrow import fs as pafs
+        # Deleted dirs must fall out of the write() memo.
+        self._made_dirs = {d for d in self._made_dirs
+                           if not d.startswith(path)}
+        info = self._fs.get_file_info([path])[0]
+        if info.type == pafs.FileType.NotFound:
+            return
+        if info.type == pafs.FileType.Directory:
+            self._fs.delete_dir(path)
+        else:
+            self._fs.delete_file(path)
 
-    def sync_fn(self, run_id: str):  # pragma: no cover
+    def sync_fn(self, run_id: str):
         run_path = self.get_run_path(run_id)
 
         def fn(local_dir: str):
@@ -234,3 +242,26 @@ class HDFSStore(Store):
                                    f.read())
 
         return fn
+
+
+class HDFSStore(ArrowFsStore):
+    """HDFS store (reference ``HDFSStore``), via ``pyarrow.fs``.
+
+    Requires a reachable HDFS (libhdfs); constructing one without it
+    raises with instructions, keeping the rest of the package usable.
+    """
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None):
+        try:
+            from pyarrow import fs as pafs
+        except ImportError as exc:  # pragma: no cover
+            raise ImportError("HDFSStore requires pyarrow") from exc
+        try:
+            filesystem = pafs.HadoopFileSystem(
+                host or "default", port or 0, user=user)
+        except Exception as exc:  # pragma: no cover - needs a cluster
+            raise RuntimeError(
+                "HDFSStore could not connect to HDFS (is libhdfs / a "
+                "cluster available?): %s" % exc) from exc
+        super().__init__(prefix_path, filesystem)

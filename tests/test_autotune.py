@@ -57,3 +57,36 @@ def test_parameter_manager_samples_and_freezes(tmp_path):
     settled = pm.fusion_threshold
     pm.observe(nbytes=123, secs=1e-3)
     assert pm.fusion_threshold == settled
+
+
+def test_engine_skips_observations_on_compile_cycles(hvd_world):
+    # A cycle that compiled a new XLA executable must not feed its
+    # wall time to the tuner (it measures the compiler, not comm).
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    class FakePM:
+        fusion_threshold = 1 << 20
+        cycle_time_ms = 1.0
+
+        def __init__(self):
+            self.observed = []
+
+        def observe(self, nbytes, secs):
+            self.observed.append(nbytes)
+
+    eng = basics._get_engine()
+    pm, old = FakePM(), eng.parameter_manager
+    eng.parameter_manager = pm
+    try:
+        # fresh odd single-tensor shape -> this cycle compiles
+        x = np.ones((hvd.size(), 97), np.float32)
+        hvd.allreduce(x, op=hvd.Sum, name="atune_compile_skip_1")
+        after_compile = len(pm.observed)
+        # same shape again -> cached executable, observation recorded
+        hvd.allreduce(x, op=hvd.Sum, name="atune_compile_skip_2")
+        assert after_compile == 0, "compile cycle was observed"
+        assert len(pm.observed) >= 1, "steady-state cycle not observed"
+    finally:
+        eng.parameter_manager = old

@@ -120,14 +120,19 @@ def test_alltoall_ragged_splits(hvd_world):
     rows = splits[0].sum()
     x = np.stack([np.full((rows, 2), r, dtype=np.float32)
                   for r in range(SIZE)])
-    out, recv_splits = hvd.alltoall(x, splits=splits)
-    np.testing.assert_array_equal(recv_splits, splits.T)
-    # rank j receives (j+1) rows from each rank, in rank order.
-    for j in range(SIZE):
-        got = np.asarray(out[j])
-        assert got.shape == ((j + 1) * SIZE, 2)
-        expected = np.repeat(np.arange(SIZE, dtype=np.float32), j + 1)
-        np.testing.assert_allclose(got[:, 0], expected)
+    # Twice with the same splits: the first call takes the eager
+    # reassembly, the repeat takes the compiled device-all_to_all
+    # program — both must agree.
+    for _ in range(2):
+        out, recv_splits = hvd.alltoall(x, splits=splits)
+        np.testing.assert_array_equal(recv_splits, splits.T)
+        # rank j receives (j+1) rows from each rank, in rank order.
+        for j in range(SIZE):
+            got = np.asarray(out[j])
+            assert got.shape == ((j + 1) * SIZE, 2)
+            expected = np.repeat(np.arange(SIZE, dtype=np.float32),
+                                 j + 1)
+            np.testing.assert_allclose(got[:, 0], expected)
 
 
 def test_reducescatter(hvd_world):

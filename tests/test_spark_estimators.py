@@ -255,3 +255,30 @@ def test_lightning_first_optimizer_contracts():
         _first_optimizer({"lr_scheduler": None})
     with pytest.raises(ValueError, match="no optimizer"):
         _first_optimizer([])
+
+
+def test_arrow_fs_store_executes_hdfs_logic(tmp_path):
+    # The exact code HDFSStore runs, executed against a local
+    # pyarrow filesystem (the reference tests its HDFS store the same
+    # way: a local fs standing in for the cluster).
+    pafs = pytest.importorskip("pyarrow.fs")
+    from horovod_tpu.spark.common import ArrowFsStore
+    s = ArrowFsStore(str(tmp_path / "store"), pafs.LocalFileSystem())
+    p = os.path.join(s.get_run_path("r1"), "sub", "blob.bin")
+    assert not s.exists(p)
+    s.write(p, b"abc")
+    assert s.exists(p) and s.read(p) == b"abc"
+    assert any(e.endswith("blob.bin")
+               for e in s.listdir(os.path.dirname(p)))
+    # sync_fn mirrors a local tree into the run path
+    local = tmp_path / "local"
+    (local / "d").mkdir(parents=True)
+    (local / "a.txt").write_text("A")
+    (local / "d" / "b.txt").write_text("B")
+    s.sync_fn("r2")(str(local))
+    assert s.read(os.path.join(s.get_run_path("r2"), "a.txt")) == b"A"
+    assert s.read(os.path.join(s.get_run_path("r2"), "d",
+                               "b.txt")) == b"B"
+    s.delete(s.get_run_path("r1"))
+    assert not s.exists(p)
+    s.delete(p)  # deleting a missing path is a no-op
