@@ -1,10 +1,58 @@
 #include "controller.h"
 
 #include <algorithm>
+#include <functional>
 
 #include "logging.h"
 
 namespace hvdtpu {
+
+namespace {
+
+// Zero-contribution join policy, shared by the cached and pending
+// response paths.  Applies only when a joined member never contributed
+// this tensor (submit-then-join keeps real data and passes through):
+//  - device-payload non-allreduce: error (a joined rank can synthesize
+//    a zero summand, but not unknown allgather/alltoall geometry);
+//  - allreduce Average: rewritten to Sum with a live-contributor
+//    divisor folded into postscale (zero is not Average's identity —
+//    dividing by the full member count would bias toward zero);
+//  - allreduce Min/Max/Product/Adasum: error (zero is not an identity
+//    and no scalar rescale can repair it).
+void ApplyJoinPolicy(const Request& q, const std::vector<int32_t>& members,
+                     const std::set<int32_t>& joined,
+                     const std::function<bool(int32_t)>& contributed,
+                     Response* r) {
+  if (joined.empty()) return;
+  int missing = 0;
+  for (auto m : members)
+    if (joined.count(m) && !contributed(m)) ++missing;
+  if (missing == 0) return;
+  if (q.external_payload && q.op_type != OpType::ALLREDUCE) {
+    r->error = true;
+    r->error_message =
+        "Join with device-payload collectives supports allreduce "
+        "only (tensor '" + q.name + "')";
+    return;
+  }
+  if (q.op_type != OpType::ALLREDUCE) return;
+  if (q.red_op == ReduceOp::SUM) return;
+  if (q.red_op == ReduceOp::AVERAGE) {
+    int live = static_cast<int>(members.size()) - missing;
+    if (live > 0) {
+      r->red_op = ReduceOp::SUM;
+      r->postscale = q.postscale / static_cast<double>(live);
+      r->join_rewrite = true;
+      return;
+    }
+  }
+  r->error = true;
+  r->error_message =
+      "Join zero-contribution supports Sum/Average allreduce only "
+      "(tensor '" + q.name + "')";
+}
+
+}  // namespace
 
 void Controller::Initialize(int rank, int size, TcpMesh* mesh,
                             ResponseCache* cache,
@@ -173,18 +221,11 @@ CycleResponse Controller::ComputeResponseList() {
       cache_->hits++;
       tensor_bytes_[q.name] = static_cast<uint64_t>(
           q.shape.num_elements()) * DataTypeSize(q.dtype);
-      // Same joined-rank restriction as the miss path: device-payload
-      // zero-contribution exists for allreduce only.
-      bool member_joined = false;
-      for (auto m : ps->Members(size_))
-        if (joined_.count(m)) member_joined = true;
-      if (q.external_payload && member_joined &&
-          q.op_type != OpType::ALLREDUCE) {
-        resp.error = true;
-        resp.error_message =
-            "Join with device-payload collectives supports allreduce "
-            "only (tensor '" + q.name + "')";
-      }
+      // Same joined-rank policy as the miss path (cache bits are the
+      // contribution record here).
+      ApplyJoinPolicy(q, ps->Members(size_), joined_,
+                      [&](int32_t m) { return kv.second.count(m) != 0; },
+                      &resp);
       out.responses.push_back(resp);
       stall_->RecordDone(q.name);
       ready_cached.push_back(kv.first);
@@ -221,23 +262,18 @@ CycleResponse Controller::ComputeResponseList() {
       if (have < groups_->GroupSize(gid)) continue;
     }
     Response r = BuildResponse(q);
-    bool member_joined = false;
-    if (ps)
-      for (auto m : ps->Members(size_))
-        if (joined_.count(m)) member_joined = true;
+    bool join_error = false;
+    if (!p.error && ps) {
+      ApplyJoinPolicy(q, ps->Members(size_), joined_,
+                      [&](int32_t m) { return p.ranks.count(m) != 0; },
+                      &r);
+      join_error = r.error;
+    }
     if (p.error) {
       r.error = true;
       r.error_message = p.error_message;
-    } else if (q.external_payload && member_joined &&
-               q.op_type != OpType::ALLREDUCE) {
-      // Device-payload zero-contribution is defined for allreduce only
-      // (a joined rank can synthesize a zero summand, but not unknown
-      // allgather/alltoall geometry); erroring here beats deadlocking
-      // the ranks that would wait in the collective.
-      r.error = true;
-      r.error_message =
-          "Join with device-payload collectives supports allreduce "
-          "only (tensor '" + q.name + "')";
+    } else if (join_error) {
+      // Error already set by the join policy.
     } else if (q.op_type == OpType::ALLGATHER) {
       // aux = first dims in member order.
       for (auto m : ps->Members(size_)) {
@@ -308,7 +344,8 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
                       std::to_string(static_cast<int>(r.red_op)) + "|" +
                       std::to_string(r.prescale) + "|" +
                       std::to_string(r.postscale) + "|" +
-                      (r.external ? "x" : "h");
+                      (r.external ? "x" : "h") +
+                      (r.join_rewrite ? "|jr" : "");
     uint64_t bytes = 0;
     auto sit = tensor_bytes_.find(r.tensor_names[0]);
     if (sit != tensor_bytes_.end()) bytes = sit->second;

@@ -112,6 +112,7 @@ void Response::Serialize(Writer& w) const {
   for (auto v : aux_sizes) w.i64(v);
   w.u32(static_cast<uint32_t>(last_joined));
   w.u8(external ? 1 : 0);
+  w.u8(join_rewrite ? 1 : 0);
 }
 
 Response Response::Deserialize(Reader& r) {
@@ -131,6 +132,7 @@ Response Response::Deserialize(Reader& r) {
   for (uint32_t i = 0; i < na; ++i) p.aux_sizes.push_back(r.i64());
   p.last_joined = static_cast<int32_t>(r.u32());
   p.external = r.u8() != 0;
+  p.join_rewrite = r.u8() != 0;
   return p;
 }
 

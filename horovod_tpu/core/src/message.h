@@ -77,6 +77,10 @@ struct Response {
   std::vector<int64_t> aux_sizes;
   int32_t last_joined = -1;  // join result
   bool external = false;  // payload executes on-device (XLA), not here
+  // Set when an Average was rewritten to Sum with a live-contributor
+  // divisor because a joined member never contributed.  Such responses
+  // are join-state-dependent and must not enter the response cache.
+  bool join_rewrite = false;
 
   void Serialize(Writer& w) const;
   static Response Deserialize(Reader& r);

@@ -300,7 +300,11 @@ void CoreState::BackgroundLoop() {
     for (auto& r : resp.responses) {
       // Populate the response cache on every rank, in broadcast order, so
       // cache ids agree across the world (the bitvector fast path).
-      if (!r.error && ResponseCache::Cacheable(r.op_type)) {
+      // join_rewrite responses carry a join-state-dependent divisor and
+      // must not be cached (a hit after the join cleared would keep
+      // dividing by the stale live count).
+      if (!r.error && !r.join_rewrite &&
+          ResponseCache::Cacheable(r.op_type)) {
         for (size_t i = 0; i < r.tensor_names.size(); ++i) {
           Request q;
           auto e = queue_.Lookup(r.tensor_names[i]);

@@ -207,10 +207,22 @@ class CollectiveEngine:
                  process_set_id=0, nbytes=0) -> CollectiveHandle:
         if self._shutdown:
             raise HorovodInternalError("engine is shut down")
+        joined_idx = self._joined_member_indices(process_set_id)
+        if joined_idx and op_type == _OP_ALLREDUCE and \
+                red_op not in (xla_ops.SUM, xla_ops.AVERAGE):
+            # Zero is Sum's reduction identity; Average is handled by
+            # dividing by the live-contributor count at execution.  For
+            # Min/Max/Product a zero contribution from joined ranks would
+            # silently corrupt the result (mirrors the Adasum guard in
+            # op_manager.py).
+            raise HorovodInternalError(
+                "allreduce %r with op=%s submitted while ranks are joined; "
+                "zero-contribution join is only supported for Sum/Average"
+                % (name, red_op))
         handle = CollectiveHandle(name)
         e = _Entry(name, op_type, payload, red_op, prescale, postscale,
                    root_rank, splits, process_set_id, handle, nbytes,
-                   joined_idx=self._joined_member_indices(process_set_id))
+                   joined_idx=joined_idx)
         self.timeline.negotiate_start(name, op_type)
         self.stall_inspector.record_enqueue(name)
         with self._wake:
@@ -292,8 +304,11 @@ class CollectiveEngine:
         for e in batch:
             self.timeline.negotiate_end(e.name)
             if e.op_type == _OP_ALLREDUCE:
+                # joined_idx is part of the key: entries straddling a
+                # join() must not fuse, or the Average live-contributor
+                # divisor below would be wrong for part of the bucket.
                 k = (e.process_set_id, str(e.payload.dtype), e.red_op,
-                     float(e.prescale), float(e.postscale))
+                     float(e.prescale), float(e.postscale), e.joined_idx)
                 fuse_groups.setdefault(k, []).append(e)
             else:
                 singles.append(e)
@@ -328,21 +343,32 @@ class CollectiveEngine:
             size = mc.size
 
             def zero_joined(stacked, joined_idx):
-                # Joined ranks contribute zeros (reference JoinOp); the
-                # AVERAGE divisor stays the full member count, matching
-                # the core ("divides once at the end by the full world
-                # count", cpu_ops.cc).  Uses the entry's enqueue-time
-                # snapshot, so join() is never retroactive.
+                # Joined ranks contribute zeros (reference JoinOp).
+                # Uses the entry's enqueue-time snapshot, so join() is
+                # never retroactive.
                 if not joined_idx:
                     return stacked
                 return stacked.at[jnp.asarray(joined_idx)].set(0)
+
+            # Average over live contributors: zero is not Average's
+            # identity, so dividing by the full member count would bias
+            # the result toward zero.  Execute as Sum with 1/live folded
+            # into postscale (mirrors the controller's join rewrite).
+            e0 = entries[0]
+            red_op, postscale = e0.red_op, float(e0.postscale)
+            if e0.joined_idx and red_op == xla_ops.AVERAGE:
+                live = size - len(e0.joined_idx)
+                if live <= 0:
+                    raise HorovodInternalError(
+                        "Average allreduce with every member joined")
+                red_op, postscale = xla_ops.SUM, postscale / live
 
             if len(entries) == 1 and entries[0].payload.ndim >= 1:
                 e = entries[0]
                 self.timeline.activity_start(e.name, "EXEC_ALLREDUCE")
                 out = mc.allreduce(
-                    zero_joined(e.payload, e.joined_idx), e.red_op,
-                    float(e.prescale), float(e.postscale))
+                    zero_joined(e.payload, e.joined_idx), red_op,
+                    float(e.prescale), postscale)
                 self.timeline.activity_end(e.name)
                 self.stall_inspector.record_done(e.name)
                 e.handle._set_result(out)
@@ -354,13 +380,12 @@ class CollectiveEngine:
             # dispatching separate concat/collective/slice programs
             # (the reference's persistent fusion buffer, the XLA way).
             self.timeline.activity_start_all(names, "EXEC_FUSED_ALLREDUCE")
-            e0 = entries[0]
             total = sum(
                 int(np.prod(e.payload.shape[1:], dtype=np.int64))
                 for e in entries)
             outs = mc.fused_allreduce(
-                [e.payload for e in entries], e0.red_op,
-                float(e0.prescale), float(e0.postscale),
+                [e.payload for e in entries], red_op,
+                float(e0.prescale), postscale,
                 [e.joined_idx for e in entries], _bucket(total))
             self.timeline.activity_end_all(names)
             for e, out in zip(entries, outs):

@@ -362,6 +362,15 @@ class MultihostEngine:
         if op == "allreduce":
             # Fused group: concat flats in negotiated order (missing =
             # joined rank -> zero contribution), one collective, split.
+            # The controller rejects joined + Min/Max/Product/Adasum at
+            # negotiation and rewrites Average to Sum with a live-count
+            # divisor; by the time a zero-fill reaches this executor the
+            # reduction must be Sum (the only op whose identity is zero).
+            if (any(arr is None for _, arr in taken)
+                    and g["red_op"] != SUM):
+                raise HorovodInternalError(
+                    "zero-contribution join reached the executor with "
+                    "op=%s; only Sum may be zero-filled" % g["red_op"])
             lengths = [int(n) for n in g["aux_sizes"]]
             flats, shapes = [], []
             for (py, arr), ln in zip(taken, lengths):

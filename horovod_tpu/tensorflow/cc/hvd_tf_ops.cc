@@ -268,11 +268,14 @@ class HvdTpuAllreduceXlaOp : public XlaOpKernel {
     for (auto d : shape.dimensions()) params.push_back(d);
     std::vector<uint8_t> name_bytes(name_.begin(), name_.end());
     xla::XlaBuilder* b = ctx->builder();
+    // has_side_effect=true: the call blocks on a rank-synchronizing
+    // negotiation, so XLA must not CSE/dedupe or DCE it — divergent
+    // scheduling across ranks would deadlock the controller.
     xla::XlaOp out = xla::CustomCall(
         b, "hvd_tpu_allreduce_host",
         {xla::ConstantR1<int64_t>(b, params),
          xla::ConstantR1<uint8_t>(b, name_bytes), ctx->Input(0)},
-        shape);
+        shape, /*opaque=*/"", /*has_side_effect=*/true);
     ctx->SetOutput(0, out);
   }
 

@@ -174,9 +174,9 @@ def test_reducescatter_uneven(hvd_world):
 
 
 def test_join_zero_contribution(hvd_world):
-    # Ranks 2 and 5 are out of data: their rows contribute zeros, the
-    # AVERAGE divisor stays the full world size (core semantics:
-    # "divides once at the end by the full world count").
+    # Ranks 2 and 5 are out of data: their rows contribute zeros to Sum;
+    # Average divides by the LIVE contributor count (zero is not
+    # Average's identity — a full-world divisor would bias toward zero).
     x = np.ones((SIZE, 4), np.float32) * (np.arange(SIZE, dtype=np.float32)
                                           + 1.0)[:, None]
     assert hvd.join(ranks=[2, 5]) == -1
@@ -185,8 +185,8 @@ def test_join_zero_contribution(hvd_world):
     out = hvd.allreduce(x, op=hvd.Sum)
     np.testing.assert_array_equal(np.asarray(out), live.sum(axis=0))
     out = hvd.allreduce(x, op=hvd.Average)
-    np.testing.assert_allclose(np.asarray(out), live.sum(axis=0) / SIZE,
-                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out),
+                               live.sum(axis=0) / (SIZE - 2), rtol=1e-6)
 
     # Fused path: several small allreduces in one cycle, still zeroed.
     hs = [hvd.allreduce_async(x, name="join_f%d" % i, op=hvd.Sum)
@@ -202,6 +202,13 @@ def test_join_zero_contribution(hvd_world):
         hvd.allgather(x)
     with pytest.raises(Exception, match="joined"):
         hvd.allreduce(x, op=hvd.Adasum)
+
+    # Min/Max/Product likewise: zero is not their reduction identity, so
+    # a zero contribution would silently corrupt the result (e.g.
+    # Min over positives returning 0) — reject loudly instead.
+    for bad_op in (hvd.Min, hvd.Max, hvd.Product):
+        with pytest.raises(Exception, match="joined"):
+            hvd.allreduce(x, op=bad_op)
 
     # Finalize: remaining ranks join in rank order; last is rank 7.
     assert hvd.join() == SIZE - 1

@@ -161,6 +161,32 @@ def run_join(core, rank, size):
     for step in range(rank + 1):
         x = np.full((4,), 1.0, np.float32)
         core.allreduce_async(x, "j.%d.%d" % (rank, step))
+    # Submit-then-join: every rank contributes real data to this Min
+    # BEFORE joining (per-rank FIFO guarantees the request precedes the
+    # join), so no zero-fill happens and the op must succeed.
+    h = core.allreduce_async(np.full((4,), float(rank + 1), np.float32),
+                             "jminok", op="Min")
+    out = h.wait(timeout=120)
+    assert np.allclose(out, 1.0), out
+    if rank > 0 and size > 1:
+        # Rank 0 has joined (or will before this becomes ready: it never
+        # submits "jmin", so readiness requires its join).  Zero is not
+        # Min's identity — the controller must error, not corrupt.
+        h = core.allreduce_async(np.full((4,), 5.0, np.float32), "jmin",
+                                 op="Min")
+        try:
+            h.wait(timeout=120)
+            raise AssertionError("Min allreduce with joined rank "
+                                 "should have errored")
+        except HorovodInternalError as e:
+            assert "Sum/Average" in str(e), str(e)
+        # Average over the live contributors: rank 0 is joined and
+        # missing, so the divisor is size-1, not size.
+        h = core.allreduce_async(np.full((4,), float(rank), np.float32),
+                                 "javg", op="Average")
+        out = h.wait(timeout=120)
+        expect = sum(range(1, size)) / float(size - 1)
+        assert np.allclose(out, expect), (out, expect)
     # Everyone joins after its own work; join returns the last rank.
     last = core.join()
     assert 0 <= last < size
