@@ -39,6 +39,12 @@ def main():
     ap.add_argument("--cpu-devices", type=int, default=None,
                     help="force an N-device virtual CPU world (the "
                          "test topology; overrides any TPU plugin)")
+    ap.add_argument("--eager", action="store_true",
+                    help="measure the hvd eager API path (hvd.allreduce"
+                         " of a device array) instead of the raw jit "
+                         "path; under the launcher's --multihost mode "
+                         "this exercises negotiation + the device-"
+                         "resident executor")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -52,31 +58,56 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    if args.eager:
+        return run_eager(args)
+
+    import os
+    hvd = None
+    if os.environ.get("HOROVOD_CONTROLLER") == "multihost":
+        # Launched under the runner's --multihost mode: join the global
+        # JAX runtime so the jit path sees the whole pod.
+        import horovod_tpu as hvd
+        hvd.init()
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    devs = jax.devices()
+    multiproc = jax.process_count() > 1
+    if multiproc:
+        # Same topology as the eager multihost plane: one device per
+        # process (device 0), so eager-vs-jit numbers are comparable.
+        by_proc = {}
+        for d in sorted(jax.devices(), key=lambda d: d.id):
+            by_proc.setdefault(d.process_index, []).append(d)
+        devs = [by_proc[p][0] for p in sorted(by_proc)]
+    else:
+        devs = jax.devices()
     n = len(devs)
     mesh = Mesh(np.array(devs), ("dp",))
     dtype = jnp.dtype(args.dtype)
 
     @jax.jit
     def allreduce(x):
-        # batch-sharded input, fully-reduced (replicated) output: XLA
-        # lowers this to an all-reduce over the mesh — the framework's
-        # inprocess-mode collective path
+        # Every device holds a FULL size-S row (the NCCL
+        # all_reduce_perf convention: per-rank buffer = message size);
+        # the axis-0 sum of the row-sharded input lowers to one
+        # all-reduce over the mesh.
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P())).sum(axis=0)
 
     results = []
     for size_mb in [float(s) for s in args.sizes_mb.split(",")]:
         size_bytes = int(size_mb * 2 ** 20)
-        elems = max(n, size_bytes // dtype.itemsize)
-        elems -= elems % n
-        x = jax.device_put(
-            jnp.ones((n, elems // n), dtype),
-            NamedSharding(mesh, P("dp", None)))
+        elems = max(1, size_bytes // dtype.itemsize)
+        if multiproc:
+            x = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P("dp", None)),
+                np.ones((1, elems), dtype), (n, elems))
+        else:
+            x = jax.device_put(
+                jnp.ones((n, elems), dtype),
+                NamedSharding(mesh, P("dp", None)))
 
         # Forced scalar fetch as the completion barrier: on the tunnel
         # runtime block_until_ready alone is not reliable.
@@ -118,7 +149,8 @@ def main():
         if args.link_gbps and bus_gbps is not None:
             rec["efficiency"] = round(bus_gbps / args.link_gbps, 4)
         results.append(rec)
-        print(json.dumps(rec))
+        if jax.process_index() == 0:
+            print(json.dumps(rec))
 
     best = max((r["bus_gb_per_sec"] for r in results
                 if r["bus_gb_per_sec"] is not None), default=0.0)
@@ -126,7 +158,84 @@ def main():
                "value": best, "unit": "GB/s", "devices": n}
     if args.link_gbps:
         summary["efficiency_vs_link"] = round(best / args.link_gbps, 4)
-    print(json.dumps(summary))
+    if jax.process_index() == 0:
+        print(json.dumps(summary))
+    if hvd is not None:
+        hvd.shutdown()
+
+
+def run_eager(args):
+    """The hvd eager-API path: negotiation + device-resident executor.
+
+    Under ``python -m horovod_tpu.runner -np N --multihost`` each
+    process contributes its own device array (per-rank semantics); in a
+    single process the in-process SPMD world takes rank-major stacked
+    input.  The jit path above is the floor this path is measured
+    against (VERDICT r2: eager within ~2x of jit bytes/s).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    # Per-rank tensors only exist in the multi-process world; a single
+    # process means the in-process SPMD engine (rank-major stacked
+    # input), regardless of hvd.size().
+    multihost = jax.process_count() > 1
+    dtype = jnp.dtype(args.dtype)
+    results = []
+    for size_mb in [float(s) for s in args.sizes_mb.split(",")]:
+        size_bytes = int(size_mb * 2 ** 20)
+        elems = max(1, size_bytes // dtype.itemsize)
+        if multihost:
+            x = jnp.full((elems,), 1.0, dtype)   # this rank's payload
+        else:
+            x = jnp.ones((n, elems), dtype)      # rank-major stacked
+        tag = "bw.%s" % size_mb
+
+        def timed(iters):
+            t0 = time.perf_counter()
+            y = None
+            for _ in range(iters):
+                y = hvd.allreduce(x, op=hvd.Sum, name=tag)
+            if y is not None:
+                float(np.asarray(y).reshape(-1)[0])  # fetch barrier
+            return time.perf_counter() - t0
+
+        timed(args.warmup)
+        t1 = timed(args.iters)
+        t2 = timed(2 * args.iters)
+        per_op = max(t2 - t1, 1e-12) / args.iters
+        resolvable = per_op >= 20e-6
+        bus_bytes = 2.0 * (n - 1) / n * elems * dtype.itemsize
+        bus_gbps = bus_bytes / per_op / 1e9 if resolvable else None
+        rec = {"metric": "allreduce_bus_bandwidth", "path": "eager",
+               "mode": "multihost" if multihost else "inprocess",
+               "size_mb": size_mb, "ranks": n,
+               "time_us": round(per_op * 1e6, 2),
+               "bus_gb_per_sec": (round(bus_gbps, 3)
+                                  if bus_gbps is not None else None)}
+        if not resolvable:
+            rec["note"] = "below timer resolution (<20us/op)"
+        if args.link_gbps and bus_gbps is not None:
+            rec["efficiency"] = round(bus_gbps / args.link_gbps, 4)
+        results.append(rec)
+        if hvd.rank() == 0:
+            print(json.dumps(rec))
+
+    best = max((r["bus_gb_per_sec"] for r in results
+                if r["bus_gb_per_sec"] is not None), default=0.0)
+    if hvd.rank() == 0:
+        summary = {"metric": "allreduce_bus_bandwidth_peak",
+                   "path": "eager", "value": best, "unit": "GB/s",
+                   "ranks": n}
+        if args.link_gbps:
+            summary["efficiency_vs_link"] = round(best / args.link_gbps,
+                                                  4)
+        print(json.dumps(summary))
+    hvd.shutdown()
 
 
 if __name__ == "__main__":

@@ -238,9 +238,9 @@ def join(device=None, ranks=None) -> int:
 
     In-process SPMD mode the single controller drives every rank, so
     ``ranks`` names which world ranks are out of data: their rows of
-    every subsequent stacked allreduce payload contribute zeros (the
-    AVERAGE divisor stays the full world size, matching the core), and
-    other collectives are rejected while any rank is joined.  A final
+    every subsequent stacked Sum allreduce payload contribute zeros,
+    Average divides by the live-contributor count, and other
+    collectives are rejected while any rank is joined.  A final
     ``join()`` with no ``ranks`` ends the round: remaining ranks join
     in rank order, the joined set clears, and the last joiner's rank is
     returned.

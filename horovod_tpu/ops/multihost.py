@@ -12,9 +12,11 @@ pods, gloo on the CPU test world.
 
 Rank semantics: one Horovod rank per process (host), exactly the
 reference's model.  A process's collective input is ITS tensor; the
-global mesh carries one leading "proc" axis (one row per member process)
-and a "local" axis over each process's addressable devices, on which
-contributions are replicated.
+eager payload plane is a one-device-per-process mesh (axis "proc",
+device 0 of every member — the NCCL one-accelerator-per-rank analog),
+so device payloads stage with at most one local device-to-device copy
+and no replication over sibling devices.  jit-path data parallelism
+(``jax/data_parallel.py``) keeps using every addressable device.
 
 Ordering contract: all member processes must issue the same global
 collective programs in the same order or the runtime deadlocks — that is
@@ -44,13 +46,37 @@ LOG = logging.getLogger("horovod_tpu")
 from .xla_ops import uneven_chunks as _uneven_chunks
 
 
+def _shard_map():
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def _is_device_array(x) -> bool:
+    import jax
+    return isinstance(x, jax.Array)
+
+
 class GlobalMeshCollectives:
-    """Compiled XLA collectives over the global (all-process) mesh.
+    """Compiled XLA collectives over a one-device-per-process mesh.
+
+    The eager payload plane is the reference's one-accelerator-per-rank
+    NCCL model (``ops/nccl_operations.cc``): each member process owns
+    exactly one mesh device (its first addressable device), payloads
+    stay device-resident end to end — ``jax.Array`` inputs are staged
+    with a device-to-device put (no host bounce), numpy inputs with a
+    single host-to-device transfer — and every collective is explicit
+    HLO (``psum`` / ``all_gather`` / ``all_to_all`` / ``psum_scatter``
+    under ``shard_map``), not a host-staged emulation.
 
     Every method is a *collective program*: all member processes must
-    call it with consistent arguments (guaranteed by negotiation).
-    Executables are cached per (op, dtype, shape, params) so steady
-    state dispatches without retracing.
+    call it with consistent negotiated arguments.  Executables are
+    cached per (op, dtype, shape, params) so steady state dispatches
+    without retracing; staged inputs are donated, so XLA may reuse the
+    payload buffer for the result (the reference's persistent fusion
+    buffer, expressed as buffer donation).
     """
 
     def __init__(self, member_procs: Optional[Sequence[int]] = None,
@@ -65,15 +91,25 @@ class GlobalMeshCollectives:
         self.name = name
         self.my_idx = (self.procs.index(jax.process_index())
                        if jax.process_index() in self.procs else -1)
-        devs = sorted((d for d in jax.devices()
-                       if d.process_index in set(self.procs)),
-                      key=lambda d: (self.procs.index(d.process_index),
-                                     d.id))
-        n_local = len(devs) // self.size
-        self.mesh = Mesh(
-            np.asarray(devs).reshape(self.size, n_local),
-            ("proc", "local"))
+        by_proc: Dict[int, list] = {}
+        for d in sorted(jax.devices(), key=lambda d: d.id):
+            by_proc.setdefault(d.process_index, []).append(d)
+        missing = [p for p in self.procs if p not in by_proc]
+        if missing:
+            raise HorovodInternalError(
+                "process set %r members %s have no addressable JAX "
+                "devices; every member process must expose at least "
+                "one device" % (name, missing))
+        devs = [by_proc[p][0] for p in self.procs]
+        self.mesh = Mesh(np.asarray(devs), ("proc",))
+        self.device = devs[self.my_idx] if self.my_idx >= 0 else None
         self._fns: Dict[tuple, object] = {}
+        # key -> lowered HLO text, populated when HVD_TPU_DUMP_HLO=1
+        # (lets tests assert the real collective ops are emitted).
+        self.hlo: Dict[tuple, str] = {}
+        # Count of host (numpy) stagings — device payloads must never
+        # bump this (the device-residency contract, testable).
+        self.host_stages = 0
 
     # -- plumbing ----------------------------------------------------------
 
@@ -81,143 +117,320 @@ class GlobalMeshCollectives:
         from jax.sharding import NamedSharding
         return NamedSharding(self.mesh, spec)
 
-    def _global(self, local: np.ndarray):
-        """Stage this process's block [1, ...] into a global array
-        [size, ...] sharded over the proc axis (replicated over local
-        devices within each process)."""
+    def _stage(self, arr, row_shape, dtype):
+        """Stage this process's contribution as its row of a global
+        [size, *row_shape] array sharded over ``proc``.
+
+        ``jax.Array`` payloads stay on device (at most a local reshape
+        + device-to-device put); numpy payloads cross the host boundary
+        exactly once; ``None`` (a joined rank's missing entry)
+        synthesizes zeros directly on the mesh device.  The staged row
+        is always a fresh buffer, so compiled programs may donate it.
+        """
         import jax
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        global_shape = (self.size,) + tuple(local.shape[1:])
-        return jax.make_array_from_process_local_data(
-            self._sharding(P("proc")), local, global_shape)
 
-    def _fetch(self, arr) -> np.ndarray:
-        """Host value of a replicated global array."""
-        import jax
-        shard = arr.addressable_shards[0].data
-        return np.asarray(jax.device_get(shard))
+        shape = (1,) + tuple(int(d) for d in row_shape)
+        if arr is None:
+            with jax.default_device(self.device):
+                row = jnp.zeros(shape, dtype)
+        elif _is_device_array(arr):
+            row = jax.device_put(jnp.reshape(arr, shape), self.device)
+        else:
+            self.host_stages += 1
+            row = jax.device_put(
+                np.ascontiguousarray(np.asarray(arr)).reshape(shape),
+                self.device)
+        return jax.make_array_from_single_device_arrays(
+            (self.size,) + shape[1:], self._sharding(P("proc")), [row])
 
-    def _compiled(self, key, build):
+    def _replicated(self, garr):
+        """This process's view of a replicated (P()) program output, as
+        a single-device jax.Array — no host transfer."""
+        return garr.addressable_shards[0].data
+
+    def _my_row(self, garr):
+        """This process's row of a P('proc') program output."""
+        return garr.addressable_shards[0].data[0]
+
+    def _compiled(self, key, build, example_args=None):
         fn = self._fns.get(key)
         if fn is None:
             fn = build()
+            import os
+            if os.environ.get("HVD_TPU_DUMP_HLO") and \
+                    example_args is not None:
+                self.hlo[key] = fn.lower(*example_args).as_text()
             self._fns[key] = fn
         return fn
 
+    def _collective_jit(self, fn, n_args, out_spec):
+        """shard_map + jit with every staged input donated."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        sm = _shard_map()
+        kw = {"mesh": self.mesh, "in_specs": (P("proc"),) * n_args,
+              "out_specs": out_spec}
+        # The static replication checker cannot see through the
+        # axis_index masking / per-process static slicing these
+        # programs use; the negotiation contract guarantees consistent
+        # collectives, so disable it (kwarg name varies by version).
+        import inspect
+        params = inspect.signature(sm).parameters
+        if "check_vma" in params:
+            kw["check_vma"] = False
+        elif "check_rep" in params:
+            kw["check_rep"] = False
+        mapped = sm(fn, **kw)
+        return jax.jit(mapped, donate_argnums=tuple(range(n_args)))
+
+    @staticmethod
+    def _scaled(v, factor):
+        return v if factor == 1.0 else v * np.asarray(factor, v.dtype)
+
     # -- collectives -------------------------------------------------------
 
-    def allreduce(self, local_flat: np.ndarray, red_op: str = SUM,
-                  prescale: float = 1.0, postscale: float = 1.0
-                  ) -> np.ndarray:
-        """Reduce one flat [n] contribution per process -> [n]."""
+    def _reduce_block(self, v, red_op, prescale, postscale, divisor):
+        """Per-shard reduction body shared by allreduce flavors."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
+        v = self._scaled(v, prescale)
+        if red_op in (SUM, AVERAGE, ADASUM):
+            r = jax.lax.psum(v, "proc")
+            if red_op == AVERAGE:
+                r = (r / divisor).astype(v.dtype) if \
+                    jnp.issubdtype(v.dtype, jnp.floating) \
+                    else r // divisor
+        elif red_op == MIN:
+            r = jax.lax.pmin(v, "proc")
+        elif red_op == MAX:
+            r = jax.lax.pmax(v, "proc")
+        elif red_op == PRODUCT:
+            r = jnp.prod(jax.lax.all_gather(v, "proc"), axis=0)
+        else:
+            raise NotImplementedError(red_op)
+        return self._scaled(r, postscale)
 
-        x = np.asarray(local_flat)[None]  # [1, n]
+    def fused_allreduce(self, payloads: Sequence, lengths: Sequence[int],
+                        dtype, red_op: str = SUM, prescale: float = 1.0,
+                        postscale: float = 1.0) -> List:
+        """One compiled program reducing a negotiated fusion group.
+
+        ``payloads[i]`` is this process's flat contribution for entry i
+        (jax.Array, numpy, or None for a joined rank's missing entry);
+        ``lengths`` are the negotiated element counts.  The program
+        takes one [size, n_i] input per entry and emits one psum per
+        entry — XLA's all-reduce combiner packs them into a single
+        fused collective (the compiler-managed fusion buffer).  Returns
+        per-entry flat device arrays, replicated on the mesh device.
+        """
+        lengths = [int(n) for n in lengths]
+        key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),
+               red_op, float(prescale), float(postscale))
         size = self.size
-        key = ("allreduce", str(x.dtype), x.shape, red_op,
-               float(prescale), float(postscale))
 
         def build():
-            def fn(g):
-                v = g * np.asarray(prescale, g.dtype) \
-                    if prescale != 1.0 else g
-                if red_op in (SUM, AVERAGE, ADASUM):
-                    r = jnp.sum(v, axis=0)
-                    if red_op == AVERAGE:
-                        r = (r / size).astype(v.dtype) if \
-                            jnp.issubdtype(v.dtype, jnp.floating) \
-                            else r // size
-                elif red_op == MIN:
-                    r = jnp.min(v, axis=0)
-                elif red_op == MAX:
-                    r = jnp.max(v, axis=0)
-                elif red_op == PRODUCT:
-                    r = jnp.prod(v, axis=0)
-                else:
-                    raise NotImplementedError(red_op)
-                if postscale != 1.0:
-                    r = r * np.asarray(postscale, r.dtype)
-                return r
+            def fn(*xs):
+                return tuple(
+                    self._reduce_block(x.reshape(-1), red_op, prescale,
+                                       postscale, size)
+                    for x in xs)
+            from jax.sharding import PartitionSpec as P
+            return self._collective_jit(fn, len(lengths), P())
 
-            return jax.jit(fn, out_shardings=self._sharding(P()))
+        staged = [self._stage(p, (n,), dtype)
+                  for p, n in zip(payloads, lengths)]
+        outs = self._compiled(key, build, staged)(*staged)
+        return [self._replicated(o) for o in outs]
 
-        return self._fetch(self._compiled(key, build)(self._global(x)))
+    def allreduce(self, local_flat, red_op: str = SUM,
+                  prescale: float = 1.0, postscale: float = 1.0):
+        """Reduce one flat [n] contribution per process -> [n] device
+        array (replicated on the mesh device)."""
+        n = int(np.prod(np.shape(local_flat), dtype=np.int64))
+        dtype = (local_flat.dtype if hasattr(local_flat, "dtype")
+                 else np.asarray(local_flat).dtype)
+        return self.fused_allreduce([local_flat], [n], dtype, red_op,
+                                    prescale, postscale)[0]
 
-    def broadcast(self, local: np.ndarray, root_idx: int) -> np.ndarray:
-        """Member ``root_idx``'s tensor to every process."""
-        import jax
-        from jax.sharding import PartitionSpec as P
-
-        x = np.asarray(local)[None]
-        key = ("broadcast", str(x.dtype), x.shape, int(root_idx))
-
-        def build():
-            return jax.jit(lambda g: g[root_idx],
-                           out_shardings=self._sharding(P()))
-
-        return self._fetch(self._compiled(key, build)(self._global(x)))
-
-    def allgather(self, local: np.ndarray,
-                  rows_per_member: Sequence[int]) -> np.ndarray:
-        """Concat dim-0-ragged per-process tensors (reference
-        AllgatherOp): pad to the max row count, one XLA all-gather,
-        slice the valid segments back out."""
+    def broadcast(self, local, root_idx: int):
+        """Member ``root_idx``'s tensor to every process (masked psum:
+        cheaper than an all-gather for size > 2, and explicit HLO)."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
+
+        shape = tuple(np.shape(local))
+        dtype = (local.dtype if hasattr(local, "dtype")
+                 else np.asarray(local).dtype)
+        key = ("broadcast", str(np.dtype(dtype)), shape, int(root_idx))
+
+        def build():
+            def fn(x):
+                idx = jax.lax.axis_index("proc")
+                v = jnp.where(idx == root_idx, x[0],
+                              jnp.zeros_like(x[0]))
+                # psum silently promotes bool to int32; reduce in uint8
+                # and cast back so broadcast preserves every dtype.
+                if v.dtype == jnp.bool_:
+                    return jax.lax.psum(
+                        v.astype(jnp.uint8), "proc").astype(jnp.bool_)
+                return jax.lax.psum(v, "proc")
+            from jax.sharding import PartitionSpec as P
+            return self._collective_jit(fn, 1, P())
+
+        staged = self._stage(local, shape, dtype)
+        return self._replicated(
+            self._compiled(key, build, (staged,))(staged))
+
+    def allgather(self, local, rows_per_member: Sequence[int]):
+        """Concat dim-0-ragged per-process tensors (reference
+        AllgatherOp): pad to the max row count, one ``lax.all_gather``,
+        static-slice the valid segments inside the program."""
+        import jax
+        import jax.numpy as jnp
 
         rows = [int(r) for r in rows_per_member]
         max_rows = max(rows) if rows else 0
-        x = np.asarray(local)
-        pad = max_rows - x.shape[0]
+        trailing = tuple(np.shape(local))[1:]
+        dtype = (local.dtype if hasattr(local, "dtype")
+                 else np.asarray(local).dtype)
+        pad = max_rows - int(np.shape(local)[0])
         if pad:
-            x = np.concatenate(
-                [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-        x = x[None]
-        key = ("allgather", str(x.dtype), x.shape, tuple(rows))
+            if _is_device_array(local):
+                local = jnp.concatenate(
+                    [local, jnp.zeros((pad,) + trailing, dtype)])
+            else:
+                local = np.concatenate(
+                    [np.asarray(local),
+                     np.zeros((pad,) + trailing, dtype)])
+        key = ("allgather", str(np.dtype(dtype)), trailing, tuple(rows))
+        size = self.size
 
         def build():
-            return jax.jit(lambda g: g,
-                           out_shardings=self._sharding(P()))
+            def fn(x):
+                g = jax.lax.all_gather(x[0], "proc")  # [size, max, ...]
+                if all(r == max_rows for r in rows):
+                    return g.reshape((size * max_rows,) + trailing)
+                return jnp.concatenate(
+                    [g[j, :rows[j]] for j in range(size)], axis=0)
+            from jax.sharding import PartitionSpec as P
+            return self._collective_jit(fn, 1, P())
 
-        full = self._fetch(self._compiled(key, build)(self._global(x)))
-        return np.concatenate(
-            [full[j, :rows[j]] for j in range(self.size)])
+        staged = self._stage(local, (max_rows,) + trailing, dtype)
+        return self._replicated(
+            self._compiled(key, build, (staged,))(staged))
 
-    def alltoall(self, local: np.ndarray, splits_matrix: np.ndarray):
-        """Member-major splits matrix routing (reference AlltoallOp).
-
-        v1 moves the exchange as one padded all-gather then local
-        slicing — correct on any mesh; a `lax.all_to_all` fast path for
-        the uniform case is a recorded follow-up.
+    def alltoall(self, local, splits_matrix: np.ndarray):
+        """Member-major splits matrix routing (reference AlltoallOp) as
+        real ``lax.all_to_all`` HLO: each send segment is padded to the
+        matrix max so every exchange block is uniform, one all-to-all
+        moves them, and the receiver slices its valid rows back out.
         Returns (my_received_rows, recv_splits).
         """
-        sm = np.asarray(splits_matrix).reshape(self.size, self.size)
-        send_rows = [int(sm[j].sum()) for j in range(self.size)]
-        gathered = self.allgather(local, send_rows)
-        # Segment offsets inside each sender's block.
-        out = []
-        base = 0
-        recv_splits = []
-        for j in range(self.size):  # sender
-            off = int(sm[j, :self.my_idx].sum())
-            cnt = int(sm[j, self.my_idx])
-            out.append(gathered[base + off: base + off + cnt])
-            recv_splits.append(cnt)
-            base += send_rows[j]
-        return np.concatenate(out) if out else gathered[:0], recv_splits
+        import jax
+        import jax.numpy as jnp
 
-    def reducescatter(self, local: np.ndarray, red_op: str = SUM
-                      ) -> np.ndarray:
-        """Reduce then take this member's dim-0 shard (uneven chunks
-        follow the reference's earlier-ranks-larger split)."""
-        reduced = self.allreduce(
-            np.asarray(local).reshape(-1), red_op).reshape(local.shape)
-        rows, offs = _uneven_chunks(local.shape[0], self.size)
-        i = self.my_idx
-        return reduced[offs[i]: offs[i] + rows[i]]
+        sm = np.asarray(splits_matrix).reshape(self.size, self.size)
+        trailing = tuple(np.shape(local))[1:]
+        dtype = (local.dtype if hasattr(local, "dtype")
+                 else np.asarray(local).dtype)
+        size = self.size
+        c = int(sm.max()) if sm.size else 0
+        my_rows = int(np.shape(local)[0])
+        recv_splits = [int(sm[j, self.my_idx]) for j in range(size)]
+        recv_total = int(sum(recv_splits))
+        if c == 0:
+            with jax.default_device(self.device):
+                return jnp.zeros((0,) + trailing, dtype), recv_splits
+        key = ("alltoall", str(np.dtype(dtype)), trailing,
+               tuple(int(v) for v in sm.reshape(-1)))
+        my_idx = self.my_idx
+        offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)
+
+        def build():
+            def fn(x):
+                y = x[0]  # [my_rows, ...]
+                # Pack [size, c, ...]: dest j's segment padded to c.
+                # Static per-process offsets — per-shard code, so
+                # differing constants across processes are fine; the
+                # exchanged block shape is identical everywhere.
+                segs = []
+                for j in range(size):
+                    cnt = int(sm[my_idx, j])
+                    seg = jax.lax.slice_in_dim(y, offs[j],
+                                               offs[j] + cnt, axis=0)
+                    if cnt < c:
+                        seg = jnp.concatenate(
+                            [seg, jnp.zeros((c - cnt,) + trailing,
+                                            y.dtype)])
+                    segs.append(seg)
+                packed = jnp.stack(segs)  # [size, c, ...]
+                w = jax.lax.all_to_all(packed, "proc", split_axis=0,
+                                       concat_axis=0)  # [size, c, ...]
+                out = jnp.concatenate(
+                    [w[j, :recv_splits[j]] for j in range(size)]
+                    ) if recv_total else w[:1, :0].reshape(
+                        (0,) + trailing)
+                return out[None]  # [1, recv_total, ...]
+            from jax.sharding import PartitionSpec as P
+            return self._collective_jit(fn, 1, P("proc"))
+
+        staged = self._stage(local, (my_rows,) + trailing, dtype)
+        out = self._my_row(self._compiled(key, build, (staged,))(staged))
+        return out, recv_splits
+
+    def reducescatter(self, local, red_op: str = SUM):
+        """Reduce then scatter dim-0 shards as real ``psum_scatter``
+        HLO (uneven chunks follow the reference's earlier-ranks-larger
+        split: each chunk is padded to the largest inside the program,
+        scattered tiled, and sliced back out)."""
+        import jax
+        import jax.numpy as jnp
+
+        shape = tuple(np.shape(local))
+        dtype = (local.dtype if hasattr(local, "dtype")
+                 else np.asarray(local).dtype)
+        d0 = shape[0]
+        trailing = shape[1:]
+        size = self.size
+        rows, offs = _uneven_chunks(d0, size)
+        c = rows[0] if rows else 0  # largest chunk (earlier ranks larger)
+        key = ("reducescatter", str(np.dtype(dtype)), shape, red_op)
+        my_idx = self.my_idx
+
+        def build():
+            def fn(x):
+                y = x[0]  # [d0, ...]
+                if d0 != size * c:
+                    y = jnp.concatenate([
+                        seg for j in range(size) for seg in (
+                            [jax.lax.slice_in_dim(
+                                y, offs[j], offs[j] + rows[j], axis=0)]
+                            + ([jnp.zeros((c - rows[j],) + trailing,
+                                          y.dtype)]
+                               if rows[j] < c else []))])
+                if red_op in (SUM, AVERAGE):
+                    w = jax.lax.psum_scatter(
+                        y, "proc", scatter_dimension=0, tiled=True)
+                    if red_op == AVERAGE:
+                        # Divides by the full member count (core
+                        # reducescatter semantics; join cannot reach
+                        # this op).
+                        w = (w / size).astype(w.dtype) if \
+                            jnp.issubdtype(w.dtype, jnp.floating) \
+                            else w // size
+                else:
+                    r = self._reduce_block(y, red_op, 1.0, 1.0, size)
+                    w = jax.lax.slice_in_dim(
+                        r, my_idx * c, (my_idx + 1) * c, axis=0)
+                return w[None]  # [1, c, ...]
+            from jax.sharding import PartitionSpec as P
+            return self._collective_jit(fn, 1, P("proc"))
+
+        staged = self._stage(local, shape, dtype)
+        out = self._my_row(self._compiled(key, build, (staged,))(staged))
+        return out[:rows[my_idx]]
 
 
 class MultihostEngine:
@@ -260,6 +473,15 @@ class MultihostEngine:
 
     # -- enqueue API (per-rank tensor semantics) ---------------------------
 
+    @staticmethod
+    def _payload(tensor):
+        """Keep device arrays device-resident; host data becomes one
+        contiguous numpy array (crossing the host boundary is then the
+        caller's choice, never this engine's)."""
+        if _is_device_array(tensor):
+            return tensor
+        return np.ascontiguousarray(np.asarray(tensor))
+
     def _enqueue(self, name, op_type, arr, **kw) -> CollectiveHandle:
         py = CollectiveHandle(name)
         # Enqueue and park ATOMICALLY w.r.t. the executor's _take: the
@@ -269,35 +491,33 @@ class MultihostEngine:
         # and the handle would never resolve.
         with self._lock:
             ch = self.core.enqueue_external(
-                name, op_type, arr.shape, arr.dtype, **kw)
+                name, op_type, tuple(arr.shape), np.dtype(arr.dtype),
+                **kw)
             self._pending[ch._h] = (py, arr)
         return py
 
     def enqueue_allreduce(self, name, tensor, red_op=SUM, prescale=1.0,
                           postscale=1.0, process_set_id=0
                           ) -> CollectiveHandle:
-        arr = np.ascontiguousarray(np.asarray(tensor))
         return self._enqueue(
-            name, "allreduce", arr, red_op=red_op,
+            name, "allreduce", self._payload(tensor), red_op=red_op,
             process_set_id=process_set_id, prescale=prescale,
             postscale=postscale)
 
     def enqueue_allgather(self, name, tensor, process_set_id=0
                           ) -> CollectiveHandle:
-        arr = np.ascontiguousarray(np.asarray(tensor))
-        return self._enqueue(name, "allgather", arr,
+        return self._enqueue(name, "allgather", self._payload(tensor),
                              process_set_id=process_set_id)
 
     def enqueue_broadcast(self, name, tensor, root_rank=0,
                           process_set_id=0) -> CollectiveHandle:
-        arr = np.ascontiguousarray(np.asarray(tensor))
-        return self._enqueue(name, "broadcast", arr,
+        return self._enqueue(name, "broadcast", self._payload(tensor),
                              root_rank=root_rank,
                              process_set_id=process_set_id)
 
     def enqueue_alltoall(self, name, tensor, splits=None,
                          process_set_id=0) -> CollectiveHandle:
-        arr = np.ascontiguousarray(np.asarray(tensor))
+        arr = self._payload(tensor)
         if splits is None:
             n = self.collectives_for(process_set_id).size
             if arr.shape[0] % n:
@@ -310,8 +530,8 @@ class MultihostEngine:
 
     def enqueue_reducescatter(self, name, tensor, red_op=SUM,
                               process_set_id=0) -> CollectiveHandle:
-        arr = np.ascontiguousarray(np.asarray(tensor))
-        return self._enqueue(name, "reducescatter", arr, red_op=red_op,
+        return self._enqueue(name, "reducescatter", self._payload(tensor),
+                             red_op=red_op,
                              process_set_id=process_set_id)
 
     # -- executor ----------------------------------------------------------
@@ -355,13 +575,28 @@ class MultihostEngine:
                 if py is not None:
                     py._set_error(exc)
 
+    @staticmethod
+    def _match(out, arr, shape=None):
+        """Shape a program output like the caller's input: device
+        arrays stay device-resident (eager reshape only), numpy inputs
+        get numpy back.  This is the single conversion point — the
+        GlobalMeshCollectives methods always return device arrays."""
+        import jax
+        import jax.numpy as jnp
+        if arr is not None and _is_device_array(arr):
+            return jnp.reshape(out, shape) if shape is not None else out
+        host = np.asarray(jax.device_get(out))
+        return host.reshape(shape) if shape is not None else host
+
     def _run_group(self, g: dict, mc: GlobalMeshCollectives,
                    taken: List[tuple]) -> List:
         op = g["op_type"]
         dtype = g["dtype"]
         if op == "allreduce":
-            # Fused group: concat flats in negotiated order (missing =
-            # joined rank -> zero contribution), one collective, split.
+            # Fused group in negotiated order (missing = joined rank ->
+            # zero contribution, synthesized on device).  One compiled
+            # program takes every entry and XLA's all-reduce combiner
+            # fuses the collectives; payloads never transit numpy.
             # The controller rejects joined + Min/Max/Product/Adasum at
             # negotiation and rewrites Average to Sum with a live-count
             # divisor; by the time a zero-fill reaches this executor the
@@ -372,38 +607,43 @@ class MultihostEngine:
                     "zero-contribution join reached the executor with "
                     "op=%s; only Sum may be zero-filled" % g["red_op"])
             lengths = [int(n) for n in g["aux_sizes"]]
-            flats, shapes = [], []
-            for (py, arr), ln in zip(taken, lengths):
-                if arr is None:
-                    flats.append(np.zeros((ln,), dtype))
-                    shapes.append((ln,))
+            outs = mc.fused_allreduce(
+                [arr for _, arr in taken], lengths, dtype,
+                g["red_op"], g["prescale"], g["postscale"])
+            # One batched device_get for every numpy-typed entry (a
+            # per-entry fetch would serialize N host round-trips on the
+            # executor thread that gates all handles).
+            import jax
+            import jax.numpy as jnp
+            to_host = [i for i, (_, arr) in enumerate(taken)
+                       if arr is None or not _is_device_array(arr)]
+            fetched = dict(zip(to_host, jax.device_get(
+                [outs[i] for i in to_host]))) if to_host else {}
+            results = []
+            for i, ((py, arr), out, ln) in enumerate(
+                    zip(taken, outs, lengths)):
+                shape = arr.shape if arr is not None else (ln,)
+                if i in fetched:
+                    results.append(np.asarray(fetched[i]).reshape(shape))
                 else:
-                    flats.append(arr.reshape(-1))
-                    shapes.append(arr.shape)
-            fused = np.concatenate(flats) if len(flats) > 1 else flats[0]
-            out = mc.allreduce(fused, g["red_op"], g["prescale"],
-                               g["postscale"])
-            results, off = [], 0
-            for ln, shape in zip(lengths, shapes):
-                results.append(out[off:off + ln].reshape(shape))
-                off += ln
+                    results.append(jnp.reshape(out, shape))
             return results
         (py, arr) = taken[0]
         if op == "allgather":
             rows = g["aux_sizes"]
-            return [mc.allgather(arr, rows)]
+            return [self._match(mc.allgather(arr, rows), arr)]
         if op == "broadcast":
             # root_rank is a GLOBAL rank; map to member index.
             ranks = self._resolve_process_set(g["process_set_id"])
             members = ranks if ranks is not None else list(
                 range(mc.size))
             root_idx = members.index(g["root_rank"])
-            return [mc.broadcast(arr, root_idx)]
+            return [self._match(mc.broadcast(arr, root_idx), arr)]
         if op == "alltoall":
             out, recv = mc.alltoall(arr, np.asarray(g["aux_sizes"]))
-            return [(out, recv)]
+            return [(self._match(out, arr), recv)]
         if op == "reducescatter":
-            return [mc.reducescatter(arr, g["red_op"])]
+            return [self._match(mc.reducescatter(arr, g["red_op"]), arr)]
         raise NotImplementedError("multihost op %r" % op)
 
     # -- shutdown ----------------------------------------------------------

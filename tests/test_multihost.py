@@ -35,7 +35,11 @@ def test_multihost_collective_matrix(size):
     # Full eager matrix over a real multi-process global mesh: fused and
     # grouped allreduce, every reduce op, ragged allgather/alltoall,
     # uneven reducescatter, process sets, join with zero contribution.
-    _assert_ok(_spawn_multihost(size))
+    # HVD_TPU_DUMP_HLO makes the worker also assert device payloads stay
+    # device-resident and the programs lower to real collective HLO
+    # (all_reduce / all_to_all / reduce_scatter).
+    _assert_ok(_spawn_multihost(size,
+                                extra_env={"HVD_TPU_DUMP_HLO": "1"}))
 
 
 def test_multihost_single_local_device():

@@ -97,6 +97,39 @@ def main():
         out, n * np.tile(np.arange(start, start + my_rows,
                                    dtype=np.float32)[:, None], (1, 3)))
 
+    # Device-residency contract: jax.Array payloads never transit
+    # numpy (no host staging), results come back as device arrays, and
+    # the emitted programs are real collective HLO (dump enabled via
+    # HVD_TPU_DUMP_HLO in the spawner).
+    import jax.numpy as jnp
+    from horovod_tpu.common import basics
+    mc = basics._get_mh_engine().collectives_for(0)
+    before = mc.host_stages
+    dx = jnp.full((8,), float(r + 1), jnp.float32)
+    dout = hvd.allreduce(dx, op=hvd.Sum, name="dev_ar")
+    assert isinstance(dout, jax.Array), type(dout)
+    np.testing.assert_allclose(np.asarray(dout),
+                               sum(i + 1.0 for i in range(n)))
+    d2, _ = hvd.alltoall(jnp.arange(n * 2, dtype=jnp.float32
+                                    ).reshape(n * 2, 1),
+                         splits=[2] * n, name="dev_a2a")
+    assert isinstance(d2, jax.Array), type(d2)
+    np.testing.assert_allclose(
+        np.asarray(d2)[:, 0], np.concatenate(
+            [[2 * r, 2 * r + 1] for _ in range(n)]))
+    d3 = hvd.reducescatter(jnp.ones((n * 3, 2), jnp.float32),
+                           op=hvd.Sum, name="dev_rs")
+    assert isinstance(d3, jax.Array), type(d3)
+    np.testing.assert_allclose(np.asarray(d3), float(n))
+    assert mc.host_stages == before, (
+        "device payloads transited the host: %d stagings"
+        % (mc.host_stages - before))
+    if os.environ.get("HVD_TPU_DUMP_HLO"):
+        hlo = "\n".join(mc.hlo.values())
+        assert "all_to_all" in hlo, "no all_to_all HLO emitted"
+        assert "reduce_scatter" in hlo, "no reduce_scatter HLO emitted"
+        assert "all_reduce" in hlo, "no all_reduce HLO emitted"
+
     # barrier + process-set-scoped collective on even ranks.
     hvd.barrier()
     ps = hvd.add_process_set([i for i in range(0, n, 2)])
