@@ -70,6 +70,77 @@ class HostDiscoveryScript(HostDiscovery):
         return hosts
 
 
+class TpuSliceDiscovery(HostDiscovery):
+    """Built-in discovery against the TPU VM metadata server.
+
+    The TPU control plane's view of the slice replaces the reference's
+    user discovery script (SURVEY.md §5: "slice-resize events +
+    preemption notices from the TPU control plane play the role of the
+    discovery script"):
+
+    * ``instance/attributes/worker-network-endpoints`` — the slice
+      membership list (comma-separated entries; each entry's host is
+      its last ``:``-separated IP field, matching the TPU VM
+      convention ``worker-id:port:ip``, with bare ``host`` or
+      ``host:port`` accepted too).
+    * ``instance/attributes/unhealthy-workers`` (optional) — hosts with
+      a pending preemption/maintenance notice, removed from the world
+      before they die so the driver resizes proactively instead of
+      reacting to a crash.
+
+    ``base_url`` is injectable (``HVD_TPU_METADATA_URL``) so tests —
+    and non-GCE control planes — can serve the same two endpoints.
+    """
+
+    def __init__(self, base_url: Optional[str] = None,
+                 slots_per_host: int = 1, timeout: float = 5.0):
+        import os
+        self._base = (base_url
+                      or os.environ.get("HVD_TPU_METADATA_URL")
+                      or "http://metadata.google.internal/"
+                         "computeMetadata/v1").rstrip("/")
+        self._slots = slots_per_host
+        self._timeout = timeout
+
+    def _get(self, path: str, default: Optional[str] = None) -> str:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            self._base + path, headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self._timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404 and default is not None:
+                return default
+            raise
+
+    @staticmethod
+    def _host_of(entry: str) -> str:
+        """TPU VM convention: 'worker-id:port:ip' -> ip; also accepts
+        'host:port' and bare 'host'."""
+        parts = entry.strip().split(":")
+        return parts[-1] if len(parts) == 3 else parts[0]
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        endpoints = self._get(
+            "/instance/attributes/worker-network-endpoints")
+        unhealthy = {
+            h.strip() for h in self._get(
+                "/instance/attributes/unhealthy-workers",
+                default="").split(",") if h.strip()}
+        hosts: Dict[str, int] = {}
+        for entry in endpoints.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            host = self._host_of(entry)
+            if host and host not in unhealthy:
+                hosts[host] = self._slots
+        return hosts
+
+
 class HostManager:
     """Tracks current hosts, applies the blacklist, and reports diffs
     (reference HostManager.update_available_hosts)."""

@@ -326,7 +326,11 @@ def elastic_run(args) -> int:
     """Entry from the launcher (``horovodrun --min-np ... --host-
     discovery-script disc.sh python train.py``)."""
     from ..runner.launch import build_common_env
-    if args.host_discovery_script:
+    if getattr(args, "tpu_discovery", False):
+        from .discovery import TpuSliceDiscovery
+        discovery = TpuSliceDiscovery(
+            slots_per_host=getattr(args, "tpu_discovery_slots", 1))
+    elif args.host_discovery_script:
         discovery = HostDiscoveryScript(args.host_discovery_script)
     else:
         hosts = util.parse_hosts(args.hosts) if args.hosts else \

@@ -10,6 +10,7 @@ cross-replica SyncBatchNorm via the framework's DP axis.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Callable, Optional, Sequence, Tuple
 
@@ -26,10 +27,107 @@ STAGE_SIZES = {
 }
 
 
+def _pallas_bn_enabled() -> bool:
+    """Opt-in fused Pallas BN kernels (HVD_TPU_PALLAS_BN=1 on TPU,
+    =force off-TPU via the interpreter).
+
+    Default OFF after measurement: mid-CNN custom calls constrain
+    operand layouts to plain row-major, and XLA brackets every kernel
+    with full-activation layout copies (323 copy ops vs 7, measured on
+    the ResNet-50 train step -> 112 ms/step vs 47 ms).  XLA's own
+    fused BN+relu+add is within ~2x of the HBM floor, so the copies
+    cost far more than the fusion saves.  The kernels stay correct and
+    tested (tests/test_pallas_bn.py) for standalone use, where no
+    layout boundary exists.  See docs/benchmarks.md."""
+    v = os.environ.get("HVD_TPU_PALLAS_BN", "0").lower()
+    if v in ("0", "false", "no", ""):
+        return False
+    if v == "force":
+        return True
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+class NormAct(nn.Module):
+    """BatchNorm + optional residual add + optional ReLU, as ONE op.
+
+    Train mode on TPU runs the fused Pallas kernels
+    (``ops/pallas_bn.py``: single-read stats, fused
+    normalize+add+relu, fused dbeta/dgamma reductions, fused
+    dx+dresidual); eval mode, sync-BN (``axis_name``), and non-tiling
+    shapes use the plain XLA path.  Parameter/stat names match flax
+    ``nn.BatchNorm`` (scale/bias, batch_stats mean/var).
+    """
+
+    relu: bool = True
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x, residual=None):
+        c = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,),
+                          jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+            y = self._xla_apply(x, mean, var, scale, bias, residual)
+            return y
+
+        out = None
+        if self.axis_name is None and _pallas_bn_enabled():
+            from ..ops.pallas_bn import batch_norm_act
+            out = batch_norm_act(x, scale, bias, residual,
+                                 eps=self.epsilon, relu=self.relu)
+        if out is not None:
+            y, mean, var = out
+        else:
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(xf, axes)
+            sq = jnp.mean(jnp.square(xf), axes)
+            if self.axis_name is not None:
+                mean = jax.lax.pmean(mean, self.axis_name)
+                sq = jax.lax.pmean(sq, self.axis_name)
+            var = jnp.maximum(sq - jnp.square(mean), 0.0)
+            y = self._xla_apply(x, mean, var, scale, bias, residual)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * mean
+            ra_var.value = m * ra_var.value + (1 - m) * var
+        return y
+
+    def _xla_apply(self, x, mean, var, scale, bias, residual):
+        # [C]-sized math stays f32; the activation-sized elementwise
+        # pass runs in the compute dtype (flax semantics — bf16 keeps
+        # the HBM traffic at half width).
+        mul = (jax.lax.rsqrt(var + self.epsilon) * scale).astype(
+            self.dtype)
+        add = (bias - mean * jax.lax.rsqrt(var + self.epsilon)
+               * scale).astype(self.dtype)
+        z = x.astype(self.dtype) * mul + add
+        if residual is not None:
+            z = z + residual.astype(self.dtype)
+        if self.relu:
+            z = jnp.maximum(z, 0)
+        return z.astype(self.dtype)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
-    norm: Callable
+    norm: Callable  # NormAct factory; kwargs: relu, scale_init
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -38,19 +136,18 @@ class BottleneckBlock(nn.Module):
         y = nn.Conv(self.filters, (1, 1), use_bias=False,
                     dtype=self.dtype)(x)
         y = self.norm()(y)
-        y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
                     dtype=self.dtype)(y)
         y = self.norm()(y)
-        y = nn.relu(y)
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
                     dtype=self.dtype)(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.filters * 4 or \
+                self.strides != (1, 1):
             residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
                                use_bias=False, dtype=self.dtype)(residual)
-            residual = self.norm()(residual)
-        return nn.relu(residual + y)
+            residual = self.norm(relu=False)(residual)
+        # One fused op: BN(y) + residual, then ReLU.
+        return self.norm(scale_init=nn.initializers.zeros)(y, residual)
 
 
 class BasicBlock(nn.Module):
@@ -65,15 +162,13 @@ class BasicBlock(nn.Module):
         y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
                     dtype=self.dtype)(x)
         y = self.norm()(y)
-        y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), use_bias=False,
                     dtype=self.dtype)(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.filters or self.strides != (1, 1):
             residual = nn.Conv(self.filters, (1, 1), self.strides,
                                use_bias=False, dtype=self.dtype)(residual)
-            residual = self.norm()(residual)
-        return nn.relu(residual + y)
+            residual = self.norm(relu=False)(residual)
+        return self.norm(scale_init=nn.initializers.zeros)(y, residual)
 
 
 class ResNet(nn.Module):
@@ -86,7 +181,7 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            NormAct, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.dtype,
             axis_name=self.axis_name if (self.sync_batch_norm and train)
             else None)
@@ -95,7 +190,6 @@ class ResNet(nn.Module):
         x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype)(x)
         x = norm()(x)
-        x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(STAGE_SIZES[self.depth]):
             for j in range(n_blocks):

@@ -60,6 +60,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--tpu-discovery", action="store_true",
+                   help="built-in elastic discovery from the TPU VM "
+                        "metadata server (slice membership + "
+                        "preemption notices; HVD_TPU_METADATA_URL "
+                        "overrides the endpoint)")
+    p.add_argument("--tpu-discovery-slots", type=int, default=1,
+                   help="worker slots per TPU host (default 1)")
     p.add_argument("--elastic-timeout", type=float, default=600.0)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command line")
@@ -238,7 +245,9 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                 "--hostfile to override)"
                 % (util.total_slots(hosts), args.np))
         hosts = hosts or [util.HostInfo("localhost", args.np or 1)]
-    if args.host_discovery_script or (args.min_np or args.max_np):
+    if args.host_discovery_script or getattr(args, "tpu_discovery",
+                                             False) \
+            or (args.min_np or args.max_np):
         from ..elastic.driver import elastic_run
         return elastic_run(args)
     return gloo_run(args, hosts)

@@ -14,8 +14,10 @@
 //
 // Scope: CPU JIT (XLA_CPU_JIT). On TPU the compiled path is JAX/XLA
 // collectives over ICI (ops/xla_ops.py); a "Host" custom-call target
-// does not exist inside a TPU executable, so the op is intentionally
-// not registered for XLA_TPU_JIT (see docs/adapters.md).
+// does not exist inside a TPU executable, so the XLA_TPU_JIT kernel
+// below fails AT TRACE TIME with a clear redirect to the JAX adapter
+// instead of letting the custom call reach the TPU compiler and die
+// with an opaque linker error (see docs/adapters.md).
 
 #include <dlfcn.h>
 #include <unistd.h>
@@ -290,5 +292,28 @@ class HvdTpuAllreduceXlaOp : public XlaOpKernel {
 REGISTER_XLA_OP(
     Name("HvdTpuAllreduce").Device(tensorflow::DEVICE_CPU_XLA_JIT),
     HvdTpuAllreduceXlaOp);
+
+// TPU jit: a host custom-call target cannot exist inside a TPU
+// executable, so surface a trace-time error that names the supported
+// path rather than an opaque compile/link failure deep in XLA.
+class HvdTpuAllreduceXlaTpuOp : public XlaOpKernel {
+ public:
+  explicit HvdTpuAllreduceXlaTpuOp(OpKernelConstruction* ctx)
+      : XlaOpKernel(ctx) {}
+
+  void Compile(XlaOpKernelContext* ctx) override {
+    ctx->SetStatus(tensorflow::errors::Unimplemented(
+        "hvd allreduce inside tf.function(jit_compile=True) is not "
+        "supported on TPU: the op lowers to a host custom-call, which "
+        "cannot live in a TPU executable. Use the JAX adapter "
+        "(horovod_tpu.jax) for compiled TPU collectives, or run the "
+        "TF op outside jit_compile (graph/eager kernels work on any "
+        "device)."));
+  }
+};
+
+REGISTER_XLA_OP(
+    Name("HvdTpuAllreduce").Device("XLA_TPU_JIT"),
+    HvdTpuAllreduceXlaTpuOp);
 
 }  // namespace

@@ -100,6 +100,69 @@ def test_object_state_commit_restore():
     assert st.batch == 5 and st.lr == 0.1
 
 
+class _FakeMetadata:
+    """GCE-style metadata server: worker-network-endpoints +
+    unhealthy-workers, both mutable by the test."""
+
+    def __init__(self):
+        import http.server
+
+        self.values = {"worker-network-endpoints": "",
+                       "unhealthy-workers": None}
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                key = self.path.rsplit("/", 1)[-1]
+                val = outer.values.get(key)
+                if (val is None
+                        or not self.path.startswith(
+                            "/computeMetadata/v1/instance/attributes/")):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = val.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # noqa: D102 - silence
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return "http://127.0.0.1:%d/computeMetadata/v1" % \
+            self.server.server_address[1]
+
+    def stop(self):
+        self.server.shutdown()
+
+
+def test_tpu_slice_discovery_parsing():
+    from horovod_tpu.elastic.discovery import TpuSliceDiscovery
+    md = _FakeMetadata()
+    try:
+        # TPU VM triple form, host:port form, bare-host form.
+        md.values["worker-network-endpoints"] = (
+            "t1v-n-x-w-0:8470:10.0.0.1, 10.0.0.2:8470,10.0.0.3")
+        disc = TpuSliceDiscovery(base_url=md.url, slots_per_host=4)
+        assert disc.find_available_hosts_and_slots() == {
+            "10.0.0.1": 4, "10.0.0.2": 4, "10.0.0.3": 4}
+        # A preemption notice removes the host before it dies; the
+        # missing unhealthy-workers attribute (404) means none.
+        md.values["unhealthy-workers"] = "10.0.0.2"
+        assert disc.find_available_hosts_and_slots() == {
+            "10.0.0.1": 4, "10.0.0.3": 4}
+    finally:
+        md.stop()
+
+
 # -- integration: real local worker processes ------------------------------
 
 def _env():
@@ -225,3 +288,53 @@ train(state)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for r in range(3):
         assert "DONE rank=%d size=3" % r in proc.stdout, proc.stdout
+
+
+def test_tpu_discovery_preemption_resizes_world(tmp_path):
+    """A preemption notice appears on the fake TPU metadata server
+    mid-run: the driver drops the host from the slice view, the doomed
+    worker is stopped, and the survivor re-rendezvouses into a smaller
+    world and finishes from committed state (SURVEY §5: control-plane
+    preemption notices play the discovery-script role)."""
+    md = _FakeMetadata()
+    md.values["worker-network-endpoints"] = (
+        "w0:8470:127.0.0.1,w1:8470:127.0.0.2")
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+state.extra = 0
+
+@elastic.run
+def train(state):
+    while hvd.size() > 1 or state.extra < 3:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.batch += 1
+        if hvd.size() == 1:
+            state.extra += 1
+        time.sleep(0.05)
+        state.commit()
+    print("DONE rank=%d size=%d batch=%d"
+          % (hvd.rank(), hvd.size(), state.batch), flush=True)
+
+train(state)
+""")
+
+    def preempt_later():
+        time.sleep(12.0)
+        md.values["unhealthy-workers"] = "127.0.0.2"
+
+    t = threading.Thread(target=preempt_later, daemon=True)
+    t.start()
+    env = _env()
+    env["HVD_TPU_METADATA_URL"] = md.url
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner",
+             "--tpu-discovery", "--min-np", "1", "--max-np", "2",
+             sys.executable, str(script)],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+    finally:
+        md.stop()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DONE rank=0 size=1" in proc.stdout, proc.stdout

@@ -1,0 +1,104 @@
+"""Fused Pallas BatchNorm kernels vs an f32 XLA oracle.
+
+Same strategy as tests/test_pallas_kernels.py: the kernels run through
+the Pallas interpreter on the CPU test world, and y / dx / dgamma /
+dbeta / dresidual are compared against plain-jnp BatchNorm autodiff.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.ops.pallas_bn import _plan, batch_norm_act
+
+EPS = 1e-5
+
+
+def _oracle(x, g, b, res, relu):
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mu = jnp.mean(xf, axes)
+    var = jnp.mean(jnp.square(xf), axes) - jnp.square(mu)  # biased
+    z = (xf - mu) * jax.lax.rsqrt(var + EPS) * g + b
+    if res is not None:
+        z = z + res.astype(jnp.float32)
+    if relu:
+        z = jnp.maximum(z, 0.0)
+    return z.astype(x.dtype), mu, var
+
+
+@pytest.mark.parametrize("relu", [True, False])
+@pytest.mark.parametrize("residual", [True, False])
+def test_bn_act_matches_oracle(relu, residual):
+    rng = np.random.RandomState(0)
+    shape = (16, 4, 4, 64)  # M = 256, C = 64
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    g = jnp.asarray(rng.randn(64), jnp.float32)
+    b = jnp.asarray(rng.randn(64), jnp.float32)
+    res = (jnp.asarray(rng.randn(*shape), jnp.float32)
+           if residual else None)
+    w = jnp.asarray(rng.randn(*shape), jnp.float32)
+
+    def loss_pallas(x, g, b, res):
+        out = batch_norm_act(x, g, b, res, eps=EPS, relu=relu)
+        assert out is not None
+        y, mean, var = out
+        return jnp.sum(y * w), (y, mean, var)
+
+    def loss_oracle(x, g, b, res):
+        y, mean, var = _oracle(x, g, b, res, relu)
+        return jnp.sum(y * w), (y, mean, var)
+
+    (lp, (yp, mp, vp)), gp = jax.value_and_grad(
+        loss_pallas, argnums=(0, 1, 2) + ((3,) if residual else ()),
+        has_aux=True)(x, g, b, res)
+    (lo, (yo, mo, vo)), go = jax.value_and_grad(
+        loss_oracle, argnums=(0, 1, 2) + ((3,) if residual else ()),
+        has_aux=True)(x, g, b, res)
+
+    np.testing.assert_allclose(yp, yo, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(mp, mo, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(vp, vo, rtol=1e-4, atol=1e-5)
+    for got, want, name in zip(gp, go, ["dx", "dgamma", "dbeta",
+                                        "dres"]):
+        np.testing.assert_allclose(
+            got, want, rtol=5e-4, atol=5e-5,
+            err_msg="%s mismatch (relu=%s residual=%s)"
+                    % (name, relu, residual))
+
+
+def test_bn_act_bf16():
+    rng = np.random.RandomState(1)
+    shape = (8, 8, 8, 128)  # M = 512, C = 128
+    x = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    g = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(128), jnp.float32)
+
+    def loss(x):
+        y, _, _ = batch_norm_act(x, g, b, None, eps=EPS, relu=True)
+        assert y.dtype == jnp.bfloat16
+        return jnp.sum(y.astype(jnp.float32))
+
+    def loss_o(x):
+        y, _, _ = _oracle(x, g, b, None, True)
+        return jnp.sum(y.astype(jnp.float32))
+
+    gx = jax.grad(loss)(x)
+    go = jax.grad(loss_o)(x)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(go, np.float32),
+                               rtol=0.1, atol=0.05)
+
+
+def test_plan_fallback():
+    # Prime M / odd C: no legal tiling -> caller falls back to XLA.
+    assert _plan(997, 64) is None
+    assert _plan(1024, 100) is None
+    # C=64 folds 2 rows into one 128-lane row.
+    assert _plan(1024, 64) == (2, 128)
+    # 128*7*7 channels-2048 case from ResNet-50's last stage.
+    assert _plan(6272, 2048) == (1, 256)
+    x = jnp.ones((997, 64), jnp.float32)
+    assert batch_norm_act(x, jnp.ones(64), jnp.zeros(64)) is None

@@ -225,6 +225,39 @@ def test_multirank_native_op_jit_compile():
         "TF_ADAPTER_OK")
 
 
+def test_tpu_jit_kernel_registered_with_clear_error():
+    # On TPU, tf.function(jit_compile=True) around hvd ops must fail at
+    # TRACE time with a redirect to the JAX adapter (a host custom-call
+    # cannot live in a TPU executable).  No TPU-enabled TF exists in
+    # this environment, so assert the XLA_TPU_JIT registration and its
+    # message are compiled into the op library; the run-time behavior
+    # test below exercises it when a TPU TF is present.
+    from horovod_tpu.tensorflow import xla_ops
+    assert xla_ops.load() is not None, xla_ops._load_error
+    blob = open(xla_ops._LIB, "rb").read()
+    assert b"XLA_TPU_JIT" in blob
+    assert b"Use the JAX adapter" in blob
+
+
+@pytest.mark.skipif(
+    not any(d.device_type == "TPU"
+            for d in __import__("tensorflow").config.list_logical_devices()),
+    reason="no TPU-enabled TensorFlow in this environment")
+def test_tpu_jit_raises_at_trace_time():
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+
+    @tf.function(jit_compile=True)
+    def step(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="tpu_jit_ar")
+
+    with tf.device("/device:TPU:0"):
+        with pytest.raises(Exception, match="JAX adapter"):
+            step(tf.constant([1.0, 2.0]))
+
+
 @pytest.mark.parametrize("size", [2, 4])
 def test_multirank_tape_optimizer_broadcast_compression(size):
     # Real N-process world: DistributedGradientTape averaging,
