@@ -14,15 +14,13 @@ double GaussianProcess::Kernel(const std::vector<double>& a,
   return std::exp(-0.5 * d2 / (length_scale_ * length_scale_));
 }
 
-void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
-                          const std::vector<double>& y) {
-  size_t n = x.size();
-  x_ = x;
+double GaussianProcess::Factor(const std::vector<double>& y) {
+  size_t n = x_.size();
   // K + noise I
   std::vector<std::vector<double>> k(n, std::vector<double>(n, 0));
   for (size_t i = 0; i < n; ++i)
     for (size_t j = 0; j < n; ++j)
-      k[i][j] = Kernel(x[i], x[j]) + (i == j ? noise_ + 1e-10 : 0.0);
+      k[i][j] = Kernel(x_[i], x_[j]) + (i == j ? noise_ + 1e-10 : 0.0);
   // Cholesky: K = L L^T
   l_.assign(n, std::vector<double>(n, 0));
   for (size_t i = 0; i < n; ++i) {
@@ -50,6 +48,49 @@ void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
     alpha_[ii] = s / l_[ii][ii];
   }
   fitted_ = true;
+  // log marginal likelihood: -1/2 y.alpha - sum log Lii - n/2 log 2pi
+  double lml = 0;
+  for (size_t i = 0; i < n; ++i) lml -= 0.5 * y[i] * alpha_[i];
+  for (size_t i = 0; i < n; ++i) lml -= std::log(l_[i][i]);
+  lml -= 0.5 * static_cast<double>(n) * std::log(2.0 * M_PI);
+  return lml;
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y,
+                          bool optimize_length_scale) {
+  x_ = x;
+  if (optimize_length_scale && x.size() >= 4) {
+    // Golden-section max of the LML over log length-scale in
+    // [log 0.1, log 10].
+    const double inv_phi = (std::sqrt(5.0) - 1.0) / 2.0;
+    double a = std::log(0.1), b = std::log(10.0);
+    double c = b - inv_phi * (b - a);
+    double d = a + inv_phi * (b - a);
+    length_scale_ = std::exp(c);
+    double fc = Factor(y);
+    length_scale_ = std::exp(d);
+    double fd = Factor(y);
+    for (int it = 0; it < 24; ++it) {
+      if (fc > fd) {
+        b = d;
+        d = c;
+        fd = fc;
+        c = b - inv_phi * (b - a);
+        length_scale_ = std::exp(c);
+        fc = Factor(y);
+      } else {
+        a = c;
+        c = d;
+        fc = fd;
+        d = a + inv_phi * (b - a);
+        length_scale_ = std::exp(d);
+        fd = Factor(y);
+      }
+    }
+    length_scale_ = std::exp((a + b) / 2.0);
+  }
+  Factor(y);
 }
 
 void GaussianProcess::Predict(const std::vector<double>& x, double* mu,

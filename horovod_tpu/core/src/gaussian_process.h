@@ -16,9 +16,15 @@ class GaussianProcess {
   explicit GaussianProcess(double length_scale = 1.0, double noise = 1e-6)
       : length_scale_(length_scale), noise_(noise) {}
 
-  // x: n samples of dim d (row-major), y: n scores.
+  // x: n samples of dim d (row-major), y: n scores.  With
+  // optimize_length_scale (and >= 4 samples), first maximizes the log
+  // marginal likelihood over the length-scale by golden-section search
+  // on its log (the reference fits kernel hyperparameters via lbfgs in
+  // optim/; a bounded 1-D search needs no solver dependency).
   void Fit(const std::vector<std::vector<double>>& x,
-           const std::vector<double>& y);
+           const std::vector<double>& y,
+           bool optimize_length_scale = false);
+  double length_scale() const { return length_scale_; }
   // Posterior mean and stddev at one point.
   void Predict(const std::vector<double>& x, double* mu,
                double* sigma) const;
@@ -27,6 +33,9 @@ class GaussianProcess {
  private:
   double Kernel(const std::vector<double>& a,
                 const std::vector<double>& b) const;
+  // Factor K(length_scale_) and compute alpha for the stored samples;
+  // returns the log marginal likelihood.
+  double Factor(const std::vector<double>& y);
 
   double length_scale_, noise_;
   bool fitted_ = false;

@@ -52,7 +52,7 @@ int BayesianOptimization::NextSample() {
     ys.push_back(yn);
     best = std::max(best, yn);
   }
-  gp_.Fit(xs, ys);
+  gp_.Fit(xs, ys, /*optimize_length_scale=*/true);
   // Expected improvement over the grid.
   int best_idx = 0;
   double best_ei = -1;

@@ -48,9 +48,25 @@ def _world_mesh():
     (the global mesh ``jax.distributed`` assembled), so the same step
     builders drive a pod the way they drive a single host."""
     if _multihost():
+        import collections
+
         from jax.sharding import Mesh
         devs = sorted(jax.devices(),
                       key=lambda d: (d.process_index, d.id))
+        counts = collections.Counter(d.process_index for d in devs)
+        if len(set(counts.values())) > 1:
+            # ValueError, NOT HorovodInternalError: the elastic wrapper
+            # retries HorovodInternalError, and a heterogeneous slice
+            # does not heal by re-rendezvousing into the same hosts —
+            # this must terminate the run with the actionable message.
+            raise ValueError(
+                "multihost data parallelism needs EQUAL addressable-"
+                "device counts on every process, got %s per process. "
+                "shard_batch/make_data_parallel_step assume uniform "
+                "per-process shards; rebalance the slice (or resize "
+                "the elastic world to homogeneous hosts) before "
+                "building the step."
+                % dict(sorted(counts.items())))
         # Key by the device identities so an elastic re-init with a
         # changed world never reuses a stale mesh; same-world calls
         # keep returning the identical Mesh object for jit cache hits.

@@ -50,15 +50,27 @@ def _device_to_jax(t: torch.Tensor):
     return jdl.from_dlpack(t.detach().contiguous())
 
 
+def _xla_to_jax(t: torch.Tensor):  # pragma: no cover - needs torch_xla
+    """Zero-copy torch_xla -> jax: one ``mark_step`` materializes the
+    lazy IR into a device buffer (inherent to lazy tensors — it is the
+    host COPY that is eliminated, not the flush), then torch_xla's
+    dlpack hands that buffer to jax in place."""
+    import torch_xla.core.xla_model as xm
+    from torch_xla.utils import dlpack as xdl
+
+    from jax import dlpack as jdl
+    xm.mark_step()
+    return jdl.from_dlpack(xdl.to_dlpack(t.detach()))
+
+
 def _payload(t: torch.Tensor):
     """Tensor -> collective payload.
 
     * CPU tensor: zero-copy numpy view (host/wire plane).
-    * torch_xla tensor (``device.type == 'xla'``): torch_xla owns the
-      device buffer behind a lazy IR; materialize to host and ship the
-      numpy payload (documented limitation: a shared-buffer bridge
-      needs torch_xla's dlpack, which this environment cannot
-      exercise).
+    * torch_xla tensor (``device.type == 'xla'``): shared-buffer dlpack
+      bridge into jax (``_xla_to_jax``) so the payload stays on the
+      device plane; host materialization only as the fallback for
+      torch_xla builds without dlpack.
     * other device tensors (e.g. cuda): dlpack into jax when a device
       payload plane exists — in tcp mode the only backend is host-TCP,
       which would immediately copy a bridged array back to host, so go
@@ -68,9 +80,21 @@ def _payload(t: torch.Tensor):
     if t.device.type == "cpu":
         return _np_view(t)
     if t.device.type == "xla":  # pragma: no cover - needs torch_xla
-        import torch_xla.core.xla_model as xm
-        xm.mark_step()
-        return _np_view(t.cpu())
+        from ..common import basics
+        if basics.is_initialized() and \
+                basics._controller_mode() == "tcp":
+            # Host-TCP payload plane: bridging to a jax device array
+            # would be copied straight back to host — materialize once
+            # and ship the host view (same rule as the cuda branch).
+            import torch_xla.core.xla_model as xm
+            xm.mark_step()
+            return _np_view(t.cpu())
+        try:
+            return _xla_to_jax(t)
+        except Exception:
+            import torch_xla.core.xla_model as xm
+            xm.mark_step()
+            return _np_view(t.cpu())
     from ..common import basics
     if basics.is_initialized() and basics._controller_mode() == "tcp":
         return _np_view(t.cpu())  # pragma: no cover - needs a device
@@ -111,6 +135,17 @@ class TorchHandle:
         return (t, splits) if splits is not None else t
 
     def _convert(self, res) -> torch.Tensor:
+        if (self._like is not None and self._like.device.type == "xla"
+                and not isinstance(res, np.ndarray)):
+            # pragma: no cover - needs torch_xla
+            # Device-plane result for an xla input: hand the jax buffer
+            # back through dlpack — the return leg of the zero-copy
+            # bridge.  Host conversion below is the fallback.
+            try:
+                from torch_xla.utils import dlpack as xdl
+                return xdl.from_dlpack(res)
+            except Exception:  # noqa: BLE001 - bridge availability
+                pass
         arr = np.ascontiguousarray(np.asarray(res))
         if arr.dtype.name == "bfloat16":
             t = torch.from_numpy(arr.view(np.uint16)) \

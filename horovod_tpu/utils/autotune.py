@@ -47,9 +47,55 @@ class GaussianProcess:
         d = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
         return np.exp(-0.5 * d / (self.length_scale ** 2))
 
-    def fit(self, x: np.ndarray, y: np.ndarray):
+    def _log_marginal_likelihood(self, ls: float) -> float:
+        """LML of the stored (x, y) at a candidate length-scale."""
+        saved = self.length_scale
+        try:
+            self.length_scale = ls
+            k = self._kernel(self._x, self._x)
+        finally:
+            self.length_scale = saved
+        k[np.diag_indices_from(k)] += self.noise + self.alpha
+        try:
+            low = np.linalg.cholesky(k)
+        except np.linalg.LinAlgError:
+            return -np.inf
+        a = np.linalg.solve(low.T, np.linalg.solve(low, self._y))
+        n = len(self._y)
+        return float(-0.5 * self._y @ a
+                     - np.log(np.diag(low)).sum()
+                     - 0.5 * n * math.log(2.0 * math.pi))
+
+    def optimize_length_scale(self, lo: float = 0.1, hi: float = 10.0,
+                              iters: int = 24):
+        """Max-marginal-likelihood length-scale via golden-section
+        search on the 1-D log length-scale (reference fits kernel
+        hyperparameters with lbfgs in optim/; one bounded 1-D search
+        needs no lbfgs dependency)."""
+        a, b = math.log(lo), math.log(hi)
+        inv_phi = (math.sqrt(5.0) - 1.0) / 2.0
+        c = b - inv_phi * (b - a)
+        d = a + inv_phi * (b - a)
+        fc = self._log_marginal_likelihood(math.exp(c))
+        fd = self._log_marginal_likelihood(math.exp(d))
+        for _ in range(iters):
+            if fc > fd:
+                b, d, fd = d, c, fc
+                c = b - inv_phi * (b - a)
+                fc = self._log_marginal_likelihood(math.exp(c))
+            else:
+                a, c, fc = c, d, fd
+                d = a + inv_phi * (b - a)
+                fd = self._log_marginal_likelihood(math.exp(d))
+        self.length_scale = math.exp((a + b) / 2.0)
+        return self.length_scale
+
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            optimize_length_scale: bool = False):
         self._x = np.atleast_2d(np.asarray(x, dtype=np.float64))
         self._y = np.asarray(y, dtype=np.float64)
+        if optimize_length_scale and len(self._y) >= 4:
+            self.optimize_length_scale()
         k = self._kernel(self._x, self._x)
         k[np.diag_indices_from(k)] += self.noise + self.alpha
         self._l = np.linalg.cholesky(k)
@@ -98,7 +144,8 @@ class BayesianOptimizer:
         if len(self.scores) < 2:
             # Bootstrap with spread-out samples.
             return [0, len(self.grid) - 1][len(self.scores)]
-        self.gp.fit(np.stack(self.points), self._normalize())
+        self.gp.fit(np.stack(self.points), self._normalize(),
+                    optimize_length_scale=True)
         mu, sigma = self.gp.predict(self.grid)
         ei = expected_improvement(mu, sigma, float(self._normalize().max()))
         return int(np.argmax(ei))

@@ -290,6 +290,56 @@ train(state)
         assert "DONE rank=%d size=3" % r in proc.stdout, proc.stdout
 
 
+def test_elastic_multihost_resize(tmp_path):
+    """Elastic scale-up of a MULTIHOST (device-payload) world: on the
+    epoch change every worker leaves the global JAX runtime
+    (jax.distributed shutdown), re-rendezvouses, and rejoins the
+    resized runtime; device collectives flow in both worlds (closes
+    the r2 gap: elastic was only exercised on the tcp plane)."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("127.0.0.1:1\n127.0.0.2:1\n")
+    disc = tmp_path / "disc.sh"
+    disc.write_text("#!/bin/sh\ncat %s\n" % hosts_file)
+    disc.chmod(0o755)
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+state.extra = 0
+
+@elastic.run
+def train(state):
+    while hvd.size() < 3 or state.extra < 2:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        assert float(np.asarray(out)[0]) == float(hvd.size())
+        state.batch += 1
+        if hvd.size() >= 3:
+            state.extra += 1
+        time.sleep(0.05)
+        state.commit()
+    print("DONE rank=%d size=%d" % (hvd.rank(), hvd.size()), flush=True)
+
+train(state)
+""")
+
+    def add_host_later():
+        time.sleep(15.0)
+        hosts_file.write_text(
+            "127.0.0.1:1\n127.0.0.2:1\n127.0.0.3:1\n")
+
+    t = threading.Thread(target=add_host_later, daemon=True)
+    t.start()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "--multihost",
+         "--host-discovery-script", str(disc),
+         "--min-np", "2", "--max-np", "3",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=_env(),
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(3):
+        assert "DONE rank=%d size=3" % r in proc.stdout, proc.stdout
+
+
 def test_tpu_discovery_preemption_resizes_world(tmp_path):
     """A preemption notice appears on the fake TPU metadata server
     mid-run: the driver drops the host from the slice view, the doomed

@@ -147,6 +147,26 @@ def test_metric_average(hvd_world):
     assert hvd.metric_average(3.0, "acc") == pytest.approx(3.0)
 
 
+def test_world_mesh_rejects_uneven_device_counts(monkeypatch):
+    # Heterogeneous pods (e.g. a mixed slice after an elastic resize)
+    # must fail mesh build with an actionable message, not a reshape
+    # error deep in sharding code.
+    import pytest as _pytest
+
+    from horovod_tpu.jax import data_parallel as dp
+
+    class FakeDev:
+        def __init__(self, p, i):
+            self.process_index, self.id = p, i
+
+    monkeypatch.setattr(dp, "_multihost", lambda: True)
+    monkeypatch.setattr(dp.jax, "devices",
+                        lambda: [FakeDev(0, 0), FakeDev(0, 1),
+                                 FakeDev(1, 2)])
+    with _pytest.raises(Exception, match="EQUAL addressable-device"):
+        dp._world_mesh()
+
+
 def test_adapter_reexports_full_surface(hvd_world):
     for name in ("init", "rank", "size", "allreduce", "grouped_allreduce",
                  "allgather", "broadcast", "alltoall", "reducescatter",
