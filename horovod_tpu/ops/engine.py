@@ -424,10 +424,6 @@ class CollectiveEngine:
                         # slice the core's chunk layout — rank j gets
                         # d0//n + (1 if j < d0%n) rows, earlier ranks
                         # larger (operations.cc REDUCESCATTER chunking).
-                        if e.red_op not in (xla_ops.SUM, xla_ops.AVERAGE):
-                            raise NotImplementedError(
-                                "reducescatter supports Sum/Average "
-                                "(reference parity)")
                         red = mc.allreduce(e.payload, e.red_op)
                         rows, offs = xla_ops.uneven_chunks(d0, mc.size)
                         out = [red[o:o + c] for c, o in zip(rows, offs)]

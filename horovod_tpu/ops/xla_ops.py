@@ -387,9 +387,26 @@ class MeshCollectives:
 
         def block_fn(x):
             # x: [1, size*k, ...] -> this rank's reduced shard [k, ...].
-            y = lax.psum_scatter(x[0], AXIS, scatter_dimension=0, tiled=True)
-            if red_op == AVERAGE:
-                y = (y / size).astype(y.dtype)
+            if red_op in (SUM, AVERAGE):
+                y = lax.psum_scatter(x[0], AXIS, scatter_dimension=0,
+                                     tiled=True)
+                if red_op == AVERAGE:
+                    y = (y / size).astype(y.dtype)
+            else:
+                # No scatter-variant collective exists for these ops:
+                # reduce fully, slice this rank's chunk.
+                if red_op == MIN:
+                    full = lax.pmin(x[0], AXIS)
+                elif red_op == MAX:
+                    full = lax.pmax(x[0], AXIS)
+                elif red_op == PRODUCT:
+                    full = jnp.prod(lax.all_gather(x[0], AXIS), axis=0)
+                else:
+                    raise NotImplementedError(
+                        "reducescatter op %r" % red_op)
+                k = x.shape[1] // size
+                y = lax.dynamic_slice_in_dim(
+                    full, lax.axis_index(AXIS) * k, k, axis=0)
             return y[None]
 
         fn = jax.shard_map(block_fn, mesh=self.mesh,
@@ -402,9 +419,6 @@ class MeshCollectives:
         through a full reduce + chunk slicing that matches the native
         core's layout (reference ReducescatterOp gives earlier ranks
         the larger shards)."""
-        if red_op not in (SUM, AVERAGE):
-            raise NotImplementedError(
-                "reducescatter supports Sum/Average (reference parity)")
         stacked = self.shard_stacked(stacked)
         key = self._key("reducescatter", stacked.dtype, stacked.shape,
                         (red_op,))

@@ -291,23 +291,65 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
 
 # -- alltoall / reducescatter ----------------------------------------------
 
+def _alltoall_graph_with_splits(tensor, splits, name, process_set):
+    """Explicit-splits alltoall inside ``tf.function``: the staged
+    py_function emits BOTH the output rows and the received splits as
+    tensors (the reference graph contract — ``HorovodAlltoall``
+    returns ``received_splits``), and the backward reverse-routes with
+    the recv_splits recorded at forward RUN time (within a step the
+    backward's py_function always executes after the forward's)."""
+    out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    rcell = {}
+    sp = tf.convert_to_tensor(splits, dtype=tf.int32)
+
+    @tf.custom_gradient
+    def _op(x, spv):
+        def _fwd(v, s):
+            res = TFHandle(_api.alltoall_async(
+                _np_view(v), [int(i) for i in np.asarray(s)], name,
+                process_set), like=v).wait()
+            out, recv = res  # explicit splits -> (out, recv_splits)
+            rcell["recv_splits"] = [int(i) for i in recv]
+            return out, np.asarray(rcell["recv_splits"], np.int32)
+
+        y, recv_t = tf.py_function(_fwd, [x, spv],
+                                   Tout=(x.dtype, tf.int32))
+        y.set_shape(out_shape)
+        recv_t.set_shape([None])
+
+        def grad(dy, d_recv):
+            def _bwd(v):
+                res = TFHandle(_api.alltoall_async(
+                    _np_view(v), list(rcell["recv_splits"]),
+                    None if name is None else name + "_grad",
+                    process_set), like=v).wait()
+                return res[0] if isinstance(res, tuple) else res
+
+            g = tf.py_function(_bwd, [dy], Tout=dy.dtype)
+            g.set_shape(x.shape)
+            return g, None
+
+        return (y, recv_t), grad
+
+    return _op(tensor, sp)
+
+
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
     """Exchange row blocks between all ranks.  Differentiable: the
     gradient is the reverse alltoall of the upstream grad, routed by
-    the received splits (reference ``HorovodAlltoall`` gradient)."""
+    the received splits (reference ``HorovodAlltoall`` gradient).
+
+    With explicit ``splits`` the return is ``(output, recv_splits)``;
+    inside ``tf.function`` both come back as tensors (reference graph
+    contract), eagerly recv_splits is a list."""
     tensor = tf.convert_to_tensor(tensor)
     if splits is not None:
         if tf.is_symbolic_tensor(tensor) or (
                 isinstance(splits, tf.Tensor)
                 and tf.is_symbolic_tensor(splits)):
-            # The eager contract returns (output, recv_splits); the
-            # received splits only exist once the staged py_function
-            # runs, so there is no trace-time value to return.
-            raise NotImplementedError(
-                "alltoall with explicit splits is not supported inside "
-                "tf.function; call it eagerly (the splits=None equal-"
-                "split form works in both modes)")
+            return _alltoall_graph_with_splits(tensor, splits, name,
+                                               process_set)
         if isinstance(splits, tf.Tensor):
             splits = splits.numpy().tolist()
     out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])

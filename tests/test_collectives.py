@@ -173,6 +173,28 @@ def test_reducescatter_uneven(hvd_world):
                                full[:2] / SIZE, rtol=1e-6)
 
 
+@pytest.mark.parametrize("op,npfn", [(hvd.Min, np.min), (hvd.Max, np.max),
+                                     (hvd.Product, np.prod)])
+def test_reducescatter_min_max_product(hvd_world, op, npfn):
+    # r2 edge closed: the scatter-less reduce ops reduce fully and
+    # slice each rank's chunk (even and uneven row counts).
+    x = _stacked((SIZE * 2, 3), seed=7)
+    out = np.asarray(hvd.reducescatter(x, op=op))  # [size, 2, 3]
+    np.testing.assert_allclose(out.reshape(SIZE * 2, 3),
+                               npfn(x, axis=0), rtol=1e-4)
+    d0 = SIZE + 3  # uneven
+    x = _stacked((d0, 2), seed=8)
+    out = hvd.reducescatter(x, op=op)
+    full = npfn(x, axis=0)
+    rows = [d0 // SIZE + (1 if j < d0 % SIZE else 0)
+            for j in range(SIZE)]
+    off = 0
+    for j in range(SIZE):
+        np.testing.assert_allclose(np.asarray(out[j]),
+                                   full[off:off + rows[j]], rtol=1e-4)
+        off += rows[j]
+
+
 def test_join_zero_contribution(hvd_world):
     # Ranks 2 and 5 are out of data: their rows contribute zeros to Sum;
     # Average divides by the LIVE contributor count (zero is not

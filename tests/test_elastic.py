@@ -333,7 +333,9 @@ train(state)
          "--host-discovery-script", str(disc),
          "--min-np", "2", "--max-np", "3",
          sys.executable, str(script)],
-        capture_output=True, text=True, timeout=300, env=_env(),
+        # 1-core box: under full-suite load the three jax runtimes
+        # start several times slower than when run alone
+        capture_output=True, text=True, timeout=600, env=_env(),
         cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for r in range(3):
@@ -382,7 +384,7 @@ train(state)
             [sys.executable, "-m", "horovod_tpu.runner",
              "--tpu-discovery", "--min-np", "1", "--max-np", "2",
              sys.executable, str(script)],
-            capture_output=True, text=True, timeout=300, env=env,
+            capture_output=True, text=True, timeout=600, env=env,
             cwd=REPO)
     finally:
         md.stop()
