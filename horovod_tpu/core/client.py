@@ -118,6 +118,9 @@ def load_library():
     lib.hvd_tcp_enqueue_external.restype = ctypes.c_int
     lib.hvd_tcp_next_negotiated.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_tcp_next_negotiated.restype = ctypes.c_int
+    lib.hvd_tcp_wait_negotiated.argtypes = [ctypes.c_char_p,
+                                            ctypes.c_int, ctypes.c_int]
+    lib.hvd_tcp_wait_negotiated.restype = ctypes.c_int
     lib.hvd_tcp_external_done.argtypes = [ctypes.c_int, ctypes.c_int,
                                           ctypes.c_char_p]
     _lib = lib
@@ -384,6 +387,22 @@ class TcpCore:
             self._poll_buf = ctypes.create_string_buffer(1 << 16)
         n = self._lib.hvd_tcp_next_negotiated(self._poll_buf,
                                               len(self._poll_buf))
+        if n < 0:  # record larger than the buffer: grow and retry
+            self._poll_buf = ctypes.create_string_buffer(-n)
+            n = self._lib.hvd_tcp_next_negotiated(self._poll_buf,
+                                                  len(self._poll_buf))
+        if n <= 0:
+            return None
+        return self._poll_buf.raw[:n]
+
+    def wait_negotiated(self, timeout_ms: int) -> Optional[bytes]:
+        """Like :meth:`next_negotiated` but blocks in the core up to
+        ``timeout_ms`` for a record — the executor wakes the instant
+        negotiation finishes instead of poll-sleeping."""
+        if self._poll_buf is None:
+            self._poll_buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.hvd_tcp_wait_negotiated(
+            self._poll_buf, len(self._poll_buf), int(timeout_ms))
         if n < 0:  # record larger than the buffer: grow and retry
             self._poll_buf = ctypes.create_string_buffer(-n)
             n = self._lib.hvd_tcp_next_negotiated(self._poll_buf,

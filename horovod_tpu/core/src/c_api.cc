@@ -97,6 +97,13 @@ int hvd_tcp_next_negotiated(unsigned char* buf, int buflen) {
   return CoreState::Get().NextNegotiated(buf, buflen);
 }
 
+// Blocking variant: waits up to timeout_ms for a record so the
+// executor never poll-sleeps on an empty queue.
+int hvd_tcp_wait_negotiated(unsigned char* buf, int buflen,
+                            int timeout_ms) {
+  return CoreState::Get().WaitNegotiated(buf, buflen, timeout_ms);
+}
+
 void hvd_tcp_external_done(int handle, int ok, const char* err) {
   CoreState::Get().ExternalDone(
       handle, ok ? Status::OK()

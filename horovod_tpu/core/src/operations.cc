@@ -227,8 +227,7 @@ void CoreState::Release(int32_t handle) {
   handles_.erase(handle);
 }
 
-int CoreState::NextNegotiated(uint8_t* buf, int buflen) {
-  std::lock_guard<std::mutex> lk(negotiated_mu_);
+int CoreState::PopNegotiatedLocked(uint8_t* buf, int buflen) {
   if (negotiated_groups_.empty()) return 0;
   auto& rec = negotiated_groups_.front();
   int n = static_cast<int>(rec.size());
@@ -236,6 +235,21 @@ int CoreState::NextNegotiated(uint8_t* buf, int buflen) {
   std::memcpy(buf, rec.data(), rec.size());
   negotiated_groups_.pop_front();
   return n;
+}
+
+int CoreState::NextNegotiated(uint8_t* buf, int buflen) {
+  std::lock_guard<std::mutex> lk(negotiated_mu_);
+  return PopNegotiatedLocked(buf, buflen);
+}
+
+int CoreState::WaitNegotiated(uint8_t* buf, int buflen,
+                              int timeout_ms) {
+  std::unique_lock<std::mutex> lk(negotiated_mu_);
+  if (negotiated_groups_.empty())
+    negotiated_cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms),
+        [&] { return !negotiated_groups_.empty(); });
+  return PopNegotiatedLocked(buf, buflen);
 }
 
 void CoreState::ExternalDone(int32_t handle, const Status& s) {
@@ -414,8 +428,11 @@ void CoreState::PerformOperation(const Response& r) {
       if (entries[i])
         timeline_.ActivityStart(r.tensor_names[i], "EXEC_EXTERNAL");
     }
-    std::lock_guard<std::mutex> lk(negotiated_mu_);
-    negotiated_groups_.push_back(std::move(w.buf));
+    {
+      std::lock_guard<std::mutex> lk(negotiated_mu_);
+      negotiated_groups_.push_back(std::move(w.buf));
+    }
+    negotiated_cv_.notify_one();
     return;
   }
 

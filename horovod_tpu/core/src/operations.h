@@ -8,6 +8,7 @@
 #define HVD_TPU_OPERATIONS_H
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <map>
@@ -60,7 +61,11 @@ class CoreState {
   // XLA executor to run; ExternalDone completes the member entries.
   // NextNegotiated copies one serialized group record into buf and
   // returns its length; 0 = none pending; -needed if buflen too small.
+  // WaitNegotiated blocks up to timeout_ms for a record instead of
+  // making the executor poll-sleep (halves eager collective latency:
+  // the executor wakes the moment negotiation finishes).
   int NextNegotiated(uint8_t* buf, int buflen);
+  int WaitNegotiated(uint8_t* buf, int buflen, int timeout_ms);
   void ExternalDone(int32_t handle, const Status& s);
 
   uint32_t RegisterProcessSet(const std::vector<int32_t>& ranks) {
@@ -104,7 +109,9 @@ class CoreState {
   std::shared_ptr<TensorTableEntry> join_entry_;
 
   std::mutex negotiated_mu_;
+  std::condition_variable negotiated_cv_;
   std::deque<std::vector<uint8_t>> negotiated_groups_;
+  int PopNegotiatedLocked(uint8_t* buf, int buflen);
 
   std::thread background_;
   std::atomic<bool> shutdown_requested_{false};

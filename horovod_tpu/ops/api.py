@@ -196,6 +196,14 @@ def alltoall(tensor, splits=None, name=None,
 def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
                         process_set: Optional[ProcessSet] = None
                         ) -> CollectiveHandle:
+    if op == ADASUM:
+        # Adasum is an allreduce algorithm (dot-product combine of full
+        # gradients); a scattered variant does not exist in the
+        # reference either.  Reject here so every backend agrees
+        # instead of some silently computing a plain Sum.
+        raise ValueError(
+            "reducescatter supports Sum/Average/Min/Max/Product; "
+            "Adasum is allreduce-only")
     return _submit("reducescatter", [tensor],
                    [_auto_name("reducescatter", name)], process_set,
                    red_op=op)

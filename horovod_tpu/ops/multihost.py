@@ -538,10 +538,14 @@ class MultihostEngine:
 
     def _loop(self):
         from ..core.client import parse_negotiated_record
+        # Blocking wait in the core (condition variable): the executor
+        # runs a record the instant negotiation finishes instead of
+        # poll-sleeping half a cycle; the timeout only bounds shutdown
+        # latency.
+        wait_ms = max(int(self.config.cycle_time_ms), 1)
         while not self._shutdown:
-            rec = self.core.next_negotiated()
+            rec = self.core.wait_negotiated(wait_ms)
             if rec is None:
-                time.sleep(self.config.cycle_time_ms / 2e3)
                 continue
             try:
                 self._execute(parse_negotiated_record(rec))

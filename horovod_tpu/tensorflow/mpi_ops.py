@@ -296,10 +296,9 @@ def _alltoall_graph_with_splits(tensor, splits, name, process_set):
     py_function emits BOTH the output rows and the received splits as
     tensors (the reference graph contract — ``HorovodAlltoall``
     returns ``received_splits``), and the backward reverse-routes with
-    the recv_splits recorded at forward RUN time (within a step the
-    backward's py_function always executes after the forward's)."""
+    the forward's recv_splits TENSOR (per-execution correct even under
+    tf.while_loop / persistent tapes)."""
     out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
-    rcell = {}
     sp = tf.convert_to_tensor(splits, dtype=tf.int32)
 
     @tf.custom_gradient
@@ -309,8 +308,7 @@ def _alltoall_graph_with_splits(tensor, splits, name, process_set):
                 _np_view(v), [int(i) for i in np.asarray(s)], name,
                 process_set), like=v).wait()
             out, recv = res  # explicit splits -> (out, recv_splits)
-            rcell["recv_splits"] = [int(i) for i in recv]
-            return out, np.asarray(rcell["recv_splits"], np.int32)
+            return out, np.asarray([int(i) for i in recv], np.int32)
 
         y, recv_t = tf.py_function(_fwd, [x, spv],
                                    Tout=(x.dtype, tf.int32))
@@ -318,14 +316,18 @@ def _alltoall_graph_with_splits(tensor, splits, name, process_set):
         recv_t.set_shape([None])
 
         def grad(dy, d_recv):
-            def _bwd(v):
+            # recv_t is the FORWARD's tensor output, so the backward's
+            # reverse routing is per-execution correct (a Python cell
+            # would hold only the LAST forward's splits — wrong under
+            # tf.while_loop or multiple forwards on a persistent tape).
+            def _bwd(v, rt):
                 res = TFHandle(_api.alltoall_async(
-                    _np_view(v), list(rcell["recv_splits"]),
+                    _np_view(v), [int(i) for i in np.asarray(rt)],
                     None if name is None else name + "_grad",
                     process_set), like=v).wait()
                 return res[0] if isinstance(res, tuple) else res
 
-            g = tf.py_function(_bwd, [dy], Tout=dy.dtype)
+            g = tf.py_function(_bwd, [dy, recv_t], Tout=dy.dtype)
             g.set_shape(x.shape)
             return g, None
 

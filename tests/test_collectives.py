@@ -173,6 +173,14 @@ def test_reducescatter_uneven(hvd_world):
                                full[:2] / SIZE, rtol=1e-6)
 
 
+def test_reducescatter_rejects_adasum(hvd_world):
+    # Adasum is allreduce-only; both even and uneven row counts must
+    # reject identically (not silently fall back to Sum).
+    for rows in (SIZE * 2, SIZE + 3):
+        with pytest.raises(ValueError, match="allreduce-only"):
+            hvd.reducescatter(_stacked((rows, 2)), op=hvd.Adasum)
+
+
 @pytest.mark.parametrize("op,npfn", [(hvd.Min, np.min), (hvd.Max, np.max),
                                      (hvd.Product, np.prod)])
 def test_reducescatter_min_max_product(hvd_world, op, npfn):

@@ -301,6 +301,7 @@ def test_elastic_multihost_resize(tmp_path):
     disc = tmp_path / "disc.sh"
     disc.write_text("#!/bin/sh\ncat %s\n" % hosts_file)
     disc.chmod(0o755)
+    started = tmp_path / "started"
     script = tmp_path / "train.py"
     script.write_text(WORKER_COMMON + """
 state.extra = 0
@@ -312,6 +313,8 @@ def train(state):
                             name="b%d" % state.batch)
         assert float(np.asarray(out)[0]) == float(hvd.size())
         state.batch += 1
+        if state.batch == 3 and hvd.rank() == 0:
+            open("@STARTED@", "w").close()  # initial world is training
         if hvd.size() >= 3:
             state.extra += 1
         time.sleep(0.05)
@@ -319,14 +322,19 @@ def train(state):
     print("DONE rank=%d size=%d" % (hvd.rank(), hvd.size()), flush=True)
 
 train(state)
-""")
+""".replace("@STARTED@", str(started)))
 
-    def add_host_later():
-        time.sleep(15.0)
+    def add_host_when_started():
+        # Progress-triggered (not a fixed delay): under full-suite load
+        # on one core the initial world can take >15s to even start.
+        deadline = time.time() + 240
+        while not started.exists() and time.time() < deadline:
+            time.sleep(0.5)
+        time.sleep(1.0)
         hosts_file.write_text(
             "127.0.0.1:1\n127.0.0.2:1\n127.0.0.3:1\n")
 
-    t = threading.Thread(target=add_host_later, daemon=True)
+    t = threading.Thread(target=add_host_when_started, daemon=True)
     t.start()
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "--multihost",
@@ -351,6 +359,7 @@ def test_tpu_discovery_preemption_resizes_world(tmp_path):
     md = _FakeMetadata()
     md.values["worker-network-endpoints"] = (
         "w0:8470:127.0.0.1,w1:8470:127.0.0.2")
+    started = tmp_path / "started"
     script = tmp_path / "train.py"
     script.write_text(WORKER_COMMON + """
 state.extra = 0
@@ -361,6 +370,8 @@ def train(state):
         out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
                             name="b%d" % state.batch)
         state.batch += 1
+        if state.batch == 3 and hvd.rank() == 0:
+            open("@STARTED@", "w").close()  # 2-rank world is training
         if hvd.size() == 1:
             state.extra += 1
         time.sleep(0.05)
@@ -369,13 +380,17 @@ def train(state):
           % (hvd.rank(), hvd.size(), state.batch), flush=True)
 
 train(state)
-""")
+""".replace("@STARTED@", str(started)))
 
-    def preempt_later():
-        time.sleep(12.0)
+    def preempt_when_started():
+        # Progress-triggered, not a fixed delay (see the resize test).
+        deadline = time.time() + 240
+        while not started.exists() and time.time() < deadline:
+            time.sleep(0.5)
+        time.sleep(1.0)
         md.values["unhealthy-workers"] = "127.0.0.2"
 
-    t = threading.Thread(target=preempt_later, daemon=True)
+    t = threading.Thread(target=preempt_when_started, daemon=True)
     t.start()
     env = _env()
     env["HVD_TPU_METADATA_URL"] = md.url
