@@ -37,6 +37,20 @@ def test_spark_run_replay_executes_real_world(monkeypatch):
     np.testing.assert_allclose([r[2] for r in results], 3.0)  # 1+2
 
 
+def test_mxnet_replay_real_branches_on_2rank_world():
+    # A fake `mxnet` module (recorded API surface: nd.NDArray/nd.array/
+    # gluon.Trainer) installed BEFORE the adapter imports, driven over
+    # a real 2-process world: NDArray reconstruction and the
+    # DistributedTrainer gradient averaging run the real-mxnet code
+    # paths that duck-typed tests cannot reach.
+    import os
+
+    from tests.utils.spawn import assert_world_ok, spawn_world
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "utils", "mxnet_contract_worker.py")
+    assert_world_ok(spawn_world(worker, 2), "MX_CONTRACT_OK")
+
+
 def test_ray_executor_replay_start_run_shutdown(monkeypatch):
     make_fake_ray(monkeypatch)
     from horovod_tpu.ray import RayExecutor
