@@ -75,6 +75,21 @@ def init_jax_distributed(config, rank: int, size: int):
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=size, process_id=rank)
     init_jax_distributed._done = True
+    # Verify the world actually formed.  A backend plugin (or any JAX
+    # computation before hvd.init()) can pre-initialize the runtime, in
+    # which case distributed init silently does not take effect and
+    # every rank would train ALONE while believing it is rank r of N —
+    # the worst possible failure mode.  Fail loudly instead.
+    got = jax.process_count()
+    if size > 1 and got != size:
+        raise RuntimeError(
+            "multihost init failed: jax.process_count()=%d but the "
+            "world has %d ranks. The JAX runtime was initialized "
+            "before hvd.init() could join the global world (a platform "
+            "plugin or an earlier JAX computation created the backend "
+            "first). Call hvd.init() before ANY JAX computation and "
+            "disable backend plugins that pre-initialize the runtime."
+            % (got, size))
 
 
 def shutdown_jax_distributed():

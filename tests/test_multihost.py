@@ -58,3 +58,33 @@ def test_multihost_data_parallel_step_matches_reference():
     # gradients are the global-batch mean by construction).
     _assert_ok(_spawn_multihost(2, local_devices=2, worker=DP_WORKER),
                marker="MH_DP_OK")
+
+
+def test_init_detects_preinitialized_runtime(monkeypatch):
+    # A pre-initialized JAX backend makes jax.distributed.initialize a
+    # silent no-op: every rank would train alone while believing it is
+    # rank r of N.  init_jax_distributed must detect the world that
+    # failed to form and raise, not proceed.
+    import types
+
+    from horovod_tpu.common import multihost as mh
+
+    fake_jax = types.SimpleNamespace(
+        config=types.SimpleNamespace(
+            update=lambda *a, **k: None, jax_platforms="cpu"),
+        distributed=types.SimpleNamespace(
+            initialize=lambda **kw: None),  # the silent no-op
+        process_count=lambda: 1,            # world never formed
+    )
+    monkeypatch.setattr(mh, "init_jax_distributed",
+                        mh.init_jax_distributed)
+    monkeypatch.setitem(__import__("sys").modules, "jax", fake_jax)
+    monkeypatch.setattr(mh.init_jax_distributed, "_done", False,
+                        raising=False)
+    cfg = types.SimpleNamespace(coordinator_addr="127.0.0.1:1",
+                                rendezvous_addr=None, secret_key=None)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="train alone|initialized "
+                                            "before|process_count"):
+        mh.init_jax_distributed(cfg, rank=0, size=2)
+    mh.init_jax_distributed._done = False
