@@ -36,7 +36,10 @@ def broadcast_parameters(params, root_rank: int = 0,
     In-process SPMD world: the single controller owns one logical copy, so
     broadcast = replicate that copy across the mesh devices (an XLA
     broadcast transfer over ICI).  Multi-process world: per-leaf engine
-    broadcast from ``root_rank``.
+    broadcast from ``root_rank`` — values come back per-process (device
+    arrays on the eager payload plane); to feed them into the jit DP
+    step afterwards, place them on the global mesh with
+    ``data_parallel.replicate`` (see examples/multihost_pod_training.py).
     """
     if basics._controller_is_spmd():
         return _replicate(params)
