@@ -153,7 +153,7 @@ def main():
 
     # Differential timing: (2N steps) - (N steps) cancels the dispatch/
     # fetch overhead of the runtime tunnel, where block_until_ready alone
-    # is not a reliable completion barrier.  Best of 3 windows: the
+    # is not a reliable completion barrier.  Best of 5 windows: the
     # tunnel shares the host with other tenants, and min over repeats
     # rejects their interference (r2's driver-run regression vs the
     # repo-measured number was exactly this noise).
@@ -163,7 +163,7 @@ def main():
     # (min over the differences would SELECT windows whose t1 was
     # noise-inflated, biasing throughput upward.)
     t1s, t2s = [], []
-    for _ in range(3 if on_accel else 1):
+    for _ in range(5 if on_accel else 1):
         t1, params, batch_stats, opt_state = run(steps, params,
                                                  batch_stats, opt_state)
         t2, params, batch_stats, opt_state = run(2 * steps, params,
