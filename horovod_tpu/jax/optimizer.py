@@ -17,7 +17,7 @@ multi-process world it routes through the eager engine instead.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -35,16 +35,31 @@ class _AggState(NamedTuple):
     counter: jnp.ndarray
 
 
+AxisSpec = Optional[Union[str, Tuple[str, str]]]
+
+
 def allreduce_gradients(grads, op: str = AVERAGE,
-                        axis_name: Optional[str] = spmd.DEFAULT_AXIS,
+                        axis_name: AxisSpec = spmd.DEFAULT_AXIS,
                         compression=Compression.none,
                         process_set: Optional[ProcessSet] = None):
     """Average a gradient pytree across the world.
 
-    ``axis_name`` set (inside shard_map/pjit): fused in-program psum.
+    ``axis_name`` set (inside shard_map/pjit): fused in-program psum; a
+    ``(inner, outer)`` PAIR of axis names selects the hierarchical
+    reduce over a hybrid mesh (reduce-scatter on ICI, cross-slice
+    allreduce of the shards on DCN, all-gather back — the reference's
+    ``HOROVOD_HIERARCHICAL_ALLREDUCE``).
     ``axis_name=None`` (eager, multi-process tcp world): engine allreduce
     per leaf, fused by the background cycle.
     """
+    if isinstance(axis_name, (tuple, list)):
+        if compression is not Compression.none:
+            raise ValueError(
+                "compression is not supported on the hierarchical "
+                "reduce path")
+        inner, outer = axis_name
+        return spmd.hierarchical_allreduce_pytree(
+            grads, op=op, inner_axis=inner, outer_axis=outer)
     if axis_name is not None:
         return spmd.allreduce_pytree(grads, op=op, axis_name=axis_name,
                                      compression=compression)
@@ -66,7 +81,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          backward_passes_per_step: int = 1,
                          op: str = AVERAGE,
                          gradient_predivide_factor: float = 1.0,
-                         axis_name: Optional[str] = spmd.DEFAULT_AXIS,
+                         axis_name: AxisSpec = spmd.DEFAULT_AXIS,
                          process_set: Optional[ProcessSet] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with cross-replica gradient reduction.
@@ -99,8 +114,14 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             red = allreduce_gradients(scaled, op=SUM, axis_name=axis_name,
                                       compression=compression,
                                       process_set=process_set)
-            denom = (spmd.size(axis_name) if axis_name is not None
-                     else (process_set.size() if process_set else _world()))
+            if isinstance(axis_name, (tuple, list)):
+                denom = (spmd.size(axis_name[0])
+                         * spmd.size(axis_name[1]))
+            elif axis_name is not None:
+                denom = spmd.size(axis_name)
+            else:
+                denom = (process_set.size() if process_set
+                         else _world())
             return jax.tree.map(
                 lambda g: g * jnp.asarray(post / denom, g.dtype), red)
         return allreduce_gradients(grads, op=op, axis_name=axis_name,
@@ -169,7 +190,7 @@ class DistributedGradientTape:
 
     def __init__(self, loss_fn, compression=Compression.none,
                  op: str = AVERAGE,
-                 axis_name: Optional[str] = spmd.DEFAULT_AXIS,
+                 axis_name: AxisSpec = spmd.DEFAULT_AXIS,
                  process_set: Optional[ProcessSet] = None):
         self._grad_fn = jax.value_and_grad(loss_fn)
         self.compression = compression

@@ -61,25 +61,78 @@ def allreduce(x, op: str = AVERAGE, axis_name: str = DEFAULT_AXIS,
     return out
 
 
-def grouped_allreduce(xs: Sequence, op: str = AVERAGE,
-                      axis_name: str = DEFAULT_AXIS,
-                      compression=Compression.none):
-    """Reduce a list of tensors as one fused payload.
+def hierarchical_allreduce(x, op: str = AVERAGE,
+                           inner_axis: str = "ici",
+                           outer_axis: str = "dcn"):
+    """The reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE``
+    (``ops/nccl_operations.cc``: NCCL reduce-scatter intra-node, MPI
+    allreduce across, NCCL allgather back) as mesh collectives:
+    ``psum_scatter`` over the fast inner axis (ICI within a slice),
+    ``psum`` of the 1/inner-sized shards over the slow outer axis
+    (DCN across slices), ``all_gather`` back over inner.  Only
+    ``1/inner_size`` of the bytes ever cross DCN.
 
-    In-program fusion: flatten-concat-reduce-split, which XLA lowers to a
-    single large all-reduce — the explicit analog of the engine's fusion
-    buffer for hand-written SPMD steps.
+    Use with a ``create_hybrid_mesh`` whose DP dimension is split into
+    (outer=dcn, inner=ici) axes; for Sum/Average only (like the
+    reference's hierarchical path).
     """
+    if op not in (SUM, AVERAGE):
+        raise NotImplementedError(
+            "hierarchical allreduce supports Sum/Average (reference "
+            "parity: the NCCL+MPI hierarchical path was Sum-based)")
+    inner = lax.axis_size(inner_axis)
+    flat = jnp.ravel(x)
+    pad = (-flat.shape[0]) % inner
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), flat.dtype)])
+    s = lax.psum_scatter(flat, inner_axis, scatter_dimension=0,
+                         tiled=True)
+    s = lax.psum(s, outer_axis)
+    if op == AVERAGE:
+        # Divide the 1/inner-sized shard BEFORE the gather: inner-times
+        # less work, and the division cannot fuse across the collective.
+        n = inner * lax.axis_size(outer_axis)
+        s = (s / n).astype(flat.dtype)
+    out = lax.all_gather(s, inner_axis, tiled=True)
+    return out[:x.size].reshape(x.shape).astype(x.dtype)
+
+
+def _fused_reduce(xs: Sequence, reduce_flat):
+    """Flatten-concat-reduce-split fusion shared by the grouped and
+    hierarchical paths: one large collective instead of one per tensor
+    (the explicit analog of the engine's fusion buffer)."""
     flats = [jnp.ravel(x) for x in xs]
     sizes = [f.shape[0] for f in flats]
-    fused = jnp.concatenate(flats)
-    red = allreduce(fused, op=op, axis_name=axis_name,
-                    compression=compression)
+    fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+    red = reduce_flat(fused)
     outs, off = [], 0
     for x, n in zip(xs, sizes):
         outs.append(red[off:off + n].reshape(x.shape).astype(x.dtype))
         off += n
     return outs
+
+
+def hierarchical_allreduce_pytree(tree, op: str = AVERAGE,
+                                  inner_axis: str = "ici",
+                                  outer_axis: str = "dcn"):
+    """Fused hierarchical reduce of a pytree: one concat, one
+    RS-inner/AR-outer/AG-inner round, one split."""
+    leaves, treedef = jax.tree.flatten(tree)
+    outs = _fused_reduce(
+        leaves, lambda fused: hierarchical_allreduce(
+            fused, op=op, inner_axis=inner_axis, outer_axis=outer_axis))
+    return jax.tree.unflatten(treedef, outs)
+
+
+def grouped_allreduce(xs: Sequence, op: str = AVERAGE,
+                      axis_name: str = DEFAULT_AXIS,
+                      compression=Compression.none):
+    """Reduce a list of tensors as one fused payload (one large
+    all-reduce — see _fused_reduce)."""
+    return _fused_reduce(
+        xs, lambda fused: allreduce(fused, op=op, axis_name=axis_name,
+                                    compression=compression))
 
 
 def allreduce_pytree(tree, op: str = AVERAGE, axis_name: str = DEFAULT_AXIS,

@@ -147,6 +147,45 @@ def test_metric_average(hvd_world):
     assert hvd.metric_average(3.0, "acc") == pytest.approx(3.0)
 
 
+def test_hierarchical_allreduce_matches_flat(hvd_world):
+    # The reference's HOROVOD_HIERARCHICAL_ALLREDUCE as mesh
+    # collectives: RS over the inner (ICI) axis, AR of the shards over
+    # the outer (DCN) axis, AG back — must equal the flat psum over
+    # both axes (ragged length exercises the inner-pad path).
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.jax import spmd
+
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("dcn", "ici"))
+    x = jnp.asarray(np.random.RandomState(3).randn(8, 6), jnp.float32)
+
+    def f(xs):
+        v = xs.reshape(-1)  # [6], 6 % 4 != 0 -> pad path
+        h_sum = spmd.hierarchical_allreduce(
+            v, op="Sum", inner_axis="ici", outer_axis="dcn")
+        # The DistributedOptimizer plumbing: a (inner, outer) pair
+        # routes the pytree through the hierarchical reduce.
+        from horovod_tpu.jax.optimizer import allreduce_gradients
+        h_avg = allreduce_gradients(
+            {"g": v}, op="Average", axis_name=("ici", "dcn"))["g"]
+        flat = jax.lax.psum(v, ("dcn", "ici"))
+        return h_sum[None], h_avg[None], flat[None]
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P(("dcn", "ici")),
+        out_specs=(P(("dcn", "ici")), P(("dcn", "ici")),
+                   P(("dcn", "ici"))), check_vma=False))
+    h_sum, h_avg, flat = fn(x)
+    np.testing.assert_allclose(np.asarray(h_sum), np.asarray(flat),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_avg),
+                               np.asarray(flat) / 8.0, rtol=1e-5)
+
+
 def test_world_mesh_rejects_uneven_device_counts(monkeypatch):
     # Heterogeneous pods (e.g. a mixed slice after an elastic resize)
     # must fail mesh build with an actionable message, not a reshape
