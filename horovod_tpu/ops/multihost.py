@@ -561,8 +561,18 @@ class MultihostEngine:
         entries = g["entries"]
         taken = [self._take(e["handle"]) if e["handle"] >= 0
                  else (None, None) for e in entries]
+        names = [e["name"] for e in entries]
         try:
-            results = self._run_group(g, mc, taken)
+            # Per-tensor timeline span (reference: the EXEC_* phases the
+            # native executors record) + an xprof TraceAnnotation so the
+            # device program shows up named in jax profiler traces.
+            import jax.profiler
+            self.timeline.activity_start_all(
+                names, "EXEC_DEVICE_" + g["op_type"].upper())
+            with jax.profiler.TraceAnnotation(
+                    "hvd.mh.%s[%d]" % (g["op_type"], len(entries))):
+                results = self._run_group(g, mc, taken)
+            self.timeline.activity_end_all(names)
             for (py, _), res, e in zip(taken, results, entries):
                 if e["handle"] >= 0:
                     self.core.external_done(e["handle"], ok=True)
@@ -570,6 +580,7 @@ class MultihostEngine:
                 if py is not None:
                     py._set_result(res)
         except Exception as exc:  # noqa: BLE001
+            self.timeline.activity_end_all(names)
             LOG.error("multihost %s failed: %s", g["op_type"], exc)
             for (py, _), e in zip(taken, entries):
                 if e["handle"] >= 0:

@@ -31,15 +31,18 @@ def _assert_ok(outs, marker="MULTIHOST_OK"):
 
 
 @pytest.mark.parametrize("size", [2, 3])
-def test_multihost_collective_matrix(size):
+def test_multihost_collective_matrix(size, tmp_path):
     # Full eager matrix over a real multi-process global mesh: fused and
     # grouped allreduce, every reduce op, ragged allgather/alltoall,
     # uneven reducescatter, process sets, join with zero contribution.
     # HVD_TPU_DUMP_HLO makes the worker also assert device payloads stay
     # device-resident and the programs lower to real collective HLO
     # (all_reduce / all_to_all / reduce_scatter).
-    _assert_ok(_spawn_multihost(size,
-                                extra_env={"HVD_TPU_DUMP_HLO": "1"}))
+    # TEST_TIMELINE_BASE additionally makes each worker assert its
+    # chrome trace contains the executor's device-exec spans.
+    _assert_ok(_spawn_multihost(size, extra_env={
+        "HVD_TPU_DUMP_HLO": "1",
+        "TEST_TIMELINE_BASE": str(tmp_path / "tl")}))
 
 
 def test_multihost_single_local_device():

@@ -24,6 +24,10 @@ import horovod_tpu as hvd
 
 
 def main():
+    tl_base = os.environ.get("TEST_TIMELINE_BASE")
+    if tl_base:
+        os.environ["HOROVOD_TIMELINE"] = "%s.%s.json" % (
+            tl_base, os.environ.get("HOROVOD_RANK", "0"))
     hvd.init(controller="multihost")
     r, n = hvd.rank(), hvd.size()
     n_local = int(os.environ.get("TEST_LOCAL_DEVICES", "4"))
@@ -150,6 +154,11 @@ def main():
 
     print("MULTIHOST_OK", r, flush=True)
     hvd.shutdown()
+    if tl_base:
+        # The executor records per-tensor device-exec spans (reference
+        # timeline EXEC_* phases) — assert they landed in the trace.
+        tl = open(os.environ["HOROVOD_TIMELINE"]).read()
+        assert "EXEC_DEVICE_ALLREDUCE" in tl, "no device exec spans"
     # The jax gloo/distributed runtime can SIGABRT in its own atexit
     # teardown on a 1-core box ("FATAL: exception not rethrown") after
     # all work AND our shutdown completed; hard-exit past it so the
