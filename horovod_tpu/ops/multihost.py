@@ -103,7 +103,12 @@ class GlobalMeshCollectives:
         devs = [by_proc[p][0] for p in self.procs]
         self.mesh = Mesh(np.asarray(devs), ("proc",))
         self.device = devs[self.my_idx] if self.my_idx >= 0 else None
-        self._fns: Dict[tuple, object] = {}
+        # Capacity-bounded LRU like the in-process engine (the
+        # reference's HOROVOD_CACHE_CAPACITY): long jobs with varying
+        # shapes must not grow compiled programs without bound.
+        from ..common.config import Config as _Cfg
+        from .executable_cache import ExecutableCache
+        self._fns = ExecutableCache(_Cfg.from_env().cache_capacity)
         # key -> lowered HLO text, populated when HVD_TPU_DUMP_HLO=1
         # (lets tests assert the real collective ops are emitted).
         self.hlo: Dict[tuple, str] = {}
@@ -155,14 +160,14 @@ class GlobalMeshCollectives:
         return garr.addressable_shards[0].data[0]
 
     def _compiled(self, key, build, example_args=None):
-        fn = self._fns.get(key)
+        fn = self._fns.lookup(key)
         if fn is None:
             fn = build()
             import os
             if os.environ.get("HVD_TPU_DUMP_HLO") and \
                     example_args is not None:
                 self.hlo[key] = fn.lower(*example_args).as_text()
-            self._fns[key] = fn
+            self._fns.put(key, fn)
         return fn
 
     def _collective_jit(self, fn, n_args, out_spec):
