@@ -1,0 +1,68 @@
+"""Drop-in import compatibility with the reference package name.
+
+``import horovod.torch as hvd``, ``import horovod.tensorflow as hvd``,
+``horovod.spark.run`` et al. resolve to the ``horovod_tpu``
+implementations — the whole migration diff disappears
+(docs/migration.md).  A lazy meta-path finder redirects every
+``horovod.X...`` import to ``horovod_tpu.X...`` and registers the SAME
+module object under both names, so ``horovod.spark.keras is
+horovod_tpu.spark.keras`` and isinstance checks never split.
+
+Do not install the real Horovod wheel alongside this package — both
+claim the ``horovod`` name (this one exists so the reference's users
+can switch without editing imports).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+__version__ = "0.1.0+tpu"
+
+
+class _RedirectFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    """horovod.X[.Y...] -> the horovod_tpu.X[.Y...] module object."""
+
+    _prefix = __name__ + "."
+    # Upstream spellings whose path differs here.
+    _renames = {"tensorflow.keras": "keras"}
+
+    def _target(self, fullname):
+        tail = fullname[len(self._prefix):]
+        return "horovod_tpu." + self._renames.get(tail, tail)
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(self._prefix):
+            return None
+        try:
+            if importlib.util.find_spec(self._target(fullname)) is None:
+                return None
+        except ModuleNotFoundError:
+            return None
+        return importlib.util.spec_from_loader(fullname, self)
+
+    def create_module(self, spec):
+        return importlib.import_module(self._target(spec.name))
+
+    def exec_module(self, module):
+        pass
+
+
+if not any(isinstance(f, _RedirectFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _RedirectFinder())
+
+
+def __getattr__(name):
+    # Top-level surface: horovod.run (the programmatic launcher),
+    # horovod.spark / horovod.ray / adapters as attributes.
+    if name == "run":
+        from horovod_tpu.runner.run_api import run
+        return run
+    try:
+        return importlib.import_module(__name__ + "." + name)
+    except ImportError as exc:
+        raise AttributeError(
+            "module %r has no attribute %r" % (__name__, name)) from exc

@@ -1,0 +1,55 @@
+"""The drop-in `horovod` import alias (docs/migration.md): reference
+scripts keep their import lines unchanged and get the horovod_tpu
+implementations — the SAME module objects, not copies."""
+
+import importlib
+
+
+def test_adapter_imports_are_the_same_modules():
+    import horovod.torch as compat_torch
+
+    import horovod_tpu.torch as real_torch
+    assert compat_torch is real_torch
+
+    import horovod.tensorflow as compat_tf
+
+    import horovod_tpu.tensorflow as real_tf
+    assert compat_tf is real_tf
+
+    # Upstream spelling horovod.tensorflow.keras -> the keras adapter.
+    compat_tfk = importlib.import_module("horovod.tensorflow.keras")
+    import horovod_tpu.keras as real_keras
+    assert compat_tfk is real_keras
+
+
+def test_nested_and_platform_imports():
+    import horovod.spark.keras as compat_sk
+
+    import horovod_tpu.spark.keras as real_sk
+    assert compat_sk is real_sk
+
+    import horovod.ray as compat_ray
+
+    import horovod_tpu.ray as real_ray
+    assert compat_ray is real_ray
+
+    import horovod.elastic as compat_elastic
+
+    import horovod_tpu.elastic as real_elastic
+    assert compat_elastic is real_elastic
+
+
+def test_top_level_surface():
+    import horovod
+
+    from horovod_tpu.runner.run_api import run as real_run
+    assert horovod.run is real_run
+    # Attribute access routes like imports do.
+    import horovod_tpu.spark
+    assert horovod.spark is horovod_tpu.spark
+
+
+def test_unknown_submodule_raises_cleanly():
+    import pytest
+    with pytest.raises(ImportError):
+        importlib.import_module("horovod.does_not_exist")
