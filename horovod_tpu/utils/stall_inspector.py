@@ -53,6 +53,11 @@ class StallInspector:
 
     def record_done(self, tensor_name: str):
         self._pending.pop(tensor_name, None)
+
+    def has_outstanding(self) -> bool:
+        """Any enqueued-but-unfinished tensors (drives the engine's
+        idle-sleep coarsening)."""
+        return bool(self._pending)
         self._warned.pop(tensor_name, None)
 
     # -- checking (called once per background cycle) -----------------------

@@ -1,0 +1,83 @@
+"""Same-minute A/B: current NormAct ResNet vs an old-style flax-BN ResNet."""
+import time, numpy as np, jax, jax.numpy as jnp, optax
+import flax.linen as nn
+from functools import partial
+from typing import Any, Callable, Tuple
+from horovod_tpu.models.resnet import create_resnet50, resnet_loss_fn, STAGE_SIZES
+
+# --- old-style model (pre-rewrite structure) ---
+class OldBottleneck(nn.Module):
+    filters: int; strides: Tuple[int, int]; norm: Callable; dtype: Any = jnp.bfloat16
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = self.norm()(y); y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False, dtype=self.dtype)(y)
+        y = self.norm()(y); y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides, use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+class OldResNet(nn.Module):
+    dtype: Any = jnp.bfloat16
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x); x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, nb in enumerate(STAGE_SIZES[50]):
+            for j in range(nb):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = OldBottleneck(64 * 2 ** i, strides, norm, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(1000, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+def bench_model(model, loss_fn, tag, batch=128, image=224, steps=30):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, image, image, 3), jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+    bd = {"x": x, "y": y}
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, image, image, 3), np.float32), train=True)
+    params, stats = v["params"], v.get("batch_stats", {})
+    tx = optax.sgd(0.1, momentum=0.9)
+    os_ = tx.init(params)
+    def train_step(p, bs, o, b):
+        def loss(pp):
+            nll, new = loss_fn(model, {"params": pp, "batch_stats": bs}, b)
+            return nll, new.get("batch_stats", bs)
+        (nll, nbs), g = jax.value_and_grad(loss, has_aux=True)(p)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), nbs, o, nll
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    fetch = jax.jit(lambda v: v.astype(jnp.float32))
+    def run(n, p, bs, o):
+        t0 = time.perf_counter()
+        nll = None
+        for _ in range(n):
+            p, bs, o, nll = step(p, bs, o, bd)
+        float(np.asarray(fetch(nll)))
+        return time.perf_counter() - t0, p, bs, o
+    _, params, stats, os_ = run(5, params, stats, os_)
+    t1s, t2s = [], []
+    for _ in range(3):
+        t1, params, stats, os_ = run(steps, params, stats, os_)
+        t2, params, stats, os_ = run(2 * steps, params, stats, os_)
+        t1s.append(t1); t2s.append(t2)
+    dt = min(t2s) - min(t1s)
+    print("%s: %.2f img/s  %.3f ms/step" % (tag, batch * steps / dt, dt / steps * 1e3), flush=True)
+
+def old_loss(model, variables, batch, train=True):
+    logits, new = model.apply(variables, batch["x"], train=True, mutable=["batch_stats"])
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, batch["y"][:, None], axis=1).mean(), new
+
+bench_model(create_resnet50(), resnet_loss_fn, "NormAct(cold)")
+bench_model(create_resnet50(), resnet_loss_fn, "NormAct(hot)")
