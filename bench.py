@@ -181,6 +181,9 @@ def main():
     dt, params, batch_stats, opt_state = measure(
         params, batch_stats, opt_state, windows=2 if on_accel else 1)
     if on_accel:
+        # The chip is hot now: the second instance needs only
+        # compile + a short dispatch warm, not the full burn-in.
+        warmup = 5
         dt2, params, batch_stats, opt_state = measure(
             params, batch_stats, opt_state, windows=3)
         dt = min(dt, dt2)

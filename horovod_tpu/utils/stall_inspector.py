@@ -53,12 +53,12 @@ class StallInspector:
 
     def record_done(self, tensor_name: str):
         self._pending.pop(tensor_name, None)
+        self._warned.pop(tensor_name, None)
 
     def has_outstanding(self) -> bool:
         """Any enqueued-but-unfinished tensors (drives the engine's
         idle-sleep coarsening)."""
         return bool(self._pending)
-        self._warned.pop(tensor_name, None)
 
     # -- checking (called once per background cycle) -----------------------
 
