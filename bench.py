@@ -81,6 +81,7 @@ def main():
                                     # v5e chip
     batch = int(os.environ.get("HVD_TPU_BENCH_BATCH", batch))
     image = 224 if on_accel else 64
+    image = int(os.environ.get("HVD_TPU_BENCH_IMAGE", image))
     steps = 30 if on_accel else 3
     # 60-step warmup: beyond compile, the chip needs a thermal/clock
     # burn-in — same-process A/B shows the first-benched model reads
