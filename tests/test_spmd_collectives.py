@@ -1,0 +1,93 @@
+"""In-program (shard_map) collective matrix for horovod_tpu.jax.spmd —
+the primitives hand-written SPMD steps build on (reference parity: the
+collective matrix of test/parallel/*, here for the jit plane)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.jax import spmd
+
+SIZE = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    if len(devs) < SIZE:
+        pytest.skip("needs %d devices" % SIZE)
+    return Mesh(np.asarray(devs[:SIZE]), (spmd.DEFAULT_AXIS,))
+
+
+def _run(mesh, fn, x, out_specs=P(spmd.DEFAULT_AXIS)):
+    mapped = jax.shard_map(fn, mesh=mesh,
+                           in_specs=P(spmd.DEFAULT_AXIS),
+                           out_specs=out_specs, check_vma=False)
+    return np.asarray(jax.jit(mapped)(x))
+
+
+def test_allreduce_ops(mesh):
+    x = np.arange(SIZE * 3, dtype=np.float32).reshape(SIZE, 3) + 1.0
+    for op, ref in [(spmd.SUM, x.sum(0)), (spmd.AVERAGE, x.mean(0)),
+                    (spmd.MIN, x.min(0)), (spmd.MAX, x.max(0)),
+                    (spmd.PRODUCT, x.prod(0))]:
+        out = _run(mesh, lambda v, op=op: spmd.allreduce(v[0], op)[None],
+                   jnp.asarray(x))
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4,
+                                   err_msg=str(op))
+
+
+def test_allreduce_scales(mesh):
+    x = np.ones((SIZE, 4), np.float32)
+    out = _run(mesh, lambda v: spmd.allreduce(
+        v[0], spmd.SUM, prescale_factor=0.5, postscale_factor=3.0)[None],
+        jnp.asarray(x))
+    np.testing.assert_allclose(out[0], SIZE * 0.5 * 3.0)
+
+
+def test_rank_size_allgather_broadcast(mesh):
+    x = np.tile(np.arange(SIZE, dtype=np.float32)[:, None], (1, 2))
+
+    def fn(v):
+        r = spmd.rank()
+        n = spmd.size()
+        g = spmd.allgather(v)          # [SIZE, 2]
+        b = spmd.broadcast(v, root_rank=3)
+        return (g + 0.0 * r + 0.0 * n)[None], b[None]
+
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=P(spmd.DEFAULT_AXIS),
+                           out_specs=(P(spmd.DEFAULT_AXIS),
+                                      P(spmd.DEFAULT_AXIS)),
+                           check_vma=False)
+    g, b = jax.jit(mapped)(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g)[0], x)
+    np.testing.assert_allclose(np.asarray(b)[:, 0, :],
+                               np.tile(x[3], (SIZE, 1)))
+
+
+def test_alltoall_and_reducescatter(mesh):
+    x = np.arange(SIZE * SIZE, dtype=np.float32).reshape(SIZE, SIZE)
+
+    def fn(v):
+        a2a = spmd.alltoall(v[0][:, None])       # [SIZE, 1]
+        rs = spmd.reducescatter(v[0][:, None], op=spmd.SUM)
+        return a2a[None], rs[None]
+
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=P(spmd.DEFAULT_AXIS),
+                           out_specs=(P(spmd.DEFAULT_AXIS),
+                                      P(spmd.DEFAULT_AXIS)),
+                           check_vma=False)
+    a2a, rs = jax.jit(mapped)(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(a2a)[..., 0], x.T)
+    # reducescatter row r = sum over ranks of their r-th element.
+    np.testing.assert_allclose(np.asarray(rs)[:, 0, 0], x.sum(0))
+
+
+def test_ppermute_ring(mesh):
+    x = np.arange(SIZE, dtype=np.float32)[:, None]
+    perm = [(i, (i + 1) % SIZE) for i in range(SIZE)]
+    out = _run(mesh, lambda v: spmd.ppermute(v, perm), jnp.asarray(x))
+    np.testing.assert_allclose(out[:, 0], np.roll(x[:, 0], 1))
