@@ -284,7 +284,13 @@ void CoreState::BackgroundLoop() {
     std::vector<bool> bits(cache_.size(), false);
     for (auto& q : queue_.DrainNewRequests()) {
       int32_t id;
+      // Grouped members never ride the cache-bit path: the group-
+      // atomicity barrier lives in the coordinator's pending table, so
+      // a cached member would complete solo while its cache-missing
+      // groupmates wait on it forever (group membership can change
+      // between calls that reuse names).
       if (q.op_type != OpType::BARRIER &&
+          groups_.GroupOf(q.name) < 0 &&
           cache_.LookupMatching(q, &id)) {
         if (static_cast<size_t>(id) >= bits.size())
           bits.resize(static_cast<size_t>(id) + 1, false);
@@ -320,6 +326,10 @@ void CoreState::BackgroundLoop() {
       if (!r.error && !r.join_rewrite &&
           ResponseCache::Cacheable(r.op_type)) {
         for (size_t i = 0; i < r.tensor_names.size(); ++i) {
+          // Grouped members are uncacheable (see the drain loop above);
+          // their records are still live here — RemoveName runs at
+          // completion, after this Put pass.
+          if (groups_.GroupOf(r.tensor_names[i]) >= 0) continue;
           Request q;
           auto e = queue_.Lookup(r.tensor_names[i]);
           if (e) {

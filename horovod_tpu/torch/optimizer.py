@@ -13,6 +13,7 @@ k-th backward triggers communication) and gradient compression.
 from __future__ import annotations
 
 import contextlib
+import itertools
 from typing import Dict, Iterator, Optional, Tuple
 
 import torch
@@ -23,6 +24,12 @@ from ..common import basics
 from .compression import Compression
 
 
+# Constructed in the same program order on every rank, so the instance
+# index is cross-rank deterministic and keeps concurrently active
+# optimizers' group names from colliding in the tensor queue.
+_instance_ids = itertools.count()
+
+
 class _DistributedOptimizer:
     def __init__(self, optimizer: torch.optim.Optimizer,
                  named_parameters=None,
@@ -30,14 +37,37 @@ class _DistributedOptimizer:
                  backward_passes_per_step: int = 1,
                  op=AVERAGE,
                  gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0,
+                 groups=None,
+                 sparse_as_dense: bool = False,
                  process_set=None):
         self._opt = optimizer
         self._compression = compression
         self._op = op
         self._process_set = process_set
         self._predivide = gradient_predivide_factor
+        self._prescale = 1.0 / gradient_predivide_factor \
+            if gradient_predivide_factor != 1.0 else 1.0
+        self._postscale = gradient_predivide_factor \
+            if gradient_predivide_factor != 1.0 else 1.0
+        self._instance_id = next(_instance_ids)
+        self._sparse_as_dense = sparse_as_dense
         self.backward_passes_per_step = backward_passes_per_step
         self._require_sync = True
+
+        # Reference surface: ``groups`` is an int (same as num_groups)
+        # or an explicit list of parameter lists; params outside any
+        # explicit group keep their individual allreduce.
+        if isinstance(groups, int):
+            num_groups, groups = groups, None
+        elif groups is not None and not isinstance(groups, (list, tuple)):
+            raise ValueError(
+                "groups must be an int or a list of parameter lists")
+        self._num_groups = num_groups
+        self._explicit_groups = groups
+        self._group_of: Dict[torch.Tensor, int] = {}
+        self._group_members: Dict[int, list] = {}
+        self._group_ready: Dict[int, list] = {}
 
         if named_parameters is not None:
             named = list(named_parameters)
@@ -54,6 +84,7 @@ class _DistributedOptimizer:
         self._hook_handles = []
         if basics.size() > 1:
             self._register_hooks()
+            self._assign_groups()
 
     # -- reference surface -------------------------------------------------
 
@@ -77,36 +108,121 @@ class _DistributedOptimizer:
                         p.register_post_accumulate_grad_hook(
                             self._make_hook()))
 
+    def _assign_groups(self):
+        """Partition hooked params into grouped-allreduce buckets
+        (reference ``num_groups``/``groups``: group members negotiate
+        and fuse atomically via ``hvd.grouped_allreduce``)."""
+        hooked = [p for group in self._opt.param_groups
+                  for p in group["params"] if p.requires_grad]
+        if self._explicit_groups is not None:
+            hooked_ids = {id(p) for p in hooked}
+            seen = set()
+            for gid, members in enumerate(self._explicit_groups):
+                for p in members:
+                    if id(p) in seen:
+                        raise ValueError(
+                            "parameter appears in more than one group")
+                    seen.add(id(p))
+                    if not p.requires_grad:
+                        continue
+                    if id(p) not in hooked_ids:
+                        # A member with no hook would keep its group from
+                        # ever completing during backward.
+                        raise ValueError(
+                            "groups contains a parameter that is not in "
+                            "this optimizer's param_groups")
+                    self._group_of[p] = gid
+                    self._group_members.setdefault(gid, []).append(p)
+        elif self._num_groups > 0:
+            n = min(self._num_groups, len(hooked)) or 1
+            size, rem = divmod(len(hooked), n)
+            start = 0
+            for gid in range(n):
+                stop = start + size + (1 if gid < rem else 0)
+                for p in hooked[start:stop]:
+                    self._group_of[p] = gid
+                    self._group_members.setdefault(gid, []).append(p)
+                start = stop
+
     def _make_hook(self):
         def hook(p: torch.Tensor):
             self._passes[p] = self._passes.get(p, 0) + 1
             if self._passes[p] < self.backward_passes_per_step:
                 return
             self._passes[p] = 0
-            self._allreduce_grad_async(p)
+            gid = self._group_of.get(p)
+            if gid is None:
+                self._allreduce_grad_async(p)
+                return
+            ready = self._group_ready.setdefault(gid, [])
+            if p in self._handles or any(p is q for q in ready):
+                raise AssertionError(
+                    "gradient for a grouped parameter produced twice "
+                    "without step()/synchronize()")
+            ready.append(p)
+            if len(ready) == len(self._group_members[gid]):
+                self._fire_group(gid)
         return hook
+
+    def _prepare_grad(self, p: torch.Tensor) -> torch.Tensor:
+        grad = p.grad
+        if grad.is_sparse:
+            if not self._sparse_as_dense:
+                raise ValueError(
+                    "sparse gradients need "
+                    "DistributedOptimizer(sparse_as_dense=True); dense "
+                    "allreduce is the only wire format")
+            grad = grad.coalesce().to_dense()
+        if self.backward_passes_per_step > 1:
+            grad = grad / float(self.backward_passes_per_step)
+        return grad
 
     def _allreduce_grad_async(self, p: torch.Tensor):
         name = "DistributedOptimizer.gradient/%s" % \
             self._param_names.get(p, "param%d" % id(p))
-        grad = p.grad
-        if self.backward_passes_per_step > 1:
-            grad = grad / float(self.backward_passes_per_step)
-        wire, ctx = self._compression.compress(grad)
-        prescale = 1.0 / self._predivide if self._predivide != 1.0 else 1.0
-        postscale = self._predivide if self._predivide != 1.0 else 1.0
+        wire, ctx = self._compression.compress(self._prepare_grad(p))
         self._grad_ctx[p] = ctx
         self._handles[p] = mpi_ops.allreduce_async(
-            wire, name=name, op=self._op, prescale_factor=prescale,
-            postscale_factor=postscale, process_set=self._process_set)
+            wire, name=name, op=self._op, prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+            process_set=self._process_set)
+
+    def _fire_group(self, gid: int):
+        params = self._group_ready.pop(gid, [])
+        if not params:
+            return
+        # Wire order must match across ranks; hook order is autograd-
+        # dependent, so sort by the cross-rank-deterministic name.
+        params.sort(key=lambda p: self._param_names.get(p, ""))
+        wires = []
+        for p in params:
+            wire, ctx = self._compression.compress(self._prepare_grad(p))
+            self._grad_ctx[p] = ctx
+            wires.append(wire)
+        handles = mpi_ops.grouped_allreduce_async(
+            wires,
+            name="DistributedOptimizer.o%d.group%d"
+                 % (self._instance_id, gid),
+            op=self._op, prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+            process_set=self._process_set)
+        for p, h in zip(params, handles):
+            self._handles[p] = h
 
     def synchronize(self):
         """Wait for every outstanding gradient allreduce and install the
         results (reference ``optimizer.synchronize()``)."""
+        # Groups left incomplete (frozen params, conditional branches)
+        # still fire over whichever members produced gradients.
+        for gid in list(self._group_ready):
+            self._fire_group(gid)
         for p, handle in list(self._handles.items()):
             out = handle.wait()
             out = self._compression.decompress(out, self._grad_ctx.get(p))
-            p.grad.data.copy_(out.reshape(p.grad.shape))
+            if p.grad.is_sparse:
+                p.grad = out.reshape(p.grad.shape)
+            else:
+                p.grad.data.copy_(out.reshape(p.grad.shape))
         self._handles.clear()
         self._grad_ctx.clear()
         self._synchronized = True
@@ -129,10 +245,11 @@ class _DistributedOptimizer:
         return self._opt.step(closure)
 
     def zero_grad(self, *args, **kwargs):
-        if self._handles:
+        if self._handles or any(self._group_ready.values()):
             raise AssertionError(
-                "zero_grad called with outstanding gradient allreduces; "
-                "call optimizer.step() or synchronize() first")
+                "zero_grad called with outstanding gradient allreduces "
+                "(or partially-ready grouped buckets); call "
+                "optimizer.step() or synchronize() first")
         return self._opt.zero_grad(*args, **kwargs)
 
     def state_dict(self):
@@ -165,10 +282,13 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op=AVERAGE,
                          gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0,
+                         groups=None,
+                         sparse_as_dense: bool = False,
                          process_set=None) -> _DistributedOptimizer:
     """Wrap a torch optimizer for data-parallel training (reference
     ``hvd.DistributedOptimizer``)."""
     return _DistributedOptimizer(
         optimizer, named_parameters, compression,
         backward_passes_per_step, op, gradient_predivide_factor,
-        process_set)
+        num_groups, groups, sparse_as_dense, process_set)

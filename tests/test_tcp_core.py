@@ -31,6 +31,13 @@ def test_tcp_response_cache_fast_path():
     _assert_ok(_spawn_world(2, "cache"))
 
 
+def test_tcp_group_name_reuse_changed_membership():
+    # Regression: reusing a grouped_allreduce name with different member
+    # count/shapes deadlocked — cached members bypassed the group
+    # barrier while the shape-changed member waited in pending forever.
+    _assert_ok(_spawn_world(2, "regroup"))
+
+
 def test_tcp_join_uneven_data():
     _assert_ok(_spawn_world(3, "join"))
 

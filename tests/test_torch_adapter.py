@@ -180,6 +180,18 @@ hvd.shutdown()
 WORKER = os.path.join(REPO, "tests", "utils", "torch_adapter_worker.py")
 
 
+GROUPED_WORKER = os.path.join(REPO, "tests", "utils",
+                              "torch_grouped_worker.py")
+
+
+def test_multirank_grouped_and_sparse_optimizer():
+    # num_groups buckets (grouped_allreduce negotiation), explicit
+    # groups with ungrouped leftovers, and sparse_as_dense embedding
+    # grads, all against a recomputed world-mean oracle.
+    from tests.utils.spawn import spawn_world, assert_world_ok
+    assert_world_ok(spawn_world(GROUPED_WORKER, 2), "TORCH_GROUPED_OK")
+
+
 @pytest.mark.parametrize("size", [2, 4])
 def test_multirank_optimizer_broadcast_compression(size):
     # Real N-process world: DistributedOptimizer averaging (differs from

@@ -30,6 +30,8 @@ def main():
             run_cache(core, rank, size)
         if scenario == "big_allgather":
             run_big_allgather(core, rank, size)
+        if scenario == "regroup":
+            run_regroup(core, rank, size)
         if scenario == "autotune":
             run_autotune(core, rank, size)
         if scenario == "join":
@@ -132,6 +134,34 @@ def run_cache(core, rank, size):
         h1, m1 = core.cache_stats()
         assert h1 - h0 >= 5, (h0, h1)
         assert m1 == m0, (m0, m1)
+
+
+def run_regroup(core, rank, size):
+    # Group-name reuse with changed membership/shapes: grouped members
+    # must not ride the response-cache bit path — a cached member would
+    # complete solo while cache-missing groupmates wait on the group
+    # barrier forever (the r3 deadlock this scenario regression-tests).
+    def grouped(tensors):
+        names = ["g.%d" % i for i in range(len(tensors))]
+        core.register_group(names)
+        hs = [core.allreduce_async(t, n) for t, n in zip(tensors, names)]
+        return [h.wait(timeout=30) for h in hs]
+
+    outs = grouped([np.ones(8, np.float32), np.ones((8, 4), np.float32),
+                    np.ones((3, 8), np.float32)])
+    for o in outs:
+        np.testing.assert_allclose(o, float(size))
+    # Same base name, fewer members, g.1 changes shape entirely.
+    outs = grouped([np.ones(8, np.float32) * 2,
+                    np.ones((2,), np.float32) * 2])
+    for o in outs:
+        np.testing.assert_allclose(o, 2.0 * size)
+    # Steady-state reuse with identical layout still completes (grouped
+    # names stay uncacheable; correctness over the bit path).
+    for _ in range(3):
+        outs = grouped([np.ones(8, np.float32), np.ones((2,), np.float32)])
+        for o in outs:
+            np.testing.assert_allclose(o, float(size))
 
 
 def run_autotune(core, rank, size):
