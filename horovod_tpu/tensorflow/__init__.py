@@ -39,8 +39,10 @@ from .functions import (allgather_object, broadcast_object,
 from .gradient_aggregation import LocalGradientAggregationHelper
 from .mpi_ops import (allgather, allgather_async, allreduce,
                       allreduce_async, alltoall, barrier, broadcast,
-                      broadcast_async, grouped_allreduce, join, poll,
-                      reducescatter, synchronize)
+                      broadcast_async, grouped_allreduce, join,
+                      local_rank_op, local_size_op, poll,
+                      process_set_included_op, rank_op, reducescatter,
+                      size_op, synchronize)
 
 Sum = SUM
 Average = AVERAGE

@@ -27,6 +27,8 @@ __all__ = [
     "alltoall", "reducescatter", "barrier", "join",
     "allreduce_async", "allgather_async", "broadcast_async",
     "synchronize", "poll",
+    "size_op", "local_size_op", "rank_op", "local_rank_op",
+    "process_set_included_op",
 ]
 
 
@@ -460,3 +462,51 @@ def barrier(process_set=None):
 
 def join(device=None) -> int:
     return _api.join(device)
+
+
+# -- graph-mode world-info ops ---------------------------------------------
+# Reference: size_op/local_size_op/rank_op/local_rank_op/
+# process_set_included_op in horovod/tensorflow/mpi_ops.py — tensors
+# read at EXECUTION time, so a tf.function traced once keeps seeing the
+# current world across elastic re-initialization without retracing.
+
+def _world_read_op(read, name):
+    def _read():
+        return np.int32(read())
+    out = tf.py_function(_read, [], tf.int32, name=name)
+    out.set_shape([])
+    return out
+
+
+def size_op(process_set_id: int = 0, name: Optional[str] = None):
+    """Current world (or process-set) size as a graph tensor."""
+    from ..common.process_sets import process_set_by_id
+    return _world_read_op(
+        lambda: process_set_by_id(process_set_id).size(),
+        name or "HorovodSize")
+
+
+def local_size_op(name: Optional[str] = None):
+    from ..common import basics
+    return _world_read_op(basics.local_size, name or "HorovodLocalSize")
+
+
+def rank_op(name: Optional[str] = None):
+    from ..common import basics
+    return _world_read_op(basics.rank, name or "HorovodRank")
+
+
+def local_rank_op(name: Optional[str] = None):
+    from ..common import basics
+    return _world_read_op(basics.local_rank, name or "HorovodLocalRank")
+
+
+def process_set_included_op(process_set_id: int = 0,
+                            name: Optional[str] = None):
+    """1 when this rank belongs to the process set, else 0 (graph
+    tensor, execution-time read; uninitialized worlds raise like the
+    sibling ops)."""
+    from ..common.process_sets import process_set_by_id
+    return _world_read_op(
+        lambda: 1 if process_set_by_id(process_set_id).included() else 0,
+        name or "HorovodProcessSetIncluded")

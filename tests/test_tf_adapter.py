@@ -40,6 +40,32 @@ def test_size1_collectives(hvd):
     hvd.barrier()
 
 
+def test_world_info_ops(hvd):
+    # Graph-mode world-info tensors (reference size_op/rank_op/...):
+    # values are read at EXECUTION time inside tf.function, so elastic
+    # re-inits show through without retracing.
+    assert int(hvd.size_op()) == 1
+    assert int(hvd.rank_op()) == 0
+    assert int(hvd.local_size_op()) == 1
+    assert int(hvd.local_rank_op()) == 0
+    assert int(hvd.process_set_included_op()) == 1
+
+    @tf.function
+    def scaled(x):
+        return x * tf.cast(hvd.size_op(), tf.float32) \
+            + tf.cast(hvd.rank_op(), tf.float32)
+
+    out = scaled(tf.constant([2.0]))
+    assert np.allclose(out.numpy(), [2.0])
+    ps = hvd.ProcessSet([0])
+    hvd.add_process_set(ps)
+    try:
+        assert int(hvd.size_op(ps.process_set_id)) == 1
+        assert int(hvd.process_set_included_op(ps.process_set_id)) == 1
+    finally:
+        hvd.remove_process_set(ps)
+
+
 def test_bfloat16_wire(hvd):
     t = tf.cast(tf.reshape(tf.range(8, dtype=tf.float32), (2, 4)),
                 tf.bfloat16)
