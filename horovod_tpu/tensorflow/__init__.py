@@ -39,7 +39,8 @@ from .functions import (allgather_object, broadcast_object,
 from .gradient_aggregation import LocalGradientAggregationHelper
 from .mpi_ops import (allgather, allgather_async, allreduce,
                       allreduce_async, alltoall, barrier, broadcast,
-                      broadcast_async, grouped_allreduce, join,
+                      broadcast_async, grouped_allreduce,
+                      grouped_allreduce_async, join,
                       local_rank_op, local_size_op, poll,
                       process_set_included_op, rank_op, reducescatter,
                       size_op, synchronize)
@@ -67,38 +68,110 @@ def _densify(grad):
 
 
 def _make_allreduce_grads_fn(name_prefix: str, op, compression,
-                             process_set):
-    def allreduce_grads(grads):
+                             process_set, num_groups: int = 0,
+                             groups=None):
+    """Build the per-gradient reduce function.
+
+    ``num_groups``/``groups`` mirror the reference TF surface: an int
+    buckets the gradients into that many atomic ``grouped_allreduce``
+    calls; a list of variable lists groups explicitly (matched against
+    the ``variables`` the caller passes), with leftovers reduced
+    individually.
+    """
+    if isinstance(groups, int):
+        num_groups, groups = groups, None
+    elif groups is not None and not isinstance(groups, (list, tuple)):
+        raise ValueError("groups must be an int or a list of variable "
+                         "lists")
+    explicit_gid = None
+    if groups is not None:
+        explicit_gid = {}
+        for gid, members in enumerate(groups):
+            for v in members:
+                key = v.ref() if hasattr(v, "ref") else id(v)
+                if key in explicit_gid:
+                    raise ValueError(
+                        "variable appears in more than one group")
+                explicit_gid[key] = gid
+
+    def allreduce_grads(grads, variables=None):
         grads = [None if g is None else _densify(g) for g in grads]
-        if any(g is not None and tf.is_symbolic_tensor(g)
-               for g in grads):
-            # traced inside tf.function: stage per-tensor through the
-            # differentiable py_function path
-            out = []
-            for i, g in enumerate(grads):
-                if g is None:
-                    out.append(None)
-                    continue
-                c, ctx = compression.compress(g)
+        live = [i for i, g in enumerate(grads) if g is not None]
+        buckets = {}
+        grouped = set()
+        for pos, i in enumerate(live):
+            if explicit_gid is not None:
+                gid = None
+                if variables is not None and i < len(variables) and \
+                        variables[i] is not None:
+                    v = variables[i]
+                    gid = explicit_gid.get(v.ref() if hasattr(v, "ref")
+                                           else id(v))
+            elif num_groups > 0:
+                n = min(num_groups, len(live)) or 1
+                gid = pos * n // len(live)
+            else:
+                gid = None
+            if gid is not None:
+                buckets.setdefault(gid, []).append(i)
+                grouped.add(i)
+        if explicit_gid is not None and live and not grouped:
+            # Mirror the aggregation-boundary ValueError: a requested
+            # explicit grouping that matches nothing must not silently
+            # degrade to per-tensor reduces.
+            raise ValueError(
+                "none of the explicit groups' variables matched this "
+                "call's sources/trainable_variables; pass the same "
+                "variable objects, or use an integer num_groups")
+        out = [None] * len(grads)
+        singles = [i for i in live if i not in grouped]
+        symbolic = any(g is not None and tf.is_symbolic_tensor(g)
+                       for g in grads)
+
+        def compress_bucket(idxs):
+            wires, ctxs = [], []
+            for i in idxs:
+                c, ctx = compression.compress(grads[i])
+                wires.append(c)
+                ctxs.append(ctx)
+            return wires, ctxs
+
+        if symbolic:
+            # traced inside tf.function: stage buckets and singles
+            # through the py_function paths
+            for gid in sorted(buckets):
+                idxs = buckets[gid]
+                wires, ctxs = compress_bucket(idxs)
+                rs = grouped_allreduce(
+                    wires, op=op, process_set=process_set,
+                    name="%s.group_%d" % (name_prefix, gid))
+                for i, r, ctx in zip(idxs, rs, ctxs):
+                    out[i] = compression.decompress(r, ctx)
+            for i in singles:
+                c, ctx = compression.compress(grads[i])
                 r = allreduce(c, op=op, process_set=process_set,
                               name="%s.grad_%d" % (name_prefix, i))
-                out.append(compression.decompress(r, ctx))
+                out[i] = compression.decompress(r, ctx)
             return out
-        # eager: submit every allreduce before waiting on any, so
-        # negotiation/transfer of all gradients overlap (the reference's
-        # async enqueue + single synchronize pattern)
+        # eager: submit every bucket and single allreduce before waiting
+        # on any, so negotiation/transfer of all gradients overlap (the
+        # reference's async enqueue + single synchronize pattern)
         pending = []
-        for i, g in enumerate(grads):
-            if g is None:
-                pending.append((None, None))
-                continue
-            c, ctx = compression.compress(g)
+        for gid in sorted(buckets):
+            idxs = buckets[gid]
+            wires, ctxs = compress_bucket(idxs)
+            hs = grouped_allreduce_async(
+                wires, op=op, process_set=process_set,
+                name="%s.group_%d" % (name_prefix, gid))
+            pending.extend(zip(idxs, hs, ctxs))
+        for i in singles:
+            c, ctx = compression.compress(grads[i])
             h = allreduce_async(c, op=op, process_set=process_set,
                                 name="%s.grad_%d" % (name_prefix, i))
-            pending.append((h, ctx))
-        return [None if h is None else compression.decompress(h.wait(),
-                                                              ctx)
-                for h, ctx in pending]
+            pending.append((i, h, ctx))
+        for i, h, ctx in pending:
+            out[i] = compression.decompress(h.wait(), ctx)
+        return out
     return allreduce_grads
 
 
@@ -108,14 +181,16 @@ class _DistributedGradientTape:
 
     def __init__(self, tape: tf.GradientTape, device_dense="",
                  device_sparse="", compression=Compression.none,
-                 sparse_as_dense=True, op=AVERAGE, process_set=None):
+                 sparse_as_dense=True, op=AVERAGE, process_set=None,
+                 num_groups: int = 0, groups=None):
         # No backward_passes_per_step here: the tape API has no way to
         # tell the caller to skip an optimizer update on non-boundary
         # passes, so local aggregation lives on DistributedOptimizer
         # only — same split as the reference.
         self._tape = tape
         self._allreduce_grads = _make_allreduce_grads_fn(
-            "DistributedGradientTape", op, compression, process_set)
+            "DistributedGradientTape", op, compression, process_set,
+            num_groups, groups)
 
     def __enter__(self):
         self._tape.__enter__()
@@ -131,7 +206,9 @@ class _DistributedGradientTape:
         grads = self._tape.gradient(target, sources, output_gradients)
         single = not isinstance(grads, (list, tuple))
         glist = [grads] if single else list(grads)
-        glist = self._allreduce_grads(glist)
+        vlist = [sources] if single else (
+            list(sources) if isinstance(sources, (list, tuple)) else None)
+        glist = self._allreduce_grads(glist, vlist)
         return glist[0] if single else glist
 
 
@@ -144,7 +221,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          sparse_as_dense: bool = True, op=AVERAGE,
                          process_set=None,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = True):
+                         average_aggregated_gradients: bool = True,
+                         num_groups: int = 0, groups=None):
     """Wrap a Keras optimizer so every ``apply``/``apply_gradients``
     first averages gradients across ranks (reference
     ``hvd.DistributedOptimizer`` for tf.keras).
@@ -154,7 +232,14 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     genuine Keras optimizer usable in ``model.compile``.
     """
     allreduce_grads = _make_allreduce_grads_fn(
-        name or "DistributedOptimizer", op, compression, process_set)
+        name or "DistributedOptimizer", op, compression, process_set,
+        num_groups, groups)
+    if isinstance(groups, (list, tuple)) and backward_passes_per_step > 1:
+        # The aggregation helper reduces without variable identities, so
+        # explicit variable groups cannot be matched on its boundary.
+        raise ValueError(
+            "explicit variable groups cannot be combined with "
+            "backward_passes_per_step > 1; use an integer num_groups")
     agg = LocalGradientAggregationHelper(
         backward_passes_per_step, allreduce_grads,
         average_aggregated_gradients) \
@@ -172,7 +257,7 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 if not should:
                     return
             else:
-                grads = allreduce_grads(grads)
+                grads = allreduce_grads(grads, trainable_variables)
             return super().apply(grads, trainable_variables, **kw)
 
     _DistributedKerasOptimizer.__name__ = "Distributed" + cls.__name__

@@ -25,8 +25,8 @@ from ..ops.xla_ops import AVERAGE, SUM
 __all__ = [
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "barrier", "join",
-    "allreduce_async", "allgather_async", "broadcast_async",
-    "synchronize", "poll",
+    "allreduce_async", "grouped_allreduce_async", "allgather_async",
+    "broadcast_async", "synchronize", "poll",
     "size_op", "local_size_op", "rank_op", "local_rank_op",
     "process_set_included_op",
 ]
@@ -179,6 +179,20 @@ def _grouped_allreduce_eager(tensors: List, average, name, op,
         [_np_view(t) for t in tensors], average, name, op,
         prescale_factor, postscale_factor, process_set)
     return [TFHandle(h, like=t).wait() for h, t in zip(hs, tensors)]
+
+
+def grouped_allreduce_async(tensors: Sequence, average=None,
+                            name: Optional[str] = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set=None) -> List[TFHandle]:
+    """Async grouped allreduce (eager tensors only; graph mode stages
+    through ``grouped_allreduce``)."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    hs = _api.grouped_allreduce_async(
+        [_np_view(t) for t in tensors], average, name, op,
+        prescale_factor, postscale_factor, process_set)
+    return [TFHandle(h, like=t) for h, t in zip(hs, tensors)]
 
 
 def grouped_allreduce(tensors: Sequence, average=None,

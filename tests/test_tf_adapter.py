@@ -110,6 +110,43 @@ def test_local_gradient_aggregation(hvd):
     assert np.allclose(grads[0].numpy(), [3.0])
 
 
+def test_grouped_gradient_paths(hvd):
+    # num_groups through the tape inside tf.function (symbolic grouped
+    # staging) matches ungrouped values.
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0], [4.0]])
+    v3 = tf.Variable(5.0)
+
+    @tf.function
+    def step():
+        with hvd.DistributedGradientTape(tf.GradientTape(),
+                                         num_groups=2) as tape:
+            loss = tf.reduce_sum(v1) * v3 + tf.reduce_sum(v2)
+        return tape.gradient(loss, [v1, v2, v3])
+
+    g1, g2, g3 = step()
+    assert np.allclose(g1.numpy(), [5.0, 5.0])
+    assert np.allclose(g2.numpy(), [[1.0], [1.0]])
+    assert np.allclose(g3.numpy(), 3.0)
+
+    # Keras optimizer with num_groups still trains.
+    from tensorflow import keras
+    model = keras.Sequential([keras.layers.Dense(2, input_shape=(3,))])
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1), num_groups=2)
+    x = tf.ones((4, 3))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean(model(x) ** 2)
+    grads = tape.gradient(loss, model.trainable_variables)
+    opt.apply(grads, model.trainable_variables)
+
+    # Explicit variable groups + local aggregation cannot be matched.
+    with pytest.raises(ValueError, match="num_groups"):
+        hvd.DistributedOptimizer(
+            keras.optimizers.SGD(), groups=[model.trainable_variables],
+            backward_passes_per_step=2)
+
+
 def test_compression_fp16(hvd):
     t = tf.constant([1.5, 2.5], dtype=tf.float32)
     c, ctx = hvd.Compression.fp16.compress(t)

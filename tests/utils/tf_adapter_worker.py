@@ -55,6 +55,31 @@ def run_tape(rank, size):
             "rank %d: tape grads identical to local grads" % rank
 
 
+def run_grouped_tape(rank, size):
+    # num_groups buckets the tape's gradients into atomic
+    # grouped_allreduce calls; values must match the ungrouped world
+    # mean exactly.
+    w, b = make_weights(seed=7)
+    with hvd.DistributedGradientTape(tf.GradientTape(),
+                                     num_groups=2) as tape:
+        loss = tf.reduce_mean(tf.square(rank_x(rank) @ w + b))
+    gw, gb = tape.gradient(loss, [w, b])
+    per_rank = [local_grads_np(w, b, rank_x(r)) for r in range(size)]
+    assert np.allclose(gw.numpy(),
+                       np.mean([g[0] for g in per_rank], axis=0),
+                       atol=1e-5)
+    assert np.allclose(gb.numpy(),
+                       np.mean([g[1] for g in per_rank], axis=0),
+                       atol=1e-5)
+    # Explicit variable groups: w grouped (singleton), b individual.
+    with hvd.DistributedGradientTape(tf.GradientTape(),
+                                     groups=[[w]]) as tape:
+        loss = tf.reduce_mean(tf.square(rank_x(rank) @ w + b))
+    gw2, gb2 = tape.gradient(loss, [w, b])
+    assert np.allclose(gw2.numpy(), gw.numpy(), atol=1e-6)
+    assert np.allclose(gb2.numpy(), gb.numpy(), atol=1e-6)
+
+
 def run_broadcast(rank, size):
     w, b = make_weights(seed=300 + rank)
     hvd.broadcast_variables([w, b], root_rank=0)
@@ -163,6 +188,7 @@ def main():
             run_xla_ops(rank, size)
         else:
             run_tape(rank, size)
+            run_grouped_tape(rank, size)
             run_broadcast(rank, size)
             run_optimizer(rank, size)
             run_compression(rank, size)
