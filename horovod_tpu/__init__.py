@@ -12,33 +12,61 @@ NCCL/MPI/Gloo.  Usage mirrors the reference::
     avg = hvd.allreduce(grads, op=hvd.Average)
 
 See SURVEY.md for the architecture map against the reference tree.
+
+The top-level namespace resolves lazily (PEP 562), like the reference's
+slim ``horovod/__init__.py``: importing the package must not pull jax,
+so launcher-only hosts (``python -m horovod_tpu.runner``, including
+``--check-build`` on a machine without any framework) work framework-
+free.
 """
 
-from .common.basics import (init, shutdown, is_initialized, rank, size,
-                            local_rank, local_size, cross_rank, cross_size,
-                            is_homogeneous, topology, start_timeline,
-                            stop_timeline, xla_built, tcp_built, gloo_built,
-                            mpi_built, nccl_built, ccl_built, ddl_built,
-                            cuda_built, rocm_built, mpi_enabled,
-                            mpi_threads_supported, register_backend)
-from .ops.op_manager import CollectiveBackend, OpRequest
-from .common.process_sets import (ProcessSet, global_process_set,
-                                  add_process_set, remove_process_set,
-                                  process_set_by_id, process_set_ids)
-from .ops.api import (SUM, AVERAGE, MIN, MAX, PRODUCT, ADASUM,
-                      allreduce, allreduce_async, grouped_allreduce,
-                      grouped_allreduce_async, allgather, allgather_async,
-                      broadcast, broadcast_async, alltoall, alltoall_async,
-                      reducescatter, reducescatter_async, barrier, join,
-                      synchronize, poll)
-from .ops.engine import CollectiveHandle, HorovodInternalError
+__version__ = "0.1.0"
+
+# name -> (module, attr); attr None re-exports the symbol name itself.
+_EXPORTS = {}
+for _mod, _names in (
+    (".common.basics",
+     ("init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+      "local_size", "cross_rank", "cross_size", "is_homogeneous",
+      "topology", "start_timeline", "stop_timeline", "xla_built",
+      "tcp_built", "gloo_built", "mpi_built", "nccl_built", "ccl_built",
+      "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
+      "mpi_threads_supported", "register_backend")),
+    (".ops.op_manager", ("CollectiveBackend", "OpRequest")),
+    (".common.process_sets",
+     ("ProcessSet", "global_process_set", "add_process_set",
+      "remove_process_set", "process_set_by_id", "process_set_ids")),
+    (".ops.api",
+     ("SUM", "AVERAGE", "MIN", "MAX", "PRODUCT", "ADASUM", "allreduce",
+      "allreduce_async", "grouped_allreduce", "grouped_allreduce_async",
+      "allgather", "allgather_async", "broadcast", "broadcast_async",
+      "alltoall", "alltoall_async", "reducescatter",
+      "reducescatter_async", "barrier", "join", "synchronize", "poll")),
+    (".ops.engine", ("CollectiveHandle", "HorovodInternalError")),
+):
+    for _n in _names:
+        _EXPORTS[_n] = (_mod, _n)
 
 # Reference-style aliases (horovod exposes mpi_ops.Sum etc. as hvd.Sum).
-Sum = SUM
-Average = AVERAGE
-Min = MIN
-Max = MAX
-Product = PRODUCT
-Adasum = ADASUM
+for _alias, _target in (("Sum", "SUM"), ("Average", "AVERAGE"),
+                        ("Min", "MIN"), ("Max", "MAX"),
+                        ("Product", "PRODUCT"), ("Adasum", "ADASUM")):
+    _EXPORTS[_alias] = (".ops.api", _target)
 
-__version__ = "0.1.0"
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            "module %r has no attribute %r" % (__name__, name)) from None
+    import importlib
+    value = getattr(importlib.import_module(mod_name, __name__), attr)
+    globals()[name] = value  # cache: subsequent lookups skip __getattr__
+    return value
+
+
+def __dir__():
+    return __all__

@@ -68,10 +68,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--tpu-discovery-slots", type=int, default=1,
                    help="worker slots per TPU host (default 1)")
     p.add_argument("--elastic-timeout", type=float, default=600.0)
+    p.add_argument("--check-build", action="store_true",
+                   help="print the build feature matrix and exit "
+                        "(reference: horovodrun --check-build)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command line")
     args = p.parse_args(argv)
-    if not args.command:
+    if not args.command and not args.check_build:
         p.error("no worker command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
@@ -225,8 +228,58 @@ def gloo_run(args, hosts: List[util.HostInfo],
         server.stop()
 
 
+def check_build(out=None) -> int:
+    """Print the build feature matrix (reference ``horovodrun
+    --check-build``: frameworks / controllers / tensor operations,
+    ``[X]`` present, ``[ ]`` absent-by-design)."""
+    out = out if out is not None else sys.stdout
+    from .. import __version__
+
+    def probe(mod):
+        try:
+            __import__(mod)
+            return True
+        except Exception:  # noqa: BLE001 - any import failure = absent
+            return False
+
+    # basics imports jax-free (its jax uses are function-level), so the
+    # probe stays the single source of truth with hvd.tcp_built().
+    from ..common.basics import tcp_built
+    tcp = tcp_built()
+    have_jax = probe("jax")
+
+    def row(flag, label):
+        return "    [%s] %s" % ("X" if flag else " ", label)
+
+    lines = ["horovod_tpu v%s:" % __version__, ""]
+    lines.append("Available Frameworks:")
+    lines.append(row(have_jax, "JAX"))
+    lines.append(row(probe("tensorflow"), "TensorFlow"))
+    lines.append(row(probe("torch"), "PyTorch"))
+    lines.append(row(probe("mxnet"), "MXNet"))
+    lines.append("")
+    lines.append("Available Controllers:")
+    lines.append(row(tcp, "TCP (gloo-equivalent negotiation plane)"))
+    lines.append(row(have_jax, "SPMD (in-process single controller)"))
+    lines.append(row(tcp and have_jax,
+                     "Multihost (jax.distributed + TCP)"))
+    lines.append(row(False, "MPI"))
+    lines.append("")
+    lines.append("Available Tensor Operations:")
+    lines.append(row(have_jax, "XLA collectives (ICI/DCN)"))
+    lines.append(row(tcp, "TCP host collectives"))
+    lines.append(row(have_jax, "Pallas TPU kernels"))
+    lines.append(row(False, "NCCL"))
+    lines.append(row(False, "oneCCL"))
+    lines.append(row(False, "DDL"))
+    print("\n".join(lines), file=out)
+    return 0
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if args.check_build:
+        return check_build()
     if args.hostfile:
         hosts = util.parse_hostfile(args.hostfile)
     elif args.hosts:

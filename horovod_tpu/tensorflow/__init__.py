@@ -257,7 +257,13 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 if not should:
                     return
             else:
-                grads = allreduce_grads(grads, trainable_variables)
+                # Keras 3 allows apply(grads) after build(); explicit
+                # groups then match against the optimizer's own built
+                # variable list.
+                tv = trainable_variables if trainable_variables \
+                    is not None else getattr(
+                        self, "_trainable_variables", None)
+                grads = allreduce_grads(grads, tv)
             return super().apply(grads, trainable_variables, **kw)
 
     _DistributedKerasOptimizer.__name__ = "Distributed" + cls.__name__

@@ -65,6 +65,48 @@ def test_parse_args_and_env():
     assert wenv["HOROVOD_CONTROLLER"] == "tcp"
 
 
+def test_package_import_is_framework_free(tmp_path):
+    # The lazy top-level namespace (PEP 562, reference: slim
+    # horovod/__init__.py) must not pull jax: launcher-only hosts run
+    # `python -m horovod_tpu.runner` framework-free.  This box's
+    # sitecustomize preloads jax into every interpreter, so simulate a
+    # jax-less host with a raising stub on PYTHONPATH (which also
+    # bypasses that sitecustomize).
+    (tmp_path / "jax.py").write_text(
+        "raise ImportError('no jax on this host (simulated)')\n")
+    code = ("import horovod_tpu, horovod_tpu.runner; "
+            "assert horovod_tpu.__version__; "
+            "from horovod_tpu.runner.launch import check_build; "
+            "import io; buf = io.StringIO(); check_build(out=buf); "
+            "assert '[ ] JAX' in buf.getvalue(); "
+            "print('LAZY_OK')")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = "%s%s%s" % (tmp_path, os.pathsep, REPO)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "LAZY_OK" in proc.stdout
+
+
+def test_check_build_matrix():
+    # Reference `horovodrun --check-build`: feature matrix prints and
+    # exits 0 without a worker command.
+    import io
+    from horovod_tpu.runner.launch import check_build, parse_args
+    args = parse_args(["--check-build"])
+    assert args.check_build and args.command == []
+    buf = io.StringIO()
+    assert check_build(out=buf) == 0
+    text = buf.getvalue()
+    assert "Available Frameworks" in text
+    assert "[X] JAX" in text
+    assert "Available Controllers" in text
+    assert "Available Tensor Operations" in text
+    assert "[ ] NCCL" in text  # absent by design, honestly reported
+
+
 def test_parse_args_requires_command():
     with pytest.raises(SystemExit):
         parse_args(["-np", "2"])

@@ -140,6 +140,14 @@ def test_grouped_gradient_paths(hvd):
     grads = tape.gradient(loss, model.trainable_variables)
     opt.apply(grads, model.trainable_variables)
 
+    # Keras 3 apply(grads) without variables: explicit groups match
+    # against the optimizer's own built variable list.
+    opt2 = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1),
+        groups=[model.trainable_variables])
+    opt2.build(model.trainable_variables)
+    opt2.apply(grads)
+
     # Explicit variable groups + local aggregation cannot be matched.
     with pytest.raises(ValueError, match="num_groups"):
         hvd.DistributedOptimizer(
