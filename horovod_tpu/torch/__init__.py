@@ -36,6 +36,7 @@ from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
                       broadcast_async, broadcast_async_,
                       grouped_allreduce, grouped_allreduce_async, join,
                       poll, reducescatter, reducescatter_async,
+                      sparse_allreduce, sparse_allreduce_async,
                       synchronize)
 from .optimizer import DistributedOptimizer
 from .sync_batch_norm import SyncBatchNorm

@@ -22,6 +22,7 @@ from ..ops.xla_ops import AVERAGE, SUM
 
 __all__ = [
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "sparse_allreduce", "sparse_allreduce_async",
     "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
@@ -206,6 +207,71 @@ def allreduce_(tensor, average=None, name=None, op=None,
                process_set=None) -> torch.Tensor:
     return allreduce_async_(tensor, average, name, op, prescale_factor,
                             postscale_factor, process_set).wait()
+
+
+class SparseTorchHandle:
+    """Handle for a sparse allreduce: two ragged allgathers (indices,
+    values) resolved into a coalesced sparse tensor (reference
+    ``sparse_allreduce_async`` in horovod/torch/mpi_ops.py)."""
+
+    def __init__(self, h_idx, h_val, shape, dtype, device, divisor):
+        self._h_idx = h_idx
+        self._h_val = h_val
+        self._shape = shape
+        self._dtype = dtype
+        self._device = device
+        self._divisor = divisor
+
+    def poll(self) -> bool:
+        return self._h_idx.poll() and self._h_val.poll()
+
+    def wait(self, timeout: Optional[float] = None) -> torch.Tensor:
+        idx = self._h_idx.wait(timeout)   # (sum nnz, ndim)
+        val = self._h_val.wait(timeout)   # (sum nnz, *dense_dims)
+        out = torch.sparse_coo_tensor(
+            idx.t().contiguous(), val, self._shape,
+            dtype=self._dtype).coalesce()  # coalesce sums duplicates
+        if self._divisor != 1:
+            out = out / self._divisor
+        return out.to(self._device) if self._device.type != "cpu" else out
+
+
+def sparse_allreduce_async(tensor: torch.Tensor,
+                           name: Optional[str] = None, op=None,
+                           process_set=None) -> SparseTorchHandle:
+    """Reduce a ``torch.sparse_coo`` tensor across ranks without
+    densifying: allgather each rank's (indices, values) and sum
+    duplicates via coalesce.  Sum and Average only."""
+    if op is None:
+        op = AVERAGE
+    if op not in (SUM, AVERAGE):
+        raise ValueError("sparse allreduce supports Sum/Average only")
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async needs a sparse tensor")
+    t = tensor.coalesce()
+    # Wire layouts gather on dim 0: indices ride transposed (nnz, ndim).
+    idx = t.indices().t().contiguous()
+    val = t.values().contiguous()
+    # Deterministic cross-rank auto-name (negotiation is keyed by exact
+    # name match; id() would differ per process).
+    base = _api._auto_name("sparse_allreduce", name)
+    h_i = allgather_async(idx, name=base + ".indices",
+                          process_set=process_set)
+    h_v = allgather_async(val, name=base + ".values",
+                          process_set=process_set)
+    from ..common import basics
+    if process_set is not None:
+        world = process_set.size()
+    else:
+        world = basics.size()
+    return SparseTorchHandle(h_i, h_v, tuple(t.shape), t.dtype,
+                             tensor.device,
+                             world if op == AVERAGE else 1)
+
+
+def sparse_allreduce(tensor: torch.Tensor, name: Optional[str] = None,
+                     op=None, process_set=None) -> torch.Tensor:
+    return sparse_allreduce_async(tensor, name, op, process_set).wait()
 
 
 def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,

@@ -168,10 +168,11 @@ class _DistributedOptimizer:
         grad = p.grad
         if grad.is_sparse:
             if not self._sparse_as_dense:
+                # Only the grouped path lands here; singles route to
+                # the sparse wire in _allreduce_grad_async.
                 raise ValueError(
-                    "sparse gradients need "
-                    "DistributedOptimizer(sparse_as_dense=True); dense "
-                    "allreduce is the only wire format")
+                    "sparse gradients in grouped buckets need "
+                    "DistributedOptimizer(sparse_as_dense=True)")
             grad = grad.coalesce().to_dense()
         if self.backward_passes_per_step > 1:
             grad = grad / float(self.backward_passes_per_step)
@@ -180,6 +181,17 @@ class _DistributedOptimizer:
     def _allreduce_grad_async(self, p: torch.Tensor):
         name = "DistributedOptimizer.gradient/%s" % \
             self._param_names.get(p, "param%d" % id(p))
+        if p.grad.is_sparse and not self._sparse_as_dense:
+            # Reference default for sparse grads: indices/values ride
+            # two ragged allgathers, duplicates summed on coalesce.
+            grad = p.grad
+            if self.backward_passes_per_step > 1:
+                grad = grad / float(self.backward_passes_per_step)
+            self._grad_ctx[p] = None
+            self._handles[p] = mpi_ops.sparse_allreduce_async(
+                grad, name=name, op=self._op,
+                process_set=self._process_set)
+            return
         wire, ctx = self._compression.compress(self._prepare_grad(p))
         self._grad_ctx[p] = ctx
         self._handles[p] = mpi_ops.allreduce_async(
@@ -218,6 +230,9 @@ class _DistributedOptimizer:
             self._fire_group(gid)
         for p, handle in list(self._handles.items()):
             out = handle.wait()
+            if isinstance(handle, mpi_ops.SparseTorchHandle):
+                p.grad = out  # averaged, still sparse
+                continue
             out = self._compression.decompress(out, self._grad_ctx.get(p))
             if p.grad.is_sparse:
                 p.grad = out.reshape(p.grad.shape)

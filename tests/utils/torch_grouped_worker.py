@@ -117,21 +117,39 @@ def main():
     for h in opt3._hook_handles:
         h.remove()
 
-    # Without sparse_as_dense, sparse grads are rejected loudly.
+    # Without sparse_as_dense, sparse grads ride the sparse wire
+    # (indices/values allgather, reference sparse_allreduce_async) and
+    # come back SPARSE and averaged.
     opt4 = hvd.DistributedOptimizer(
         torch.optim.SGD(wrap.parameters(), lr=0.1),
         named_parameters=wrap.named_parameters())
     wrap.e.weight.grad = None
-    try:
-        # The hook raises inside backward; torch surfaces it (possibly
-        # wrapped in RuntimeError) from .backward().
-        emb_loss(wrap.e, rank).backward()
-        raised = False
-    except Exception as e:  # noqa: BLE001 - wrapper type varies
-        raised = "sparse_as_dense" in str(e)
-    if size > 1:
-        assert raised, "sparse grad without sparse_as_dense must raise"
-    del opt4
+    emb_loss(wrap.e, rank).backward()
+    assert wrap.e.weight.grad.is_sparse
+    opt4.synchronize()
+    g = wrap.e.weight.grad
+    assert g.is_sparse, "sparse wire must return a sparse grad"
+    np.testing.assert_allclose(g.to_dense().numpy(),
+                               expected[0].numpy(), atol=1e-6)
+    for h in opt4._hook_handles:
+        h.remove()
+
+    # Direct sparse collective: disjoint and overlapping indices.
+    sp = torch.sparse_coo_tensor(
+        torch.tensor([[rank, 3]]), torch.tensor([1.0 + rank, 2.0]),
+        (max(size, 4) + 4,))
+    out = hvd.sparse_allreduce(sp, name="sp0", op=hvd.Sum)
+    dense = out.to_dense()
+    exp = np.zeros(max(size, 4) + 4, np.float32)
+    for r in range(size):
+        exp[r] += 1.0 + r
+        exp[3] += 2.0
+    np.testing.assert_allclose(dense.numpy(), exp, atol=1e-6)
+    # Unnamed call: the deterministic auto-name counter negotiates
+    # cross-rank (Average default divides by world size).
+    out2 = hvd.sparse_allreduce(sp)
+    np.testing.assert_allclose(out2.to_dense().numpy(), exp / size,
+                               atol=1e-6)
 
     print("TORCH_GROUPED_OK", rank, flush=True)
     hvd.shutdown()
