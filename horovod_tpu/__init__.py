@@ -39,9 +39,12 @@ for _mod, _names in (
     (".ops.api",
      ("SUM", "AVERAGE", "MIN", "MAX", "PRODUCT", "ADASUM", "allreduce",
       "allreduce_async", "grouped_allreduce", "grouped_allreduce_async",
-      "allgather", "allgather_async", "broadcast", "broadcast_async",
+      "allgather", "allgather_async", "grouped_allgather",
+      "grouped_allgather_async", "broadcast", "broadcast_async",
       "alltoall", "alltoall_async", "reducescatter",
-      "reducescatter_async", "barrier", "join", "synchronize", "poll")),
+      "reducescatter_async", "grouped_reducescatter",
+      "grouped_reducescatter_async", "barrier", "join", "synchronize",
+      "poll")),
     (".ops.engine", ("CollectiveHandle", "HorovodInternalError")),
 ):
     for _n in _names:

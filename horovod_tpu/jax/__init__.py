@@ -21,9 +21,11 @@ from ..common.process_sets import (ProcessSet, global_process_set,
 from ..ops.api import (SUM, AVERAGE, MIN, MAX, PRODUCT, ADASUM,
                        allreduce, allreduce_async, grouped_allreduce,
                        grouped_allreduce_async, allgather, allgather_async,
+                       grouped_allgather, grouped_allgather_async,
                        broadcast, broadcast_async, alltoall, alltoall_async,
-                       reducescatter, reducescatter_async, barrier, join,
-                       synchronize, poll)
+                       reducescatter, reducescatter_async,
+                       grouped_reducescatter, grouped_reducescatter_async,
+                       barrier, join, synchronize, poll)
 from ..ops.engine import CollectiveHandle, HorovodInternalError
 
 # Adapter-specific surface.

@@ -33,9 +33,11 @@ __all__ = [
     "SUM", "AVERAGE", "MIN", "MAX", "PRODUCT", "ADASUM",
     "allreduce", "allreduce_async", "grouped_allreduce",
     "grouped_allreduce_async", "allgather", "allgather_async",
+    "grouped_allgather", "grouped_allgather_async",
     "broadcast", "broadcast_async", "alltoall", "alltoall_async",
-    "reducescatter", "reducescatter_async", "barrier", "join",
-    "synchronize", "poll",
+    "reducescatter", "reducescatter_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
+    "barrier", "join", "synchronize", "poll",
 ]
 
 
@@ -139,6 +141,55 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
         process_set)]
 
 
+def _check_reducescatter_op(op):
+    if op == ADASUM:
+        # Adasum is an allreduce algorithm (dot-product combine of full
+        # gradients); a scattered variant does not exist in the
+        # reference either.  Reject here so every backend agrees
+        # instead of some silently computing a plain Sum.
+        raise ValueError(
+            "reducescatter supports Sum/Average/Min/Max/Product; "
+            "Adasum is allreduce-only")
+
+
+# -- grouped allgather / reducescatter (reference v0.28 additions) ---------
+
+def grouped_allgather_async(tensors: Sequence,
+                            name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None
+                            ) -> List[CollectiveHandle]:
+    """Gather a group atomically (all members negotiate together)."""
+    base = _auto_name("grouped_allgather", name)
+    names = ["%s.%d" % (base, i) for i in range(len(tensors))]
+    return _submit("allgather", list(tensors), names, process_set,
+                   is_group=True)
+
+
+def grouped_allgather(tensors: Sequence, name=None,
+                      process_set: Optional[ProcessSet] = None):
+    return [h.wait() for h in grouped_allgather_async(
+        tensors, name, process_set)]
+
+
+def grouped_reducescatter_async(tensors: Sequence, op=None,
+                                name: Optional[str] = None,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> List[CollectiveHandle]:
+    """Reduce-scatter a group atomically."""
+    red_op = SUM if op is None else op
+    _check_reducescatter_op(red_op)
+    base = _auto_name("grouped_reducescatter", name)
+    names = ["%s.%d" % (base, i) for i in range(len(tensors))]
+    return _submit("reducescatter", list(tensors), names, process_set,
+                   red_op=red_op, is_group=True)
+
+
+def grouped_reducescatter(tensors: Sequence, op=None, name=None,
+                          process_set: Optional[ProcessSet] = None):
+    return [h.wait() for h in grouped_reducescatter_async(
+        tensors, op, name, process_set)]
+
+
 # -- allgather -------------------------------------------------------------
 
 def allgather_async(tensor, name: Optional[str] = None,
@@ -196,14 +247,7 @@ def alltoall(tensor, splits=None, name=None,
 def reducescatter_async(tensor, op=SUM, name: Optional[str] = None,
                         process_set: Optional[ProcessSet] = None
                         ) -> CollectiveHandle:
-    if op == ADASUM:
-        # Adasum is an allreduce algorithm (dot-product combine of full
-        # gradients); a scattered variant does not exist in the
-        # reference either.  Reject here so every backend agrees
-        # instead of some silently computing a plain Sum.
-        raise ValueError(
-            "reducescatter supports Sum/Average/Min/Max/Product; "
-            "Adasum is allreduce-only")
+    _check_reducescatter_op(op)
     return _submit("reducescatter", [tensor],
                    [_auto_name("reducescatter", name)], process_set,
                    red_op=op)

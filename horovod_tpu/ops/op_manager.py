@@ -133,18 +133,28 @@ class MultihostIciBackend(CollectiveBackend):
 
     def submit(self, req: OpRequest):
         eng = self._get_engine()
+        if req.is_group:
+            # Atomic negotiation for any grouped op (reference
+            # group_table.cc covers allgather/reducescatter too).
+            self._get_core().register_group(req.names)
         if req.op_type == "allreduce":
-            if req.is_group:
-                self._get_core().register_group(req.names)
             hs = [eng.enqueue_allreduce(
                 n, t, red_op=req.red_op, prescale=req.prescale,
                 postscale=req.postscale, process_set_id=req.process_set_id)
                 for t, n in zip(req.tensors, req.names)]
             return hs if req.is_group else hs[0]
-        t, n = req.tensors[0], req.names[0]
         if req.op_type == "allgather":
-            return eng.enqueue_allgather(
+            hs = [eng.enqueue_allgather(
                 n, t, process_set_id=req.process_set_id)
+                for t, n in zip(req.tensors, req.names)]
+            return hs if req.is_group else hs[0]
+        if req.op_type == "reducescatter":
+            hs = [eng.enqueue_reducescatter(
+                n, t, red_op=req.red_op,
+                process_set_id=req.process_set_id)
+                for t, n in zip(req.tensors, req.names)]
+            return hs if req.is_group else hs[0]
+        t, n = req.tensors[0], req.names[0]
         if req.op_type == "broadcast":
             return eng.enqueue_broadcast(
                 n, t, root_rank=req.root_rank,
@@ -154,10 +164,6 @@ class MultihostIciBackend(CollectiveBackend):
                       else list(np.asarray(req.splits)))
             return eng.enqueue_alltoall(
                 n, t, splits=splits,
-                process_set_id=req.process_set_id)
-        if req.op_type == "reducescatter":
-            return eng.enqueue_reducescatter(
-                n, t, red_op=req.red_op,
                 process_set_id=req.process_set_id)
         raise HorovodInternalError("unsupported op %s" % req.op_type)
 
@@ -176,18 +182,26 @@ class HostTcpBackend(CollectiveBackend):
 
     def submit(self, req: OpRequest):
         core = self._get_core()
+        if req.is_group:
+            core.register_group(req.names)
         if req.op_type == "allreduce":
-            if req.is_group:
-                core.register_group(req.names)
             hs = [core.allreduce_async(
                 _np(t), n, op=req.red_op, prescale=req.prescale,
                 postscale=req.postscale, process_set_id=req.process_set_id)
                 for t, n in zip(req.tensors, req.names)]
             return hs if req.is_group else hs[0]
-        t, n = req.tensors[0], req.names[0]
         if req.op_type == "allgather":
-            return core.allgather_async(
+            hs = [core.allgather_async(
                 _np(t), n, process_set_id=req.process_set_id)
+                for t, n in zip(req.tensors, req.names)]
+            return hs if req.is_group else hs[0]
+        if req.op_type == "reducescatter":
+            hs = [core.reducescatter_async(
+                _np(t), n, op=req.red_op,
+                process_set_id=req.process_set_id)
+                for t, n in zip(req.tensors, req.names)]
+            return hs if req.is_group else hs[0]
+        t, n = req.tensors[0], req.names[0]
         if req.op_type == "broadcast":
             return core.broadcast_async(
                 _np(t), n, root_rank=req.root_rank,
@@ -197,10 +211,6 @@ class HostTcpBackend(CollectiveBackend):
                       else list(np.asarray(req.splits)))
             return core.alltoall_async(
                 _np(t), n, splits=splits,
-                process_set_id=req.process_set_id)
-        if req.op_type == "reducescatter":
-            return core.reducescatter_async(
-                _np(t), n, op=req.red_op,
                 process_set_id=req.process_set_id)
         raise HorovodInternalError("unsupported op %s" % req.op_type)
 
@@ -262,16 +272,27 @@ class InProcessIciBackend(CollectiveBackend):
                 req.prescale, req.postscale, req.process_set_id)
                 for t, n in zip(req.tensors, req.names)]
             return hs if req.is_group else hs[0]
-        t, n = req.tensors[0], req.names[0]
         if req.op_type == "allgather":
-            if isinstance(t, (list, tuple)):
-                per_rank = [jnp.asarray(x) for x in t]
-                if len(per_rank) != req.ps_size:
-                    raise ValueError("need one tensor per rank")
-            else:
-                arr = jnp.asarray(t)
-                per_rank = [arr[r] for r in range(req.ps_size)]
-            return eng.enqueue_allgather(n, per_rank, req.process_set_id)
+            def one_allgather(t, n):
+                if isinstance(t, (list, tuple)):
+                    per_rank = [jnp.asarray(x) for x in t]
+                    if len(per_rank) != req.ps_size:
+                        raise ValueError("need one tensor per rank")
+                else:
+                    arr = jnp.asarray(t)
+                    per_rank = [arr[r] for r in range(req.ps_size)]
+                return eng.enqueue_allgather(n, per_rank,
+                                             req.process_set_id)
+            hs = [one_allgather(t, n)
+                  for t, n in zip(req.tensors, req.names)]
+            return hs if req.is_group else hs[0]
+        if req.op_type == "reducescatter":
+            hs = [eng.enqueue_reducescatter(
+                n, self._stack(t, req.ps_size), req.red_op,
+                req.process_set_id)
+                for t, n in zip(req.tensors, req.names)]
+            return hs if req.is_group else hs[0]
+        t, n = req.tensors[0], req.names[0]
         if req.op_type == "broadcast":
             return eng.enqueue_broadcast(
                 n, self._stack(t, req.ps_size), req.root_rank,
@@ -287,8 +308,4 @@ class InProcessIciBackend(CollectiveBackend):
                     t = jnp.stack(t) if len(
                         {x.shape for x in t}) == 1 else t
             return eng.enqueue_alltoall(n, t, splits, req.process_set_id)
-        if req.op_type == "reducescatter":
-            return eng.enqueue_reducescatter(
-                n, self._stack(t, req.ps_size), req.red_op,
-                req.process_set_id)
         raise HorovodInternalError("unsupported op %s" % req.op_type)

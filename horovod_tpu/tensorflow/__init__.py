@@ -39,8 +39,10 @@ from .functions import (allgather_object, broadcast_object,
 from .gradient_aggregation import LocalGradientAggregationHelper
 from .mpi_ops import (allgather, allgather_async, allreduce,
                       allreduce_async, alltoall, barrier, broadcast,
-                      broadcast_async, grouped_allreduce,
-                      grouped_allreduce_async, join,
+                      broadcast_async, grouped_allgather,
+                      grouped_allgather_async, grouped_allreduce,
+                      grouped_allreduce_async, grouped_reducescatter,
+                      grouped_reducescatter_async, join,
                       local_rank_op, local_size_op, poll,
                       process_set_included_op, rank_op, reducescatter,
                       size_op, synchronize)

@@ -25,6 +25,8 @@ from ..ops.xla_ops import AVERAGE, SUM
 __all__ = [
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "barrier", "join",
+    "grouped_allgather", "grouped_allgather_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
     "allreduce_async", "grouped_allreduce_async", "allgather_async",
     "broadcast_async", "synchronize", "poll",
     "size_op", "local_size_op", "rank_op", "local_rank_op",
@@ -201,19 +203,68 @@ def grouped_allreduce(tensors: Sequence, average=None,
                       postscale_factor: float = 1.0,
                       process_set=None) -> List:
     tensors = [tf.convert_to_tensor(t) for t in tensors]
+    return _stage_group(
+        lambda ts: _grouped_allreduce_eager(
+            ts, average, name, op, prescale_factor, postscale_factor,
+            process_set),
+        tensors, out_shapes=[t.shape for t in tensors])
+
+
+def _stage_group(eager_fn, tensors, out_shapes=None):
+    """Run a grouped eager fn now, or stage it through py_function when
+    any input is symbolic (shapes set when statically known)."""
     if any(tf.is_symbolic_tensor(t) for t in tensors):
-        ys = tf.py_function(
-            lambda *xs: _grouped_allreduce_eager(
-                list(xs), average, name, op, prescale_factor,
-                postscale_factor, process_set),
-            tensors, Tout=[t.dtype for t in tensors])
+        ys = tf.py_function(lambda *xs: eager_fn(list(xs)), tensors,
+                            Tout=[t.dtype for t in tensors])
         ys = list(ys) if isinstance(ys, (list, tuple)) else [ys]
-        for y, t in zip(ys, tensors):
-            y.set_shape(t.shape)
+        if out_shapes is not None:
+            for y, s in zip(ys, out_shapes):
+                y.set_shape(s)
         return ys
-    return _grouped_allreduce_eager(tensors, average, name, op,
-                                    prescale_factor, postscale_factor,
-                                    process_set)
+    return eager_fn(tensors)
+
+
+def grouped_allgather_async(tensors: Sequence,
+                            name: Optional[str] = None,
+                            process_set=None) -> List[TFHandle]:
+    """Async grouped allgather (eager tensors only)."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    hs = _api.grouped_allgather_async(
+        [_np_view(t) for t in tensors], name, process_set)
+    return [TFHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
+                      process_set=None) -> List:
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    return _stage_group(
+        lambda ts: [h.wait() for h in grouped_allgather_async(
+            ts, name, process_set)],
+        tensors,
+        out_shapes=[tf.TensorShape([None]).concatenate(t.shape[1:])
+                    for t in tensors])
+
+
+def grouped_reducescatter_async(tensors: Sequence, op=None,
+                                name: Optional[str] = None,
+                                process_set=None) -> List[TFHandle]:
+    """Async grouped reducescatter (eager tensors only)."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    hs = _api.grouped_reducescatter_async(
+        [_np_view(t) for t in tensors], op, name, process_set)
+    return [TFHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_reducescatter(tensors: Sequence, op=None,
+                          name: Optional[str] = None,
+                          process_set=None) -> List:
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    return _stage_group(
+        lambda ts: [h.wait() for h in grouped_reducescatter_async(
+            ts, op, name, process_set)],
+        tensors,
+        out_shapes=[tf.TensorShape([None]).concatenate(t.shape[1:])
+                    for t in tensors])
 
 
 # -- allgather -------------------------------------------------------------

@@ -24,6 +24,8 @@ __all__ = [
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "sparse_allreduce", "sparse_allreduce_async",
     "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_allgather", "grouped_allgather_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
     "reducescatter", "reducescatter_async", "barrier", "join",
@@ -303,6 +305,34 @@ def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
 
 def allgather(tensor, name=None, process_set=None) -> torch.Tensor:
     return allgather_async(tensor, name, process_set).wait()
+
+
+def grouped_allgather_async(tensors: Sequence[torch.Tensor],
+                            name: Optional[str] = None,
+                            process_set=None) -> List[TorchHandle]:
+    hs = _api.grouped_allgather_async(
+        [_payload(t) for t in tensors], name, process_set)
+    return [TorchHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_allgather(tensors, name=None,
+                      process_set=None) -> List[torch.Tensor]:
+    return [h.wait() for h in grouped_allgather_async(
+        tensors, name, process_set)]
+
+
+def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
+                                op=None, name: Optional[str] = None,
+                                process_set=None) -> List[TorchHandle]:
+    hs = _api.grouped_reducescatter_async(
+        [_payload(t) for t in tensors], op, name, process_set)
+    return [TorchHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_reducescatter(tensors, op=None, name=None,
+                          process_set=None) -> List[torch.Tensor]:
+    return [h.wait() for h in grouped_reducescatter_async(
+        tensors, op, name, process_set)]
 
 
 # -- broadcast -------------------------------------------------------------

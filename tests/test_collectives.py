@@ -86,6 +86,25 @@ def test_grouped_allreduce_fusion(hvd_world):
         np.testing.assert_allclose(o, t.sum(axis=0), rtol=1e-4)
 
 
+def test_grouped_allgather_and_reducescatter(hvd_world):
+    # Grouped variants (reference v0.28): atomic groups in the
+    # in-process stacked-input mode.
+    a = _stacked((2, 3), seed=1)
+    b = _stacked((4,), seed=2)
+    ga, gb = hvd.grouped_allgather([a, b], name="gag")
+    np.testing.assert_allclose(ga, a.reshape(SIZE * 2, 3), rtol=1e-6)
+    np.testing.assert_allclose(gb, b.reshape(SIZE * 4), rtol=1e-6)
+    c = _stacked((SIZE * 2,), seed=3)
+    d = _stacked((SIZE,), seed=4)
+    rc, rd = hvd.grouped_reducescatter([c, d], op=hvd.Sum, name="grs")
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(x) for x in rc]),
+        c.sum(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(x) for x in rd]),
+        d.sum(axis=0), rtol=1e-4)
+
+
 def test_allgather_uniform(hvd_world):
     x = _stacked((2, 3))
     out = hvd.allgather(x)

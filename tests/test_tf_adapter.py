@@ -37,6 +37,17 @@ def test_size1_collectives(hvd):
     assert np.allclose(a2a.numpy(), np.arange(4))
     outs = hvd.grouped_allreduce([t, 2 * t], op=hvd.Sum, name="tf_gar")
     assert np.allclose(outs[1].numpy(), 2 * t.numpy())
+    gg = hvd.grouped_allgather([t, 3 * t], name="tf_gag")
+    assert np.allclose(gg[1].numpy(), 3 * t.numpy())
+    gr = hvd.grouped_reducescatter([t, 2 * t], op=hvd.Sum, name="tf_grs")
+    assert np.allclose(gr[0].numpy(), t.numpy())
+
+    @tf.function
+    def grouped_fn(x):
+        return hvd.grouped_allgather([x, x + 1.0], name="tf_gag_fn")
+
+    a, b = grouped_fn(tf.ones((2, 2)))
+    assert np.allclose(b.numpy(), 2.0)
     hvd.barrier()
 
 

@@ -162,6 +162,28 @@ def run_regroup(core, rank, size):
         outs = grouped([np.ones(8, np.float32), np.ones((2,), np.float32)])
         for o in outs:
             np.testing.assert_allclose(o, float(size))
+    # Grouped allgather and reducescatter negotiate atomically too
+    # (reference v0.28 grouped variants; ragged first member).
+    names = ["gag.0", "gag.1"]
+    core.register_group(names)
+    hs = [core.allgather_async(
+        np.full((rank + 1, 2), float(rank), np.float32), names[0]),
+        core.allgather_async(np.full((3,), float(rank), np.float32),
+                             names[1])]
+    g0, g1 = [h.wait(timeout=30) for h in hs]
+    assert g0.shape == (size * (size + 1) // 2, 2)
+    assert g1.shape == (3 * size,)
+    names = ["grs.0", "grs.1"]
+    core.register_group(names)
+    hs = [core.reducescatter_async(
+        np.arange(size * 2, dtype=np.float32), names[0]),
+        core.reducescatter_async(
+            np.ones(size, np.float32) * (rank + 1), names[1])]
+    r0, r1 = [h.wait(timeout=30) for h in hs]
+    np.testing.assert_allclose(
+        r0, np.arange(size * 2, dtype=np.float32)[
+            rank * 2:(rank + 1) * 2] * size)
+    np.testing.assert_allclose(r1, sum(range(1, size + 1)))
 
 
 def run_autotune(core, rank, size):

@@ -151,6 +151,21 @@ def main():
     np.testing.assert_allclose(out2.to_dense().numpy(), exp / size,
                                atol=1e-6)
 
+    # Grouped allgather / reducescatter (reference v0.28 variants).
+    g0, g1 = hvd.grouped_allgather(
+        [torch.full((rank + 1, 2), float(rank)),
+         torch.full((3,), float(rank))], name="tg")
+    assert g0.shape == (size * (size + 1) // 2, 2)
+    assert g1.shape == (3 * size,)
+    r0, r1 = hvd.grouped_reducescatter(
+        [torch.arange(size * 2, dtype=torch.float32),
+         torch.ones(size) * (rank + 1)], name="tr")
+    np.testing.assert_allclose(
+        r0.numpy(),
+        np.arange(size * 2, dtype=np.float32)[rank * 2:(rank + 1) * 2]
+        * size)
+    np.testing.assert_allclose(r1.numpy(), sum(range(1, size + 1)))
+
     print("TORCH_GROUPED_OK", rank, flush=True)
     hvd.shutdown()
 
