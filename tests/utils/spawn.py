@@ -12,7 +12,17 @@ import socket
 import subprocess
 import sys
 
-_port_base = [27100]
+def _initial_port_base() -> int:
+    # Disjoint ranges per pytest-xdist worker: two workers probing the
+    # same base can both see a port free (probe binds then closes) and
+    # collide when their spawned worlds bind for real.
+    worker = os.environ.get("PYTEST_XDIST_WORKER", "")
+    idx = int(worker[2:]) if worker.startswith("gw") and \
+        worker[2:].isdigit() else 0
+    return 27100 + idx * 2400
+
+
+_port_base = [_initial_port_base()]
 
 
 def free_port_block(size, extra_offsets=()):
