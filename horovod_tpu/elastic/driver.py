@@ -322,9 +322,10 @@ class ElasticDriver:
             self._kv.stop()
 
 
-def elastic_run(args) -> int:
+def elastic_run(args, base_env=None) -> int:
     """Entry from the launcher (``horovodrun --min-np ... --host-
-    discovery-script disc.sh python train.py``)."""
+    discovery-script disc.sh python train.py``).  ``base_env`` overlays
+    the workers' base environment (the programmatic ``run`` path)."""
     from ..runner.launch import build_common_env
     if getattr(args, "tpu_discovery", False):
         from .discovery import TpuSliceDiscovery
@@ -340,7 +341,7 @@ def elastic_run(args) -> int:
     max_np = args.max_np
     driver = ElasticDriver(
         args.command, discovery, min_np, max_np,
-        env=build_common_env(args),
+        env=build_common_env(args, base_env),
         elastic_timeout=args.elastic_timeout,
         ssh_port=getattr(args, "ssh_port", 22))
     return driver.run()

@@ -25,21 +25,39 @@ payload = loads_base64(os.environ["HVD_TPU_RUN_PAYLOAD"])
 fn, args, kwargs = payload
 result = fn(*args, **kwargs)
 out_dir = os.environ["HVD_TPU_RUN_OUT"]
+if "HOROVOD_RANK" not in os.environ:
+    # Elastic workers learn their rank from the driver rendezvous,
+    # installed into the env by hvd.init(); without it there is no
+    # rank to file the result under.
+    sys.stderr.write(
+        "horovod_tpu.runner.run: elastic runs require fn to call "
+        "hvd.init() (rank is assigned at rendezvous)\n")
+    sys.exit(3)
 rank = os.environ["HOROVOD_RANK"]
+size = os.environ["HOROVOD_SIZE"]
 with open(os.path.join(out_dir, "result.%s.pkl" % rank), "wb") as fh:
-    pickle.dump(result, fh)
+    pickle.dump((int(size), result), fh)
 """
 
 
 def run(fn, args=(), kwargs=None, np: int = 1,
         hosts: Optional[str] = None, verbose: bool = False,
+        min_np: Optional[int] = None, max_np: Optional[int] = None,
+        host_discovery_script: Optional[str] = None,
+        elastic_timeout: Optional[float] = None,
         extra_cli: Optional[List[str]] = None,
         env: Optional[dict] = None) -> List[Any]:
     """Execute ``fn(*args, **kwargs)`` on np workers; returns the list of
     per-rank results (rank order).  ``env`` overlays extra variables on
     the workers' environment for this run only (the caller's environment
-    is untouched)."""
+    is untouched).  Passing ``min_np``/``max_np``/
+    ``host_discovery_script`` runs elastically (reference
+    ``horovod.run`` elastic parameters): ``fn`` must call
+    ``hvd.init()`` (rank assignment happens at the driver rendezvous),
+    and results are the final world's per-rank values, whose length may
+    differ from ``np``."""
     kwargs = kwargs or {}
+    elastic = bool(min_np or max_np or host_discovery_script)
     payload = util.dumps_base64((fn, tuple(args), kwargs))
     with tempfile.TemporaryDirectory() as out_dir:
         cli = ["-np", str(np)]
@@ -47,6 +65,14 @@ def run(fn, args=(), kwargs=None, np: int = 1,
             cli += ["-H", hosts]
         if verbose:
             cli.append("-v")
+        if min_np:
+            cli += ["--min-np", str(min_np)]
+        if max_np:
+            cli += ["--max-np", str(max_np)]
+        if host_discovery_script:
+            cli += ["--host-discovery-script", host_discovery_script]
+        if elastic_timeout is not None:
+            cli += ["--elastic-timeout", str(elastic_timeout)]
         cli += extra_cli or []
         cli += [sys.executable, "-c", _STUB]
         parsed = parse_args(cli)
@@ -54,15 +80,42 @@ def run(fn, args=(), kwargs=None, np: int = 1,
         worker_env.update(env or {})
         worker_env["HVD_TPU_RUN_PAYLOAD"] = payload
         worker_env["HVD_TPU_RUN_OUT"] = out_dir
-        host_list = (util.parse_hosts(hosts) if hosts
-                     else [util.HostInfo("localhost", np)])
-        rc = gloo_run(parsed, host_list, env=worker_env)
+        if elastic:
+            from ..elastic.driver import elastic_run
+            rc = elastic_run(parsed, base_env=worker_env)
+        else:
+            host_list = (util.parse_hosts(hosts) if hosts
+                         else [util.HostInfo("localhost", np)])
+            rc = gloo_run(parsed, host_list, env=worker_env)
         if rc != 0:
             raise RuntimeError("horovod_tpu.runner.run failed (rc=%d)" % rc)
-        import pickle
-        results = []
-        for rank in range(np):
-            with open(os.path.join(out_dir,
-                                   "result.%d.pkl" % rank), "rb") as fh:
-                results.append(pickle.load(fh))
-        return results
+        return _collect_results(out_dir, None if elastic else np)
+
+
+def _collect_results(out_dir: str, np: Optional[int]) -> List[Any]:
+    """Per-rank results.  Static runs know the world size; elastic runs
+    take it from the recorded (size, result) tuples — the final epoch's
+    workers are exactly the ones that ran to completion, and stale files
+    from larger earlier epochs are filtered by the recorded size."""
+    import pickle
+    found = {}
+    for name in os.listdir(out_dir):
+        if not (name.startswith("result.") and name.endswith(".pkl")):
+            continue
+        rank = int(name.split(".")[1])
+        with open(os.path.join(out_dir, name), "rb") as fh:
+            found[rank] = pickle.load(fh)
+    elastic = np is None
+    if elastic:
+        if 0 not in found:
+            raise RuntimeError("elastic run finished without a rank-0 "
+                               "result")
+        np = found[0][0]  # final world size recorded by rank 0
+    results = []
+    for rank in range(np):
+        if rank not in found or (elastic and found[rank][0] != np):
+            # A stale file from a larger earlier epoch records a
+            # different size — surface it rather than return old data.
+            raise RuntimeError("missing result for rank %d" % rank)
+        results.append(found[rank][1])
+    return results

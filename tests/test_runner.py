@@ -222,6 +222,17 @@ def test_programmatic_run():
     assert results == [0, 2]
 
 
+def test_programmatic_run_elastic():
+    # Reference horovod.run elastic parameters: min_np routes through
+    # the elastic driver; results are the final world's per-rank
+    # values over a real driver-rendezvous'd world.
+    from tests.utils.run_fn import elastic_rank_value
+    from horovod_tpu.runner import run
+    results = run(elastic_rank_value, np=2, min_np=2,
+                  elastic_timeout=60)
+    assert results == [2, 12]
+
+
 def test_lsf_host_parsing(monkeypatch):
     from horovod_tpu.runner import util
     monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 2")

@@ -4,3 +4,16 @@ import os
 
 def rank_times_two():
     return int(os.environ["HOROVOD_RANK"]) * 2
+
+
+def elastic_rank_value():
+    """Real elastic world: init via the driver rendezvous, one
+    allreduce across the wire, value encodes (rank, world size)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    total = float(np.asarray(
+        hvd.allreduce(np.ones(1, np.float32), op=hvd.SUM))[0])
+    rank = hvd.rank()
+    hvd.shutdown()
+    return rank * 10 + int(total)
