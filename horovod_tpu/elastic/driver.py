@@ -52,6 +52,7 @@ class ElasticDriver:
         self.ssh_port = ssh_port
 
         self._registry = WorkerStateRegistry(failure_threshold)
+        self._extra_handler = None  # platform hook for extra msg kinds
         self._hosts = HostManager(discovery, self._registry.is_blacklisted)
         self._secret = util.make_secret()
         self._server = MessageServer(self._handle, self._secret)
@@ -86,6 +87,8 @@ class ElasticDriver:
                 (req["host"], int(req["slot"])))
         if kind == "ping":
             return {"ok": True, "epoch": self._epoch}
+        if self._extra_handler is not None:
+            return self._extra_handler(req)
         return {"error": "unknown request %r" % kind}
 
     def _handle_rendezvous(self, slot: Slot) -> Dict:
@@ -177,10 +180,10 @@ class ElasticDriver:
             self._assignments = {}
             LOG.info("world change (%s): epoch %d, target %d slots",
                      reason, self._epoch, len(new_target))
-            target_hosts = {h for h, _ in new_target}
-            # Stop procs on hosts no longer in the world.
+            # Stop procs whose slot left the world (host removed, or a
+            # shrunk host renumbered its slots away).
             for slot, mp in list(self._procs.items()):
-                if slot[0] not in target_hosts and mp.poll() is None:
+                if slot not in new_target and mp.poll() is None:
                     self._stopped.add(slot)
             # Spawn procs for target slots without a live process.
             for slot in new_target:
@@ -203,7 +206,7 @@ class ElasticDriver:
                 if mp is not None and mp.poll() is None:
                     mp.terminate()
 
-    def _spawn_worker(self, slot: Slot):
+    def _worker_env(self, slot: Slot) -> Dict[str, str]:
         host, idx = slot
         env = dict(self.env)
         env.update({
@@ -215,6 +218,13 @@ class ElasticDriver:
             "HOROVOD_SECRET_KEY": self._secret,
             "HOROVOD_ELASTIC_TIMEOUT": str(self.elastic_timeout),
         })
+        return env
+
+    def _make_worker_proc(self, slot: Slot, env: Dict[str, str]):
+        """Start one worker process for ``slot``; returns a proc-like
+        object with ``poll()``/``terminate()``.  Platform integrations
+        (Spark task agents) override this to place workers themselves."""
+        host, idx = slot
         is_local = (host == "localhost" or host.startswith("127.")
                     or host == util.host_hash())
         if is_local:
@@ -223,12 +233,21 @@ class ElasticDriver:
             from ..runner.launch import _ssh_wrap
             cmd = _ssh_wrap(host, self.ssh_port, env, self.command)
         prefix = "[%s:%d]" % (host, idx)
-        mp = safe_shell_exec.ManagedProcess(
+        return safe_shell_exec.ManagedProcess(
             cmd, env,
             stdout_sink=lambda l, p=prefix: sys.stdout.write(
                 p + "<stdout>" + l),
             stderr_sink=lambda l, p=prefix: sys.stderr.write(
                 p + "<stderr>" + l))
+
+    def _spawn_worker(self, slot: Slot):
+        host, idx = slot
+        mp = self._make_worker_proc(slot, self._worker_env(slot))
+        if mp is None:
+            # Platform overrides may decline (agent not registered yet);
+            # the next recompute retries.
+            LOG.info("no carrier for worker %s:%d yet", host, idx)
+            return
         self._procs[slot] = mp
         self._stopped.discard(slot)
         self._succeeded.discard(slot)
@@ -265,6 +284,15 @@ class ElasticDriver:
                     LOG.warning("worker %s:%d failed (rc=%d)",
                                 slot[0], slot[1], rc)
                     failed_hosts.append(slot[0])
+            # Retry target slots with no process: a platform carrier may
+            # have declined the spawn (agent busy / not yet registered);
+            # without this the run would wait forever on a slot nothing
+            # is driving.
+            for slot in self._target:
+                if slot not in self._procs and slot not in self._stopped \
+                        and slot not in self._succeeded \
+                        and slot[0] not in failed_hosts:
+                    self._spawn_worker(slot)
             target = list(self._target)
             done = (bool(target) and self._published
                     and all(s in self._succeeded for s in target))

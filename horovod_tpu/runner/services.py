@@ -16,6 +16,7 @@ import pickle
 import socket
 import socketserver
 import struct
+import sys
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -108,6 +109,7 @@ class TaskService:
     def __init__(self, index: int, secret: str):
         self.index = index
         self._notify_cb: Optional[Callable[[Any], None]] = None
+        self._proc = None  # one managed worker process at a time
         self.server = MessageServer(self._handle, secret)
 
     def _handle(self, req: Any) -> Any:
@@ -120,6 +122,25 @@ class TaskService:
         if kind == "notify":
             if self._notify_cb:
                 self._notify_cb(req.get("payload"))
+            return {"ok": True}
+        if kind == "run":
+            # Execute a worker command for the driver (the reference
+            # task service's run_command): one at a time, replacing a
+            # finished predecessor.
+            from . import safe_shell_exec
+            if self._proc is not None and self._proc.poll() is None:
+                return {"error": "a command is already running"}
+            self._proc = safe_shell_exec.ManagedProcess(
+                list(req["cmd"]), dict(req.get("env") or {}),
+                stdout_sink=sys.stdout.write,
+                stderr_sink=sys.stderr.write)
+            return {"ok": True}
+        if kind == "proc_poll":
+            return {"rc": None if self._proc is None
+                    else self._proc.poll()}
+        if kind == "proc_stop":
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.terminate()
             return {"ok": True}
         return {"error": "unknown request %r" % kind}
 

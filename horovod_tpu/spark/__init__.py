@@ -20,7 +20,9 @@ from typing import Any, Callable, Dict, List, Optional
 from ..runner import util
 from ..runner.http_server import RendezvousServer
 
-__all__ = ["run", "default_num_proc"]
+from .elastic import run_elastic  # noqa: E402,F401  (pyspark-free import)
+
+__all__ = ["run", "run_elastic", "default_num_proc"]
 
 
 def _require_pyspark():

@@ -1,0 +1,320 @@
+"""Elastic training on Spark executors.
+
+Reference parity: ``horovod.spark.run_elastic`` and the task-service
+architecture in ``horovod/spark/driver/`` + ``horovod/spark/task/``:
+the driver cannot place Spark tasks on chosen hosts, so placement is
+inverted — Spark schedules AGENT tasks wherever it likes, each agent
+registers its (host, slot) with the elastic driver, and the driver
+discovers its world from the live agents and starts/stops worker
+processes THROUGH them (``TaskService`` "run"/"proc_poll"/"proc_stop").
+
+Worker results ride the driver's rendezvous KV (executors share no
+filesystem with the driver), keyed ``result/<rank>`` with the epoch's
+world size, so the final world's values are collected exactly like the
+programmatic ``run`` API's file protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..elastic.discovery import HostDiscovery
+from ..elastic.driver import ElasticDriver, Slot
+from ..runner import util
+from ..runner.services import TaskService, send_message
+
+__all__ = ["run_elastic"]
+
+
+class _AgentRegistry:
+    """Live agent task services: per-host ordered lists, compacted when
+    an agent dies, so (host, i) always resolves to the i-th LIVE agent
+    — matching ``ordered_slots``' 0-based renumbering.  Already-running
+    workers are unaffected by compaction: their ``_AgentProc`` captured
+    the agent address at spawn time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_host: Dict[str, List[Tuple[str, int]]] = {}
+
+    def register(self, host: str, port: int) -> int:
+        with self._lock:
+            lst = self._by_host.setdefault(host, [])
+            lst.append((host, port))
+            return len(lst) - 1
+
+    def addr(self, slot: Slot) -> Optional[Tuple[str, int]]:
+        host, idx = slot
+        with self._lock:
+            lst = self._by_host.get(host, [])
+            return lst[idx] if idx < len(lst) else None
+
+    def drop_addr(self, addr: Tuple[str, int]):
+        with self._lock:
+            lst = self._by_host.get(addr[0], [])
+            if addr in lst:
+                lst.remove(addr)
+
+    def addrs(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [a for lst in self._by_host.values() for a in lst]
+
+
+class AgentDiscovery(HostDiscovery):
+    """Hosts = wherever live agents registered from (ping-checked)."""
+
+    def __init__(self, registry: _AgentRegistry,
+                 secret: Optional[str] = None):
+        self._registry = registry
+        self._secret = secret  # installed from the driver's after build
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        hosts: Dict[str, int] = {}
+        for addr in self._registry.addrs():
+            try:
+                send_message(addr, self._secret, {"kind": "ping"},
+                             timeout=5.0)
+            except Exception:  # noqa: BLE001 - dead agent (task lost)
+                self._registry.drop_addr(addr)
+                continue
+            hosts[addr[0]] = hosts.get(addr[0], 0) + 1
+        return hosts
+
+
+class _AgentProc:
+    """Proc-like proxy for a worker process running under an agent.
+    Polls are rate-limited (the driver's reap loop runs at 10 Hz) and a
+    single failed RPC is retried before the agent is declared dead."""
+
+    _POLL_INTERVAL = 1.0
+    _MAX_FAILURES = 3
+
+    def __init__(self, addr: Tuple[str, int], secret: str):
+        self._addr = addr
+        self._secret = secret
+        self._failures = 0
+        self._last_poll = 0.0
+        self._last_rc = None
+
+    def poll(self):
+        if self._last_rc is not None:
+            return self._last_rc  # terminal
+        now = time.monotonic()
+        if now - self._last_poll < self._POLL_INTERVAL:
+            return None
+        self._last_poll = now
+        try:
+            rc = send_message(self._addr, self._secret,
+                              {"kind": "proc_poll"}, timeout=5.0)["rc"]
+            self._failures = 0
+            self._last_rc = rc
+            return rc
+        except Exception:  # noqa: BLE001 - transient or dead agent
+            self._failures += 1
+            if self._failures >= self._MAX_FAILURES:
+                self._last_rc = 1  # agent gone = worker failed
+                return 1
+            return None
+
+    def terminate(self):
+        try:
+            send_message(self._addr, self._secret,
+                         {"kind": "proc_stop"}, timeout=5.0)
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+
+
+class SparkElasticDriver(ElasticDriver):
+    """ElasticDriver whose workers run under Spark agent tasks."""
+
+    def __init__(self, *args, registry: _AgentRegistry, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._agents = registry
+        self._extra_handler = self._handle_agent
+
+    def _handle_agent(self, req: Dict) -> Dict:
+        if req.get("kind") == "agent_register":
+            idx = self._agents.register(req["host"], int(req["port"]))
+            return {"ok": True, "slot": idx}
+        return {"error": "unknown request %r" % req.get("kind")}
+
+    def _make_worker_proc(self, slot: Slot, env: Dict[str, str]):
+        addr = self._agents.addr(slot)
+        if addr is None:
+            return None  # agent not registered yet; reap loop retries
+        # Agents run in foreign interpreters: only string env crosses.
+        try:
+            resp = send_message(addr, self._secret, {
+                "kind": "run", "cmd": list(self.command),
+                "env": {k: str(v) for k, v in env.items()}}, timeout=10.0)
+        except Exception:  # noqa: BLE001 - agent died between ping+run
+            self._agents.drop_addr(addr)
+            return None
+        if resp.get("error"):
+            # Agent refused (e.g. a previous epoch's worker is still
+            # being stopped): decline so the reap loop retries rather
+            # than attaching to the wrong process.
+            return None
+        return _AgentProc(addr, self._secret)
+
+    def shutdown_agents(self):
+        for addr in self._agents.addrs():
+            try:
+                send_message(addr, self._secret,
+                             {"kind": "notify",
+                              "payload": {"type": "agent_exit"}},
+                             timeout=5.0)
+            except Exception:  # noqa: BLE001 - already gone
+                pass
+
+
+def _agent_mapper(driver_addr: Tuple[str, int], secret: str,
+                  extra_env: Dict[str, str]):
+    """Body of one Spark agent task (must be picklable)."""
+
+    def mapper(it):
+        import socket as _socket
+        os.environ.update(extra_env)
+        try:
+            host = _socket.gethostbyname(_socket.gethostname())
+        except _socket.gaierror:
+            host = "127.0.0.1"
+        if driver_addr[0].startswith("127."):
+            host = "127.0.0.1"  # single-machine worlds stay on loopback
+        done = threading.Event()
+        agent = TaskService(index=0, secret=secret)
+        agent.on_notify(lambda payload: done.set()
+                        if (payload or {}).get("type") == "agent_exit"
+                        else None)
+        port = agent.server.start()
+        # The driver's message server comes up inside driver.run();
+        # agents may be scheduled first, so registration retries.
+        slot = None
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                resp = send_message(driver_addr, secret, {
+                    "kind": "agent_register", "host": host,
+                    "port": port}, timeout=10.0)
+                slot = resp.get("slot")
+                break
+            except Exception:  # noqa: BLE001 - driver not serving yet
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        done.wait()
+        agent.server.stop()
+        yield ("agent", host, slot)
+
+    return mapper
+
+
+def _worker_body(fn: Callable, args: tuple, kwargs: Dict):
+    """Runs on the worker process under an agent: execute fn, then PUT
+    the result to the driver's rendezvous KV (no shared filesystem)."""
+    result = fn(*args, **(kwargs or {}))
+    from ..runner.http_client import RendezvousClient
+    from ..runner.util import dumps_base64
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    rank = os.environ["HOROVOD_RANK"]
+    size = os.environ["HOROVOD_SIZE"]
+    client = RendezvousClient(addr,
+                              secret=os.environ.get("HOROVOD_SECRET_KEY"))
+    client.put("result/%s" % rank, dumps_base64((int(size), result)))
+    return result
+
+
+_WORKER_STUB = r"""
+import os
+from horovod_tpu.runner.util import loads_base64
+from horovod_tpu.spark.elastic import _worker_body
+fn, args, kwargs = loads_base64(os.environ["HVD_TPU_RUN_PAYLOAD"])
+_worker_body(fn, args, kwargs)
+"""
+
+
+def run_elastic(fn: Callable, args: tuple = (),
+                kwargs: Optional[Dict] = None,
+                num_proc: Optional[int] = None,
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None,
+                elastic_timeout: float = 600.0,
+                start_timeout: float = 120.0,
+                extra_env: Optional[Dict[str, str]] = None,
+                verbose: int = 1) -> List[Any]:
+    """Run ``fn`` elastically on Spark executors (reference
+    ``horovod.spark.run_elastic``); returns the final world's per-rank
+    results.  ``fn`` must call ``hvd.init()`` (elastic rendezvous
+    assigns ranks) and should use the ``hvd.elastic`` state pattern to
+    survive resizes."""
+    import pyspark
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("no active SparkContext; create a "
+                           "SparkSession before run_elastic")
+    num_proc = num_proc or sc.defaultParallelism
+    min_np = min_np or num_proc
+    max_np = max_np or num_proc
+
+    registry = _AgentRegistry()
+    payload = util.dumps_base64((fn, tuple(args), kwargs or {}))
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    env["HVD_TPU_RUN_PAYLOAD"] = payload
+    discovery = AgentDiscovery(registry)
+    driver = SparkElasticDriver(
+        [sys.executable, "-c", _WORKER_STUB], discovery,
+        min_np, max_np, env=env, elastic_timeout=elastic_timeout,
+        start_timeout=start_timeout, registry=registry)
+    secret = driver._secret  # one shared HMAC key for every channel
+    discovery._secret = secret
+
+    # MessageServer binds in its constructor, so the port is known
+    # before driver.run() starts serving; agents retry until it does.
+    driver_addr = (util.routable_ip(), driver._server.port)
+    if verbose:
+        print("horovod_tpu.spark.run_elastic: agents=%d np=[%d,%d] "
+              "driver at %s:%d" % (max_np, min_np, max_np,
+                                   driver_addr[0], driver_addr[1]))
+
+    # Spark schedules the agents wherever it likes; they call home.
+    agent_rdd = sc.parallelize(range(max_np), max_np)
+    mapper = _agent_mapper(driver_addr, secret, extra_env or {})
+    agent_job = threading.Thread(
+        target=lambda: agent_rdd.mapPartitions(mapper).collect(),
+        daemon=True)
+    agent_job.start()
+
+    try:
+        rc = driver.run()
+    finally:
+        driver.shutdown_agents()
+        agent_job.join(timeout=30)
+    if rc != 0:
+        raise RuntimeError("run_elastic failed (rc=%d)" % rc)
+
+    # Final world's results from the KV (reset happens per epoch, so
+    # only the last epoch's PUTs survive).
+    # run() already stopped the HTTP server; the in-memory store
+    # outlives it.
+    store = driver._kv._httpd.store
+    found: Dict[int, Tuple[int, Any]] = {}
+    for key, value in list(store.items()):
+        parts = key.strip("/").split("/")
+        if len(parts) == 2 and parts[0] == "result":
+            found[int(parts[1])] = util.loads_base64(
+                value if isinstance(value, str) else value.decode())
+    if 0 not in found:
+        raise RuntimeError("elastic run finished without a rank-0 "
+                           "result")
+    size = found[0][0]
+    results = []
+    for rank in range(size):
+        if rank not in found or found[rank][0] != size:
+            raise RuntimeError("missing result for rank %d" % rank)
+        results.append(found[rank][1])
+    return results

@@ -37,6 +37,23 @@ def test_spark_run_replay_executes_real_world(monkeypatch):
     np.testing.assert_allclose([r[2] for r in results], 3.0)  # 1+2
 
 
+def test_spark_run_elastic_replay_executes_real_world(monkeypatch):
+    # reference horovod.spark.run_elastic: Spark schedules AGENT tasks
+    # (fake harness: real child processes), each registers with the
+    # elastic driver, which starts the workers THROUGH the agents
+    # (TaskService run/proc_poll) and collects results over the
+    # rendezvous KV — no shared filesystem assumed.
+    install_fake_pyspark(monkeypatch, parallelism=2)
+    import horovod_tpu.spark as hvd_spark
+    results = hvd_spark.run_elastic(_train_fn, args=("spark_elastic",),
+                                    num_proc=2, min_np=2, verbose=0,
+                                    start_timeout=60,
+                                    elastic_timeout=60)
+    assert [r[0] for r in results] == [0, 1]
+    assert all(r[1] == 2 for r in results)
+    np.testing.assert_allclose([r[2] for r in results], 3.0)
+
+
 def test_mxnet_replay_real_branches_on_2rank_world():
     # A fake `mxnet` module (recorded API surface: nd.NDArray/nd.array/
     # gluon.Trainer) installed BEFORE the adapter imports, driven over
