@@ -69,6 +69,7 @@ class ElasticDriver:
         self._worker_addrs: Dict[Slot, Tuple[str, int]] = {}
         self._stopped: set = set()       # slots told/forced to stop
         self._succeeded: set = set()     # slots whose proc exited 0
+        self._spawn_attempts: Dict[Slot, float] = {}  # retry throttle
         self._shutdown = threading.Event()
         self._below_min_since: Optional[float] = None
         self._rc = 0
@@ -178,6 +179,10 @@ class ElasticDriver:
             self._ready = set()
             self._published = False
             self._assignments = {}
+            # A slot stopped in an earlier epoch that re-enters the
+            # world must be spawnable again (stale membership would
+            # block the reap-loop retry forever).
+            self._stopped.difference_update(new_target)
             LOG.info("world change (%s): epoch %d, target %d slots",
                      reason, self._epoch, len(new_target))
             # Stop procs whose slot left the world (host removed, or a
@@ -269,11 +274,15 @@ class ElasticDriver:
     def _check_procs(self) -> bool:
         """Reap exited workers; returns True when the run is finished."""
         failed_hosts = []
+        # Poll OUTSIDE the lock: platform proc proxies (Spark agents)
+        # may do blocking RPCs, and the message handler needs the lock.
         with self._lock:
-            for slot, mp in list(self._procs.items()):
-                rc = mp.poll()
-                if rc is None:
-                    continue
+            snapshot = list(self._procs.items())
+        polled = [(slot, mp, mp.poll()) for slot, mp in snapshot]
+        with self._lock:
+            for slot, mp, rc in polled:
+                if rc is None or self._procs.get(slot) is not mp:
+                    continue  # alive, or replaced while we polled
                 del self._procs[slot]
                 if slot in self._stopped:
                     continue
@@ -287,11 +296,15 @@ class ElasticDriver:
             # Retry target slots with no process: a platform carrier may
             # have declined the spawn (agent busy / not yet registered);
             # without this the run would wait forever on a slot nothing
-            # is driving.
+            # is driving.  Throttled per slot — each attempt can be a
+            # network RPC.
+            now = time.monotonic()
             for slot in self._target:
                 if slot not in self._procs and slot not in self._stopped \
                         and slot not in self._succeeded \
-                        and slot[0] not in failed_hosts:
+                        and slot[0] not in failed_hosts \
+                        and now - self._spawn_attempts.get(slot, 0) >= 1.0:
+                    self._spawn_attempts[slot] = now
                     self._spawn_worker(slot)
             target = list(self._target)
             done = (bool(target) and self._published

@@ -173,6 +173,25 @@ class RayExecutor:
         """Reference API: run a function taking no arguments."""
         return self.run(fn)
 
+    def run_remote(self, fn: Callable, args: tuple = (),
+                   kwargs: Optional[Dict] = None) -> List[Any]:
+        """Reference API: launch on every worker and return the ray
+        ObjectRefs WITHOUT blocking (caller ray.get()s them)."""
+        if not self._workers:
+            raise RuntimeError(
+                "RayExecutor not started; call start() first")
+        return [w.execute.remote(fn, args, kwargs)
+                for w in self._workers]
+
+    def execute_single(self, fn: Callable) -> Any:
+        """Reference API: run a no-argument function on the rank-0
+        worker only."""
+        if not self._workers:
+            raise RuntimeError(
+                "RayExecutor not started; call start() first")
+        ray = _require_ray()
+        return ray.get(self._workers[0].execute.remote(fn, (), None))
+
     def shutdown(self):
         # each step independent: a dead actor / already-invalidated PG
         # must not leak the remaining resources (esp. the rendezvous

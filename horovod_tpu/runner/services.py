@@ -126,12 +126,20 @@ class TaskService:
         if kind == "run":
             # Execute a worker command for the driver (the reference
             # task service's run_command): one at a time, replacing a
-            # finished predecessor.
+            # finished predecessor.  The requested env OVERLAYS this
+            # host's own environment (the driver's env does not apply
+            # on a foreign executor), and "__PYTHON__" resolves to this
+            # host's interpreter.
+            import os
             from . import safe_shell_exec
             if self._proc is not None and self._proc.poll() is None:
                 return {"error": "a command is already running"}
+            env = dict(os.environ)
+            env.update(dict(req.get("env") or {}))
+            cmd = [sys.executable if c == "__PYTHON__" else c
+                   for c in req["cmd"]]
             self._proc = safe_shell_exec.ManagedProcess(
-                list(req["cmd"]), dict(req.get("env") or {}),
+                cmd, env,
                 stdout_sink=sys.stdout.write,
                 stderr_sink=sys.stderr.write)
             return {"ok": True}

@@ -17,7 +17,6 @@ programmatic ``run`` API's file protocol.
 from __future__ import annotations
 
 import os
-import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -67,10 +66,13 @@ class _AgentRegistry:
 class AgentDiscovery(HostDiscovery):
     """Hosts = wherever live agents registered from (ping-checked)."""
 
+    _MAX_PING_FAILURES = 3
+
     def __init__(self, registry: _AgentRegistry,
                  secret: Optional[str] = None):
         self._registry = registry
         self._secret = secret  # installed from the driver's after build
+        self._ping_failures: Dict[Tuple[str, int], int] = {}
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
         hosts: Dict[str, int] = {}
@@ -78,9 +80,17 @@ class AgentDiscovery(HostDiscovery):
             try:
                 send_message(addr, self._secret, {"kind": "ping"},
                              timeout=5.0)
-            except Exception:  # noqa: BLE001 - dead agent (task lost)
-                self._registry.drop_addr(addr)
-                continue
+                self._ping_failures.pop(addr, None)
+            except Exception:  # noqa: BLE001 - transient or task lost
+                # One blip must not kill a live agent (its healthy
+                # worker would be renumbered away); drop only after
+                # consecutive failures.
+                n = self._ping_failures.get(addr, 0) + 1
+                self._ping_failures[addr] = n
+                if n >= self._MAX_PING_FAILURES:
+                    self._registry.drop_addr(addr)
+                    self._ping_failures.pop(addr, None)
+                    continue
             hosts[addr[0]] = hosts.get(addr[0], 0) + 1
         return hosts
 
@@ -206,7 +216,19 @@ def _agent_mapper(driver_addr: Tuple[str, int], secret: str,
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.5)
-        done.wait()
+        # Wait for agent_exit, but don't leak the Spark task forever if
+        # the single best-effort notify is lost: when the driver itself
+        # stops answering pings, exit.
+        misses = 0
+        while not done.wait(10.0):
+            try:
+                send_message(driver_addr, secret, {"kind": "ping"},
+                             timeout=5.0)
+                misses = 0
+            except Exception:  # noqa: BLE001 - driver gone?
+                misses += 1
+                if misses >= 3:
+                    break
         agent.server.stop()
         yield ("agent", host, slot)
 
@@ -262,12 +284,14 @@ def run_elastic(fn: Callable, args: tuple = (),
 
     registry = _AgentRegistry()
     payload = util.dumps_base64((fn, tuple(args), kwargs or {}))
-    env = dict(os.environ)
-    env.update(extra_env or {})
+    # Workers run on foreign executors: ship only the overlay (the
+    # agent merges it over ITS OWN environment) and resolve the
+    # interpreter agent-side ("__PYTHON__" → the executor's python).
+    env = dict(extra_env or {})
     env["HVD_TPU_RUN_PAYLOAD"] = payload
     discovery = AgentDiscovery(registry)
     driver = SparkElasticDriver(
-        [sys.executable, "-c", _WORKER_STUB], discovery,
+        ["__PYTHON__", "-c", _WORKER_STUB], discovery,
         min_np, max_np, env=env, elastic_timeout=elastic_timeout,
         start_timeout=start_timeout, registry=registry)
     secret = driver._secret  # one shared HMAC key for every channel

@@ -37,6 +37,11 @@ def test_spark_run_replay_executes_real_world(monkeypatch):
     np.testing.assert_allclose([r[2] for r in results], 3.0)  # 1+2
 
 
+def _rank_probe():
+    import os
+    return int(os.environ["HOROVOD_RANK"])
+
+
 def test_spark_run_elastic_replay_executes_real_world(monkeypatch):
     # reference horovod.spark.run_elastic: Spark schedules AGENT tasks
     # (fake harness: real child processes), each registers with the
@@ -78,6 +83,12 @@ def test_ray_executor_replay_start_run_shutdown(monkeypatch):
         assert sorted(r[0] for r in results) == [0, 1]
         assert all(r[1] == 2 for r in results)
         np.testing.assert_allclose([r[2] for r in results], 3.0)
+        # run_remote returns unresolved refs; execute_single hits rank 0.
+        import ray as fake_ray
+        refs = ex.run_remote(_train_fn, args=("ray2",))
+        results2 = fake_ray.get(refs)
+        assert sorted(r[0] for r in results2) == [0, 1]
+        assert ex.execute_single(_rank_probe) == 0
     finally:
         ex.shutdown()
     assert ex._workers == []
