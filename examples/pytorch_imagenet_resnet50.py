@@ -1,0 +1,168 @@
+"""Data-parallel ImageNet ResNet-50 with the torch adapter (reference:
+examples/pytorch/pytorch_imagenet_resnet50.py — the BASELINE config's
+torch workload).  Uses torchvision's ResNet-50 when installed, else a
+compact plain-torch Bottleneck ResNet-50; real ImageFolder data with
+``--train-dir``, else synthetic ImageNet batches (zero-egress env).
+
+    python -m horovod_tpu.runner -np 2 python \
+        examples/pytorch_imagenet_resnet50.py --steps 8 --batch-size 8
+"""
+
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
+import argparse
+import time
+
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def resnet50(num_classes: int = 1000) -> torch.nn.Module:
+    try:
+        from torchvision.models import resnet50 as tv_resnet50
+        return tv_resnet50(num_classes=num_classes)
+    except ImportError:
+        return _PlainResNet50(num_classes)
+
+
+class _Bottleneck(torch.nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        cout = planes * self.expansion
+        self.conv1 = torch.nn.Conv2d(cin, planes, 1, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(planes)
+        self.conv2 = torch.nn.Conv2d(planes, planes, 3, stride=stride,
+                                     padding=1, bias=False)
+        self.bn2 = torch.nn.BatchNorm2d(planes)
+        self.conv3 = torch.nn.Conv2d(planes, cout, 1, bias=False)
+        self.bn3 = torch.nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = torch.nn.Sequential(
+                torch.nn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+                torch.nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        res = x if self.down is None else self.down(x)
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = F.relu(self.bn2(self.conv2(y)))
+        return F.relu(self.bn3(self.conv3(y)) + res)
+
+
+class _PlainResNet50(torch.nn.Module):
+    """ResNet-50 without the torchvision dependency."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False),
+            torch.nn.BatchNorm2d(64), torch.nn.ReLU(),
+            torch.nn.MaxPool2d(3, stride=2, padding=1))
+        stages = []
+        cin = 64
+        for planes, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                       (256, 6, 2), (512, 3, 2)):
+            for b in range(blocks):
+                stages.append(_Bottleneck(cin, planes,
+                                          stride if b == 0 else 1))
+                cin = planes * _Bottleneck.expansion
+        self.stages = torch.nn.Sequential(*stages)
+        self.fc = torch.nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        y = self.stages(self.stem(x))
+        y = torch.flatten(F.adaptive_avg_pool2d(y, 1), 1)
+        return self.fc(y)
+
+
+def make_loader(args):
+    if args.train_dir:
+        from torchvision import datasets, transforms
+        ds = datasets.ImageFolder(
+            args.train_dir,
+            transforms.Compose([
+                transforms.RandomResizedCrop(args.image_size),
+                transforms.ToTensor()]))
+        # DistributedSampler equivalent: shard by rank.
+        idx = list(range(hvd.rank(), len(ds), hvd.size()))
+        sub = torch.utils.data.Subset(ds, idx)
+        return torch.utils.data.DataLoader(
+            sub, batch_size=args.batch_size, shuffle=True,
+            num_workers=args.workers, drop_last=True)
+
+    def synthetic():
+        g = torch.Generator().manual_seed(1234 + hvd.rank())
+        while True:
+            yield (torch.randn(args.batch_size, 3, args.image_size,
+                               args.image_size, generator=g),
+                   torch.randint(0, args.num_classes,
+                                 (args.batch_size,), generator=g))
+    return synthetic()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train-dir", default=None,
+                   help="ImageFolder root; synthetic batches if unset")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--steps", type=int, default=20,
+                   help="steps per epoch on synthetic data")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--base-lr", type=float, default=0.0125,
+                   help="per-worker lr (scaled by world size)")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=5e-5)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    model = resnet50(args.num_classes)
+    opt = torch.optim.SGD(model.parameters(),
+                          lr=args.base_lr * hvd.size(),
+                          momentum=args.momentum, weight_decay=args.wd)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        compression=(hvd.Compression.fp16 if args.fp16_allreduce
+                     else hvd.Compression.none))
+
+    model.train()
+    for epoch in range(args.epochs):
+        it = iter(make_loader(args))
+        t0 = time.time()
+        seen = 0
+        for step in range(args.steps):
+            try:
+                x, y = next(it)
+            except StopIteration:
+                break
+            opt.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            seen += len(x)
+            if hvd.rank() == 0 and (step + 1) % 5 == 0:
+                avg = hvd.allreduce(loss.detach(), name="loss",
+                                    op=hvd.Average)
+                print("epoch %d step %d loss %.4f  %.1f img/s/worker"
+                      % (epoch, step + 1, float(avg),
+                         seen / (time.time() - t0)), flush=True)
+            elif (step + 1) % 5 == 0:
+                hvd.allreduce(loss.detach(), name="loss",
+                              op=hvd.Average)
+    if hvd.rank() == 0:
+        print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

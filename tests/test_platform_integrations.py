@@ -118,6 +118,47 @@ def test_ray_executor_requires_worker_spec():
         RayExecutor()
 
 
+def test_spark_agent_registry_compaction_and_ping_tolerance():
+    # Fault injection on the spark-elastic agent plane: a dead agent is
+    # dropped only after consecutive ping failures, and per-host lists
+    # compact so (host, i) keeps resolving to the i-th LIVE agent —
+    # the slot-renumbering contract ordered_slots relies on.
+    from horovod_tpu.runner.services import MessageServer
+    from horovod_tpu.runner import util
+    from horovod_tpu.spark.elastic import AgentDiscovery, _AgentRegistry
+
+    secret = util.make_secret()
+    servers = [MessageServer(lambda req: {"ok": True}, secret)
+               for _ in range(3)]
+    ports = [s.start() for s in servers]
+    reg = _AgentRegistry()
+    for p in ports:
+        reg.register("127.0.0.1", p)
+    disc = AgentDiscovery(reg, secret)
+    assert disc.find_available_hosts_and_slots() == {"127.0.0.1": 3}
+
+    # Kill the middle agent: host count must NOT drop on the first
+    # failed ping (transient tolerance)...
+    servers[1].stop()
+    assert disc.find_available_hosts_and_slots() == {"127.0.0.1": 3}
+    assert disc.find_available_hosts_and_slots() == {"127.0.0.1": 3}
+    # ...but the third consecutive failure drops it and compacts.
+    assert disc.find_available_hosts_and_slots() == {"127.0.0.1": 2}
+    assert reg.addr(("127.0.0.1", 0)) == ("127.0.0.1", ports[0])
+    assert reg.addr(("127.0.0.1", 1)) == ("127.0.0.1", ports[2])
+    assert reg.addr(("127.0.0.1", 2)) is None
+    # A ping that succeeds again resets the failure counter: seed a
+    # live agent with 2 prior blips — the successful round must clear
+    # them (otherwise blips spread over time would accumulate to a
+    # drop).
+    live = ("127.0.0.1", ports[0])
+    disc._ping_failures[live] = 2
+    assert disc.find_available_hosts_and_slots() == {"127.0.0.1": 2}
+    assert live not in disc._ping_failures
+    for s in (servers[0], servers[2]):
+        s.stop()
+
+
 def test_elastic_ray_retry_budget(monkeypatch):
     from horovod_tpu.ops.engine import HorovodInternalError
     from horovod_tpu.ray.elastic import (ElasticRayExecutor,
