@@ -280,6 +280,34 @@ class elastic:
     from ..elastic.state import ObjectState, State  # noqa: F401
     from ..elastic.worker import HostsUpdatedInterrupt  # noqa: F401
 
+    class TensorFlowState(ObjectState):
+        """Plain tf.Variable elastic state (reference
+        ``TensorFlowState``): values snapshotted on commit, broadcast
+        from rank 0 on sync."""
+
+        def __init__(self, variables=None, **kwargs):
+            self._variables = list(variables or [])
+            super().__init__(**kwargs)
+
+        def save(self):
+            super().save()
+            self._saved_values = [v.numpy() for v in self._variables]
+
+        def restore(self):
+            super().restore()
+            for v, val in zip(self._variables, self._saved_values):
+                v.assign(val)
+
+        def sync(self):
+            super().sync()
+            from ..common import basics
+            if basics.is_initialized() and basics.size() > 1:
+                synced = broadcast_object(
+                    [v.numpy() for v in self._variables], root_rank=0,
+                    name="elastic.TensorFlowState")
+                for v, val in zip(self._variables, synced):
+                    v.assign(val)
+
     class TensorFlowKerasState(ObjectState):
         """Keras model + optimizer elastic state: weights snapshotted on
         commit, broadcast from rank 0 on sync (reference

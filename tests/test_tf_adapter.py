@@ -241,6 +241,18 @@ def test_elastic_state(hvd):
     assert state.epoch == 0
     assert np.allclose(model.get_weights()[0], w0)
 
+    # Plain-variable state (reference TensorFlowState).
+    v = tf.Variable([1.0, 2.0])
+    vs = hvd.elastic.TensorFlowState(variables=[v], step=3)
+    vs.commit()
+    v.assign([9.0, 9.0])
+    vs.step = 7
+    vs.restore()
+    assert vs.step == 3
+    assert np.allclose(v.numpy(), [1.0, 2.0])
+    vs.sync()  # size-1: broadcast no-op, values keep
+    assert np.allclose(v.numpy(), [1.0, 2.0])
+
 
 def test_allgather_gradient_registered(hvd):
     x = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
