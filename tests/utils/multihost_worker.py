@@ -65,6 +65,22 @@ def main():
     np.testing.assert_allclose(np.asarray(outs[1]),
                                sum(i + 1 for i in range(n)))
 
+    # grouped allgather / reducescatter (v0.28 variants) negotiate
+    # atomically on the device plane too.
+    g0, g1 = hvd.grouped_allgather(
+        [np.full((r + 1, 2), float(r), np.float32),
+         np.full((3,), float(r), np.float32)], name="gag")
+    assert np.asarray(g0).shape == (n * (n + 1) // 2, 2)
+    assert np.asarray(g1).shape == (3 * n,)
+    r0, r1 = hvd.grouped_reducescatter(
+        [np.arange(n * 2, dtype=np.float32),
+         np.ones(n, np.float32) * (r + 1)], op=hvd.Sum, name="grs")
+    np.testing.assert_allclose(
+        np.asarray(r0),
+        np.arange(n * 2, dtype=np.float32)[r * 2:(r + 1) * 2] * n)
+    np.testing.assert_allclose(np.asarray(r1),
+                               sum(range(1, n + 1)))
+
     # broadcast from root 1.
     x = (np.arange(6, dtype=np.float32).reshape(2, 3) if r == 1
          else np.zeros((2, 3), np.float32))
