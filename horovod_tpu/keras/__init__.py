@@ -12,9 +12,10 @@ from ..tensorflow import (  # noqa: F401
     ProcessSet, Sum, add_process_set, allgather, allgather_object,
     allreduce, alltoall, barrier, broadcast, broadcast_object,
     broadcast_variables, cross_rank, cross_size, global_process_set,
-    grouped_allgather, grouped_allreduce, grouped_reducescatter, init,
-    is_initialized, join, local_rank, local_size, rank, reducescatter,
-    remove_process_set, shutdown, size, start_timeline, stop_timeline)
+    SyncBatchNormalization, grouped_allgather, grouped_allreduce,
+    grouped_reducescatter, init, is_initialized, join, local_rank,
+    local_size, rank, reducescatter, remove_process_set, shutdown,
+    size, start_timeline, stop_timeline)
 from . import callbacks  # noqa: F401
 
 

@@ -34,6 +34,7 @@ from ..common.process_sets import (ProcessSet, global_process_set,
 from ..ops.engine import HorovodInternalError
 from ..ops.xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
 from .compression import Compression
+from .sync_batch_norm import SyncBatchNormalization
 from .functions import (allgather_object, broadcast_object,
                         broadcast_variables)
 from .gradient_aggregation import LocalGradientAggregationHelper

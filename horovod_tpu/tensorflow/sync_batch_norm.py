@@ -1,0 +1,96 @@
+"""Synchronized BatchNormalization for tf.keras.
+
+Reference parity: ``horovod/tensorflow/sync_batch_norm.py``
+(``SyncBatchNormalization``): training-mode batch statistics are
+computed over the GLOBAL batch by allreducing per-rank sums /
+square-sums / counts.  The backward pass needs no custom code: the
+collective is differentiable (allreduce's registered gradient is an
+allreduce of the upstream gradient), so autodiff produces exactly the
+synced-BN input gradient — the same two-collective structure the
+reference builds by hand in torch.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from .mpi_ops import allreduce
+from ..ops.xla_ops import SUM
+
+
+class SyncBatchNormalization(tf.keras.layers.Layer):
+    """Drop-in BatchNormalization whose train-mode statistics cover
+    the global batch (channels-last; normalizes over all axes but the
+    last, like ``BatchNormalization(axis=-1)``)."""
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
+                 center: bool = True, scale: bool = True,
+                 name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.center = center
+        self.scale = scale
+
+    def build(self, input_shape):
+        c = int(input_shape[-1])
+        self.gamma = self.add_weight(
+            name="gamma", shape=(c,), initializer="ones",
+            trainable=self.scale)
+        self.beta = self.add_weight(
+            name="beta", shape=(c,), initializer="zeros",
+            trainable=self.center)
+        self.moving_mean = self.add_weight(
+            name="moving_mean", shape=(c,), initializer="zeros",
+            trainable=False)
+        self.moving_variance = self.add_weight(
+            name="moving_variance", shape=(c,), initializer="ones",
+            trainable=False)
+        super().build(input_shape)
+
+    def _global_moments(self, x):
+        axes = list(range(x.shape.rank - 1))
+        n_local = tf.cast(tf.reduce_prod(tf.shape(x)[:-1]), tf.float32)
+        s = tf.reduce_sum(x, axis=axes)
+        sq = tf.reduce_sum(tf.square(x), axis=axes)
+        packed = tf.concat([s, sq, [n_local]], axis=0)
+        packed = allreduce(packed, op=SUM,
+                           name="%s.stats" % self.name)
+        c = tf.shape(s)[0]
+        total = packed[-1]
+        mean = packed[:c] / total
+        # E[x²]−E[x]² can cancel slightly negative in f32; clamp like
+        # the jax sibling (rsqrt of a negative would be NaN).
+        var = tf.maximum(packed[c:2 * c] / total - tf.square(mean), 0.0)
+        return mean, var
+
+    def call(self, x, training=False):
+        x = tf.convert_to_tensor(x)
+        # Frozen layers run in inference mode (keras BatchNormalization
+        # contract): batch stats untouched, moving averages preserved.
+        if training and self.trainable:
+            mean, var = self._global_moments(tf.cast(x, tf.float32))
+            self.moving_mean.assign(
+                self.momentum * self.moving_mean
+                + (1.0 - self.momentum) * tf.stop_gradient(mean))
+            self.moving_variance.assign(
+                self.momentum * self.moving_variance
+                + (1.0 - self.momentum) * tf.stop_gradient(var))
+        else:
+            mean = self.moving_mean
+            var = self.moving_variance
+        mean = tf.cast(mean, x.dtype)
+        var = tf.cast(var, x.dtype)
+        inv = tf.math.rsqrt(var + self.epsilon)
+        out = (x - mean) * inv
+        if self.scale:
+            out = out * self.gamma
+        if self.center:
+            out = out + self.beta
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update({"momentum": self.momentum, "epsilon": self.epsilon,
+                    "center": self.center, "scale": self.scale})
+        return cfg

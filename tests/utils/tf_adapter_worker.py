@@ -80,6 +80,45 @@ def run_grouped_tape(rank, size):
     assert np.allclose(gb2.numpy(), gb.numpy(), atol=1e-6)
 
 
+def run_sync_batch_norm(rank, size):
+    # Synced BN over the global batch == local BN over the concatenated
+    # batch, forward AND gradient (autodiff through the differentiable
+    # allreduce).
+    full = np.random.RandomState(5).randn(4 * size, 3).astype("float32")
+    mine = tf.constant(full[rank * 4:(rank + 1) * 4])
+    bn = hvd.SyncBatchNormalization(epsilon=1e-5)
+    with tf.GradientTape() as tape:
+        tape.watch(mine)
+        out = bn(mine, training=True)
+        loss = tf.reduce_sum(out * out)
+    g = tape.gradient(loss, mine)
+
+    # Local oracle on the concatenated batch.
+    ref = tf.constant(full)
+    gamma = tf.ones(3)
+    beta = tf.zeros(3)
+    with tf.GradientTape() as tape2:
+        tape2.watch(ref)
+        m, v = tf.nn.moments(ref, axes=[0])
+        ro = (ref - m) * tf.math.rsqrt(v + 1e-5) * gamma + beta
+        rl = tf.reduce_sum(ro * ro)
+    rg = tape2.gradient(rl, ref)
+    assert np.allclose(out.numpy(), ro.numpy()[rank * 4:(rank + 1) * 4],
+                       atol=1e-4), "rank %d: synced BN forward" % rank
+    assert np.allclose(g.numpy(), rg.numpy()[rank * 4:(rank + 1) * 4],
+                       atol=1e-4), "rank %d: synced BN gradient" % rank
+    # Moving stats absorbed the GLOBAL moments (both halves of the EMA).
+    assert np.allclose(bn.moving_mean.numpy(), 0.01 * m.numpy(),
+                       atol=1e-5)
+    assert np.allclose(bn.moving_variance.numpy(),
+                       0.99 * 1.0 + 0.01 * v.numpy(), atol=1e-5)
+    # Frozen layer = inference mode: stats untouched.
+    bn.trainable = False
+    frozen_mean = bn.moving_mean.numpy().copy()
+    bn(mine, training=True)
+    assert np.allclose(bn.moving_mean.numpy(), frozen_mean)
+
+
 def run_broadcast(rank, size):
     w, b = make_weights(seed=300 + rank)
     hvd.broadcast_variables([w, b], root_rank=0)
@@ -189,6 +228,7 @@ def main():
         else:
             run_tape(rank, size)
             run_grouped_tape(rank, size)
+            run_sync_batch_norm(rank, size)
             run_broadcast(rank, size)
             run_optimizer(rank, size)
             run_compression(rank, size)
