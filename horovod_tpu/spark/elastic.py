@@ -266,6 +266,7 @@ def run_elastic(fn: Callable, args: tuple = (),
                 max_np: Optional[int] = None,
                 elastic_timeout: float = 600.0,
                 start_timeout: float = 120.0,
+                failure_threshold: int = 1,
                 extra_env: Optional[Dict[str, str]] = None,
                 verbose: int = 1) -> List[Any]:
     """Run ``fn`` elastically on Spark executors (reference
@@ -293,7 +294,8 @@ def run_elastic(fn: Callable, args: tuple = (),
     driver = SparkElasticDriver(
         ["__PYTHON__", "-c", _WORKER_STUB], discovery,
         min_np, max_np, env=env, elastic_timeout=elastic_timeout,
-        start_timeout=start_timeout, registry=registry)
+        start_timeout=start_timeout,
+        failure_threshold=failure_threshold, registry=registry)
     secret = driver._secret  # one shared HMAC key for every channel
     discovery._secret = secret
 

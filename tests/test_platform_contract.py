@@ -42,6 +42,40 @@ def _rank_probe():
     return int(os.environ["HOROVOD_RANK"])
 
 
+def _elastic_spark_fn(marker):
+    """User fn with the hvd.elastic pattern: rank 1 dies once mid-run
+    (simulated hardware failure), the survivor restores its commit, the
+    driver respawns the slot through the agent, and the resumed world
+    finishes."""
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    state = elastic.ObjectState(batch=0, total=0.0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < 6:
+            if (hvd.rank() == 1 and state.batch == 2
+                    and not os.path.exists(marker)):
+                open(marker, "w").write("x")
+                os._exit(13)
+            out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                name="sb%d" % state.batch)
+            state.total += float(np.asarray(out)[0])
+            state.batch += 1
+            state.commit()
+        return (hvd.rank(), hvd.size(), state.total)
+
+    result = train(state)
+    hvd.shutdown()
+    return result
+
+
 def test_spark_run_elastic_replay_executes_real_world(monkeypatch):
     # reference horovod.spark.run_elastic: Spark schedules AGENT tasks
     # (fake harness: real child processes), each registers with the
@@ -57,6 +91,29 @@ def test_spark_run_elastic_replay_executes_real_world(monkeypatch):
     assert [r[0] for r in results] == [0, 1]
     assert all(r[1] == 2 for r in results)
     np.testing.assert_allclose([r[2] for r in results], 3.0)
+
+
+def test_spark_run_elastic_worker_failure_recovers(monkeypatch,
+                                                   tmp_path):
+    # Fault injection through the agent plane: a worker process dies
+    # mid-training, the driver records the failure WITHOUT blacklisting
+    # (failure_threshold=3 — fake world is one host), respawns the slot
+    # via the agent's TaskService, and the resumed world finishes from
+    # the survivor's last commit.
+    install_fake_pyspark(monkeypatch, parallelism=2)
+    import horovod_tpu.spark as hvd_spark
+    marker = str(tmp_path / "died_once")
+    results = hvd_spark.run_elastic(
+        _elastic_spark_fn, args=(marker,), num_proc=2, min_np=2,
+        verbose=0, start_timeout=60, elastic_timeout=60,
+        failure_threshold=3)
+    assert [r[0] for r in results] == [0, 1]
+    assert all(r[1] == 2 for r in results)
+    # 6 batches × allreduce(ones)×2 ranks = 12, restored across the
+    # failure (totals synced from rank 0 at re-rendezvous).
+    assert results[0][2] == 12.0
+    import os
+    assert os.path.exists(marker), "the injected failure never fired"
 
 
 def test_mxnet_replay_real_branches_on_2rank_world():
