@@ -17,6 +17,14 @@ from ..tensorflow import (  # noqa: F401
     local_size, rank, reducescatter, remove_process_set, shutdown,
     size, start_timeline, stop_timeline)
 from . import callbacks  # noqa: F401
+from ..tensorflow import elastic as _tf_elastic
+
+
+class elastic(_tf_elastic):
+    """Reference ``horovod.keras.elastic``: ``KerasState`` is the
+    tf.keras state under its keras-adapter name."""
+
+    KerasState = _tf_elastic.TensorFlowKerasState
 
 
 def broadcast_global_variables(root_rank: int = 0, model=None):
