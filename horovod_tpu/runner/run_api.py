@@ -45,6 +45,7 @@ def run(fn, args=(), kwargs=None, np: int = 1,
         min_np: Optional[int] = None, max_np: Optional[int] = None,
         host_discovery_script: Optional[str] = None,
         elastic_timeout: Optional[float] = None,
+        use_gloo: Optional[bool] = None, use_mpi: Optional[bool] = None,
         extra_cli: Optional[List[str]] = None,
         env: Optional[dict] = None) -> List[Any]:
     """Execute ``fn(*args, **kwargs)`` on np workers; returns the list of
@@ -56,6 +57,14 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     ``hvd.init()`` (rank assignment happens at the driver rendezvous),
     and results are the final world's per-rank values, whose length may
     differ from ``np``."""
+    # Reference signature compatibility: the TCP controller IS the
+    # gloo-equivalent plane; MPI is absent by design.
+    if use_mpi:
+        raise ValueError(
+            "use_mpi is not supported: this framework has no MPI "
+            "backend by design (the TCP controller is the "
+            "gloo-equivalent plane; leave use_gloo/use_mpi unset)")
+    del use_gloo  # accepted for signature parity; TCP is the only plane
     kwargs = kwargs or {}
     elastic = bool(min_np or max_np or host_discovery_script)
     payload = util.dumps_base64((fn, tuple(args), kwargs))

@@ -222,6 +222,17 @@ def test_programmatic_run():
     assert results == [0, 2]
 
 
+def test_programmatic_run_backend_kwargs():
+    # Reference-signature compatibility: use_gloo accepted (TCP IS the
+    # gloo-equivalent plane), use_mpi rejected loudly (absent by
+    # design).
+    from horovod_tpu.runner import run
+    from tests.utils.run_fn import rank_times_two
+    assert run(rank_times_two, np=1, use_gloo=True) == [0]
+    with pytest.raises(ValueError, match="MPI"):
+        run(rank_times_two, np=1, use_mpi=True)
+
+
 def test_programmatic_run_elastic():
     # Reference horovod.run elastic parameters: min_np routes through
     # the elastic driver; results are the final world's per-rank
