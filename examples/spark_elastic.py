@@ -1,0 +1,54 @@
+"""Elastic Horovod training on Spark executors (reference:
+examples/elastic/spark + ``horovod.spark.run_elastic``).
+
+Requires a live SparkSession (pyspark is not bundled in the zero-egress
+build environment; on a real cluster this runs unchanged — the replay
+contract tests drive the same code over recorded API surfaces).
+
+    spark-submit examples/spark_elastic.py
+"""
+
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
+
+def train_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    state = elastic.ObjectState(epoch=0, total=0.0)
+
+    @elastic.run
+    def train(state):
+        for epoch in range(state.epoch, 5):
+            # ... real work: one epoch of training ...
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Average,
+                                name="epoch%d" % epoch)
+            state.total += float(np.asarray(out)[0])
+            state.epoch = epoch + 1
+            state.commit()      # rollback point for worker failures
+        return state.total
+
+    result = train(state)
+    hvd.shutdown()
+    return result
+
+
+def main():
+    from pyspark.sql import SparkSession
+
+    import horovod_tpu.spark
+
+    spark = SparkSession.builder.appName("hvd-elastic").getOrCreate()
+    try:
+        results = horovod_tpu.spark.run_elastic(
+            train_fn, num_proc=2, min_np=1, max_np=4)
+        print("per-rank results:", results)
+    finally:
+        spark.stop()
+
+
+if __name__ == "__main__":
+    main()
