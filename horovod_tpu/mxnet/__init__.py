@@ -26,7 +26,8 @@ from ..common.basics import (shutdown, is_initialized, rank, size,
                              mpi_threads_supported)
 from ..common.basics import init as _base_init
 from ..common.process_sets import (ProcessSet, global_process_set,
-                                   add_process_set, remove_process_set)
+                                   add_process_set, remove_process_set,
+                                   process_set_by_id, process_set_ids)
 from ..ops.engine import HorovodInternalError
 from ..ops.xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
 from .functions import (allgather_object, broadcast_object,
