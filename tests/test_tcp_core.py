@@ -31,6 +31,13 @@ def test_tcp_response_cache_fast_path():
     _assert_ok(_spawn_world(2, "cache"))
 
 
+def test_tcp_cache_eviction_under_capacity_pressure():
+    # LRU eviction with id reuse must stay rank-identical (evictions
+    # follow broadcast order); 10 rotating tensors against capacity 4.
+    _assert_ok(_spawn_world(2, "cache_evict",
+                            extra_env={"HOROVOD_CACHE_CAPACITY": "4"}))
+
+
 def test_tcp_group_name_reuse_changed_membership():
     # Regression: reusing a grouped_allreduce name with different member
     # count/shapes deadlocked — cached members bypassed the group

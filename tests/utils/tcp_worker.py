@@ -32,6 +32,8 @@ def main():
             run_big_allgather(core, rank, size)
         if scenario == "regroup":
             run_regroup(core, rank, size)
+        if scenario == "cache_evict":
+            run_cache_evict(core, rank, size)
         if scenario == "autotune":
             run_autotune(core, rank, size)
         if scenario == "join":
@@ -184,6 +186,29 @@ def run_regroup(core, rank, size):
         r0, np.arange(size * 2, dtype=np.float32)[
             rank * 2:(rank + 1) * 2] * size)
     np.testing.assert_allclose(r1, sum(range(1, size + 1)))
+
+
+def run_cache_evict(core, rank, size):
+    # Capacity overflow: 10 rotating names against HOROVOD_CACHE_
+    # CAPACITY=4 force constant LRU eviction + id reuse; correctness
+    # requires every rank to assign/evict identically (broadcast
+    # order), with a hot tensor pinned at the LRU front throughout.
+    for round_ in range(6):
+        hot = core.allreduce_async(
+            np.full((8,), float(rank + round_), np.float32),
+            "hot").wait(30)
+        np.testing.assert_allclose(
+            hot, sum(r + round_ for r in range(size)))
+        for i in range(10):
+            x = np.full((4,), float(rank + 1 + i), np.float32)
+            out = core.allreduce_async(x, "rot.%d" % i).wait(30)
+            np.testing.assert_allclose(
+                out, sum(r + 1 + i for r in range(size)))
+    # Shape change on a cached-then-evicted-then-reused name still
+    # negotiates (LookupMatching guards shape).
+    out = core.allreduce_async(
+        np.full((2, 3), float(rank), np.float32), "rot.0").wait(30)
+    np.testing.assert_allclose(out, sum(range(size)))
 
 
 def run_autotune(core, rank, size):
