@@ -189,6 +189,13 @@ def _agent_mapper(driver_addr: Tuple[str, int], secret: str,
     def mapper(it):
         import socket as _socket
         os.environ.update(extra_env)
+        pid = next(iter(it), 0)
+        # Test hook: stagger agent registration per partition so scale-
+        # up (an agent appearing mid-run) is exercisable; unset in
+        # production.
+        stagger = float(extra_env.get("HVD_TPU_TEST_AGENT_STAGGER", 0))
+        if stagger and pid:
+            time.sleep(stagger * pid)
         try:
             host = _socket.gethostbyname(_socket.gethostname())
         except _socket.gaierror:

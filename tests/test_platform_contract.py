@@ -116,6 +116,52 @@ def test_spark_run_elastic_worker_failure_recovers(monkeypatch,
     assert os.path.exists(marker), "the injected failure never fired"
 
 
+def _elastic_growing_fn():
+    """Runs long enough for a late-registering agent to join; the
+    HostsUpdatedInterrupt resizes the world mid-run and the remaining
+    batches run at the larger size."""
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    state = elastic.ObjectState(batch=0, max_size=0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < 30:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                          name="gb%d" % state.batch)
+            state.max_size = max(state.max_size, hvd.size())
+            state.batch += 1
+            state.commit()
+            if state.max_size < 2:
+                time.sleep(0.5)  # give the late agent time to appear
+        return (hvd.rank(), hvd.size(), state.max_size)
+
+    result = train(state)
+    hvd.shutdown()
+    return result
+
+
+def test_spark_run_elastic_scale_up_mid_run(monkeypatch):
+    # Elastic scale-UP through the agent plane: the second agent task
+    # registers ~6s late (stagger hook), discovery grows the world,
+    # workers take HostsUpdatedInterrupt and re-rendezvous at size 2.
+    install_fake_pyspark(monkeypatch, parallelism=2)
+    import horovod_tpu.spark as hvd_spark
+    results = hvd_spark.run_elastic(
+        _elastic_growing_fn, num_proc=2, min_np=1, max_np=2, verbose=0,
+        start_timeout=60, elastic_timeout=120,
+        extra_env={"HVD_TPU_TEST_AGENT_STAGGER": "6"})
+    assert [r[0] for r in results] == [0, 1]
+    assert all(r[1] == 2 for r in results)      # finished at size 2
+    assert all(r[2] == 2 for r in results)      # resize observed
+
+
 def test_mxnet_replay_real_branches_on_2rank_world():
     # A fake `mxnet` module (recorded API surface: nd.NDArray/nd.array/
     # gluon.Trainer) installed BEFORE the adapter imports, driven over
