@@ -197,9 +197,208 @@ def allreduce_async_(tensor: torch.Tensor, average=None,
     return TorchHandle(h, like=tensor, out=tensor)
 
 
+# -- autograd integration (reference: the HorovodAllreduce/... autograd
+# Functions in horovod/torch/mpi_ops.py — sync collectives are
+# differentiable; backward math mirrors the TF gradient registrations)
+
+def _gname(name):
+    return None if name is None else name + "_grad"
+
+
+class _AllreduceFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale, postscale,
+                process_set):
+        ctx.args = (average, name, op, prescale, postscale, process_set)
+        return allreduce_async(tensor, average, name, op, prescale,
+                               postscale, process_set).wait()
+
+    @staticmethod
+    def backward(ctx, grad):
+        average, name, op, prescale, postscale, ps = ctx.args
+        g = allreduce_async(grad.contiguous(), average, _gname(name),
+                            op, prescale, postscale, ps).wait()
+        return g, None, None, None, None, None, None
+
+
+class _AllgatherFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name, process_set):
+        ctx.n_local = int(tensor.shape[0])
+        ctx.name = name
+        ctx.process_set = process_set
+        return allgather_async(tensor, name, process_set).wait()
+
+    @staticmethod
+    def backward(ctx, grad):
+        gname = _gname(ctx.name)
+        summed = allreduce_async(grad.contiguous(), op=SUM, name=gname,
+                                 process_set=ctx.process_set).wait()
+        sizes = np.asarray(_api.allgather(
+            np.asarray([ctx.n_local], np.int64),
+            name=None if gname is None else gname + "_sizes",
+            process_set=ctx.process_set))
+        from ..common import basics
+        if ctx.process_set is not None:
+            my = ctx.process_set.rank()
+        else:
+            my = basics.rank()
+        off = int(sizes[:my].sum())
+        return summed[off:off + ctx.n_local], None, None
+
+
+class _BroadcastFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name, process_set):
+        ctx.root_rank = root_rank
+        ctx.name = name
+        ctx.process_set = process_set
+        return broadcast_async(tensor, root_rank, name,
+                               process_set).wait()
+
+    @staticmethod
+    def backward(ctx, grad):
+        # Sum of upstream grads lands on the root; non-roots get zero
+        # (root_rank is a GLOBAL rank, core broadcast semantics).
+        g = allreduce_async(grad.contiguous(), op=SUM,
+                            name=_gname(ctx.name),
+                            process_set=ctx.process_set).wait()
+        from ..common import basics
+        if basics.rank() != ctx.root_rank:
+            g = torch.zeros_like(g)
+        return g, None, None, None
+
+
+class _ReducescatterFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, op, name, process_set):
+        ctx.op = op
+        ctx.name = name
+        ctx.process_set = process_set
+        return reducescatter_async(tensor, op, name, process_set).wait()
+
+    @staticmethod
+    def backward(ctx, grad):
+        g = allgather_async(grad.contiguous(), name=_gname(ctx.name),
+                            process_set=ctx.process_set).wait()
+        if ctx.op == AVERAGE:
+            # The forward divides by the set size; the backward must
+            # scale the allgathered grad the same way.
+            from ..common import basics
+            size = (ctx.process_set.size() if ctx.process_set is not None
+                    else basics.size())
+            g = g / size
+        return g, None, None, None
+
+
+class _AlltoallFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, splits_list, name, process_set):
+        ctx.name = name
+        ctx.process_set = process_set
+        # With explicit splits the handle always resolves to
+        # (output, recv_splits).
+        out, recv = alltoall_async(tensor, splits_list, name,
+                                   process_set).wait()
+        ctx.recv = [int(i) for i in recv]
+        recv_t = torch.as_tensor(ctx.recv, dtype=torch.int64)
+        ctx.mark_non_differentiable(recv_t)
+        return out, recv_t
+
+    @staticmethod
+    def backward(ctx, grad, _grad_recv):
+        # Reverse routing with the FORWARD's receive splits.
+        g, _ = alltoall_async(grad.contiguous(), ctx.recv,
+                              _gname(ctx.name), ctx.process_set).wait()
+        return g, None, None, None
+
+
+class _GroupedAllreduceFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, average, name, op, prescale, postscale,
+                process_set, *tensors):
+        ctx.args = (average, name, op, prescale, postscale, process_set)
+        hs = grouped_allreduce_async(list(tensors), average, name, op,
+                                     prescale, postscale, process_set)
+        return tuple(h.wait() for h in hs)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        average, name, op, prescale, postscale, ps = ctx.args
+        hs = grouped_allreduce_async(
+            [g.contiguous() for g in grads], average, _gname(name), op,
+            prescale, postscale, ps)
+        return (None,) * 6 + tuple(h.wait() for h in hs)
+
+
+class _GroupedAllgatherFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, name, process_set, *tensors):
+        ctx.name = name
+        ctx.process_set = process_set
+        ctx.n_locals = [int(t.shape[0]) for t in tensors]
+        hs = grouped_allgather_async(list(tensors), name, process_set)
+        return tuple(h.wait() for h in hs)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        gname = _gname(ctx.name)
+        hs = grouped_allreduce_async(
+            [g.contiguous() for g in grads], op=SUM, name=gname,
+            process_set=ctx.process_set)
+        summed = [h.wait() for h in hs]
+        # One tiny sizes allgather covers every member's offsets.
+        sizes = np.asarray(_api.allgather(
+            np.asarray(ctx.n_locals, np.int64).reshape(1, -1),
+            name=None if gname is None else gname + "_sizes",
+            process_set=ctx.process_set))
+        from ..common import basics
+        my = (ctx.process_set.rank() if ctx.process_set is not None
+              else basics.rank())
+        outs = []
+        for i, (s, n) in enumerate(zip(summed, ctx.n_locals)):
+            off = int(sizes[:my, i].sum())
+            outs.append(s[off:off + n])
+        return (None, None) + tuple(outs)
+
+
+class _GroupedReducescatterFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, op, name, process_set, *tensors):
+        ctx.op = op
+        ctx.name = name
+        ctx.process_set = process_set
+        hs = grouped_reducescatter_async(list(tensors), op, name,
+                                         process_set)
+        return tuple(h.wait() for h in hs)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        hs = grouped_allgather_async(
+            [g.contiguous() for g in grads], _gname(ctx.name),
+            ctx.process_set)
+        gs = [h.wait() for h in hs]
+        if ctx.op == AVERAGE:
+            from ..common import basics
+            size = (ctx.process_set.size()
+                    if ctx.process_set is not None else basics.size())
+            gs = [g / size for g in gs]
+        return (None, None, None) + tuple(gs)
+
+
+def _wants_grad(tensor) -> bool:
+    return (torch.is_grad_enabled()
+            and isinstance(tensor, torch.Tensor)
+            and tensor.requires_grad)
+
+
 def allreduce(tensor, average=None, name=None, op=None,
               prescale_factor=1.0, postscale_factor=1.0,
               process_set=None) -> torch.Tensor:
+    if _wants_grad(tensor):
+        return _AllreduceFn.apply(tensor, average, name, op,
+                                  prescale_factor, postscale_factor,
+                                  process_set)
     return allreduce_async(tensor, average, name, op, prescale_factor,
                            postscale_factor, process_set).wait()
 
@@ -290,6 +489,10 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
 def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
                       process_set=None) -> List[torch.Tensor]:
+    if any(_wants_grad(t) for t in tensors):
+        return list(_GroupedAllreduceFn.apply(
+            average, name, op, prescale_factor, postscale_factor,
+            process_set, *tensors))
     return [h.wait() for h in grouped_allreduce_async(
         tensors, average, name, op, prescale_factor, postscale_factor,
         process_set)]
@@ -304,6 +507,8 @@ def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
 
 
 def allgather(tensor, name=None, process_set=None) -> torch.Tensor:
+    if _wants_grad(tensor):
+        return _AllgatherFn.apply(tensor, name, process_set)
     return allgather_async(tensor, name, process_set).wait()
 
 
@@ -317,6 +522,9 @@ def grouped_allgather_async(tensors: Sequence[torch.Tensor],
 
 def grouped_allgather(tensors, name=None,
                       process_set=None) -> List[torch.Tensor]:
+    if any(_wants_grad(t) for t in tensors):
+        return list(_GroupedAllgatherFn.apply(
+            name, process_set, *tensors))
     return [h.wait() for h in grouped_allgather_async(
         tensors, name, process_set)]
 
@@ -331,6 +539,9 @@ def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
 
 def grouped_reducescatter(tensors, op=None, name=None,
                           process_set=None) -> List[torch.Tensor]:
+    if any(_wants_grad(t) for t in tensors):
+        return list(_GroupedReducescatterFn.apply(
+            op, name, process_set, *tensors))
     return [h.wait() for h in grouped_reducescatter_async(
         tensors, op, name, process_set)]
 
@@ -355,6 +566,8 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
 
 def broadcast(tensor, root_rank: int, name=None,
               process_set=None) -> torch.Tensor:
+    if _wants_grad(tensor):
+        return _BroadcastFn.apply(tensor, root_rank, name, process_set)
     return broadcast_async(tensor, root_rank, name, process_set).wait()
 
 
@@ -375,10 +588,22 @@ def alltoall_async(tensor: torch.Tensor, splits=None,
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
+    # Differentiable when splits are explicit (the backward reverse-
+    # routes with the forward's receive splits); the splits-less form
+    # may return ragged per-rank results and stays non-differentiable.
+    if _wants_grad(tensor) and splits is not None:
+        if isinstance(splits, torch.Tensor):
+            splits = splits.tolist()
+        out, recv_t = _AlltoallFn.apply(tensor, splits, name,
+                                        process_set)
+        return out, recv_t
     res = alltoall_async(tensor, splits, name, process_set).wait()
-    if splits is None and isinstance(res, tuple):
-        return res[0]
-    return res
+    if splits is None:
+        return res[0] if isinstance(res, tuple) else res
+    out, recv = res
+    # recv_splits is a torch tensor on both the grad and no-grad paths.
+    return out, torch.as_tensor([int(i) for i in recv],
+                                dtype=torch.int64)
 
 
 def reducescatter_async(tensor: torch.Tensor, op=SUM,
@@ -390,6 +615,8 @@ def reducescatter_async(tensor: torch.Tensor, op=SUM,
 
 def reducescatter(tensor, op=SUM, name=None,
                   process_set=None) -> torch.Tensor:
+    if _wants_grad(tensor):
+        return _ReducescatterFn.apply(tensor, op, name, process_set)
     return reducescatter_async(tensor, op, name, process_set).wait()
 
 

@@ -141,7 +141,9 @@ for step in range(3):
     loss.backward()
     opt.step()
     opt.zero_grad()
-w = torch.cat([p.flatten() for p in model.parameters()])
+# detach: collectives of requires-grad tensors are differentiable now
+# (reference autograd semantics), and this is a plain value check
+w = torch.cat([p.detach().flatten() for p in model.parameters()])
 peer = hvd.allgather(w.unsqueeze(0), name="weights")
 np.testing.assert_allclose(peer[0].numpy(), peer[1].numpy(), atol=1e-6)
 

@@ -166,6 +166,66 @@ def main():
         * size)
     np.testing.assert_allclose(r1.numpy(), sum(range(1, size + 1)))
 
+    # --- differentiable sync collectives (reference autograd
+    # Functions); gradients follow the distributed contract: the
+    # backward collective sums upstream grads across ranks ------------
+    x = torch.arange(3, dtype=torch.float32, requires_grad=True)
+    out = hvd.allreduce(x, op=hvd.Sum, name="dar")
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), size * np.ones(3),
+                               atol=1e-6)
+
+    x2 = (torch.arange(4, dtype=torch.float32).reshape(2, 2)
+          * (rank + 1)).requires_grad_(True)
+    g = hvd.allgather(x2, name="dag")
+    assert g.shape == (2 * size, 2)
+    (g * g).sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(),
+                               2.0 * size * x2.detach().numpy(),
+                               atol=1e-5)
+
+    x3 = torch.ones(2, requires_grad=True)
+    out = hvd.broadcast(x3, root_rank=0, name="dbc")
+    (out * (rank + 1)).sum().backward()
+    expected = (np.full(2, size * (size + 1) / 2.0) if rank == 0
+                else np.zeros(2))
+    np.testing.assert_allclose(x3.grad.numpy(), expected, atol=1e-6)
+
+    x4 = torch.ones(size * 2, requires_grad=True)
+    out = hvd.reducescatter(x4, op=hvd.Sum, name="drs")
+    out.sum().backward()
+    np.testing.assert_allclose(x4.grad.numpy(), np.ones(size * 2),
+                               atol=1e-6)
+
+    x5 = torch.arange(size, dtype=torch.float32).reshape(size, 1) \
+        .requires_grad_(True)
+    out, recv = hvd.alltoall(x5, splits=[1] * size, name="da2a")
+    assert list(recv.numpy()) == [1] * size
+    (out * (rank + 1)).sum().backward()
+    np.testing.assert_allclose(
+        x5.grad.numpy(),
+        np.arange(1, size + 1, dtype=np.float32).reshape(size, 1),
+        atol=1e-6)
+
+    # Grouped variants are differentiable too.
+    a = torch.ones(2, requires_grad=True)
+    b = torch.ones(3, requires_grad=True)
+    outs = hvd.grouped_allreduce([a, b], op=hvd.Sum, name="dgar")
+    sum(o.sum() for o in outs).backward()
+    np.testing.assert_allclose(a.grad.numpy(), size * np.ones(2))
+    np.testing.assert_allclose(b.grad.numpy(), size * np.ones(3))
+    c = (torch.arange(2, dtype=torch.float32) * (rank + 1)) \
+        .requires_grad_(True)
+    g0, = hvd.grouped_allgather([c], name="dgag")
+    (g0 * g0).sum().backward()
+    np.testing.assert_allclose(c.grad.numpy(),
+                               2.0 * size * c.detach().numpy(),
+                               atol=1e-5)
+    d = torch.ones(size * 2, requires_grad=True)
+    r0, = hvd.grouped_reducescatter([d], op=hvd.Sum, name="dgrs")
+    r0.sum().backward()
+    np.testing.assert_allclose(d.grad.numpy(), np.ones(size * 2))
+
     print("TORCH_GROUPED_OK", rank, flush=True)
     hvd.shutdown()
 
