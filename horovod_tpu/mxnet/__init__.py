@@ -36,7 +36,10 @@ from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
                       allreduce_async, allreduce_async_, alltoall,
                       alltoall_async, barrier, broadcast, broadcast_,
                       broadcast_async, broadcast_async_,
-                      grouped_allreduce, grouped_allreduce_async, join,
+                      grouped_allgather, grouped_allgather_async,
+                      grouped_allreduce, grouped_allreduce_async,
+                      grouped_reducescatter,
+                      grouped_reducescatter_async, join,
                       poll, reducescatter, reducescatter_async,
                       synchronize)
 

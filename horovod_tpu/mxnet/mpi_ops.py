@@ -31,6 +31,8 @@ except ImportError:  # pragma: no cover - exercised when mxnet missing
 __all__ = [
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_allgather", "grouped_allgather_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
     "reducescatter", "reducescatter_async", "barrier", "join",
@@ -152,6 +154,33 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
     return [h.wait() for h in grouped_allreduce_async(
         tensors, average, name, op, prescale_factor, postscale_factor,
         process_set)]
+
+
+def grouped_allgather_async(tensors: Sequence,
+                            name: Optional[str] = None,
+                            process_set=None) -> List[MXHandle]:
+    hs = _api.grouped_allgather_async(
+        [_to_np(t) for t in tensors], name, process_set)
+    return [MXHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_allgather(tensors, name=None, process_set=None) -> List:
+    return [h.wait() for h in grouped_allgather_async(
+        tensors, name, process_set)]
+
+
+def grouped_reducescatter_async(tensors: Sequence, op=None,
+                                name: Optional[str] = None,
+                                process_set=None) -> List[MXHandle]:
+    hs = _api.grouped_reducescatter_async(
+        [_to_np(t) for t in tensors], op, name, process_set)
+    return [MXHandle(h, like=t) for h, t in zip(hs, tensors)]
+
+
+def grouped_reducescatter(tensors, op=None, name=None,
+                          process_set=None) -> List:
+    return [h.wait() for h in grouped_reducescatter_async(
+        tensors, op, name, process_set)]
 
 
 # -- allgather -------------------------------------------------------------
