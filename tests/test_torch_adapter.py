@@ -186,12 +186,14 @@ GROUPED_WORKER = os.path.join(REPO, "tests", "utils",
                               "torch_grouped_worker.py")
 
 
-def test_multirank_grouped_and_sparse_optimizer():
+@pytest.mark.parametrize("size", [2, 4])
+def test_multirank_grouped_and_sparse_optimizer(size):
     # num_groups buckets (grouped_allreduce negotiation), explicit
-    # groups with ungrouped leftovers, and sparse_as_dense embedding
-    # grads, all against a recomputed world-mean oracle.
+    # groups with ungrouped leftovers, sparse embedding grads, and the
+    # differentiable collectives, all against recomputed world oracles.
     from tests.utils.spawn import spawn_world, assert_world_ok
-    assert_world_ok(spawn_world(GROUPED_WORKER, 2), "TORCH_GROUPED_OK")
+    assert_world_ok(spawn_world(GROUPED_WORKER, size),
+                    "TORCH_GROUPED_OK")
 
 
 @pytest.mark.parametrize("size", [2, 4])
