@@ -71,9 +71,18 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--check-build", action="store_true",
                    help="print the build feature matrix and exit "
                         "(reference: horovodrun --check-build)")
+    p.add_argument("--gloo", action="store_true",
+                   help="accepted for reference-CLI parity: the TCP "
+                        "controller IS the gloo-equivalent plane")
+    p.add_argument("--mpi", action="store_true",
+                   help="rejected: no MPI backend by design")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command line")
     args = p.parse_args(argv)
+    if args.mpi:
+        p.error("--mpi is not supported: this framework has no MPI "
+                "backend by design (drop the flag; --gloo/default is "
+                "the TCP gloo-equivalent plane)")
     if not args.command and not args.check_build:
         p.error("no worker command given")
     if args.command and args.command[0] == "--":

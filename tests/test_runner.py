@@ -107,6 +107,14 @@ def test_check_build_matrix():
     assert "[ ] NCCL" in text  # absent by design, honestly reported
 
 
+def test_cli_backend_flags():
+    from horovod_tpu.runner.launch import parse_args
+    args = parse_args(["--gloo", "-np", "2", "python", "x.py"])
+    assert args.gloo and args.np == 2
+    with pytest.raises(SystemExit):
+        parse_args(["--mpi", "-np", "2", "python", "x.py"])
+
+
 def test_parse_args_requires_command():
     with pytest.raises(SystemExit):
         parse_args(["-np", "2"])
