@@ -162,6 +162,62 @@ def test_spark_run_elastic_scale_up_mid_run(monkeypatch):
     assert all(r[2] == 2 for r in results)      # resize observed
 
 
+def _failing_once_fn(marker):
+    """Simulated hardware failure on the first attempt: rank 1 dies
+    (process exit — dead sockets are what a real node loss looks like),
+    the survivor's collective fails with HorovodInternalError, which
+    surfaces from ray.get as a RayError."""
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if hvd.rank() == 1 and not os.path.exists(marker):
+        open(marker, "w").write("x")
+        os._exit(1)
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                        name="retry")
+    result = (hvd.rank(), hvd.size(), float(np.asarray(out)[0]))
+    hvd.shutdown()
+    return result
+
+
+def test_elastic_ray_executor_replay_run_and_retry(monkeypatch,
+                                                   tmp_path):
+    # End-to-end elastic on the fake-ray actors: a clean run, then a
+    # first-attempt collective failure that surfaces as RayError from
+    # ray.get — the executor tears the world down, rebuilds fresh
+    # actors, and the retry succeeds.
+    make_fake_ray(monkeypatch)
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.ray.elastic import ElasticRayExecutor
+    ex = ElasticRayExecutor(min_np=2,
+                            override_discovery=FixedHosts(
+                                {"127.0.0.1": 2}))
+    ex.start()
+    try:
+        results = ex.run(_train_fn, args=("elastic_ray",))
+        assert sorted(r[0] for r in results) == [0, 1]
+        assert all(r[1] == 2 for r in results)
+    finally:
+        ex.shutdown()
+
+    ex2 = ElasticRayExecutor(min_np=2, retries=2, cooldown_s=0.1,
+                             override_discovery=FixedHosts(
+                                 {"127.0.0.1": 2}))
+    marker = str(tmp_path / "ray_died_once")
+    try:
+        results = ex2.run(_failing_once_fn, args=(marker,))
+        assert sorted(r[0] for r in results) == [0, 1]
+        np.testing.assert_allclose([r[2] for r in results], 2.0)
+    finally:
+        ex2.shutdown()
+    import os
+    assert os.path.exists(marker), "the injected failure never fired"
+
+
 def test_mxnet_replay_real_branches_on_2rank_world():
     # A fake `mxnet` module (recorded API surface: nd.NDArray/nd.array/
     # gluon.Trainer) installed BEFORE the adapter imports, driven over

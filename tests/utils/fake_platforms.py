@@ -162,6 +162,12 @@ def _install_fake_ray_child():
     sys.modules["ray.util"] = util_mod
 
 
+class FakeRayError(Exception):
+    """Stands in for ray.exceptions.RayError: actor-side exceptions
+    surface from ray.get as a RayError subclass on real ray, and the
+    elastic executor's retry logic keys on that type."""
+
+
 class _Future:
     """Dispatched at .remote() time (like real ray) so concurrent
     actor calls — e.g. a blocking collective world — actually overlap;
@@ -173,7 +179,7 @@ class _Future:
     def _resolve(self):
         status, out = cloudpickle.loads(self._actor._conn.recv_bytes())
         if status != "ok":
-            raise RuntimeError(out)
+            raise FakeRayError(out)
         return out
 
 
@@ -241,9 +247,14 @@ def make_fake_ray(monkeypatch):
         except Exception:  # noqa: BLE001 - already dead
             pass
 
+    exceptions_mod = types.ModuleType("ray.exceptions")
+    exceptions_mod.RayError = FakeRayError
+    fake.exceptions = exceptions_mod
+
     fake.remote = remote
     fake.get = get
     fake.kill = kill
     monkeypatch.setitem(sys.modules, "ray", fake)
     monkeypatch.setitem(sys.modules, "ray.util", util_mod)
+    monkeypatch.setitem(sys.modules, "ray.exceptions", exceptions_mod)
     return fake
