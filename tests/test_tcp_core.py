@@ -38,11 +38,13 @@ def test_tcp_cache_eviction_under_capacity_pressure():
                             extra_env={"HOROVOD_CACHE_CAPACITY": "4"}))
 
 
-def test_tcp_group_name_reuse_changed_membership():
+@pytest.mark.parametrize("size", [2, 4])
+def test_tcp_group_name_reuse_changed_membership(size):
     # Regression: reusing a grouped_allreduce name with different member
     # count/shapes deadlocked — cached members bypassed the group
     # barrier while the shape-changed member waited in pending forever.
-    _assert_ok(_spawn_world(2, "regroup"))
+    # Size 4 adds process-set-scoped grouped negotiation.
+    _assert_ok(_spawn_world(size, "regroup"))
 
 
 def test_tcp_join_uneven_data():

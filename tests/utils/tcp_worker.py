@@ -186,6 +186,23 @@ def run_regroup(core, rank, size):
         r0, np.arange(size * 2, dtype=np.float32)[
             rank * 2:(rank + 1) * 2] * size)
     np.testing.assert_allclose(r1, sum(range(1, size + 1)))
+    if size >= 4:
+        # Grouped collective scoped to a process set (even ranks):
+        # atomic negotiation within the subgroup while odd ranks sit
+        # out entirely.
+        ps = core.add_process_set([0, 2])
+        if rank in (0, 2):
+            names = ["psg.0", "psg.1"]
+            core.register_group(names)
+            hs = [core.allreduce_async(
+                np.ones(3, np.float32) * (rank + 1), names[0],
+                process_set_id=ps),
+                core.allreduce_async(np.ones(2, np.float32), names[1],
+                                     process_set_id=ps)]
+            o0, o1 = [h.wait(timeout=30) for h in hs]
+            np.testing.assert_allclose(o0, 4.0)  # ranks 1 + 3
+            np.testing.assert_allclose(o1, 2.0)
+        core.barrier("psg_done")
 
 
 def run_cache_evict(core, rank, size):
