@@ -165,7 +165,7 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
             return _run_op(
                 lambda v: allreduce_async(
                     v, average,
-                    None if name is None else name + "_grad", op,
+                    _grad_name(name), op,
                     prescale_factor, postscale_factor,
                     process_set).wait(), dy)
 
@@ -197,17 +197,48 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
     return [TFHandle(h, like=t) for h, t in zip(hs, tensors)]
 
 
+def _grad_name(name):
+    return None if name is None else name + "_grad"
+
+
+def _grouped_custom_grad(tensors, fwd_fn, fwd_shapes, grad_fn,
+                         grad_shapes):
+    """Shared scaffold: grouped forward + grouped backward, both
+    stageable into tf.function, list in / list out."""
+
+    @tf.custom_gradient
+    def _op(*xs):
+        ys = _stage_group(fwd_fn, list(xs), out_shapes=fwd_shapes)
+
+        def grad(*dys):
+            return _stage_group(grad_fn, list(dys),
+                                out_shapes=grad_shapes)
+
+        return ys, grad
+
+    out = _op(*tensors)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
 def grouped_allreduce(tensors: Sequence, average=None,
                       name: Optional[str] = None, op=None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
                       process_set=None) -> List:
+    """Differentiable like the single op: the gradient of a grouped
+    allreduce is the grouped allreduce of the gradients."""
     tensors = [tf.convert_to_tensor(t) for t in tensors]
-    return _stage_group(
+    shapes = [t.shape for t in tensors]
+    return _grouped_custom_grad(
+        tensors,
         lambda ts: _grouped_allreduce_eager(
             ts, average, name, op, prescale_factor, postscale_factor,
             process_set),
-        tensors, out_shapes=[t.shape for t in tensors])
+        shapes,
+        lambda ts: _grouped_allreduce_eager(
+            ts, average, _grad_name(name), op, prescale_factor,
+            postscale_factor, process_set),
+        shapes)
 
 
 def _stage_group(eager_fn, tensors, out_shapes=None):
@@ -236,13 +267,37 @@ def grouped_allgather_async(tensors: Sequence,
 
 def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
                       process_set=None) -> List:
+    """Differentiable: each member's gradient is the allreduce-sum of
+    the upstream grad sliced to this rank's rows (the single-allgather
+    gradient, grouped)."""
     tensors = [tf.convert_to_tensor(t) for t in tensors]
-    return _stage_group(
+    n_locals = [t.shape[0] for t in tensors]
+    gname = _grad_name(name)
+
+    def _g(ts):
+        if any(n is None for n in n_locals):
+            raise NotImplementedError(
+                "grouped_allgather gradient needs static first "
+                "dimensions")
+        summed = [h.wait() for h in grouped_allreduce_async(
+            ts, op=SUM, name=gname, process_set=process_set)]
+        sizes = np.asarray(_api.allgather(
+            np.asarray([int(n) for n in n_locals],
+                       np.int64).reshape(1, -1),
+            name=None if gname is None else gname + "_sizes",
+            process_set=process_set))
+        my = _ps_rank(process_set)
+        return [s[int(sizes[:my, i].sum()):
+                  int(sizes[:my, i].sum()) + int(n)]
+                for i, (s, n) in enumerate(zip(summed, n_locals))]
+
+    return _grouped_custom_grad(
+        tensors,
         lambda ts: [h.wait() for h in grouped_allgather_async(
             ts, name, process_set)],
-        tensors,
-        out_shapes=[tf.TensorShape([None]).concatenate(t.shape[1:])
-                    for t in tensors])
+        [tf.TensorShape([None]).concatenate(t.shape[1:])
+         for t in tensors],
+        _g, [t.shape for t in tensors])
 
 
 def grouped_reducescatter_async(tensors: Sequence, op=None,
@@ -258,13 +313,25 @@ def grouped_reducescatter_async(tensors: Sequence, op=None,
 def grouped_reducescatter(tensors: Sequence, op=None,
                           name: Optional[str] = None,
                           process_set=None) -> List:
+    """Differentiable: the gradient is the grouped allgather of the
+    upstream grads (scaled by 1/size for Average, like the single op)."""
     tensors = [tf.convert_to_tensor(t) for t in tensors]
-    return _stage_group(
+
+    def _g(ts):
+        gs = [h.wait() for h in grouped_allgather_async(
+            ts, _grad_name(name), process_set)]
+        if op == AVERAGE:
+            gs = [g / tf.cast(_ps_size(process_set), g.dtype)
+                  for g in gs]
+        return gs
+
+    return _grouped_custom_grad(
+        tensors,
         lambda ts: [h.wait() for h in grouped_reducescatter_async(
             ts, op, name, process_set)],
-        tensors,
-        out_shapes=[tf.TensorShape([None]).concatenate(t.shape[1:])
-                    for t in tensors])
+        [tf.TensorShape([None]).concatenate(t.shape[1:])
+         for t in tensors],
+        _g, [t.shape for t in tensors])
 
 
 # -- allgather -------------------------------------------------------------
@@ -297,7 +364,7 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
                     "allgather gradient needs a static first dimension")
 
             def _g(dyv):
-                gname = None if name is None else name + "_grad"
+                gname = _grad_name(name)
                 summed = allreduce_async(dyv, op=SUM, name=gname,
                                          process_set=process_set).wait()
                 sizes = np.asarray(_api.allgather(
@@ -341,7 +408,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
             g = _run_op(
                 lambda v: allreduce_async(
                     v, op=SUM,
-                    name=None if name is None else name + "_grad",
+                    name=_grad_name(name),
                     process_set=process_set).wait(), dy)
             # root_rank is a GLOBAL rank (core operations.cc broadcast
             # semantics), so compare against the global rank even when
@@ -390,7 +457,7 @@ def _alltoall_graph_with_splits(tensor, splits, name, process_set):
             def _bwd(v, rt):
                 res = TFHandle(_api.alltoall_async(
                     _np_view(v), [int(i) for i in np.asarray(rt)],
-                    None if name is None else name + "_grad",
+                    _grad_name(name),
                     process_set), like=v).wait()
                 return res[0] if isinstance(res, tuple) else res
 
@@ -467,7 +534,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
                     rs = [int(s) for s in sizes.reshape(-1)]
                 res = TFHandle(_api.alltoall_async(
                     _np_view(v), rs,
-                    None if name is None else name + "_grad",
+                    _grad_name(name),
                     process_set), like=v).wait()
                 return res[0] if isinstance(res, tuple) else res
 
@@ -502,7 +569,7 @@ def reducescatter(tensor, op=SUM, name: Optional[str] = None,
             def _g(v):
                 g = TFHandle(_api.allgather_async(
                     _np_view(v),
-                    None if name is None else name + "_grad",
+                    _grad_name(name),
                     process_set), like=v).wait()
                 if op == AVERAGE:
                     # The forward divides the reduction by the set size;
