@@ -18,7 +18,6 @@ step, so this module provides the two TPU-native ways to run DP:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax

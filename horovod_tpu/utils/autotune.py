@@ -20,7 +20,6 @@ engine and is also importable for tests of the math itself.
 from __future__ import annotations
 
 import math
-import time
 from typing import List, Optional, Tuple
 
 import numpy as np

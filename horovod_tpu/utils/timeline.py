@@ -15,7 +15,6 @@ reference's timeline was for.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from typing import Optional
