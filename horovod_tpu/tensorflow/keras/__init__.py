@@ -1,0 +1,7 @@
+"""tf.keras adapter spelling (reference ``horovod.tensorflow.keras``):
+identical surface to ``horovod_tpu.keras`` — in the Keras-3 era there
+is one keras, so both import paths resolve to the same adapter.
+"""
+
+from ...keras import *  # noqa: F401,F403
+from ...keras import callbacks, elastic  # noqa: F401
