@@ -70,6 +70,7 @@ class ElasticDriver:
         self._stopped: set = set()       # slots told/forced to stop
         self._succeeded: set = set()     # slots whose proc exited 0
         self._spawn_attempts: Dict[Slot, float] = {}  # retry throttle
+        self._pending_spawns: set = set()  # spawn RPC in flight off-lock
         self._shutdown = threading.Event()
         self._below_min_since: Optional[float] = None
         self._rc = 0
@@ -157,7 +158,20 @@ class ElasticDriver:
     def _recompute_world(self, reason: str):
         """Epoch bump: recompute target slots, spawn/stop workers,
         notify live ones (caller must NOT hold the lock)."""
+        # Poll OUTSIDE the lock: platform proc proxies (Spark agents)
+        # may do blocking RPCs, and the message handler needs the lock.
         with self._lock:
+            snapshot = list(self._procs.items())
+        polled = {slot: (mp, mp.poll() is None) for slot, mp in snapshot}
+        with self._lock:
+            def _alive(slot):
+                mp = self._procs.get(slot)
+                if mp is None:
+                    return False
+                rec = polled.get(slot)
+                if rec is not None and rec[0] is mp:
+                    return rec[1]
+                return True  # installed after the poll pass: fresh
             new_target = self._hosts.ordered_slots(self.max_np)
             if len(new_target) < self.min_np:
                 if self._below_min_since is None:
@@ -168,9 +182,6 @@ class ElasticDriver:
                 new_target = []
             else:
                 self._below_min_since = None
-            def _alive(slot):
-                mp = self._procs.get(slot)
-                return mp is not None and mp.poll() is None
             if (new_target == self._target and self._published
                     and all(_alive(s) for s in new_target)):
                 return
@@ -187,15 +198,23 @@ class ElasticDriver:
                      reason, self._epoch, len(new_target))
             # Stop procs whose slot left the world (host removed, or a
             # shrunk host renumbered its slots away).
-            for slot, mp in list(self._procs.items()):
-                if slot not in new_target and mp.poll() is None:
+            for slot in list(self._procs):
+                if slot not in new_target and _alive(slot):
                     self._stopped.add(slot)
-            # Spawn procs for target slots without a live process.
-            for slot in new_target:
-                mp = self._procs.get(slot)
-                if mp is None or mp.poll() is not None:
-                    self._spawn_worker(slot)
+            # Collect target slots without a live process; the spawn
+            # RPCs themselves run after the lock is released.  A slot
+            # whose spawn is already in flight on the other thread is
+            # skipped — double-spawning would race two real processes
+            # for one rendezvous slot.
+            to_spawn = [slot for slot in new_target
+                        if not _alive(slot)
+                        and slot not in self._pending_spawns]
+            now = time.monotonic()
+            for slot in to_spawn:
+                self._pending_spawns.add(slot)
+                self._spawn_attempts[slot] = now
             addrs = list(self._worker_addrs.items())
+        self._spawn_workers(to_spawn)
         # Notify outside the lock (network).
         for slot, addr in addrs:
             try:
@@ -205,11 +224,14 @@ class ElasticDriver:
                                 "epoch": self._epoch}}, timeout=5.0)
             except Exception:  # noqa: BLE001 — worker may be dead
                 pass
+        # Terminate stopped procs off-lock too (AgentProc.terminate is
+        # a network RPC).
         with self._lock:
-            for slot in self._stopped:
-                mp = self._procs.get(slot)
-                if mp is not None and mp.poll() is None:
-                    mp.terminate()
+            to_stop = [mp for slot, mp in self._procs.items()
+                       if slot in self._stopped]
+        for mp in to_stop:
+            if mp.poll() is None:
+                mp.terminate()
 
     def _worker_env(self, slot: Slot) -> Dict[str, str]:
         host, idx = slot
@@ -245,18 +267,52 @@ class ElasticDriver:
             stderr_sink=lambda l, p=prefix: sys.stderr.write(
                 p + "<stderr>" + l))
 
-    def _spawn_worker(self, slot: Slot):
-        host, idx = slot
-        mp = self._make_worker_proc(slot, self._worker_env(slot))
-        if mp is None:
-            # Platform overrides may decline (agent not registered yet);
-            # the next recompute retries.
-            LOG.info("no carrier for worker %s:%d yet", host, idx)
-            return
-        self._procs[slot] = mp
-        self._stopped.discard(slot)
-        self._succeeded.discard(slot)
-        LOG.info("spawned worker %s:%d", host, idx)
+    def _spawn_workers(self, slots):
+        """Start workers for ``slots``, doing the spawn itself OUTSIDE
+        the lock — platform carriers (Spark agents) may block on a
+        network RPC and the message handler needs the lock — then
+        install the returned procs under the lock.  Every slot here is
+        in ``self._pending_spawns`` (set by the caller under the lock),
+        which keeps the reap loop and the discovery thread from double-
+        spawning the same slot while the RPC is in flight.
+
+        A spawn that raced a world change (slot dropped from the
+        target) or the shutdown is terminated instead of installed;
+        the worker's env is epoch-independent, so a spawn that merely
+        crossed an epoch bump while its slot stayed in the target is
+        still the process the new epoch wants."""
+        for slot in slots:
+            host, idx = slot
+            try:
+                mp = self._make_worker_proc(slot, self._worker_env(slot))
+            finally:
+                # Cleared before install so a failure can't wedge the
+                # slot; install below re-checks under the same lock.
+                with self._lock:
+                    self._pending_spawns.discard(slot)
+            if mp is None:
+                # Platform overrides may decline (agent not registered
+                # yet); the next recompute retries.
+                LOG.info("no carrier for worker %s:%d yet", host, idx)
+                continue
+            with self._lock:
+                stale = (self._shutdown.is_set()
+                         or slot not in self._target
+                         or slot in self._stopped)
+                if not stale:
+                    self._procs[slot] = mp
+                    self._succeeded.discard(slot)
+            if stale:
+                # The pending guard means no replacement proc can exist
+                # for this slot, so terminating the carrier (for agent
+                # proxies: the agent's single proc slot) only ever kills
+                # the process this very call started.
+                try:
+                    mp.terminate()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            else:
+                LOG.info("spawned worker %s:%d", host, idx)
 
     # -- monitoring --------------------------------------------------------
 
@@ -299,16 +355,20 @@ class ElasticDriver:
             # is driving.  Throttled per slot — each attempt can be a
             # network RPC.
             now = time.monotonic()
+            to_spawn = []
             for slot in self._target:
                 if slot not in self._procs and slot not in self._stopped \
                         and slot not in self._succeeded \
+                        and slot not in self._pending_spawns \
                         and slot[0] not in failed_hosts \
                         and now - self._spawn_attempts.get(slot, 0) >= 1.0:
                     self._spawn_attempts[slot] = now
-                    self._spawn_worker(slot)
+                    self._pending_spawns.add(slot)
+                    to_spawn.append(slot)
             target = list(self._target)
             done = (bool(target) and self._published
                     and all(s in self._succeeded for s in target))
+        self._spawn_workers(to_spawn)
         if done:
             self._rc = 0
             return True

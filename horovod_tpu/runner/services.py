@@ -144,8 +144,12 @@ class TaskService:
                 stderr_sink=sys.stderr.write)
             return {"ok": True}
         if kind == "proc_poll":
-            return {"rc": None if self._proc is None
-                    else self._proc.poll()}
+            # has_proc lets the caller tell "running" (rc=None with a
+            # live proc) from "no proc at all" (agent restarted and lost
+            # state) — the latter must read as a failed spawn upstream.
+            if self._proc is None:
+                return {"rc": None, "has_proc": False}
+            return {"rc": self._proc.poll(), "has_proc": True}
         if kind == "proc_stop":
             if self._proc is not None and self._proc.poll() is None:
                 self._proc.terminate()

@@ -118,11 +118,18 @@ class _AgentProc:
             return None
         self._last_poll = now
         try:
-            rc = send_message(self._addr, self._secret,
-                              {"kind": "proc_poll"}, timeout=5.0)["rc"]
+            resp = send_message(self._addr, self._secret,
+                                {"kind": "proc_poll"}, timeout=5.0)
             self._failures = 0
-            self._last_rc = rc
-            return rc
+            # An agent with NO process (restarted, lost state) must not
+            # read as "running" forever: treat it as a failed spawn so
+            # the driver's reap loop retries the slot.  Older agents
+            # without the has_proc field keep the lenient reading.
+            if resp.get("has_proc") is False:
+                self._last_rc = 1
+                return 1
+            self._last_rc = resp["rc"]
+            return self._last_rc
         except Exception:  # noqa: BLE001 - transient or dead agent
             self._failures += 1
             if self._failures >= self._MAX_FAILURES:

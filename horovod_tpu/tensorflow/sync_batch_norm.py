@@ -64,18 +64,42 @@ class SyncBatchNormalization(tf.keras.layers.Layer):
         var = tf.maximum(packed[c:2 * c] / total - tf.square(mean), 0.0)
         return mean, var
 
+    def _train_moments(self, x):
+        mean, var = self._global_moments(tf.cast(x, tf.float32))
+        self.moving_mean.assign(
+            self.momentum * self.moving_mean
+            + (1.0 - self.momentum) * tf.stop_gradient(mean))
+        self.moving_variance.assign(
+            self.momentum * self.moving_variance
+            + (1.0 - self.momentum) * tf.stop_gradient(var))
+        return mean, var
+
+    def _infer_moments(self):
+        return (tf.identity(self.moving_mean),
+                tf.identity(self.moving_variance))
+
     def call(self, x, training=False):
         x = tf.convert_to_tensor(x)
+        # ``training`` may be a symbolic tensor inside tf.function
+        # (keras smart_cond contract); resolve it statically when
+        # possible, else tf.cond over both branches.  The predicate is
+        # rank-uniform (same training flag everywhere), so the
+        # collective in the train branch fires on all ranks or none.
+        if tf.is_tensor(training):
+            static = tf.get_static_value(training)
+            training = bool(static) if static is not None else training
         # Frozen layers run in inference mode (keras BatchNormalization
         # contract): batch stats untouched, moving averages preserved.
-        if training and self.trainable:
-            mean, var = self._global_moments(tf.cast(x, tf.float32))
-            self.moving_mean.assign(
-                self.momentum * self.moving_mean
-                + (1.0 - self.momentum) * tf.stop_gradient(mean))
-            self.moving_variance.assign(
-                self.momentum * self.moving_variance
-                + (1.0 - self.momentum) * tf.stop_gradient(var))
+        if tf.is_tensor(training):
+            if self.trainable:
+                mean, var = tf.cond(
+                    tf.cast(training, tf.bool),
+                    lambda: self._train_moments(x),
+                    self._infer_moments)
+            else:
+                mean, var = self._infer_moments()
+        elif training and self.trainable:
+            mean, var = self._train_moments(x)
         else:
             mean = self.moving_mean
             var = self.moving_variance

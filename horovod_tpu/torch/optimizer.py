@@ -71,6 +71,25 @@ class _DistributedOptimizer:
 
         if named_parameters is not None:
             named = list(named_parameters)
+            # Reference parity: a partial mapping would leave some params
+            # falling back to hook order for the grouped wire sort, which
+            # is not cross-rank deterministic — upstream rejects it too.
+            names = [name for name, _ in named]
+            if len(set(names)) < len(names):
+                dup = sorted({n for n in names if names.count(n) > 1})
+                raise ValueError(
+                    "named_parameters contains duplicate names: %s"
+                    % ", ".join(dup))
+            covered = {id(p) for _, p in named}
+            missing = sum(
+                1 for group in optimizer.param_groups
+                for p in group["params"] if id(p) not in covered)
+            if missing:
+                raise ValueError(
+                    "named_parameters was specified, but %d of the "
+                    "optimizer's parameters are not named; pass "
+                    "model.named_parameters() covering every parameter "
+                    "in optimizer.param_groups" % missing)
         else:
             named = []
             for gi, group in enumerate(optimizer.param_groups):

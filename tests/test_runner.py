@@ -175,6 +175,34 @@ def test_driver_task_services():
         task.stop()
 
 
+def test_task_service_proc_poll_distinguishes_no_proc():
+    # An agent with NO process (restarted, lost state) must not read as
+    # "running" forever: proc_poll carries has_proc so the elastic
+    # driver's _AgentProc treats it as a failed spawn and retries.
+    from horovod_tpu.runner.services import send_message
+    from horovod_tpu.spark.elastic import _AgentProc
+    task = TaskService(index=0, secret="k")
+    port = task.start()
+    try:
+        resp = send_message(("127.0.0.1", port), "k",
+                            {"kind": "proc_poll"}, timeout=5.0)
+        assert resp == {"rc": None, "has_proc": False}
+        proxy = _AgentProc(("127.0.0.1", port), "k")
+        assert proxy.poll() == 1  # no-proc reads as failed, not alive
+        # A real (running) proc reads as alive, then its exit code.
+        send_message(("127.0.0.1", port), "k",
+                     {"kind": "run", "cmd": ["__PYTHON__", "-c",
+                                             "import time; time.sleep(5)"],
+                      "env": {}}, timeout=5.0)
+        resp = send_message(("127.0.0.1", port), "k",
+                            {"kind": "proc_poll"}, timeout=5.0)
+        assert resp["has_proc"] is True and resp["rc"] is None
+        send_message(("127.0.0.1", port), "k",
+                     {"kind": "proc_stop"}, timeout=5.0)
+    finally:
+        task.stop()
+
+
 def _worker_env():
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)

@@ -346,6 +346,35 @@ def test_alltoall_explicit_splits_inside_tf_function(hvd):
     assert np.allclose(g.numpy(), np.full(4, 3.0))
 
 
+def test_sync_batch_norm_symbolic_training_flag(hvd):
+    # Keras passes `training` as a symbolic tensor inside tf.function
+    # (smart_cond contract); the layer must trace a tf.cond over both
+    # branches instead of evaluating the tensor as a Python bool.
+    import tensorflow as tf
+
+    bn = hvd.SyncBatchNormalization(epsilon=1e-5)
+    x = tf.random.normal([8, 4])
+    bn(x, training=True)  # build + one eager train step
+
+    @tf.function
+    def step(inp, training):
+        return bn(inp, training=training)
+
+    train_out = step(x, tf.constant(True))
+    mean = tf.reduce_mean(x, axis=0)
+    var = tf.math.reduce_variance(x, axis=0)
+    want = (x - mean) * tf.math.rsqrt(var + 1e-5) * bn.gamma + bn.beta
+    assert np.allclose(train_out.numpy(), want.numpy(), atol=1e-4)
+
+    infer_out = step(x, tf.constant(False))
+    want_inf = ((x - bn.moving_mean)
+                * tf.math.rsqrt(bn.moving_variance + 1e-5)
+                * bn.gamma + bn.beta)
+    assert np.allclose(infer_out.numpy(), want_inf.numpy(), atol=1e-4)
+    # The train branch updated the moving averages under the cond.
+    assert not np.allclose(bn.moving_mean.numpy(), np.zeros(4))
+
+
 def test_tpu_jit_kernel_registered_with_clear_error():
     # On TPU, tf.function(jit_compile=True) around hvd ops must fail at
     # TRACE time with a redirect to the JAX adapter (a host custom-call

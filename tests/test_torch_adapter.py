@@ -59,6 +59,25 @@ def test_size1_optimizer_matches_plain(hvd):
         assert torch.allclose(pa, pb)
 
 
+def test_partial_named_parameters_rejected(hvd):
+    # Reference parity: a named_parameters that does not cover every
+    # optimizer param is rejected at construction — otherwise grouped
+    # wire order would fall back to autograd hook order, which is not
+    # cross-rank deterministic.
+    model = torch.nn.Sequential(torch.nn.Linear(4, 3),
+                                torch.nn.Linear(3, 2))
+    partial = list(model.named_parameters())[:2]
+    with pytest.raises(ValueError, match="not named"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=partial)
+    dup = [("w", p) for p in model.parameters()]
+    with pytest.raises(ValueError, match="duplicate"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=dup)
+
+
 def test_compression_roundtrip():
     from horovod_tpu.torch.compression import Compression
     t = torch.randn(5)
