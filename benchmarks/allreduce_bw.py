@@ -45,6 +45,19 @@ def main():
                          "path; under the launcher's --multihost mode "
                          "this exercises negotiation + the device-"
                          "resident executor")
+    ap.add_argument("--eager-async", action="store_true",
+                    help="eager path, but issue every iteration's op "
+                         "with allreduce_async and wait at the end — "
+                         "the DistributedOptimizer traffic shape, and "
+                         "the apples-to-apples comparison against the "
+                         "jit loop (which also dispatches all iters "
+                         "before its single fetch barrier)")
+    ap.add_argument("--burst", type=int, default=None,
+                    help="with --eager-async: enqueue BURST ops per "
+                         "wait round (a fixed-size gradient bucket, "
+                         "like one optimizer step) instead of all "
+                         "iters at once — keeps the fused group "
+                         "composition identical between timing passes")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -58,7 +71,7 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
 
-    if args.eager:
+    if args.eager or args.eager_async:
         return run_eager(args)
 
     import os
@@ -195,14 +208,43 @@ def run_eager(args):
             x = jnp.ones((n, elems), dtype)      # rank-major stacked
         tag = "bw.%s" % size_mb
 
-        def timed(iters):
-            t0 = time.perf_counter()
-            y = None
-            for _ in range(iters):
-                y = hvd.allreduce(x, op=hvd.Sum, name=tag)
-            if y is not None:
-                float(np.asarray(y).reshape(-1)[0])  # fetch barrier
-            return time.perf_counter() - t0
+        if args.eager_async:
+            seq = [0]
+
+            def timed(iters):
+                # Burst shape: B async enqueues then one synchronize
+                # (one optimizer step's gradient bucket; B = all iters
+                # unless --burst caps it) — the negotiation/dispatch/
+                # execution pipeline overlaps across in-flight ops the
+                # way the jit loop's N dispatches overlap before its
+                # single fetch barrier.  Unique in-flight names per op
+                # (the engine's duplicate-name contract).
+                burst = args.burst or iters
+                t0 = time.perf_counter()
+                y = None
+                done = 0
+                while done < iters:
+                    hs = []
+                    for _ in range(min(burst, iters - done)):
+                        seq[0] += 1
+                        hs.append(hvd.allreduce_async(
+                            x, op=hvd.Sum,
+                            name="%s.%d" % (tag, seq[0])))
+                    done += len(hs)
+                    for h in hs:
+                        y = hvd.synchronize(h)
+                if y is not None:
+                    float(np.asarray(y).reshape(-1)[0])  # fetch barrier
+                return time.perf_counter() - t0
+        else:
+            def timed(iters):
+                t0 = time.perf_counter()
+                y = None
+                for _ in range(iters):
+                    y = hvd.allreduce(x, op=hvd.Sum, name=tag)
+                if y is not None:
+                    float(np.asarray(y).reshape(-1)[0])  # fetch barrier
+                return time.perf_counter() - t0
 
         timed(args.warmup)
         t1 = timed(args.iters)
@@ -211,7 +253,8 @@ def run_eager(args):
         resolvable = per_op >= 20e-6
         bus_bytes = 2.0 * (n - 1) / n * elems * dtype.itemsize
         bus_gbps = bus_bytes / per_op / 1e9 if resolvable else None
-        rec = {"metric": "allreduce_bus_bandwidth", "path": "eager",
+        rec = {"metric": "allreduce_bus_bandwidth",
+               "path": "eager_async" if args.eager_async else "eager",
                "mode": "multihost" if multihost else "inprocess",
                "size_mb": size_mb, "ranks": n,
                "time_us": round(per_op * 1e6, 2),
@@ -229,8 +272,9 @@ def run_eager(args):
                 if r["bus_gb_per_sec"] is not None), default=0.0)
     if hvd.rank() == 0:
         summary = {"metric": "allreduce_bus_bandwidth_peak",
-                   "path": "eager", "value": best, "unit": "GB/s",
-                   "ranks": n}
+                   "path": ("eager_async" if args.eager_async
+                            else "eager"),
+                   "value": best, "unit": "GB/s", "ranks": n}
         if args.link_gbps:
             summary["efficiency_vs_link"] = round(best / args.link_gbps,
                                                   4)

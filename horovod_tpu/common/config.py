@@ -104,6 +104,10 @@ class Config:
     num_streams: int = 1  # HOROVOD_NUM_NCCL_STREAMS analog: engine executors
     batch_d2d_memcopies: bool = True
     elastic_timeout_secs: float = 600.0
+    # Multihost executor pipeline depth: negotiated groups dispatched
+    # but not yet completed.  Bounds live staging/output buffers the
+    # way the reference's finite NCCL stream queue does.
+    max_inflight_groups: int = 4
 
     @staticmethod
     def from_env() -> "Config":
@@ -144,4 +148,6 @@ class Config:
             num_streams=_env_int("NUM_STREAMS", 1),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
             elastic_timeout_secs=_env_float("ELASTIC_TIMEOUT", 600.0),
+            max_inflight_groups=max(
+                1, _env_int("MAX_INFLIGHT_GROUPS", 4)),
         )

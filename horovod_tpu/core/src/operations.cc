@@ -158,7 +158,16 @@ Status CoreState::Initialize(int rank, int size,
   return Status::OK();
 }
 
-void CoreState::RequestShutdown() { shutdown_requested_ = true; }
+void CoreState::RequestShutdown() {
+  shutdown_requested_ = true;
+  WakeLoop();
+}
+
+void CoreState::WakeLoop() {
+  std::lock_guard<std::mutex> lk(wake_mu_);
+  ++enqueue_seq_;
+  wake_cv_.notify_one();
+}
 
 void CoreState::WaitShutdown() {
   if (background_.joinable()) background_.join();
@@ -186,10 +195,14 @@ int32_t CoreState::Enqueue(Request req, const void* data, int64_t nbytes) {
         "' is already pending; names must be unique among in-flight ops");
     entry->PublishDone();
   }
-  std::lock_guard<std::mutex> lk(handles_mu_);
-  int32_t h = next_handle_++;
-  entry->handle = h;
-  handles_[h] = entry;
+  int32_t h;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    h = next_handle_++;
+    entry->handle = h;
+    handles_[h] = entry;
+  }
+  WakeLoop();
   return h;
 }
 
@@ -204,8 +217,9 @@ int32_t CoreState::EnqueueJoin() {
     entry->handle = h;
     handles_[h] = entry;
     join_requested_ = true;
-    return h;
   }
+  WakeLoop();
+  return entry->handle;
 }
 
 int CoreState::Poll(int32_t handle) {
@@ -274,6 +288,14 @@ void CoreState::BackgroundLoop() {
     auto cycle_start = std::chrono::steady_clock::now();
     ++cycle_count_;
     timeline_.MarkCycle(cycle_count_);
+    // Enqueues at or before this point are drained by THIS cycle; any
+    // later one flips the predicate of the end-of-cycle wait below so
+    // the next cycle starts without the fixed pause.
+    uint64_t seen_seq;
+    {
+      std::lock_guard<std::mutex> lk(wake_mu_);
+      seen_seq = enqueue_seq_;
+    }
 
     // Build this cycle's message: cache bits for known tensors, full
     // requests for new ones (reference: RunLoopOnce request path).
@@ -390,8 +412,16 @@ void CoreState::BackgroundLoop() {
       stopped_ = true;
       return;
     }
-    std::this_thread::sleep_for(
-        std::chrono::duration<double, std::milli>(cycle_time_ms_));
+    // Inter-cycle pause: at most cycle_time, but a fresh enqueue (or
+    // shutdown request) wakes the loop immediately — the reference
+    // pays up to a full HOROVOD_CYCLE_TIME of latency here; a cv wait
+    // keeps the idle pacing without taxing every synchronous op.
+    {
+      std::unique_lock<std::mutex> lk(wake_mu_);
+      wake_cv_.wait_for(
+          lk, std::chrono::duration<double, std::milli>(cycle_time_ms_),
+          [&] { return enqueue_seq_ != seen_seq; });
+    }
   }
 }
 

@@ -119,6 +119,16 @@ class CoreState {
   std::atomic<bool> stopped_{false};
   double cycle_time_ms_ = 5.0;
   uint64_t cycle_count_ = 0;
+
+  // Wake-on-enqueue: the background loop's inter-cycle pause is a cv
+  // wait, not a fixed sleep — an Enqueue/EnqueueJoin/RequestShutdown
+  // during the pause starts the next cycle immediately instead of
+  // paying up to a full cycle_time of latency (the dominant fixed cost
+  // of a synchronous eager collective).
+  void WakeLoop();
+  std::mutex wake_mu_;
+  std::condition_variable wake_cv_;
+  uint64_t enqueue_seq_ = 0;  // guarded by wake_mu_
 };
 
 }  // namespace hvdtpu

@@ -28,6 +28,7 @@ the reference's background thread plays for NCCL kernels).
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -231,6 +232,9 @@ class GlobalMeshCollectives:
         per-entry flat device arrays, replicated on the mesh device.
         """
         lengths = [int(n) for n in lengths]
+        if len(lengths) > 1:
+            return self._fused_allreduce_packed(
+                payloads, lengths, dtype, red_op, prescale, postscale)
         key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),
                red_op, float(prescale), float(postscale))
         size = self.size
@@ -248,6 +252,52 @@ class GlobalMeshCollectives:
                   for p, n in zip(payloads, lengths)]
         outs = self._compiled(key, build, staged)(*staged)
         return [self._replicated(o) for o in outs]
+
+    def _fused_allreduce_packed(self, payloads, lengths, dtype, red_op,
+                                prescale, postscale):
+        """Multi-entry fusion via a bucket-padded flat buffer — the
+        reference's fusion buffer (MemcpyInFusionBuffer / 64 MB
+        persistent buffer, SURVEY §2.1 row 8) in XLA form.
+
+        Group COMPOSITION depends on arrival timing: a DistributedOptimizer
+        burst negotiates different (n_1..n_k) tuples cycle to cycle, and
+        a compiled program per composition recompiles endlessly (measured
+        16-60x slowdowns on async bursts).  Packing the entries into one
+        power-of-two bucket keys the collective executable by bucket size
+        alone; the pack/unpack copies are cheap eager device ops, exactly
+        the memcpy in/out the reference pays."""
+        import jax
+        import jax.numpy as jnp
+        from .engine import _bucket
+
+        total = int(sum(lengths))
+        bucket = _bucket(total)
+        np_dtype = np.dtype(dtype)
+        parts = []
+        with jax.default_device(self.device):
+            for p, n in zip(payloads, lengths):
+                if p is None:
+                    parts.append(jnp.zeros((n,), np_dtype))
+                elif _is_device_array(p):
+                    # device_put: a payload committed to a DIFFERENT
+                    # local device must move to the mesh device or the
+                    # concatenate below rejects the mixed placement
+                    # (no-op for the common already-here case).
+                    parts.append(jax.device_put(
+                        jnp.reshape(p, (n,)), self.device))
+                else:
+                    self.host_stages += 1
+                    parts.append(jnp.asarray(np.ascontiguousarray(
+                        np.asarray(p)).reshape(n)))
+            if bucket > total:
+                parts.append(jnp.zeros((bucket - total,), np_dtype))
+            flat = (jnp.concatenate(parts) if len(parts) > 1
+                    else parts[0])
+        out = self.fused_allreduce([flat], [bucket], np_dtype, red_op,
+                                   prescale, postscale)[0]
+        offs = np.concatenate([[0], np.cumsum(lengths)]).astype(int)
+        return [out[offs[i]:offs[i] + lengths[i]]
+                for i in range(len(lengths))]
 
     def allreduce(self, local_flat, red_op: str = SUM,
                   prescale: float = 1.0, postscale: float = 1.0):
@@ -459,6 +509,33 @@ class MultihostEngine:
         # core handle -> (py handle, local payload ndarray, orig shape)
         self._pending: Dict[int, tuple] = {}
         self._shutdown = False
+        # Two-stage pipeline (the reference's background loop negotiates
+        # cycle N+1 while N's NCCL kernels run async, SURVEY §3.2): the
+        # drain thread only stages + dispatches compiled programs (XLA
+        # dispatch is async), the completion thread performs the
+        # blocking device_get / handle resolution.  Bounded so a slow
+        # host fetch backpressures dispatch instead of piling device
+        # programs without limit.
+        # Pipeline depth: device programs dispatched but not yet
+        # complete.  The drain thread parks one representative output
+        # per group and blocks on the OLDEST once the window fills —
+        # bounding live staging/output buffers (the reference's finite
+        # NCCL stream queue) while keeping up to `depth` collectives
+        # overlapped on device.  Only the drain thread touches it.
+        self._depth = max(1, int(getattr(config, "max_inflight_groups",
+                                         4)))
+        self._inflight_outs: List = []
+        self._done_q: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=self._depth)
+        # Groups routed through the completion thread and not yet
+        # finished (guarded by _lock): the drain thread completes a
+        # device-only group inline ONLY when this is zero, so handle
+        # resolution order always follows negotiation order.
+        self._host_inflight = 0
+        self._done_thread = threading.Thread(
+            target=self._completion_loop,
+            name="hvd-tpu-multihost-done", daemon=True)
+        self._done_thread.start()
         self._thread = threading.Thread(
             target=self._loop, name="hvd-tpu-multihost-exec", daemon=True)
         self._thread.start()
@@ -562,6 +639,11 @@ class MultihostEngine:
             return self._pending.pop(handle, (None, None))
 
     def _execute(self, g: dict):
+        """Stage and dispatch one negotiated group, then hand the
+        blocking tail (device_get for numpy-typed entries, handle
+        resolution) to the completion thread — the drain loop is free
+        to pop and dispatch group N+1 while N's program runs on
+        device."""
         mc = self.collectives_for(g["process_set_id"])
         entries = g["entries"]
         taken = [self._take(e["handle"]) if e["handle"] >= 0
@@ -576,7 +658,47 @@ class MultihostEngine:
                 names, "EXEC_DEVICE_" + g["op_type"].upper())
             with jax.profiler.TraceAnnotation(
                     "hvd.mh.%s[%d]" % (g["op_type"], len(entries))):
-                results = self._run_group(g, mc, taken)
+                finalize, needs_host, rep = self._dispatch_group(
+                    g, mc, taken)
+        except Exception as exc:  # noqa: BLE001
+            self._complete_error(g, names, taken, entries, exc)
+            return
+        if rep is not None:
+            self._inflight_outs.append(rep)
+            while len(self._inflight_outs) > self._depth:
+                try:
+                    self._inflight_outs.pop(0).block_until_ready()
+                except Exception:  # noqa: BLE001 - surfaced via handles
+                    pass
+        with self._lock:
+            route_q = needs_host or self._host_inflight > 0
+            if route_q:
+                self._host_inflight += 1
+        if route_q:
+            # Blocking host fetch — or completions still in flight
+            # whose relative order we keep — go through the completion
+            # thread.  (_host_inflight is decremented only after
+            # _finish fully resolves a queued group, so "zero" really
+            # means every earlier group's handles are set.)
+            self._done_q.put((g, names, taken, entries, finalize))
+        else:
+            # Device-resident group: finalize never blocks, so complete
+            # inline and spare the cross-thread handoff (a scheduler
+            # quantum per op on busy hosts).
+            self._finish(g, names, taken, entries, finalize)
+
+    def _completion_loop(self):
+        while True:
+            item = self._done_q.get()
+            if item is None:
+                return
+            self._finish(*item)
+            with self._lock:
+                self._host_inflight -= 1
+
+    def _finish(self, g, names, taken, entries, finalize):
+        try:
+            results = finalize()
             self.timeline.activity_end_all(names)
             for (py, _), res, e in zip(taken, results, entries):
                 if e["handle"] >= 0:
@@ -584,16 +706,19 @@ class MultihostEngine:
                     self.core._lib.hvd_tcp_release(e["handle"])
                 if py is not None:
                     py._set_result(res)
-        except Exception as exc:  # noqa: BLE001
-            self.timeline.activity_end_all(names)
-            LOG.error("multihost %s failed: %s", g["op_type"], exc)
-            for (py, _), e in zip(taken, entries):
-                if e["handle"] >= 0:
-                    self.core.external_done(e["handle"], ok=False,
-                                            error=str(exc))
-                    self.core._lib.hvd_tcp_release(e["handle"])
-                if py is not None:
-                    py._set_error(exc)
+        except Exception as exc:  # noqa: BLE001 - keep draining
+            self._complete_error(g, names, taken, entries, exc)
+
+    def _complete_error(self, g, names, taken, entries, exc):
+        self.timeline.activity_end_all(names)
+        LOG.error("multihost %s failed: %s", g["op_type"], exc)
+        for (py, _), e in zip(taken, entries):
+            if e["handle"] >= 0:
+                self.core.external_done(e["handle"], ok=False,
+                                        error=str(exc))
+                self.core._lib.hvd_tcp_release(e["handle"])
+            if py is not None:
+                py._set_error(exc)
 
     @staticmethod
     def _match(out, arr, shape=None):
@@ -608,8 +733,15 @@ class MultihostEngine:
         host = np.asarray(jax.device_get(out))
         return host.reshape(shape) if shape is not None else host
 
-    def _run_group(self, g: dict, mc: GlobalMeshCollectives,
-                   taken: List[tuple]) -> List:
+    def _dispatch_group(self, g: dict, mc: GlobalMeshCollectives,
+                        taken: List[tuple]):
+        """Issue the group's compiled collective (async XLA dispatch)
+        and return ``(finalize, needs_host, rep)``: a finalize() ->
+        results closure, whether it blocks on a host fetch (numpy-typed
+        entries), and one representative output array of the dispatched
+        program (for the drain thread's pipeline-depth window).
+        Blocking finalizes run only on the completion thread;
+        device-resident ones may complete inline."""
         op = g["op_type"]
         dtype = g["dtype"]
         if op == "allreduce":
@@ -630,40 +762,50 @@ class MultihostEngine:
             outs = mc.fused_allreduce(
                 [arr for _, arr in taken], lengths, dtype,
                 g["red_op"], g["prescale"], g["postscale"])
-            # One batched device_get for every numpy-typed entry (a
-            # per-entry fetch would serialize N host round-trips on the
-            # executor thread that gates all handles).
-            import jax
-            import jax.numpy as jnp
-            to_host = [i for i, (_, arr) in enumerate(taken)
-                       if arr is None or not _is_device_array(arr)]
-            fetched = dict(zip(to_host, jax.device_get(
-                [outs[i] for i in to_host]))) if to_host else {}
-            results = []
-            for i, ((py, arr), out, ln) in enumerate(
-                    zip(taken, outs, lengths)):
-                shape = arr.shape if arr is not None else (ln,)
-                if i in fetched:
-                    results.append(np.asarray(fetched[i]).reshape(shape))
-                else:
-                    results.append(jnp.reshape(out, shape))
-            return results
+            needs_host = any(arr is None or not _is_device_array(arr)
+                             for _, arr in taken)
+
+            def finalize():
+                # One batched device_get for every numpy-typed entry (a
+                # per-entry fetch would serialize N host round-trips on
+                # the thread that gates all handles).
+                import jax
+                import jax.numpy as jnp
+                to_host = [i for i, (_, arr) in enumerate(taken)
+                           if arr is None or not _is_device_array(arr)]
+                fetched = dict(zip(to_host, jax.device_get(
+                    [outs[i] for i in to_host]))) if to_host else {}
+                results = []
+                for i, ((py, arr), out, ln) in enumerate(
+                        zip(taken, outs, lengths)):
+                    shape = arr.shape if arr is not None else (ln,)
+                    if i in fetched:
+                        results.append(
+                            np.asarray(fetched[i]).reshape(shape))
+                    else:
+                        results.append(jnp.reshape(out, shape))
+                return results
+            return finalize, needs_host, outs[0]
         (py, arr) = taken[0]
+        needs_host = arr is None or not _is_device_array(arr)
         if op == "allgather":
-            rows = g["aux_sizes"]
-            return [self._match(mc.allgather(arr, rows), arr)]
+            out = mc.allgather(arr, g["aux_sizes"])
+            return (lambda: [self._match(out, arr)]), needs_host, out
         if op == "broadcast":
             # root_rank is a GLOBAL rank; map to member index.
             ranks = self._resolve_process_set(g["process_set_id"])
             members = ranks if ranks is not None else list(
                 range(mc.size))
             root_idx = members.index(g["root_rank"])
-            return [self._match(mc.broadcast(arr, root_idx), arr)]
+            out = mc.broadcast(arr, root_idx)
+            return (lambda: [self._match(out, arr)]), needs_host, out
         if op == "alltoall":
             out, recv = mc.alltoall(arr, np.asarray(g["aux_sizes"]))
-            return [(self._match(out, arr), recv)]
+            return ((lambda: [(self._match(out, arr), recv)]),
+                    needs_host, out)
         if op == "reducescatter":
-            return [self._match(mc.reducescatter(arr, g["red_op"]), arr)]
+            out = mc.reducescatter(arr, g["red_op"])
+            return (lambda: [self._match(out, arr)]), needs_host, out
         raise NotImplementedError("multihost op %r" % op)
 
     # -- shutdown ----------------------------------------------------------
@@ -671,6 +813,38 @@ class MultihostEngine:
     def shutdown(self):
         self._shutdown = True
         self._thread.join(timeout=10.0)
+        # Stop the completion thread with a sentinel AFTER the queued
+        # work, so every dispatched group still resolves its handles.
+        # The put is bounded: the queue may be permanently full if a
+        # completion is wedged on a collective whose peer died — give
+        # up after the deadline (the thread is a daemon) rather than
+        # hanging shutdown in exactly the failure it must clean up.
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._done_q.put_nowait(None)
+                break
+            except queue_mod.Full:
+                if (time.monotonic() > deadline
+                        or not self._done_thread.is_alive()):
+                    break
+                time.sleep(0.05)
+        self._done_thread.join(timeout=10.0)
+        # Fail anything stranded: groups still queued (a wedged
+        # completion, or a drain thread that outlived its join and
+        # enqueued past the sentinel) would otherwise leave their
+        # already-_take()n handles unresolved forever.
+        while True:
+            try:
+                item = self._done_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if item is None:
+                continue
+            g, names, taken, entries, _fin = item
+            self._complete_error(
+                g, names, taken, entries,
+                HorovodInternalError("engine shut down"))
         with self._lock:
             pending, self._pending = self._pending, {}
         for py, _ in pending.values():

@@ -150,6 +150,21 @@ def main():
         assert "reduce_scatter" in hlo, "no reduce_scatter HLO emitted"
         assert "all_reduce" in hlo, "no all_reduce HLO emitted"
 
+    # Async burst (DistributedOptimizer traffic shape): many uniquely
+    # named in-flight device-array ops of varying shapes.  Whatever
+    # composition each negotiation cycle fuses rides the packed fusion
+    # buffer (bucket-keyed executable — no per-composition recompile)
+    # and the executor's pipeline window keeps groups overlapped.
+    bhs = [hvd.allreduce_async(
+        jnp.full((5 + i,), float(r + 1) * (i + 1), jnp.float32),
+        op=hvd.Sum, name="burst.%d" % i) for i in range(12)]
+    tot = sum(j + 1.0 for j in range(n))
+    for i, h in enumerate(bhs):
+        res = h.wait(60)
+        assert isinstance(res, jax.Array), type(res)
+        np.testing.assert_allclose(
+            np.asarray(res), np.full((5 + i,), tot * (i + 1)))
+
     # barrier + process-set-scoped collective on even ranks.
     hvd.barrier()
     ps = hvd.add_process_set([i for i in range(0, n, 2)])
