@@ -32,7 +32,13 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--flash", default=None,
                     help="force HOROVOD_FLASH_ATTENTION")
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--fused", type=int, default=1,
+                    help="fused qkv + gate projections (A/B lever)")
     args = ap.parse_args()
+    if args.d_model % args.head_dim:
+        raise SystemExit("--head-dim %d does not divide --d-model %d"
+                         % (args.head_dim, args.d_model))
     if args.flash is not None:
         os.environ["HOROVOD_FLASH_ATTENTION"] = args.flash
 
@@ -46,8 +52,10 @@ def main():
 
     cfg = TransformerConfig(
         vocab_size=8192, d_model=args.d_model, n_layers=args.layers,
-        n_heads=args.d_model // 64, n_kv_heads=args.d_model // 64,
-        d_ff=args.d_model * 3, max_seq=args.seq)
+        n_heads=args.d_model // args.head_dim,
+        n_kv_heads=args.d_model // args.head_dim,
+        d_ff=args.d_model * 3, max_seq=args.seq,
+        fused_qkv=bool(args.fused), fused_gate=bool(args.fused))
     mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
                 ("dp", "sp", "tp"))
 

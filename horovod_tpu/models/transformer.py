@@ -66,11 +66,31 @@ class TransformerConfig:
     # n_kv_heads/tp — must both divide by sp; composes with flash
     # attention)
     sp_mode: str = "ring"
+    # Projection fusion: concatenate the per-shard wq|wk|wv (and
+    # w1|w3) weight slices ONCE per step before the layer scan, so
+    # each layer issues one [d, (q+2kv)·hd] (resp. [d, 2f]) matmul
+    # instead of three (two).  Host param layout is unchanged — the
+    # packing happens inside the shard_map body on the local slices,
+    # so it is correct for any tp degree.
+    fused_qkv: bool = False
+    fused_gate: bool = False
+    # Vocab-projection dtype: "f32" (safe default), "bf16" (bf16
+    # operands, f32 accumulation), or "auto" = bf16 only when the
+    # Pallas flash-attention path is active — a bf16 vocab einsum
+    # measured ~3% faster on the flash path but collapses the
+    # chunked-XLA attention fallback ~12x (an XLA fusion/layout
+    # interaction, docs/benchmarks.md), so it must never ride with it.
+    logits_dtype: str = "auto"
+    # lax.scan unroll factor over the layer stack (1 = no unroll).
+    scan_unroll: int = 1
 
     def __post_init__(self):
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError("sp_mode must be 'ring' or 'ulysses', "
                              "got %r" % (self.sp_mode,))
+        if self.logits_dtype not in ("auto", "bf16", "f32"):
+            raise ValueError("logits_dtype must be 'auto', 'bf16' or "
+                             "'f32', got %r" % (self.logits_dtype,))
 
     @property
     def head_dim(self) -> int:
@@ -243,9 +263,21 @@ def _use_flash_attention() -> bool:
 def _attention_block(x, lp, cfg: TransformerConfig, cos, sin, sp_size):
     b, s, _ = x.shape
     hd = cfg.head_dim
-    q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s, -1, hd)
-    k = (x @ lp["wk"].astype(x.dtype)).reshape(b, s, -1, hd)
-    v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, -1, hd)
+    if "wqkv" in lp:
+        # Fused projection: one matmul, split at the LOCAL q/k/v
+        # boundaries (exact for any tp: the per-shard fused width is
+        # (qh + 2·kvh)·hd/tp and the ratios are preserved).
+        qkv = x @ lp["wqkv"].astype(x.dtype)
+        tot = qkv.shape[-1]
+        q_sz = tot * cfg.n_heads // (cfg.n_heads + 2 * cfg.n_kv_heads)
+        kv_sz = (tot - q_sz) // 2
+        q = qkv[..., :q_sz].reshape(b, s, -1, hd)
+        k = qkv[..., q_sz:q_sz + kv_sz].reshape(b, s, -1, hd)
+        v = qkv[..., q_sz + kv_sz:].reshape(b, s, -1, hd)
+    else:
+        q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s, -1, hd)
+        k = (x @ lp["wk"].astype(x.dtype)).reshape(b, s, -1, hd)
+        v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, -1, hd)
     q = _rope(cos, sin, q)
     k = _rope(cos, sin, k)
     if sp_size > 1 and cfg.sp_mode == "ulysses":
@@ -273,8 +305,13 @@ def _attention_block(x, lp, cfg: TransformerConfig, cos, sin, sp_size):
 
 
 def _dense_ffn(h, lp, cfg: TransformerConfig):
-    a = jax.nn.silu(h @ lp["w1"].astype(h.dtype))
-    g = h @ lp["w3"].astype(h.dtype)
+    if "w13" in lp:
+        ag = h @ lp["w13"].astype(h.dtype)
+        a, g = jnp.split(ag, 2, axis=-1)
+        a = jax.nn.silu(a)
+    else:
+        a = jax.nn.silu(h @ lp["w1"].astype(h.dtype))
+        g = h @ lp["w3"].astype(h.dtype)
     out = (a * g) @ lp["w2"].astype(h.dtype)
     return lax.psum(out, cfg.tp_axis)
 
@@ -303,6 +340,17 @@ def forward(params, tokens, cfg: TransformerConfig):
     x = _sharded_embed_lookup(params["embed"], tokens, cfg.tp_axis)
     x = x.astype(cfg.act_dtype)
 
+    layers = params["layers"]
+    if cfg.fused_qkv:
+        layers = dict(layers)
+        layers["wqkv"] = jnp.concatenate(
+            [layers.pop("wq"), layers.pop("wk"), layers.pop("wv")],
+            axis=-1)
+    if cfg.fused_gate and cfg.n_experts == 0:
+        layers = dict(layers)
+        layers["w13"] = jnp.concatenate(
+            [layers.pop("w1"), layers.pop("w3")], axis=-1)
+
     def layer(carry, lp):
         x, aux = carry
         h = rms_norm(x, lp["ln1"], cfg.norm_eps)
@@ -318,15 +366,24 @@ def forward(params, tokens, cfg: TransformerConfig):
 
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
     (x, aux), _ = lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
-                           params["layers"])
+                           layers, unroll=max(1, cfg.scan_unroll))
     x = rms_norm(x, params["ln_f"], cfg.norm_eps)
-    # The f32 vocab matmul stays: a bf16 einsum with
-    # preferred_element_type=f32 is ~3% faster on the flash path but
-    # collapses the chunked-XLA attention fallback ~12× (159k -> 13.6k
-    # tok/s at seq 2048 post-CE-fix, v5e — an XLA fusion/layout interaction), so
-    # the plain f32 form is the better global choice.
-    logits = (x.astype(jnp.float32)
-              @ params["embed"].astype(jnp.float32).T)
+    # Vocab projection dtype: bf16 operands with f32 accumulation only
+    # on the flash path ("auto"); with the chunked-XLA attention
+    # fallback the bf16 form collapses throughput ~12x (159k -> 13.6k
+    # tok/s at seq 2048, v5e — an XLA fusion/layout interaction), so
+    # f32 stays the fallback-path form.
+    bf16_logits = (cfg.logits_dtype == "bf16"
+                   or (cfg.logits_dtype == "auto"
+                       and _use_flash_attention()))
+    if bf16_logits:
+        logits = jnp.matmul(
+            x.astype(cfg.act_dtype),
+            params["embed"].astype(cfg.act_dtype).T,
+            preferred_element_type=jnp.float32)
+    else:
+        logits = (x.astype(jnp.float32)
+                  @ params["embed"].astype(jnp.float32).T)
     return logits, aux / cfg.n_layers
 
 
