@@ -33,8 +33,10 @@ def main():
     ap.add_argument("--flash", default=None,
                     help="force HOROVOD_FLASH_ATTENTION")
     ap.add_argument("--head-dim", type=int, default=64)
-    ap.add_argument("--fused", type=int, default=1,
-                    help="fused qkv + gate projections (A/B lever)")
+    ap.add_argument("--fused", type=int, default=0,
+                    help="fused qkv + gate projections (A/B lever; "
+                         "measured rejection at d1024 — see "
+                         "docs/benchmarks.md — so off by default)")
     args = ap.parse_args()
     if args.d_model % args.head_dim:
         raise SystemExit("--head-dim %d does not divide --d-model %d"

@@ -60,6 +60,45 @@ def _is_device_array(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def adasum_combine(v, axis_name: str, size: int):
+    """Device-resident Adasum over a mesh axis (per-shard code).
+
+    The reference's GPU-resident Adasum (SURVEY §2.2,
+    ``adasum_gpu_operations.cc``) keeps payloads on the accelerator;
+    here the recursive-halving tree of ``utils/adasum.py`` runs as
+    log2(size) ``ppermute`` exchange rounds over the axis: partners at
+    XOR-stride distance swap full vectors, both compute the SAME
+    symmetric merge, and every shard converges to the tree result —
+    bytes = n·log2(N) over ICI, no host bounce.  Merge order matches
+    ``utils/adasum.adasum_reduce_stacked`` (strides n/2, n/4, …, 1 =
+    the stacked halving tree), including the per-round cast back to
+    the payload dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+    if size & (size - 1):
+        raise HorovodInternalError(
+            "Adasum requires a power-of-two member count (got %d), as "
+            "in the reference's recursive-halving implementation" % size)
+    out_dtype = v.dtype
+    shape = v.shape
+    vf = v.astype(jnp.float32).reshape(-1)
+    stride = size // 2
+    while stride >= 1:
+        perm = [(i, i ^ stride) for i in range(size)]
+        wf = jax.lax.ppermute(vf, axis_name, perm)
+        dot = jnp.vdot(vf, wf)
+        na = jnp.vdot(vf, vf)
+        nb = jnp.vdot(wf, wf)
+        ca = 1.0 - dot / jnp.maximum(2.0 * na, 1e-30)
+        cb = 1.0 - dot / jnp.maximum(2.0 * nb, 1e-30)
+        # Per-round cast mirrors the host tree (vmap'd adasum_pair
+        # returns the payload dtype each round).
+        vf = (ca * vf + cb * wf).astype(out_dtype).astype(jnp.float32)
+        stride //= 2
+    return vf.astype(out_dtype).reshape(shape)
+
+
 class GlobalMeshCollectives:
     """Compiled XLA collectives over a one-device-per-process mesh.
 
@@ -202,7 +241,9 @@ class GlobalMeshCollectives:
         import jax
         import jax.numpy as jnp
         v = self._scaled(v, prescale)
-        if red_op in (SUM, AVERAGE, ADASUM):
+        if red_op == ADASUM:
+            r = adasum_combine(v, "proc", self.size)
+        elif red_op in (SUM, AVERAGE):
             r = jax.lax.psum(v, "proc")
             if red_op == AVERAGE:
                 r = (r / divisor).astype(v.dtype) if \
@@ -232,7 +273,11 @@ class GlobalMeshCollectives:
         per-entry flat device arrays, replicated on the mesh device.
         """
         lengths = [int(n) for n in lengths]
-        if len(lengths) > 1:
+        if len(lengths) > 1 and red_op != ADASUM:
+            # Adasum must stay per-entry: its dot-product combine over
+            # a packed bucket would merge ACROSS tensors (wrong math),
+            # so fused Adasum groups compile the direct multi-input
+            # program with one combine per entry.
             return self._fused_allreduce_packed(
                 payloads, lengths, dtype, red_op, prescale, postscale)
         key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),

@@ -128,8 +128,12 @@ class MultihostIciBackend(CollectiveBackend):
 
     def enabled(self, req: OpRequest) -> bool:
         from .xla_ops import ADASUM
-        # Adasum rides the host plane (TreeAdasum in the native core).
-        return req.op_type in DEVICE_OPS and req.red_op != ADASUM
+        # Adasum allreduce is device-resident (adasum_combine: ppermute
+        # XOR-tree under shard_map — the adasum_gpu_operations.cc
+        # analog); other Adasum ops stay on the host plane (TreeAdasum).
+        if req.red_op == ADASUM and req.op_type != "allreduce":
+            return False
+        return req.op_type in DEVICE_OPS
 
     def submit(self, req: OpRequest):
         eng = self._get_engine()

@@ -327,3 +327,35 @@ def test_timeline_written(tmp_path):
     assert any(n and n.startswith("NEGOTIATE") for n in names)
     assert any(n and n.startswith("EXEC") for n in names)
     assert all("ts" in e for e in events)
+
+
+def test_multihost_adasum_combine_matches_host_tree():
+    """Device-plane Adasum (ppermute XOR-tree, ops/multihost.py) must
+    reproduce the host recursive-halving tree on every shard of an
+    8-device mesh — the oracle the multihost executor relies on."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops.multihost import adasum_combine
+    from horovod_tpu.utils.adasum import adasum_reduce_stacked
+
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.asarray(devs), ("proc",))
+    rng = np.random.RandomState(42)
+    stacked = rng.randn(8, 33).astype(np.float32)
+    fn = jax.jit(jax.shard_map(
+        lambda x: adasum_combine(x[0], "proc", 8)[None],
+        mesh=mesh, in_specs=(P("proc"),), out_specs=P("proc"),
+        check_vma=False))
+    out = np.asarray(fn(stacked))
+    oracle = np.asarray(adasum_reduce_stacked(stacked))
+    for r in range(8):  # every shard converges to the tree result
+        np.testing.assert_allclose(out[r], oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_multihost_adasum_combine_rejects_non_pow2():
+    from horovod_tpu.ops.engine import HorovodInternalError
+    from horovod_tpu.ops.multihost import adasum_combine
+    import jax.numpy as jnp
+    with pytest.raises(HorovodInternalError, match="power-of-two"):
+        adasum_combine(jnp.ones((4,)), "proc", 6)

@@ -141,6 +141,30 @@ def main():
                            op=hvd.Sum, name="dev_rs")
     assert isinstance(d3, jax.Array), type(d3)
     np.testing.assert_allclose(np.asarray(d3), float(n))
+    # Device-plane Adasum (r4): the ppermute XOR-tree combine runs on
+    # the mesh — device payloads stay resident, results match the host
+    # recursive-halving oracle.  Non-pow2 worlds must error loudly.
+    my_vec = (np.arange(6, dtype=np.float32) + 1.0) * (r + 1)
+    if n & (n - 1) == 0:
+        d4 = hvd.allreduce(jnp.asarray(my_vec), op=hvd.Adasum,
+                           name="dev_adasum")
+        assert isinstance(d4, jax.Array), type(d4)
+        from horovod_tpu.utils.adasum import adasum_reduce_stacked
+        oracle = adasum_reduce_stacked(np.stack(
+            [(np.arange(6, dtype=np.float32) + 1.0) * (j + 1)
+             for j in range(n)]))
+        np.testing.assert_allclose(np.asarray(d4), np.asarray(oracle),
+                                   rtol=1e-5)
+    else:
+        try:
+            hvd.allreduce(jnp.asarray(my_vec), op=hvd.Adasum,
+                          name="dev_adasum_bad")
+        except Exception as exc:
+            assert "power-of-two" in str(exc), (
+                "expected the pow2 Adasum rejection, got: %r" % exc)
+        else:
+            raise AssertionError(
+                "Adasum on a non-power-of-two world must error")
     assert mc.host_stages == before, (
         "device payloads transited the host: %d stagings"
         % (mc.host_stages - before))
@@ -149,6 +173,9 @@ def main():
         assert "all_to_all" in hlo, "no all_to_all HLO emitted"
         assert "reduce_scatter" in hlo, "no reduce_scatter HLO emitted"
         assert "all_reduce" in hlo, "no all_reduce HLO emitted"
+        if n & (n - 1) == 0:
+            assert "collective_permute" in hlo, (
+                "no collective_permute HLO from device Adasum")
 
     # Async burst (DistributedOptimizer traffic shape): many uniquely
     # named in-flight device-array ops of varying shapes.  Whatever
