@@ -108,6 +108,14 @@ class Config:
     # but not yet completed.  Bounds live staging/output buffers the
     # way the reference's finite NCCL stream queue does.
     max_inflight_groups: int = 4
+    # Execution-phase watchdog (device plane): a negotiated group whose
+    # compiled program has not completed within this many seconds fails
+    # its handles with a diagnostic naming the group — the device-plane
+    # analog of the stall inspector's shutdown threshold (a member that
+    # dies between negotiation and dispatch otherwise hangs survivors
+    # inside the runtime with no Horovod-level signal).  0 = warn-only
+    # (warnings after stall_warning_secs).
+    device_exec_timeout_secs: float = 0.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -150,4 +158,6 @@ class Config:
             elastic_timeout_secs=_env_float("ELASTIC_TIMEOUT", 600.0),
             max_inflight_groups=max(
                 1, _env_int("MAX_INFLIGHT_GROUPS", 4)),
+            device_exec_timeout_secs=_env_float(
+                "DEVICE_EXEC_TIMEOUT_SECONDS", 0.0),
         )

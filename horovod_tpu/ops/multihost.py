@@ -80,23 +80,18 @@ def adasum_combine(v, axis_name: str, size: int):
         raise HorovodInternalError(
             "Adasum requires a power-of-two member count (got %d), as "
             "in the reference's recursive-halving implementation" % size)
-    out_dtype = v.dtype
-    shape = v.shape
-    vf = v.astype(jnp.float32).reshape(-1)
+    from ..utils.adasum import adasum_pair
     stride = size // 2
     while stride >= 1:
         perm = [(i, i ^ stride) for i in range(size)]
-        wf = jax.lax.ppermute(vf, axis_name, perm)
-        dot = jnp.vdot(vf, wf)
-        na = jnp.vdot(vf, vf)
-        nb = jnp.vdot(wf, wf)
-        ca = 1.0 - dot / jnp.maximum(2.0 * na, 1e-30)
-        cb = 1.0 - dot / jnp.maximum(2.0 * nb, 1e-30)
-        # Per-round cast mirrors the host tree (vmap'd adasum_pair
-        # returns the payload dtype each round).
-        vf = (ca * vf + cb * wf).astype(out_dtype).astype(jnp.float32)
+        w = jax.lax.ppermute(v, axis_name, perm)
+        # adasum_pair is the single source of truth for the merge rule
+        # (f32 dots, epsilon guard, payload-dtype round-trip) — both
+        # partners compute the SAME symmetric merge, so every shard
+        # converges to the host tree's result.
+        v = adasum_pair(v, w)
         stride //= 2
-    return vf.astype(out_dtype).reshape(shape)
+    return v
 
 
 class GlobalMeshCollectives:
@@ -577,6 +572,28 @@ class MultihostEngine:
         # device-only group inline ONLY when this is zero, so handle
         # resolution order always follows negotiation order.
         self._host_inflight = 0
+        # Execution-phase watchdog (the device-plane analog of the
+        # stall inspector): dispatched groups register here; a group
+        # that outlives stall_warning_secs logs a warning, and — when
+        # device_exec_timeout_secs > 0 — one that outlives the timeout
+        # fails every outstanding handle with a diagnostic naming the
+        # group, then poisons the engine (a member that died after
+        # negotiation leaves the runtime wedged; callers must not hang
+        # with it).
+        self._watch_lock = threading.Lock()
+        self._watched: Dict[int, dict] = {}
+        self._killed_wids: set = set()
+        self._watch_seq = 0
+        self._last_progress = time.monotonic()
+        self._failed: Optional[Exception] = None
+        self._exec_warn = max(float(config.stall_warning_secs), 0.0)
+        self._exec_timeout = max(float(getattr(
+            config, "device_exec_timeout_secs", 0.0)), 0.0)
+        if self._exec_warn > 0 or self._exec_timeout > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="hvd-tpu-multihost-watchdog", daemon=True)
+            self._watchdog.start()
         self._done_thread = threading.Thread(
             target=self._completion_loop,
             name="hvd-tpu-multihost-done", daemon=True)
@@ -615,8 +632,14 @@ class MultihostEngine:
         # instant enqueue_external returns, the background thread can
         # negotiate the op and the executor can pop its record — if the
         # payload weren't parked yet, this rank would contribute zeros
-        # and the handle would never resolve.
+        # and the handle would never resolve.  The _failed check lives
+        # under the same lock the watchdog uses for its pending sweep,
+        # so a handle either raises here or is guaranteed to be swept.
         with self._lock:
+            if self._failed is not None:
+                raise HorovodInternalError(
+                    "multihost engine disabled after watchdog "
+                    "failure: %s" % self._failed)
             ch = self.core.enqueue_external(
                 name, op_type, tuple(arr.shape), np.dtype(arr.dtype),
                 **kw)
@@ -683,6 +706,92 @@ class MultihostEngine:
         with self._lock:
             return self._pending.pop(handle, (None, None))
 
+    # -- execution-phase watchdog ------------------------------------------
+
+    def _watch_register(self, g, names, taken, entries) -> int:
+        with self._watch_lock:
+            wid = self._watch_seq
+            self._watch_seq += 1
+            self._watched[wid] = {
+                "g": g, "names": names, "taken": taken,
+                "entries": entries, "start": time.monotonic(),
+                "warned": False,
+            }
+        return wid
+
+    def _watch_clear(self, wid: int) -> bool:
+        """Remove the record; returns True if the watchdog already
+        failed this group's handles (completion must not repeat it)."""
+        with self._watch_lock:
+            self._watched.pop(wid, None)
+            killed = wid in self._killed_wids
+            self._killed_wids.discard(wid)
+            self._last_progress = time.monotonic()
+        return killed
+
+    def _watchdog_loop(self):
+        while not self._shutdown:
+            time.sleep(1.0)
+            now = time.monotonic()
+            with self._watch_lock:
+                items = list(self._watched.items())
+                idle = now - self._last_progress
+            fired = False
+            for wid, rec in items:
+                age = now - rec["start"]
+                if (self._exec_warn and age > self._exec_warn
+                        and not rec["warned"]):
+                    rec["warned"] = True
+                    LOG.warning(
+                        "multihost %s group %s executing for %.0fs — a "
+                        "member process may have died after negotiation "
+                        "(device-plane stall)", rec["g"]["op_type"],
+                        rec["names"], age)
+                # Fire only when the whole pipeline is starved too: a
+                # busy-but-healthy executor (deep queue, long compile)
+                # keeps completing OTHER groups and must not be killed
+                # for being slow.
+                if (self._exec_timeout and age > self._exec_timeout
+                        and idle > self._exec_timeout):
+                    fired = True
+            if fired:
+                self._watchdog_fire()
+
+    def _watchdog_fire(self):
+        """Fail every outstanding handle and poison the engine: the
+        device program a dead member never joined will wedge the
+        runtime thread forever, but callers get a loud diagnostic
+        instead of hanging with it."""
+        with self._watch_lock:
+            records = dict(self._watched)
+            # Keep the records (cleared by _finish) but remember they
+            # were killed, so a program that later unwedges does not
+            # repeat completion on already-failed handles.
+            self._killed_wids.update(records)
+        groups = sorted({rec["g"]["op_type"] + str(rec["names"])
+                         for rec in records.values()})
+        exc = HorovodInternalError(
+            "device execution watchdog: negotiated group(s) %s did not "
+            "complete within %.1fs (HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS)"
+            "; a member process likely died between negotiation and "
+            "dispatch — failing outstanding handles" % (
+                groups, self._exec_timeout))
+        LOG.error("%s", exc)
+        # _failed is set under the SAME lock that guards _enqueue's
+        # check + park, so a racing enqueue either raises or lands in
+        # the pending map swept here.
+        with self._lock:
+            self._failed = exc
+            pending, self._pending = self._pending, {}
+        for rec in records.values():
+            self._complete_error(rec["g"], rec["names"], rec["taken"],
+                                 rec["entries"], exc)
+        # Payloads never dispatched (parked behind the wedged program)
+        # fail too — and _enqueue rejects new work from here on.
+        for py, _ in pending.values():
+            if py is not None and not py.poll():
+                py._set_error(exc)
+
     def _execute(self, g: dict):
         """Stage and dispatch one negotiated group, then hand the
         blocking tail (device_get for numpy-typed entries, handle
@@ -694,6 +803,10 @@ class MultihostEngine:
         taken = [self._take(e["handle"]) if e["handle"] >= 0
                  else (None, None) for e in entries]
         names = [e["name"] for e in entries]
+        if self._failed is not None:
+            self._complete_error(g, names, taken, entries, self._failed)
+            return
+        wid = self._watch_register(g, names, taken, entries)
         try:
             # Per-tensor timeline span (reference: the EXEC_* phases the
             # native executors record) + an xprof TraceAnnotation so the
@@ -706,7 +819,8 @@ class MultihostEngine:
                 finalize, needs_host, rep = self._dispatch_group(
                     g, mc, taken)
         except Exception as exc:  # noqa: BLE001
-            self._complete_error(g, names, taken, entries, exc)
+            if not self._watch_clear(wid):
+                self._complete_error(g, names, taken, entries, exc)
             return
         if rep is not None:
             self._inflight_outs.append(rep)
@@ -725,12 +839,12 @@ class MultihostEngine:
             # thread.  (_host_inflight is decremented only after
             # _finish fully resolves a queued group, so "zero" really
             # means every earlier group's handles are set.)
-            self._done_q.put((g, names, taken, entries, finalize))
+            self._done_q.put((g, names, taken, entries, finalize, wid))
         else:
             # Device-resident group: finalize never blocks, so complete
             # inline and spare the cross-thread handoff (a scheduler
             # quantum per op on busy hosts).
-            self._finish(g, names, taken, entries, finalize)
+            self._finish(g, names, taken, entries, finalize, wid)
 
     def _completion_loop(self):
         while True:
@@ -741,15 +855,25 @@ class MultihostEngine:
             with self._lock:
                 self._host_inflight -= 1
 
-    def _finish(self, g, names, taken, entries, finalize):
+    def _finish(self, g, names, taken, entries, finalize, wid=None):
         try:
             results = finalize()
+        except Exception as exc:  # noqa: BLE001 - keep draining
+            if not (wid is not None and self._watch_clear(wid)):
+                self._complete_error(g, names, taken, entries, exc)
+            return
+        if wid is not None and self._watch_clear(wid):
+            # The watchdog already failed this group's handles while
+            # the program was wedged; a late completion must not
+            # repeat external_done/release or overwrite the error.
+            return
+        try:
             self.timeline.activity_end_all(names)
             for (py, _), res, e in zip(taken, results, entries):
                 if e["handle"] >= 0:
                     self.core.external_done(e["handle"], ok=True)
                     self.core._lib.hvd_tcp_release(e["handle"])
-                if py is not None:
+                if py is not None and not py.poll():
                     py._set_result(res)
         except Exception as exc:  # noqa: BLE001 - keep draining
             self._complete_error(g, names, taken, entries, exc)
@@ -762,7 +886,7 @@ class MultihostEngine:
                 self.core.external_done(e["handle"], ok=False,
                                         error=str(exc))
                 self.core._lib.hvd_tcp_release(e["handle"])
-            if py is not None:
+            if py is not None and not py.poll():
                 py._set_error(exc)
 
     @staticmethod
@@ -886,7 +1010,7 @@ class MultihostEngine:
                 break
             if item is None:
                 continue
-            g, names, taken, entries, _fin = item
+            g, names, taken, entries, _fin, _wid = item
             self._complete_error(
                 g, names, taken, entries,
                 HorovodInternalError("engine shut down"))

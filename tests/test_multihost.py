@@ -63,6 +63,33 @@ def test_multihost_data_parallel_step_matches_reference():
                marker="MH_DP_OK")
 
 
+WATCHDOG_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "utils", "multihost_watchdog_worker.py")
+
+
+def test_execution_watchdog_fails_survivors_loudly():
+    # VERDICT r3 item 4: a member that wedges BETWEEN negotiation and
+    # dispatch (alive, but never joining the compiled program — the
+    # undetectable-on-ICI failure) blocks survivors inside the runtime
+    # where the negotiation-phase stall inspector cannot see them.
+    # Rank 1 negotiates the marked group but never dispatches; rank
+    # 0's watchdog (HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS=6) must fail
+    # the handle with a diagnostic naming the group, reject new work,
+    # and let the process exit cleanly — all well inside the 60 s wait.
+    outs = _spawn_multihost(2, local_devices=2, extra_env={
+        "HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS": "6",
+    }, worker=WATCHDOG_WORKER)
+    rc0, out0, err0 = outs[0]
+    rc1, out1, _err1 = outs[1]
+    assert rc0 == 0, "survivor rank 0 failed (rc=%d):\n%s\n%s" % (
+        rc0, out0, err0)
+    assert "MH_WATCHDOG_OK 0" in out0, out0
+    # Rank 1 wedged by design and dies when the coordination service
+    # notices rank 0's exit — its exact exit code is runtime noise,
+    # but it must never report success.
+    assert rc1 != 0 and "MH_WATCHDOG_OK" not in out1, (rc1, out1)
+
+
 def test_init_detects_preinitialized_runtime(monkeypatch):
     # A pre-initialized JAX backend makes jax.distributed.initialize a
     # silent no-op: every rank would train alone while believing it is

@@ -1,0 +1,94 @@
+"""Fault-injection worker for the execution-phase watchdog: rank 1
+negotiates the marked group but NEVER DISPATCHES its side of the
+compiled global program, while staying alive — so rank 0 wedges inside
+the runtime on a collective its peer never joins.  This is the
+deadlock class the negotiation-phase stall inspector cannot see, and
+(unlike a process death, which CPU gloo detects with a connection
+error) the transport cannot detect it either — exactly the ICI
+behavior on a pod, where a stuck or dying member leaves survivors
+blocked with no signal.  The device-plane watchdog
+(HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS) must fail rank 0's handle with a
+diagnostic naming the group, and the engine must reject new work."""
+
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("TEST_LOCAL_DEVICES", "2")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2, n
+
+    from horovod_tpu.common import basics
+    eng = basics._get_mh_engine()
+
+    if r == 1:
+        orig = eng._execute
+
+        def never_dispatch_the_wedged_group(g):
+            if any(e["name"] == "wedge" for e in g["entries"]):
+                return  # negotiated, never dispatched; stay alive
+            orig(g)
+
+        eng._execute = never_dispatch_the_wedged_group
+
+    # A clean collective first: both planes warm, world healthy.
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="ok")
+    np.testing.assert_allclose(np.asarray(out), float(n))
+
+    h = hvd.allreduce_async(np.full((8,), float(r + 1), np.float32),
+                            op=hvd.Sum, name="wedge")
+    if r == 1:
+        # Stay alive (heartbeats flowing, transport healthy) long
+        # enough for rank 0's watchdog to fire and rank 0 to finish.
+        # Once rank 0 hard-exits, the jax coordination service may
+        # kill this process first — the exit code is runtime noise;
+        # the test only requires that the wedge marker never prints.
+        time.sleep(25)
+        os._exit(17)
+
+    try:
+        h.wait(60)
+    except Exception as exc:
+        msg = str(exc)
+        assert "watchdog" in msg and "wedge" in msg, (
+            "expected the watchdog diagnostic naming the group, "
+            "got: %r" % msg)
+        # The engine is poisoned: new work must fail fast, not park
+        # behind the wedged device program.
+        try:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                          name="after_watchdog")
+        except Exception:
+            pass
+        else:
+            raise AssertionError(
+                "engine accepted new work after the watchdog fired")
+        print("MH_WATCHDOG_OK", r, flush=True)
+        # The runtime thread is wedged in the dead collective by
+        # design; hard-exit past it.
+        os._exit(0)
+    raise AssertionError(
+        "the wedged collective completed although rank 1 died before "
+        "dispatch")
+
+
+if __name__ == "__main__":
+    main()
