@@ -249,7 +249,11 @@ class GlobalMeshCollectives:
         elif red_op == MAX:
             r = jax.lax.pmax(v, "proc")
         elif red_op == PRODUCT:
-            r = jnp.prod(jax.lax.all_gather(v, "proc"), axis=0)
+            # Exact bytes-proportional product (reduce-scatter +
+            # tiled all_gather, ~2x like Sum — not N x all_gather).
+            from .xla_ops import product_allreduce
+            r = product_allreduce(
+                v.reshape(-1), "proc", self.size).reshape(v.shape)
         else:
             raise NotImplementedError(red_op)
         return self._scaled(r, postscale)
@@ -515,6 +519,11 @@ class GlobalMeshCollectives:
                         w = (w / size).astype(w.dtype) if \
                             jnp.issubdtype(w.dtype, jnp.floating) \
                             else w // size
+                elif red_op in (MIN, MAX, PRODUCT):
+                    # One all_to_all + local reduce: 1x payload bytes
+                    # (the full-reduce-then-slice fallback moved N x).
+                    from .xla_ops import alltoall_chunk_reduce
+                    w = alltoall_chunk_reduce(y, "proc", size, red_op)
                 else:
                     r = self._reduce_block(y, red_op, 1.0, 1.0, size)
                     w = jax.lax.slice_in_dim(
@@ -586,7 +595,13 @@ class MultihostEngine:
         self._watch_seq = 0
         self._last_progress = time.monotonic()
         self._failed: Optional[Exception] = None
-        self._exec_warn = max(float(config.stall_warning_secs), 0.0)
+        # HOROVOD_STALL_CHECK_DISABLE silences the warning path here
+        # exactly like the negotiation-phase inspector; the explicit
+        # timeout knob remains a separate opt-in.
+        self._exec_warn = (0.0 if getattr(config, "stall_check_disable",
+                                          False)
+                           else max(float(config.stall_warning_secs),
+                                    0.0))
         self._exec_timeout = max(float(getattr(
             config, "device_exec_timeout_secs", 0.0)), 0.0)
         if self._exec_warn > 0 or self._exec_timeout > 0:
@@ -734,7 +749,11 @@ class MultihostEngine:
             time.sleep(1.0)
             now = time.monotonic()
             with self._watch_lock:
-                items = list(self._watched.items())
+                # Already-fired records stay in _watched until their
+                # (wedged) program clears them, but must not re-fire
+                # and re-log every tick.
+                items = [(w, r) for w, r in self._watched.items()
+                         if w not in self._killed_wids]
                 idle = now - self._last_progress
             fired = False
             for wid, rec in items:
@@ -763,10 +782,12 @@ class MultihostEngine:
         runtime thread forever, but callers get a loud diagnostic
         instead of hanging with it."""
         with self._watch_lock:
-            records = dict(self._watched)
+            records = {w: r for w, r in self._watched.items()
+                       if w not in self._killed_wids}
             # Keep the records (cleared by _finish) but remember they
             # were killed, so a program that later unwedges does not
-            # repeat completion on already-failed handles.
+            # repeat completion on already-failed handles — and the
+            # fire loop never re-fires them.
             self._killed_wids.update(records)
         groups = sorted({rec["g"]["op_type"] + str(rec["names"])
                          for rec in records.values()})

@@ -41,6 +41,46 @@ ADASUM = "Adasum"
 _REDUCE_OPS = (SUM, AVERAGE, MIN, MAX, PRODUCT, ADASUM)
 
 
+def alltoall_chunk_reduce(x, axis_name: str, size: int, red_op: str):
+    """Bytes-proportional Min/Max/Product reduce-scatter (per-shard
+    code): ``x`` [size*k, ...] -> this shard's reduced [k, ...] chunk
+    via one ``all_to_all`` + a local reduce.  1× payload bytes on the
+    wire — the all-gather fallback these ops used moved N× — with
+    exact arithmetic (no log/exp decomposition).  An allreduce is this
+    plus a tiled all_gather (2× total, the Sum paths' bus bytes)."""
+    from jax import lax
+    import jax.numpy as jnp
+    k = x.shape[0] // size
+    blocks = x.reshape((size, k) + x.shape[1:])
+    w = lax.all_to_all(blocks, axis_name, split_axis=0, concat_axis=0)
+    if red_op == MIN:
+        return w.min(axis=0)
+    if red_op == MAX:
+        return w.max(axis=0)
+    if red_op == PRODUCT:
+        return jnp.prod(w, axis=0)
+    raise NotImplementedError("chunk reduce op %r" % red_op)
+
+
+def product_allreduce(flat, axis_name: str, size: int):
+    """Exact bytes-proportional Product allreduce (per-shard code):
+    reduce-scatter chunks via ``alltoall_chunk_reduce``, then a tiled
+    all_gather — ~2× payload bytes like the Sum path, instead of the
+    N× of all_gather + local product."""
+    from jax import lax
+    import jax.numpy as jnp
+    n = flat.shape[0]
+    if n == 0 or size == 1:
+        return flat
+    c = -(-n // size)
+    if size * c > n:
+        flat = jnp.concatenate(
+            [flat, jnp.ones((size * c - n,), flat.dtype)])
+    chunk = alltoall_chunk_reduce(flat, axis_name, size, PRODUCT)
+    full = lax.all_gather(chunk, axis_name, tiled=True)
+    return full[:n]
+
+
 def uneven_chunks(total_rows: int, n: int):
     """Reference ReducescatterOp chunk math: earlier members take the
     larger shards (cpu_ops.cc uses the same base/remainder split).
@@ -118,14 +158,15 @@ class MeshCollectives:
             elif red_op == MAX:
                 r = lax.pmax(x, AXIS)
             elif red_op == PRODUCT:
-                g = lax.all_gather(x, AXIS)  # [size, 1, ...]
-                r = jnp.prod(g, axis=0)
+                r = product_allreduce(
+                    x.reshape(-1), AXIS, size).reshape(x.shape)
             else:
                 raise NotImplementedError(red_op)
             return r * post.astype(x.dtype)
 
-        # check_vma off: the all_gather+prod product path is replicated in
-        # value but not statically inferable as such.
+        # check_vma off for Product: the reduce-scatter + tiled
+        # all_gather result is replicated in value but not statically
+        # inferable as such.
         return jax.shard_map(block_fn, mesh=self.mesh,
                              in_specs=(P(AXIS), P(), P()),
                              out_specs=P(), check_vma=(red_op != PRODUCT))
@@ -393,20 +434,11 @@ class MeshCollectives:
                 if red_op == AVERAGE:
                     y = (y / size).astype(y.dtype)
             else:
-                # No scatter-variant collective exists for these ops:
-                # reduce fully, slice this rank's chunk.
-                if red_op == MIN:
-                    full = lax.pmin(x[0], AXIS)
-                elif red_op == MAX:
-                    full = lax.pmax(x[0], AXIS)
-                elif red_op == PRODUCT:
-                    full = jnp.prod(lax.all_gather(x[0], AXIS), axis=0)
-                else:
-                    raise NotImplementedError(
-                        "reducescatter op %r" % red_op)
-                k = x.shape[1] // size
-                y = lax.dynamic_slice_in_dim(
-                    full, lax.axis_index(AXIS) * k, k, axis=0)
+                # No scatter-variant collective exists for these ops;
+                # one all_to_all + a local reduce keeps the wire at 1×
+                # payload bytes (the full-reduce-then-slice fallback
+                # moved N×).
+                y = alltoall_chunk_reduce(x[0], AXIS, size, red_op)
             return y[None]
 
         fn = jax.shard_map(block_fn, mesh=self.mesh,

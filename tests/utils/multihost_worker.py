@@ -141,6 +141,14 @@ def main():
                            op=hvd.Sum, name="dev_rs")
     assert isinstance(d3, jax.Array), type(d3)
     np.testing.assert_allclose(np.asarray(d3), float(n))
+    # Min reducescatter rides the bytes-proportional all_to_all path
+    # (r4): numerically the cross-rank min, structurally asserted in
+    # the HLO block below.
+    d3m = hvd.reducescatter(
+        jnp.full((n * 2, 2), float(r + 1), jnp.float32),
+        op=hvd.Min, name="dev_rs_min")
+    assert isinstance(d3m, jax.Array), type(d3m)
+    np.testing.assert_allclose(np.asarray(d3m), 1.0)
     # Device-plane Adasum (r4): the ppermute XOR-tree combine runs on
     # the mesh — device payloads stay resident, results match the host
     # recursive-halving oracle.  Non-pow2 worlds must error loudly.
@@ -176,6 +184,18 @@ def main():
         if n & (n - 1) == 0:
             assert "collective_permute" in hlo, (
                 "no collective_permute HLO from device Adasum")
+        # Bytes-proportionality, structurally: Min reducescatter must
+        # be one all_to_all with NO all_gather (1x payload bytes, not
+        # the N x full-reduce-then-slice fallback); Product allreduce
+        # must carry the all_to_all reduce-scatter stage.
+        rs_min = "\n".join(v for k, v in mc.hlo.items()
+                           if k[0] == "reducescatter" and "Min" in k)
+        assert rs_min and "all_to_all" in rs_min, rs_min or "missing"
+        assert "all_gather" not in rs_min, (
+            "Min reducescatter still moves N x bytes:\n" + rs_min)
+        prod = "\n".join(v for k, v in mc.hlo.items()
+                         if k[0] == "fused_allreduce" and "Product" in k)
+        assert prod and "all_to_all" in prod, prod or "missing"
 
     # Async burst (DistributedOptimizer traffic shape): many uniquely
     # named in-flight device-array ops of varying shapes.  Whatever
