@@ -1,0 +1,112 @@
+"""Autotuner A/B harness: does HOROVOD_AUTOTUNE=1 beat the defaults?
+
+A DistributedOptimizer-shaped eager loop — K mixed-size "gradient"
+tensors allreduced per step, all synchronized at the step boundary —
+run twice from the same command line: once with the defaults, once
+under the autotuner (reference ``parameter_manager.cc``: fusion
+threshold + cycle time tuned online by a GP surrogate scoring observed
+bytes/sec).  Prints one JSON line with steps/sec.
+
+Worlds:
+* in-process 8-device CPU world (Python tuner, ``utils/autotune.py``):
+    python benchmarks/autotune_ab.py --cpu-devices 8
+* real multi-process TCP world (C++ tuner, ``core/src/parameter_manager.cc``):
+    python -m horovod_tpu.runner -np 2 python benchmarks/autotune_ab.py
+  (numpy payloads ride the cpu_ops rings synchronously inside the
+  negotiation cycle, so the tuner scores real communication time)
+
+Set HOROVOD_AUTOTUNE=1 [HOROVOD_AUTOTUNE_LOG=samples.csv] for the B arm.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--sizes-kb", default="4,16,64,256,1024",
+                    help="per-tensor sizes; the tensor list cycles "
+                         "through these (mixed-size gradient bucket)")
+    ap.add_argument("--tensors", type=int, default=16,
+                    help="tensors per step")
+    ap.add_argument("--cpu-devices", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % args.cpu_devices).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    multiproc = jax.process_count() > 1 or \
+        os.environ.get("HOROVOD_CONTROLLER") in ("tcp", "multihost") or \
+        os.environ.get("HOROVOD_RANK") is not None
+
+    sizes = [int(float(s) * 1024) // 4 for s in args.sizes_kb.split(",")]
+    rng = np.random.RandomState(0)
+    grads = []
+    for i in range(args.tensors):
+        elems = sizes[i % len(sizes)]
+        if multiproc:
+            grads.append(rng.randn(elems).astype(np.float32))
+        else:
+            # In-process world: rank-major stacked input.
+            grads.append(rng.randn(n, elems).astype(np.float32))
+
+    def step(s):
+        hs = [hvd.allreduce_async(g, op=hvd.Sum, name="g%d" % i)
+              for i, g in enumerate(grads)]
+        out = None
+        for h in hs:
+            out = hvd.synchronize(h)
+        return out
+
+    for s in range(args.warmup):
+        step(s)
+    t0 = time.perf_counter()
+    out = None
+    for s in range(args.steps):
+        out = step(s)
+    # Force the last result so async tails are inside the clock.
+    float(np.asarray(out).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+
+    total_bytes = sum(
+        (g.nbytes if multiproc else g.nbytes // n) for g in grads)
+    if hvd.rank() == 0:
+        print(json.dumps({
+            "metric": "autotune_ab_steps_per_sec",
+            "value": round(args.steps / dt, 2),
+            "unit": "steps/sec",
+            "autotune": os.environ.get("HOROVOD_AUTOTUNE", "0"),
+            "tensors": args.tensors,
+            "bytes_per_step": total_bytes,
+            "ranks": n,
+            "mb_per_sec": round(
+                total_bytes * args.steps / dt / 1e6, 1),
+        }))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

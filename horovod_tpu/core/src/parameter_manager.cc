@@ -125,10 +125,23 @@ bool ParameterManager::Observe(uint64_t bytes, double secs) {
     Apply(bo_.NextSample());
     return true;
   }
+  if (cycles_seen_ == 0) {
+    // Observe runs at cycle END; backdate by this cycle's active time
+    // so the window covers every cycle it accumulates bytes for.
+    sample_start_ = std::chrono::steady_clock::now() -
+        std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            std::chrono::duration<double>(std::max(secs, 0.0)));
+  }
   acc_bytes_ += static_cast<double>(bytes);
   acc_secs_ += std::max(secs, 1e-9);
   if (++cycles_seen_ < cycles_per_sample_) return false;
-  double score = acc_bytes_ / acc_secs_;
+  // Score by WALL time across the sample window, not the summed
+  // active-cycle time: the inter-cycle pause (and any contention a
+  // candidate cycle time causes) must count, or short cycle times
+  // look free and the tuner converges to an end-to-end loser.
+  double wall = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - sample_start_).count();
+  double score = acc_bytes_ / std::max(wall, acc_secs_);
   bo_.Record(current_idx_, score);
   ++samples_done_;
   if (log_) {

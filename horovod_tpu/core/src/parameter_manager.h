@@ -9,6 +9,7 @@
 #ifndef HVD_TPU_PARAMETER_MANAGER_H
 #define HVD_TPU_PARAMETER_MANAGER_H
 
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <string>
@@ -64,6 +65,7 @@ class ParameterManager {
   int cycles_seen_ = 0;
   int samples_done_ = 0;
   double acc_bytes_ = 0, acc_secs_ = 0;
+  std::chrono::steady_clock::time_point sample_start_{};
   FILE* log_ = nullptr;
 };
 

@@ -39,7 +39,8 @@ from ..common.config import Config
 from ..utils.timeline import Timeline
 from . import xla_ops
 from .engine import CollectiveHandle, HorovodInternalError
-from .xla_ops import ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM
+from .xla_ops import (ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM,
+                      alltoall_chunk_reduce, product_allreduce)
 
 LOG = logging.getLogger("horovod_tpu")
 
@@ -251,7 +252,6 @@ class GlobalMeshCollectives:
         elif red_op == PRODUCT:
             # Exact bytes-proportional product (reduce-scatter +
             # tiled all_gather, ~2x like Sum — not N x all_gather).
-            from .xla_ops import product_allreduce
             r = product_allreduce(
                 v.reshape(-1), "proc", self.size).reshape(v.shape)
         else:
@@ -522,7 +522,6 @@ class GlobalMeshCollectives:
                 elif red_op in (MIN, MAX, PRODUCT):
                     # One all_to_all + local reduce: 1x payload bytes
                     # (the full-reduce-then-slice fallback moved N x).
-                    from .xla_ops import alltoall_chunk_reduce
                     w = alltoall_chunk_reduce(y, "proc", size, red_op)
                 else:
                     r = self._reduce_block(y, red_op, 1.0, 1.0, size)

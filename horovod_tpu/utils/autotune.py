@@ -20,6 +20,7 @@ engine and is also importable for tests of the math itself.
 from __future__ import annotations
 
 import math
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -197,12 +198,23 @@ class ParameterManager:
             return
         if self._current_idx is None:
             self._apply(self.bo.next_index())
+        if self._cycles_seen == 0:
+            # observe() runs at cycle END; backdate by this cycle's
+            # active time so the window covers every accumulated cycle.
+            self._sample_t0 = time.monotonic() - max(secs, 0.0)
         self._cycle_bytes += nbytes
         self._cycle_secs += max(secs, 1e-9)
         self._cycles_seen += 1
         if self._cycles_seen < self.steps_per_sample:
             return
-        score = self._cycle_bytes / self._cycle_secs
+        # Score by WALL time across the sample window, not the summed
+        # active-cycle time: the cycle pause and any contention the
+        # candidate point causes (e.g. a 1 ms tick starving compute on
+        # small hosts) must count, or short cycle times look free and
+        # the tuner converges to a point that loses end to end.
+        wall = max(time.monotonic() - self._sample_t0,
+                   self._cycle_secs, 1e-9)
+        score = self._cycle_bytes / wall
         self.bo.record(self._current_idx, score)
         self._samples_done += 1
         if self._log:

@@ -142,13 +142,16 @@ def main():
     assert isinstance(d3, jax.Array), type(d3)
     np.testing.assert_allclose(np.asarray(d3), float(n))
     # Min reducescatter rides the bytes-proportional all_to_all path
-    # (r4): numerically the cross-rank min, structurally asserted in
-    # the HLO block below.
+    # (r4).  POSITION-dependent payload: chunk j's expected values are
+    # distinct, so delivering the wrong rank's chunk (a split/concat
+    # axis or mesh-ordering regression in alltoall_chunk_reduce) fails
+    # the numeric check, not just the structural HLO one below.
+    base = np.tile(np.arange(n * 2, dtype=np.float32)[:, None], (1, 2))
     d3m = hvd.reducescatter(
-        jnp.full((n * 2, 2), float(r + 1), jnp.float32),
-        op=hvd.Min, name="dev_rs_min")
+        jnp.asarray(base + 10.0 * r), op=hvd.Min, name="dev_rs_min")
     assert isinstance(d3m, jax.Array), type(d3m)
-    np.testing.assert_allclose(np.asarray(d3m), 1.0)
+    np.testing.assert_allclose(  # min over ranks = base; my chunk rows
+        np.asarray(d3m), base[r * 2:(r + 1) * 2])
     # Device-plane Adasum (r4): the ppermute XOR-tree combine runs on
     # the mesh — device payloads stay resident, results match the host
     # recursive-halving oracle.  Non-pow2 worlds must error loudly.
