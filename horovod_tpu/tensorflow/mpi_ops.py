@@ -41,6 +41,72 @@ def _np_view(t) -> np.ndarray:
     return np.asarray(t)
 
 
+def _tpu_present() -> bool:
+    """Whether TF exposes a TPU device (monkeypatchable in tests).
+
+    Only a POSITIVE enumeration is cached: a trace that runs before
+    ``initialize_tpu_system`` must not pin False forever and silently
+    disable the jit_compile guard for later TPU traces."""
+    global _TPU_PRESENT
+    if _TPU_PRESENT:
+        return True
+    try:
+        if tf.config.list_logical_devices("TPU"):
+            _TPU_PRESENT = True
+    except Exception:  # noqa: BLE001 - device enumeration is best-effort
+        pass
+    return bool(_TPU_PRESENT)
+
+
+_TPU_PRESENT: Optional[bool] = None
+
+
+def _tracing_jit_compile() -> bool:
+    """True when the current symbolic trace belongs to a
+    ``tf.function(jit_compile=True)``: the polymorphic ``Function``
+    driving the trace sits on the Python stack with its
+    ``_jit_compile`` flag (there is no FuncGraph-level signal).  The
+    match is restricted to TF's polymorphic Function type — other
+    objects carry a ``_jit_compile`` attribute too (e.g. a Keras
+    model after ``compile(jit_compile=True)``) without meaning THIS
+    trace is XLA-compiled."""
+    import sys
+    try:
+        from tensorflow.python.eager.polymorphic_function import (
+            polymorphic_function as _pf)
+        fn_type = _pf.Function
+    except Exception:  # noqa: BLE001 - internal layout varies by TF
+        fn_type = None
+    frame = sys._getframe()
+    while frame is not None:
+        obj = frame.f_locals.get("self")
+        if (getattr(obj, "_jit_compile", None) is True
+                and (fn_type is None or isinstance(obj, fn_type))):
+            return True
+        frame = frame.f_back
+    return False
+
+
+def _check_tpu_jit_trace():
+    """Actionable trace-time error for ``jit_compile=True`` on TPU.
+
+    A host ``py_function`` (or a host custom-call, reference
+    ``xla_mpi_ops.cc``) is structurally impossible to embed in a TPU
+    executable — without this check the user gets an opaque XLA
+    compile error at step time.  (SURVEY §2.3 TF XLA ops row; the
+    JAX adapter is the supported TPU compiled-collective path.)"""
+    if _tpu_present() and _tracing_jit_compile():
+        raise NotImplementedError(
+            "horovod_tpu.tensorflow collectives cannot be compiled "
+            "into a tf.function(jit_compile=True) TPU executable: the "
+            "collective executes on the host, and a host call cannot "
+            "live inside a TPU program. Either drop jit_compile=True "
+            "(the collective stages as a py_function at step time), "
+            "or use the JAX adapter (horovod_tpu.jax), whose "
+            "collectives compile into the TPU program as native XLA "
+            "ops over ICI. See docs/adapters.md (jax2tf note).")
+
+
 def _run_op(fn, x, out_shape=None):
     """Run ``fn`` (an eager collective) on ``x``; inside a traced
     ``tf.function`` the call is staged as a ``tf.py_function`` so the
@@ -48,6 +114,7 @@ def _run_op(fn, x, out_shape=None):
     reference's registered TF custom kernels play in graph mode
     (``horovod/tensorflow/mpi_ops.cc``)."""
     if tf.is_symbolic_tensor(x):
+        _check_tpu_jit_trace()
         y = tf.py_function(fn, [x], Tout=x.dtype)
         y.set_shape(out_shape if out_shape is not None else x.shape)
         return y
@@ -245,6 +312,7 @@ def _stage_group(eager_fn, tensors, out_shapes=None):
     """Run a grouped eager fn now, or stage it through py_function when
     any input is symbolic (shapes set when statically known)."""
     if any(tf.is_symbolic_tensor(t) for t in tensors):
+        _check_tpu_jit_trace()
         ys = tf.py_function(lambda *xs: eager_fn(list(xs)), tensors,
                             Tout=[t.dtype for t in tensors])
         ys = list(ys) if isinstance(ys, (list, tuple)) else [ys]
@@ -444,6 +512,7 @@ def _alltoall_graph_with_splits(tensor, splits, name, process_set):
             out, recv = res  # explicit splits -> (out, recv_splits)
             return out, np.asarray([int(i) for i in recv], np.int32)
 
+        _check_tpu_jit_trace()
         y, recv_t = tf.py_function(_fwd, [x, spv],
                                    Tout=(x.dtype, tf.int32))
         y.set_shape(out_shape)
@@ -605,6 +674,7 @@ def join(device=None) -> int:
 def _world_read_op(read, name):
     def _read():
         return np.int32(read())
+    _check_tpu_jit_trace()
     out = tf.py_function(_read, [], tf.int32, name=name)
     out.set_shape([])
     return out

@@ -419,3 +419,51 @@ def test_multirank_tape_optimizer_broadcast_compression(size):
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "utils", "tf_adapter_worker.py")
     assert_world_ok(spawn_world(worker, size), "TF_ADAPTER_OK")
+
+
+def test_jit_compile_on_tpu_raises_at_trace_time(hvd, monkeypatch):
+    """VERDICT r3 item 6: a host py_function collective cannot live in
+    a TPU executable; jit_compile=True tracing on a TPU must raise an
+    actionable error redirecting to horovod_tpu.jax — at TRACE time,
+    not as an opaque XLA compile failure at step time.  TPU presence
+    is forced via the predicate so the contract is covered on CPU; the
+    TPU-gated test below exercises the real device enumeration."""
+    from horovod_tpu.tensorflow import mpi_ops
+    monkeypatch.setattr(mpi_ops, "_TPU_PRESENT", True)
+
+    @tf.function(jit_compile=True)
+    def jit_step(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="tf_jit_tpu")
+
+    with pytest.raises(Exception, match="horovod_tpu.jax"):
+        jit_step(tf.ones((4,)))
+
+    @tf.function(jit_compile=True)
+    def jit_group(x):
+        return hvd.grouped_allreduce([x, x], op=hvd.Sum,
+                                     name="tf_jit_tpu_g")
+
+    with pytest.raises(Exception, match="horovod_tpu.jax"):
+        jit_group(tf.ones((4,)))
+
+    # Plain tf.function (no jit_compile) must keep tracing and running
+    # through the py_function staging even with a TPU present.
+    @tf.function
+    def graph_step(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="tf_nojit_tpu")
+
+    out = graph_step(tf.ones((4,)))
+    assert np.allclose(out.numpy(), 1.0)
+
+
+@pytest.mark.skipif(
+    not tf.config.list_logical_devices("TPU"),
+    reason="no TF TPU device attached (CPU CI); the forced-predicate "
+           "test above covers the contract")
+def test_jit_compile_on_real_tpu_raises_at_trace_time(hvd):
+    @tf.function(jit_compile=True)
+    def jit_step(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="tf_jit_real_tpu")
+
+    with pytest.raises(Exception, match="horovod_tpu.jax"):
+        jit_step(tf.ones((4,)))
