@@ -88,14 +88,18 @@ def _tracing_jit_compile() -> bool:
 
 
 def _check_tpu_jit_trace():
-    """Actionable trace-time error for ``jit_compile=True`` on TPU.
+    """Actionable trace-time error for ``jit_compile=True``.
 
-    A host ``py_function`` (or a host custom-call, reference
-    ``xla_mpi_ops.cc``) is structurally impossible to embed in a TPU
-    executable — without this check the user gets an opaque XLA
-    compile error at step time.  (SURVEY §2.3 TF XLA ops row; the
-    JAX adapter is the supported TPU compiled-collective path.)"""
-    if _tpu_present() and _tracing_jit_compile():
+    A ``py_function`` is unsupported inside ANY jit-compiled XLA
+    executable — without this check the user gets an opaque
+    "Detected unsupported operations ... EagerPyFunc" compile error at
+    step time.  On TPU (where even the native host custom-call path,
+    reference ``xla_mpi_ops.cc``, is structurally impossible) the
+    message redirects to the JAX adapter; elsewhere it points at the
+    native-op path.  (SURVEY §2.3 TF XLA ops row.)"""
+    if not _tracing_jit_compile():
+        return
+    if _tpu_present():
         raise NotImplementedError(
             "horovod_tpu.tensorflow collectives cannot be compiled "
             "into a tf.function(jit_compile=True) TPU executable: the "
@@ -105,6 +109,13 @@ def _check_tpu_jit_trace():
             "or use the JAX adapter (horovod_tpu.jax), whose "
             "collectives compile into the TPU program as native XLA "
             "ops over ICI. See docs/adapters.md (jax2tf note).")
+    raise NotImplementedError(
+        "horovod_tpu.tensorflow collectives stage as a py_function, "
+        "which cannot live inside a tf.function(jit_compile=True) "
+        "executable. Either drop jit_compile=True, or (allreduce, "
+        "tcp/multihost worlds) set HOROVOD_ENABLE_XLA_OPS=1 to route "
+        "through the native custom-call op, which jit-compiles on "
+        "CPU (reference xla_mpi_ops.cc).")
 
 
 def _run_op(fn, x, out_shape=None):

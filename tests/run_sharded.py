@@ -1,0 +1,106 @@
+"""Shard-by-file test runner: split the suite across machines/jobs.
+
+The serial suite is ~16 min on a 1-core box; CI with several runners
+can take shard k of M instead:
+
+    python tests/run_sharded.py --shard 0/3
+    python tests/run_sharded.py --shard 1/3
+    python tests/run_sharded.py --shard 2/3
+
+Files are partitioned deterministically by LPT (longest-processing-
+time-first) over recorded per-file durations, so shards are balanced
+and stable across invocations — every file runs in exactly one shard.
+Extra pytest args pass through after ``--``:
+
+    python tests/run_sharded.py --shard 1/2 -- -x -q
+
+Each shard is a separate pytest process, so the spawn harness's
+port-range isolation (tests/utils/spawn.py honors
+``HVD_TPU_TEST_PORT_SHARD`` here the same way it honors
+``PYTEST_XDIST_WORKER``) keeps concurrent shards on one host from
+colliding.  For in-process parallelism on a multi-core host, plain
+``pytest -n N --dist loadfile`` also works (ports are xdist-safe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import subprocess
+import sys
+
+# Approximate serial durations (seconds) recorded on the 1-core build
+# box, 2026-07-31.  Files not listed default to 10 s; exact values only
+# matter for balance, not correctness.
+RECORDED_SECONDS = {
+    "test_tf_adapter.py": 205,
+    "test_tcp_core.py": 150,
+    "test_elastic.py": 140,
+    "test_multihost.py": 130,
+    "test_bench_smoke.py": 345,
+    "test_torch_adapter.py": 120,
+    "test_platform_contract.py": 90,
+    "test_basics.py": 80,
+    "test_keras_adapter.py": 60,
+    "test_transformer.py": 55,
+    "test_spark_estimators.py": 45,
+    "test_runner.py": 45,
+    "test_collectives.py": 30,
+    "test_sequence_parallel.py": 25,
+    "test_pallas_kernels.py": 25,
+    "test_moe_pipeline.py": 20,
+    "test_jax_adapter.py": 20,
+    "test_zero.py": 15,
+    "test_pallas_bn.py": 15,
+}
+
+
+def partition(files, n_shards):
+    """Deterministic LPT: heaviest file to the lightest shard."""
+    weights = {f: RECORDED_SECONDS.get(os.path.basename(f), 10)
+               for f in files}
+    order = sorted(files, key=lambda f: (-weights[f], f))
+    shards = [[] for _ in range(n_shards)]
+    loads = [0.0] * n_shards
+    for f in order:
+        i = loads.index(min(loads))
+        shards[i].append(f)
+        loads[i] += weights[f]
+    return [sorted(s) for s in shards], loads
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shard", required=True,
+                    help="k/M — run shard k (0-based) of M")
+    ap.add_argument("--list", action="store_true",
+                    help="print the file partition and exit")
+    ap.add_argument("rest", nargs=argparse.REMAINDER,
+                    help="extra pytest args after --")
+    args = ap.parse_args()
+    k, m = (int(v) for v in args.shard.split("/"))
+    if not (0 <= k < m):
+        raise SystemExit("--shard k/M needs 0 <= k < M")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = sorted(glob.glob(os.path.join(here, "test_*.py")))
+    shards, loads = partition(files, m)
+    if args.list:
+        for i, (s, w) in enumerate(zip(shards, loads)):
+            print("shard %d/%d (~%ds): %s" % (
+                i, m, w, " ".join(os.path.basename(f) for f in s)))
+        return 0
+    rest = [a for a in args.rest if a != "--"] or ["-q"]
+    env = dict(os.environ)
+    # Disjoint spawn-port ranges per shard (mirrors the xdist handling
+    # in tests/utils/spawn.py).
+    env["HVD_TPU_TEST_PORT_SHARD"] = str(k)
+    cmd = [sys.executable, "-m", "pytest", *shards[k], *rest]
+    print("shard %d/%d: %d files (~%ds serial)" % (
+        k, m, len(shards[k]), loads[k]), flush=True)
+    return subprocess.call(cmd, env=env, cwd=os.path.dirname(here))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

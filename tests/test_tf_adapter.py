@@ -456,6 +456,22 @@ def test_jit_compile_on_tpu_raises_at_trace_time(hvd, monkeypatch):
     assert np.allclose(out.numpy(), 1.0)
 
 
+def test_jit_compile_raises_on_any_device(hvd):
+    """py_function is unsupported in ANY jit_compile=True executable
+    (not just TPU): without a TPU the trace-time error points at the
+    native-op knob instead of producing the opaque EagerPyFunc XLA
+    compile failure at step time."""
+    from horovod_tpu.tensorflow import mpi_ops
+    assert mpi_ops._TPU_PRESENT is not True  # CPU CI
+
+    @tf.function(jit_compile=True)
+    def jit_step(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="tf_jit_cpu")
+
+    with pytest.raises(Exception, match="HOROVOD_ENABLE_XLA_OPS"):
+        jit_step(tf.ones((4,)))
+
+
 @pytest.mark.skipif(
     not tf.config.list_logical_devices("TPU"),
     reason="no TF TPU device attached (CPU CI); the forced-predicate "

@@ -12,14 +12,27 @@ import socket
 import subprocess
 import sys
 
+_SLOT_PORTS = 1200  # ports per (worker, shard) slot
+_SLOT_COUNT = 31    # 27100 + 31*1200 = 64300 < 65535
+_BASE_FLOOR = 27100
+
+
 def _initial_port_base() -> int:
-    # Disjoint ranges per pytest-xdist worker: two workers probing the
-    # same base can both see a port free (probe binds then closes) and
-    # collide when their spawned worlds bind for real.
+    # Disjoint ranges per pytest-xdist worker (and per run_sharded.py
+    # shard): two processes probing the same base can both see a port
+    # free (probe binds then closes) and collide when their spawned
+    # worlds bind for real.  Slots are (worker + 8*shard) mod 31 —
+    # collision-free for up to 8 workers x 3 shards concurrently on
+    # one host (and any single dimension up to 31); beyond capacity
+    # slots wrap, degrading to probe-time detection rather than
+    # overflowing the 65535 port ceiling.
     worker = os.environ.get("PYTEST_XDIST_WORKER", "")
     idx = int(worker[2:]) if worker.startswith("gw") and \
         worker[2:].isdigit() else 0
-    return 27100 + idx * 2400
+    shard = os.environ.get("HVD_TPU_TEST_PORT_SHARD", "")
+    if shard.isdigit():
+        idx += int(shard) * 8
+    return _BASE_FLOOR + (idx % _SLOT_COUNT) * _SLOT_PORTS
 
 
 _port_base = [_initial_port_base()]
@@ -27,8 +40,13 @@ _port_base = [_initial_port_base()]
 
 def free_port_block(size, extra_offsets=()):
     """A base where [base, base+size) plus any extra offsets bind."""
+    hi = max(size, *extra_offsets) if extra_offsets else size
     for _ in range(200):
         _port_base[0] += size + 30
+        # A long run can walk past the port ceiling — wrap back to the
+        # slot floor (binds below still confirm actual freeness).
+        if _port_base[0] + hi > 65000:
+            _port_base[0] = _initial_port_base()
         base = _port_base[0]
         socks = []
         try:
@@ -39,7 +57,7 @@ def free_port_block(size, extra_offsets=()):
                 s.bind(("127.0.0.1", port))
                 socks.append(s)
             return base
-        except OSError:
+        except (OSError, OverflowError):
             continue
         finally:
             for s in socks:
