@@ -96,6 +96,19 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr,
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-manual-axes so
+    pallas_call outputs type-check inside ``check_vma=True`` shard_maps
+    (per-shard kernel outputs vary exactly like their inputs)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # older jax without the vma kwarg
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_attention_fwd_flat(q, k, v, *, causal: bool, block_q: int,
                               block_k: int, interpret: bool):
     """(BH, S, D) → ((BH, S, D) output, (BH, S, 1) lse), D lane-padded."""
@@ -121,8 +134,8 @@ def _flash_attention_fwd_flat(q, k, v, *, causal: bool, block_q: int,
             pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
+            _sds((bh, seq, d), q.dtype, q),
+            _sds((bh, seq, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -342,7 +355,7 @@ def _flash_attention_bwd_flat(q, k, v, g, lse, delta, *, causal: bool,
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda i, j, t: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        out_shape=_sds((bh, seq, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -363,8 +376,8 @@ def _flash_attention_bwd_flat(q, k, v, g, lse, delta, *, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+            _sds((bh, seq, d), k.dtype, k),
+            _sds((bh, seq, d), v.dtype, v),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -484,7 +497,7 @@ def fused_scale_sum(a, b, alpha: float = 1.0, beta: float = 1.0):
         in_specs=[pl.BlockSpec((block_rows, lane), lambda i: (i, 0)),
                   pl.BlockSpec((block_rows, lane), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((block_rows, lane), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, lane), a.dtype),
+        out_shape=_sds((rows, lane), a.dtype, a),
         interpret=not _on_tpu(),
     )(flat_a.reshape(rows, lane), flat_b.reshape(rows, lane))
     return out.reshape(-1)[:n].reshape(a.shape)

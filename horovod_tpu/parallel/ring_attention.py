@@ -56,6 +56,23 @@ def _repeat_kv(kv, n_rep: int):
     return jnp.repeat(kv, n_rep, axis=2)
 
 
+def pvary_missing(v, axes):
+    """Mark ``v`` varying over any of ``axes`` it is not already
+    varying over (vma tracking for check_vma=True shard_maps); identity
+    when tracking is off.  Loop carries must enter with the
+    varying-axes superset their outputs acquire."""
+    try:
+        have = jax.typeof(v).vma
+    except Exception:  # noqa: BLE001 - no vma tracking in this trace
+        return v
+    missing = tuple(a for a in axes if a not in have)
+    if not missing:
+        return v
+    if hasattr(lax, "pcast"):
+        return lax.pcast(v, missing, to="varying")
+    return lax.pvary(v, missing)  # older jax spelling
+
+
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                    query_offset=None, kv_offset=None):
     """Blockwise ring attention inside a shard_map over ``axis_name``.
@@ -100,7 +117,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, m, l, acc
 
-    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    vma = getattr(jax.typeof(q), "vma", ())
+    init = tuple(pvary_missing(c, tuple(vma)) for c in
+                 (k, v, m0, l0, acc0))
+    _, _, m, l, acc = lax.fori_loop(0, n, body, init)
     l_t = l.transpose(0, 2, 1)[..., None]
     out = acc / jnp.maximum(l_t, 1e-30)
     return out.astype(q.dtype)

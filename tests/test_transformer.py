@@ -113,6 +113,36 @@ def test_remat_matches_no_remat(hvd_world):
                                rtol=1e-4)
 
 
+def test_sharded_gradients_match_single_device(hvd_world):
+    """Loss AND gradients must be mesh-invariant under the vma-tracked
+    step (r4: the previous check_vma=False form psum'ed grads over
+    (dp, sp) on top of already-combined cotangents, scaling updates by
+    dp*sp — this is the regression guard)."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    rng = np.random.RandomState(5)
+    batch = _batch(rng, 4, 16)
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.models.transformer import param_specs
+
+    def loss_and_gradnorm(mesh):
+        bspec = {"tokens": P("dp", "sp"), "targets": P("dp", "sp")}
+        f = jax.jit(jax.shard_map(
+            jax.value_and_grad(lambda p, b: loss_fn(p, b, cfg)),
+            mesh=mesh, in_specs=(param_specs(cfg), bspec),
+            out_specs=(P(), param_specs(cfg)), check_vma=True))
+        loss, g = f(params, batch)
+        return float(loss), float(optax.global_norm(
+            jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), g)))
+
+    l1, g1 = loss_and_gradnorm(
+        Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+             ("dp", "sp", "tp")))
+    l8, g8 = loss_and_gradnorm(_mesh((2, 2, 2), ("dp", "sp", "tp")))
+    np.testing.assert_allclose(l8, l1, rtol=1e-5)
+    np.testing.assert_allclose(g8, g1, rtol=1e-4)
+
+
 def test_fused_projections_match_unfused(hvd_world):
     """fused_qkv/fused_gate only repack the per-shard weight slices —
     loss and gradients must be identical to the three-matmul form,
