@@ -30,9 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .transformer import (_sharded_embed_lookup,  # noqa: F401
-                          _use_flash_attention, opt_spec_tree,
-                          rms_norm, vocab_parallel_cross_entropy)
+from .transformer import (_sharded_embed_lookup, _use_flash_attention,
+                          opt_spec_tree, vocab_parallel_cross_entropy)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,16 +238,22 @@ def cls_logits(params, hidden):
 
 
 def mlm_loss(params, batch, cfg: BertConfig):
-    """Per-shard masked-LM loss: mean nll over positions where
-    ``mlm_mask`` is 1, psum-averaged over dp."""
+    """Masked-LM loss: mean nll over GLOBAL masked positions.
+
+    Numerator and denominator are psum'ed over dp separately before
+    the division — a per-shard masked mean then pmean'ed would weight
+    shards with few masked positions as heavily as full ones (uneven
+    ~15% masking makes per-shard counts differ every batch), breaking
+    mesh invariance of the loss and gradients."""
     hidden = encode(params, batch["tokens"], cfg,
                     batch.get("token_type"), batch.get("mask"))
     logits = mlm_logits_local(params, hidden, cfg)
     nll = vocab_parallel_cross_entropy(logits, batch["targets"],
                                        cfg.tp_axis)
     m = batch["mlm_mask"].astype(jnp.float32)
-    loss = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return lax.pmean(loss, cfg.dp_axis)
+    num = lax.psum((nll * m).sum(), cfg.dp_axis)
+    den = lax.psum(m.sum(), cfg.dp_axis)
+    return num / jnp.maximum(den, 1.0)
 
 
 def classification_loss(params, batch, cfg: BertConfig):

@@ -88,7 +88,10 @@ def test_bert_sharded_matches_single_device(hvd_world):
     params = init_params(jax.random.PRNGKey(2), cfg)
     rng = np.random.RandomState(2)
     tokens = rng.randint(0, VOCAB, size=(4, 16)).astype(np.int32)
-    mlm_mask = np.ones((4, 16), np.int32)
+    # UNEVEN mask counts per row (realistic ~15% masking): the global
+    # masked mean must not depend on how rows land on dp shards.
+    mlm_mask = (rng.rand(4, 16) < 0.3).astype(np.int32)
+    mlm_mask[:, 0] = 1  # at least one target per row
     batch = {"tokens": tokens, "targets": tokens, "mlm_mask": mlm_mask}
 
     def loss_and_gradnorm(mesh):
