@@ -1,0 +1,62 @@
+"""TF2 Keras ResNet-50 data-parallel training — the reference's
+"TF2 Keras ResNet-50 ImageNet" flagship config (BASELINE.json
+configs[1]; reference ``examples/tensorflow2/
+tensorflow2_keras_synthetic_benchmark.py`` shape).
+
+``keras.applications.ResNet50`` wrapped in ``hvd.DistributedOptimizer``
+with the broadcast + metric-average callbacks.  Synthetic ImageNet
+batches (zero-egress env); ``--image`` shrinks the spatial size for
+CPU smoke runs.
+
+    python -m horovod_tpu.runner -np 2 python examples/tensorflow2_keras_resnet50.py
+"""
+
+import _path_setup  # noqa: F401  (repo-checkout imports)
+
+import argparse
+
+import numpy as np
+import keras
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--image", type=int, default=64,
+                    help="224 for the real ResNet-50 geometry")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=1)
+    args = ap.parse_args()
+
+    hvd.init()
+    rng = np.random.RandomState(42 + hvd.rank())
+    n = args.batch * args.steps
+    x = rng.rand(n, args.image, args.image, 3).astype("float32")
+    y = rng.randint(0, 1000, n)
+
+    model = keras.applications.ResNet50(
+        weights=None, input_shape=(args.image, args.image, 3),
+        classes=1000)
+    # Reference recipe: linear LR scaling with world size, wrapped
+    # optimizer, broadcast initial state from rank 0.
+    opt = keras.optimizers.SGD(0.0125 * hvd.size(), momentum=0.9)
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(opt),
+        loss=keras.losses.SparseCategoricalCrossentropy(
+            from_logits=False),
+        metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ]
+    model.fit(x, y, batch_size=args.batch, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=2 if hvd.rank() == 0 else 0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
