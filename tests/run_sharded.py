@@ -44,6 +44,7 @@ RECORDED_SECONDS = {
     "test_basics.py": 80,
     "test_keras_adapter.py": 60,
     "test_transformer.py": 55,
+    "test_bert.py": 40,
     "test_spark_estimators.py": 45,
     "test_runner.py": 45,
     "test_collectives.py": 30,
