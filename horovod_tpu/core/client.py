@@ -123,6 +123,9 @@ def load_library():
     lib.hvd_tcp_wait_negotiated.restype = ctypes.c_int
     lib.hvd_tcp_external_done.argtypes = [ctypes.c_int, ctypes.c_int,
                                           ctypes.c_char_p]
+    lib.hvd_tcp_autotune_observe.argtypes = [ctypes.c_ulonglong,
+                                             ctypes.c_double]
+    lib.hvd_tcp_autotune_observe.restype = None
     _lib = lib
     return lib
 
@@ -415,6 +418,11 @@ class TcpCore:
                       error: str = ""):
         self._lib.hvd_tcp_external_done(handle, 1 if ok else 0,
                                         error.encode())
+
+    def autotune_observe(self, nbytes: int, secs: float):
+        """Report a device-plane allreduce group's (bytes, time-to-
+        completion) to rank 0's autotuner (no-op elsewhere)."""
+        self._lib.hvd_tcp_autotune_observe(int(nbytes), float(secs))
 
     def barrier(self, name=None, process_set_id=0):
         h = self._enqueue(name or "barrier.%f" % time.monotonic(),

@@ -110,6 +110,12 @@ void hvd_tcp_external_done(int handle, int ok, const char* err) {
                  : Status::UnknownError(err ? err : "external op failed"));
 }
 
+// Device-plane autotune feedback: bytes + seconds-to-completion of an
+// external (XLA) allreduce group, reported by the multihost executor.
+void hvd_tcp_autotune_observe(unsigned long long bytes, double secs) {
+  CoreState::Get().AutotuneObserve(static_cast<uint64_t>(bytes), secs);
+}
+
 int hvd_tcp_poll(int handle) { return CoreState::Get().Poll(handle); }
 
 long long hvd_tcp_result_nbytes(int handle) {

@@ -169,6 +169,13 @@ void CoreState::WakeLoop() {
   wake_cv_.notify_one();
 }
 
+void CoreState::AutotuneObserve(uint64_t bytes, double secs) {
+  // Device-plane completion report (multihost executor): rank 0's
+  // tuner scores it exactly like a cycle observation.
+  if (!initialized_ || rank_ != 0) return;
+  params_.Observe(bytes, secs);
+}
+
 void CoreState::WaitShutdown() {
   if (background_.joinable()) background_.join();
   pool_.reset();
@@ -377,7 +384,12 @@ void CoreState::BackgroundLoop() {
         }
       }
       PerformOperation(r);
-      if (r.op_type == OpType::ALLREDUCE)
+      // External (device-payload) groups execute asynchronously on
+      // the XLA plane: the cycle wall time says nothing about them.
+      // Their bytes/seconds arrive via AutotuneObserve from the
+      // executor instead, so the tuner scores real transfer time on
+      // both planes.
+      if (r.op_type == OpType::ALLREDUCE && !r.external)
         for (size_t i = 0; i < r.aux_sizes.size(); ++i)
           cycle_bytes += static_cast<uint64_t>(r.aux_sizes[i]) *
                          DataTypeSize(r.dtype);

@@ -68,6 +68,11 @@ class CoreState {
   int WaitNegotiated(uint8_t* buf, int buflen, int timeout_ms);
   void ExternalDone(int32_t handle, const Status& s);
 
+  // Device-plane autotune feedback: the multihost executor reports
+  // (bytes, seconds-to-completion) per allreduce group, replacing the
+  // meaningless negotiation-cycle timing for external payloads.
+  void AutotuneObserve(uint64_t bytes, double secs);
+
   uint32_t RegisterProcessSet(const std::vector<int32_t>& ranks) {
     return process_sets_.Register(ranks);
   }

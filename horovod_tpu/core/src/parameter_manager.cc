@@ -116,6 +116,7 @@ void ParameterManager::Apply(int grid_index) {
 }
 
 bool ParameterManager::Observe(uint64_t bytes, double secs) {
+  std::lock_guard<std::mutex> lk(mu_);
   if (!enabled_ || converged_) return false;
   if (warmup_ > 0) {
     --warmup_;
@@ -126,22 +127,25 @@ bool ParameterManager::Observe(uint64_t bytes, double secs) {
     return true;
   }
   if (cycles_seen_ == 0) {
-    // Observe runs at cycle END; backdate by this cycle's active time
-    // so the window covers every cycle it accumulates bytes for.
+    // Observe runs at observation END; backdate by this observation's
+    // active time so the window covers everything it accumulates.
     sample_start_ = std::chrono::steady_clock::now() -
         std::chrono::duration_cast<std::chrono::steady_clock::duration>(
             std::chrono::duration<double>(std::max(secs, 0.0)));
   }
   acc_bytes_ += static_cast<double>(bytes);
-  acc_secs_ += std::max(secs, 1e-9);
+  max_secs_ = std::max(max_secs_, std::max(secs, 1e-9));
   if (++cycles_seen_ < cycles_per_sample_) return false;
-  // Score by WALL time across the sample window, not the summed
-  // active-cycle time: the inter-cycle pause (and any contention a
-  // candidate cycle time causes) must count, or short cycle times
-  // look free and the tuner converges to an end-to-end loser.
+  // Score by WALL time across the sample window: the inter-cycle
+  // pause (and any contention a candidate causes) must count, or
+  // short cycle times look free.  Observations may OVERLAP (pipelined
+  // device-plane groups report concurrent durations), so summing them
+  // would double-count wall time in proportion to pipeline depth —
+  // the guard against a mis-ordered clock is the LONGEST single
+  // observation, never the sum.
   double wall = std::chrono::duration<double>(
       std::chrono::steady_clock::now() - sample_start_).count();
-  double score = acc_bytes_ / std::max(wall, acc_secs_);
+  double score = acc_bytes_ / std::max(wall, max_secs_);
   bo_.Record(current_idx_, score);
   ++samples_done_;
   if (log_) {
@@ -150,7 +154,7 @@ bool ParameterManager::Observe(uint64_t bytes, double secs) {
                  cycle_time_ms_, score);
     std::fflush(log_);
   }
-  acc_bytes_ = acc_secs_ = 0;
+  acc_bytes_ = max_secs_ = 0;
   cycles_seen_ = 0;
   if (samples_done_ >= max_samples_) {
     Apply(bo_.BestSample());

@@ -12,6 +12,7 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -44,11 +45,23 @@ class ParameterManager {
   // Called once per non-empty cycle with reduced bytes and cycle seconds.
   // Returns true if the tuned values changed (so the coordinator should
   // re-broadcast them).
+  // Thread-safe: called from the background cycle loop AND, in
+  // multihost mode, from the Python executor reporting device-plane
+  // completion times (hvd_tcp_autotune_observe).
   bool Observe(uint64_t bytes, double secs);
 
-  uint64_t fusion_threshold() const { return fusion_threshold_; }
-  double cycle_time_ms() const { return cycle_time_ms_; }
-  bool converged() const { return converged_; }
+  uint64_t fusion_threshold() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return fusion_threshold_;
+  }
+  double cycle_time_ms() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return cycle_time_ms_;
+  }
+  bool converged() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return converged_;
+  }
 
  private:
   void Apply(int grid_index);
@@ -64,8 +77,9 @@ class ParameterManager {
   int current_idx_ = -1;
   int cycles_seen_ = 0;
   int samples_done_ = 0;
-  double acc_bytes_ = 0, acc_secs_ = 0;
+  double acc_bytes_ = 0, max_secs_ = 0;
   std::chrono::steady_clock::time_point sample_start_{};
+  mutable std::mutex mu_;
   FILE* log_ = nullptr;
 };
 

@@ -842,6 +842,15 @@ class MultihostEngine:
             if not self._watch_clear(wid):
                 self._complete_error(g, names, taken, entries, exc)
             return
+        with self._lock:
+            route_q = needs_host or self._host_inflight > 0
+            if route_q:
+                self._host_inflight += 1
+        nbytes = 0
+        if self.config.autotune and g["op_type"] == "allreduce":
+            nbytes = int(sum(int(n) for n in g["aux_sizes"])
+                         * np.dtype(g["dtype"]).itemsize)
+        t0 = time.monotonic()
         if rep is not None:
             self._inflight_outs.append(rep)
             while len(self._inflight_outs) > self._depth:
@@ -849,44 +858,76 @@ class MultihostEngine:
                     self._inflight_outs.pop(0).block_until_ready()
                 except Exception:  # noqa: BLE001 - surfaced via handles
                     pass
-        with self._lock:
-            route_q = needs_host or self._host_inflight > 0
-            if route_q:
-                self._host_inflight += 1
         if route_q:
             # Blocking host fetch — or completions still in flight
             # whose relative order we keep — go through the completion
             # thread.  (_host_inflight is decremented only after
             # _finish fully resolves a queued group, so "zero" really
             # means every earlier group's handles are set.)
-            self._done_q.put((g, names, taken, entries, finalize, wid))
+            self._done_q.put((g, names, taken, entries, finalize, wid,
+                              nbytes, t0))
         else:
             # Device-resident group: finalize never blocks, so complete
             # inline and spare the cross-thread handoff (a scheduler
             # quantum per op on busy hosts).
             self._finish(g, names, taken, entries, finalize, wid)
+            if nbytes and rep is not None:
+                # Autotune signal: the completion thread blocks on the
+                # output and reports true dispatch-to-completion time
+                # (measuring at a later pipeline-window pop would add
+                # arbitrary idle; the negotiation cycle says nothing
+                # about async XLA payloads).
+                self._done_q.put(("observe", rep, nbytes, t0))
 
     def _completion_loop(self):
         while True:
             item = self._done_q.get()
             if item is None:
                 return
-            self._finish(*item)
+            if item[0] == "observe":
+                # Device-resident group: block on its output, report
+                # true completion time to the autotuner.
+                _, rep, nbytes, t0 = item
+                try:
+                    rep.block_until_ready()
+                except Exception:  # noqa: BLE001 - failed groups are
+                    continue       # not throughput samples
+                self._observe_exec(nbytes, t0)
+                continue
+            g, names, taken, entries, finalize, wid, nbytes, t0 = item
+            ok = self._finish(g, names, taken, entries, finalize, wid)
+            # Host-fetch completion IS the group's true completion —
+            # but a failed/watchdog-killed group is not a throughput
+            # sample.
+            if ok:
+                self._observe_exec(nbytes, t0)
             with self._lock:
                 self._host_inflight -= 1
 
-    def _finish(self, g, names, taken, entries, finalize, wid=None):
+    def _observe_exec(self, nbytes, t0):
+        if not nbytes:
+            return
+        try:
+            self.core.autotune_observe(nbytes, time.monotonic() - t0)
+        except Exception:  # noqa: BLE001 - optional feedback path
+            pass
+
+    def _finish(self, g, names, taken, entries, finalize, wid=None
+                ) -> bool:
+        """Resolve the group's handles; returns True only on a clean
+        completion (False for errors or watchdog-killed groups, which
+        must not become autotune throughput samples)."""
         try:
             results = finalize()
         except Exception as exc:  # noqa: BLE001 - keep draining
             if not (wid is not None and self._watch_clear(wid)):
                 self._complete_error(g, names, taken, entries, exc)
-            return
+            return False
         if wid is not None and self._watch_clear(wid):
             # The watchdog already failed this group's handles while
             # the program was wedged; a late completion must not
             # repeat external_done/release or overwrite the error.
-            return
+            return False
         try:
             self.timeline.activity_end_all(names)
             for (py, _), res, e in zip(taken, results, entries):
@@ -897,6 +938,8 @@ class MultihostEngine:
                     py._set_result(res)
         except Exception as exc:  # noqa: BLE001 - keep draining
             self._complete_error(g, names, taken, entries, exc)
+            return False
+        return True
 
     def _complete_error(self, g, names, taken, entries, exc):
         self.timeline.activity_end_all(names)
@@ -1028,9 +1071,9 @@ class MultihostEngine:
                 item = self._done_q.get_nowait()
             except queue_mod.Empty:
                 break
-            if item is None:
+            if item is None or item[0] == "observe":
                 continue
-            g, names, taken, entries, _fin, _wid = item
+            g, names, taken, entries = item[:4]
             self._complete_error(
                 g, names, taken, entries,
                 HorovodInternalError("engine shut down"))

@@ -178,7 +178,7 @@ class ParameterManager:
         if self._log:
             self._log.write("sample,fusion_bytes,cycle_ms,score_bytes_per_s\n")
         self._cycle_bytes = 0.0
-        self._cycle_secs = 0.0
+        self._max_secs = 0.0
         self._cycles_seen = 0
         self._samples_done = 0
         self._current_idx: Optional[int] = None
@@ -203,17 +203,17 @@ class ParameterManager:
             # active time so the window covers every accumulated cycle.
             self._sample_t0 = time.monotonic() - max(secs, 0.0)
         self._cycle_bytes += nbytes
-        self._cycle_secs += max(secs, 1e-9)
+        self._max_secs = max(self._max_secs, secs, 1e-9)
         self._cycles_seen += 1
         if self._cycles_seen < self.steps_per_sample:
             return
-        # Score by WALL time across the sample window, not the summed
-        # active-cycle time: the cycle pause and any contention the
-        # candidate point causes (e.g. a 1 ms tick starving compute on
-        # small hosts) must count, or short cycle times look free and
-        # the tuner converges to a point that loses end to end.
+        # Score by WALL time across the sample window: the cycle pause
+        # and any contention the candidate point causes must count, or
+        # short cycle times look free.  Observations may overlap
+        # (pipelined device groups), so the clock guard is the LONGEST
+        # single observation, never their sum.
         wall = max(time.monotonic() - self._sample_t0,
-                   self._cycle_secs, 1e-9)
+                   self._max_secs, 1e-9)
         score = self._cycle_bytes / wall
         self.bo.record(self._current_idx, score)
         self._samples_done += 1
@@ -222,7 +222,7 @@ class ParameterManager:
                 self._samples_done, self.fusion_threshold,
                 self.cycle_time_ms, score))
             self._log.flush()
-        self._cycle_bytes = self._cycle_secs = 0.0
+        self._cycle_bytes = self._max_secs = 0.0
         self._cycles_seen = 0
         if self._samples_done >= self.max_samples:
             self._apply(self.bo.best_index())
