@@ -198,11 +198,19 @@ def _plan(s: int, d: int):
     """Block plan shared by fwd and bwd.  Large tiles amortize
     per-grid-step overhead; MXU tiles are 128-aligned so any divisor
     ≥64 works.  The head dim is lane-padded to 128 (zero columns add 0
-    to every dot product)."""
-    block_q = next((bq for bq in (512, 256, 128, 64) if s % bq == 0),
-                   None)
-    block_k = next((bk for bk in (1024, 512, 256, 128, 64)
-                    if s % bk == 0), None)
+    to every dot product).  HVD_TPU_FLASH_BLOCK_Q/K override the
+    defaults for A/B tuning (must divide the sequence length)."""
+    import os
+
+    def _env_block(name, dflt_chain):
+        v = os.environ.get(name)
+        if v and v.isdigit() and s % int(v) == 0:
+            return int(v)
+        return next((b for b in dflt_chain if s % b == 0), None)
+
+    block_q = _env_block("HVD_TPU_FLASH_BLOCK_Q", (512, 256, 128, 64))
+    block_k = _env_block("HVD_TPU_FLASH_BLOCK_K",
+                         (1024, 512, 256, 128, 64))
     d_pad = max(128, ((d + 127) // 128) * 128)
     scale_fix = math.sqrt(d_pad / d)  # kernels scale by 1/sqrt(d_pad)
     return block_q, block_k, d_pad, scale_fix
