@@ -204,8 +204,19 @@ def _plan(s: int, d: int):
 
     def _env_block(name, dflt_chain):
         v = os.environ.get(name)
-        if v and v.isdigit() and s % int(v) == 0:
-            return int(v)
+        if v:
+            # Fail loudly, like HVD_TPU_FLASH_BWD below: a silently
+            # ignored override would mislabel an A/B comparison.
+            try:
+                b = int(v)
+            except ValueError:
+                raise ValueError("%s=%r is not an integer" % (name, v))
+            if b < 64 or b % 64 or s % b:
+                raise ValueError(
+                    "%s=%d invalid: blocks must be multiples of 64 "
+                    "(MXU tiling) that divide the sequence length %d"
+                    % (name, b, s))
+            return b
         return next((b for b in dflt_chain if s % b == 0), None)
 
     block_q = _env_block("HVD_TPU_FLASH_BLOCK_Q", (512, 256, 128, 64))
