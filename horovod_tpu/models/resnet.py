@@ -206,6 +206,15 @@ def create_resnet50(num_classes: int = 1000, dtype=jnp.bfloat16,
                   sync_batch_norm=sync_batch_norm)
 
 
+def create_resnet101(num_classes: int = 1000, dtype=jnp.bfloat16,
+                     sync_batch_norm: bool = False):
+    """The reference's published ~90% scaling-efficiency row pairs
+    ResNet-101 with Inception-V3 (BASELINE.md); depth 101 reuses the
+    same bottleneck stack ([3, 4, 23, 3] stages)."""
+    return ResNet(depth=101, num_classes=num_classes, dtype=dtype,
+                  sync_batch_norm=sync_batch_norm)
+
+
 def resnet_loss_fn(model: ResNet, variables, batch, train: bool = True):
     """Cross-entropy + batch-stat update handling for flax BatchNorm."""
     if train:

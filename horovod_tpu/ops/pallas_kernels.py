@@ -211,11 +211,11 @@ def _plan(s: int, d: int):
                 b = int(v)
             except ValueError:
                 raise ValueError("%s=%r is not an integer" % (name, v))
-            if b < 64 or b % 64 or s % b:
+            if b < 64 or b % 16 or s % b:
                 raise ValueError(
-                    "%s=%d invalid: blocks must be multiples of 64 "
-                    "(MXU tiling) that divide the sequence length %d"
-                    % (name, b, s))
+                    "%s=%d invalid: blocks must be >=64, sublane-"
+                    "aligned (multiple of 16), and divide the "
+                    "sequence length %d" % (name, b, s))
             return b
         return next((b for b in dflt_chain if s % b == 0), None)
 

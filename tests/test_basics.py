@@ -96,6 +96,25 @@ def test_vgg16_forward_and_loss():
     assert np.isfinite(float(nll)) and new_state == {}
 
 
+def test_resnet101_forward_and_loss():
+    # The reference's published scaling row pairs ResNet-101 with
+    # Inception-V3 (BASELINE.md); the deeper stack must build and
+    # train-step like ResNet-50.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models.resnet import (create_resnet101,
+                                           resnet_loss_fn)
+    model = create_resnet101(num_classes=10, dtype=jnp.float32)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    nll, new_state = resnet_loss_fn(model, variables,
+                                    {"x": x, "y": np.array([1, 2])})
+    assert np.isfinite(float(nll)) and "batch_stats" in new_state
+
+
 def test_checkpoint_save_restore(tmp_path, hvd_world):
     import numpy as np
     import jax.numpy as jnp
