@@ -126,13 +126,33 @@ bool ParameterManager::Observe(uint64_t bytes, double secs) {
     Apply(bo_.NextSample());
     return true;
   }
+  auto now = std::chrono::steady_clock::now();
+  double s = std::max(secs, 0.0);
+  if (cycles_seen_ > 0) {
+    // Long application idle inside a window (eval pauses, data
+    // stalls) is not the candidate's fault: wall time spanning it
+    // would deflate the bytes/sec score arbitrarily — discard the
+    // partial window and restart it at this observation.  The
+    // threshold must sit well ABOVE a normal compute gap between
+    // optimizer steps (which recurs every step and must stay inside
+    // the window, or no window would ever reach cycles_per_sample):
+    // seconds, not cycle times.
+    double gap = std::chrono::duration<double>(now - last_obs_end_)
+                     .count() - s;
+    double idle_threshold = std::max(5.0, 50.0 * cycle_time_ms_ / 1e3);
+    if (gap > idle_threshold) {
+      acc_bytes_ = max_secs_ = 0;
+      cycles_seen_ = 0;
+    }
+  }
   if (cycles_seen_ == 0) {
     // Observe runs at observation END; backdate by this observation's
     // active time so the window covers everything it accumulates.
-    sample_start_ = std::chrono::steady_clock::now() -
+    sample_start_ = now -
         std::chrono::duration_cast<std::chrono::steady_clock::duration>(
-            std::chrono::duration<double>(std::max(secs, 0.0)));
+            std::chrono::duration<double>(s));
   }
+  last_obs_end_ = now;
   acc_bytes_ += static_cast<double>(bytes);
   max_secs_ = std::max(max_secs_, std::max(secs, 1e-9));
   if (++cycles_seen_ < cycles_per_sample_) return false;

@@ -79,6 +79,7 @@ class ParameterManager {
   int samples_done_ = 0;
   double acc_bytes_ = 0, max_secs_ = 0;
   std::chrono::steady_clock::time_point sample_start_{};
+  std::chrono::steady_clock::time_point last_obs_end_{};
   mutable std::mutex mu_;
   FILE* log_ = nullptr;
 };

@@ -148,6 +148,11 @@ class GlobalMeshCollectives:
         # key -> lowered HLO text, populated when HVD_TPU_DUMP_HLO=1
         # (lets tests assert the real collective ops are emitted).
         self.hlo: Dict[tuple, str] = {}
+        # Invoked after a COLD build+compile completes (set by the
+        # engine around a dispatch): lets the execution watchdog
+        # restart its clock so compile time is never charged to the
+        # watched execution window.
+        self.compile_notify = None
         # Count of host (numpy) stagings — device payloads must never
         # bump this (the device-residency contract, testable).
         self.host_stages = 0
@@ -200,9 +205,22 @@ class GlobalMeshCollectives:
         if fn is None:
             fn = build()
             import os
-            if os.environ.get("HVD_TPU_DUMP_HLO") and \
-                    example_args is not None:
-                self.hlo[key] = fn.lower(*example_args).as_text()
+            if self.compile_notify is not None:
+                self.compile_notify("begin")
+            try:
+                if example_args is not None:
+                    # AOT lower+compile HERE (not lazily at the first
+                    # call): compilation is local and can be long;
+                    # doing it inside this helper lets the engine's
+                    # watchdog distinguish compiling (healthy) from a
+                    # wedged execution (member died after negotiation).
+                    lowered = fn.lower(*example_args)
+                    if os.environ.get("HVD_TPU_DUMP_HLO"):
+                        self.hlo[key] = lowered.as_text()
+                    fn = lowered.compile()
+            finally:
+                if self.compile_notify is not None:
+                    self.compile_notify("end")
             self._fns.put(key, fn)
         return fn
 
@@ -272,6 +290,16 @@ class GlobalMeshCollectives:
         per-entry flat device arrays, replicated on the mesh device.
         """
         lengths = [int(n) for n in lengths]
+        if red_op != SUM and any(p is None for p in payloads):
+            # Zero fill is only the identity for Sum: a joined rank's
+            # zeros clamp Min to <=0 and annihilate Product.  The
+            # controller rewrites Average->Sum with a live-count divisor
+            # and rejects the rest at negotiation; a direct caller that
+            # reaches here with None + non-Sum must fail loudly, not
+            # corrupt the reduction (reference join semantics).
+            raise HorovodInternalError(
+                "joined-rank (None) payload with op=%s: zero fill is "
+                "only neutral for Sum" % red_op)
         if len(lengths) > 1 and red_op != ADASUM:
             # Adasum must stay per-entry: its dot-product combine over
             # a packed bucket would merge ACROSS tensors (wrong math),
@@ -733,6 +761,24 @@ class MultihostEngine:
             }
         return wid
 
+    def _watch_compile(self, wid: int, phase: str):
+        """Cold-compile bracketing: while a compile runs, the record is
+        marked so the watchdog holds fire (the executor thread is alive
+        doing local work — charging compile time to the watched window
+        would poison a healthy engine); at compile end the clock
+        restarts so the window times execution only."""
+        with self._watch_lock:
+            rec = self._watched.get(wid)
+            if rec is not None:
+                rec["compiling"] = phase == "begin"
+                if phase == "end":
+                    rec["start"] = time.monotonic()
+            # _last_progress is NOT advanced here: completions are the
+            # only liveness signal.  Registering or compiling must not
+            # push out detection of an already-wedged earlier group —
+            # an app that keeps enqueuing (or keeps cold-compiling)
+            # would otherwise starve the watchdog forever.
+
     def _watch_clear(self, wid: int) -> bool:
         """Remove the record; returns True if the watchdog already
         failed this group's handles (completion must not repeat it)."""
@@ -744,6 +790,7 @@ class MultihostEngine:
         return killed
 
     def _watchdog_loop(self):
+        strikes = 0
         while not self._shutdown:
             time.sleep(1.0)
             now = time.monotonic()
@@ -754,6 +801,14 @@ class MultihostEngine:
                 items = [(w, r) for w, r in self._watched.items()
                          if w not in self._killed_wids]
                 idle = now - self._last_progress
+                compiling = any(r.get("compiling") for r in
+                                self._watched.values())
+            if compiling:
+                # The executor thread is mid-compile (local, always
+                # terminates): hold fire — a genuinely wedged earlier
+                # group is still caught the tick after compile ends.
+                strikes = 0
+                continue
             fired = False
             for wid, rec in items:
                 age = now - rec["start"]
@@ -772,7 +827,13 @@ class MultihostEngine:
                 if (self._exec_timeout and age > self._exec_timeout
                         and idle > self._exec_timeout):
                     fired = True
-            if fired:
+            # Poisoning the engine is irreversible, so demand the
+            # starved condition on consecutive ticks: a single tick can
+            # straddle the instant a slow-but-healthy program completes
+            # (progress lands right after the snapshot above).
+            strikes = strikes + 1 if fired else 0
+            if strikes >= 2:
+                strikes = 0
                 self._watchdog_fire()
 
     def _watchdog_fire(self):
@@ -826,7 +887,14 @@ class MultihostEngine:
         if self._failed is not None:
             self._complete_error(g, names, taken, entries, self._failed)
             return
+        # Register BEFORE dispatch — on worlds where the compiled call
+        # itself blocks until peers join (CPU gloo), a wedged dispatch
+        # must already be watched.  Cold compiles run AOT inside
+        # _compiled and report back via compile_notify, which restarts
+        # this group's clock: compile time (local, legitimately long)
+        # is never charged to the watched execution window.
         wid = self._watch_register(g, names, taken, entries)
+        mc.compile_notify = lambda phase: self._watch_compile(wid, phase)
         try:
             # Per-tensor timeline span (reference: the EXEC_* phases the
             # native executors record) + an xprof TraceAnnotation so the
@@ -842,6 +910,8 @@ class MultihostEngine:
             if not self._watch_clear(wid):
                 self._complete_error(g, names, taken, entries, exc)
             return
+        finally:
+            mc.compile_notify = None
         with self._lock:
             route_q = needs_host or self._host_inflight > 0
             if route_q:
@@ -856,8 +926,14 @@ class MultihostEngine:
             while len(self._inflight_outs) > self._depth:
                 try:
                     self._inflight_outs.pop(0).block_until_ready()
-                except Exception:  # noqa: BLE001 - surfaced via handles
-                    pass
+                except Exception as exc:  # noqa: BLE001
+                    # Handles were resolved at dispatch for
+                    # device-resident groups; the failure would
+                    # otherwise only surface when a consumer touches
+                    # the array — leave a diagnostic trail here.
+                    LOG.error(
+                        "multihost device program failed after "
+                        "dispatch-time completion: %s", exc)
         if route_q:
             # Blocking host fetch — or completions still in flight
             # whose relative order we keep — go through the completion
@@ -890,8 +966,17 @@ class MultihostEngine:
                 _, rep, nbytes, t0 = item
                 try:
                     rep.block_until_ready()
-                except Exception:  # noqa: BLE001 - failed groups are
-                    continue       # not throughput samples
+                except Exception as exc:  # noqa: BLE001
+                    # The group's handles resolved ok=True at dispatch
+                    # (device-resident inline completion); a runtime
+                    # failure here must not be a throughput sample, and
+                    # must not vanish — the consumer will hit it when
+                    # touching the array, so leave the diagnostic now.
+                    LOG.error(
+                        "multihost device program failed after "
+                        "dispatch-time completion (autotune observe): "
+                        "%s", exc)
+                    continue
                 self._observe_exec(nbytes, t0)
                 continue
             g, names, taken, entries, finalize, wid, nbytes, t0 = item

@@ -80,9 +80,20 @@ def _tracing_jit_compile() -> bool:
     frame = sys._getframe()
     while frame is not None:
         obj = frame.f_locals.get("self")
-        if (getattr(obj, "_jit_compile", None) is True
-                and (fn_type is None or isinstance(obj, fn_type))):
-            return True
+        if getattr(obj, "_jit_compile", None) is True:
+            if fn_type is not None:
+                if isinstance(obj, fn_type):
+                    return True
+            elif (hasattr(obj, "function_spec")
+                  or hasattr(obj, "_variable_creation_config")):
+                # Type resolution failed (internal layout varies by TF
+                # version): accept the duck-typed match only with
+                # polymorphic-Function evidence beyond the bare flag —
+                # an arbitrary object carrying _jit_compile=True on the
+                # stack (e.g. a Keras model after
+                # compile(jit_compile=True)) must not trip the guard
+                # for an uncompiled trace.
+                return True
         frame = frame.f_back
     return False
 

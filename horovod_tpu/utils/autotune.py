@@ -180,6 +180,7 @@ class ParameterManager:
         self._cycle_bytes = 0.0
         self._max_secs = 0.0
         self._cycles_seen = 0
+        self._last_obs_end = 0.0
         self._samples_done = 0
         self._current_idx: Optional[int] = None
         self.frozen = False
@@ -198,10 +199,24 @@ class ParameterManager:
             return
         if self._current_idx is None:
             self._apply(self.bo.next_index())
+        now = time.monotonic()
+        s = max(secs, 0.0)
+        if self._cycles_seen > 0:
+            # LONG application idle inside a window (eval pauses, data
+            # stalls) is not the candidate's fault — discard the
+            # partial window and restart it here.  The threshold sits
+            # well above a normal compute gap between optimizer steps
+            # (which recurs every step and must stay inside the window,
+            # or no window would ever fill): seconds, not cycle times.
+            gap = (now - self._last_obs_end) - s
+            if gap > max(5.0, 50.0 * self.cycle_time_ms / 1e3):
+                self._cycle_bytes = self._max_secs = 0.0
+                self._cycles_seen = 0
         if self._cycles_seen == 0:
             # observe() runs at cycle END; backdate by this cycle's
             # active time so the window covers every accumulated cycle.
-            self._sample_t0 = time.monotonic() - max(secs, 0.0)
+            self._sample_t0 = now - s
+        self._last_obs_end = now
         self._cycle_bytes += nbytes
         self._max_secs = max(self._max_secs, secs, 1e-9)
         self._cycles_seen += 1
