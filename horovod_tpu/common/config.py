@@ -57,6 +57,22 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _parse_hier_mode(v: Optional[str]) -> str:
+    """auto | on | off, failing loudly on anything else (a typo that
+    silently pinned the one-device plane would discard the multi-chip
+    bandwidth path with no signal)."""
+    s = (v or "").strip().lower()
+    if s in ("", "auto"):
+        return "auto"
+    if s in ("1", "true", "yes", "on"):
+        return "on"
+    if s in ("0", "false", "no", "off"):
+        return "off"
+    raise ValueError(
+        "HOROVOD_HIERARCHICAL_ALLREDUCE=%r: expected auto, on/1, or "
+        "off/0" % v)
+
+
 @dataclasses.dataclass
 class Config:
     """Typed snapshot of all runtime knobs, read once at ``hvd.init()``."""
@@ -98,6 +114,18 @@ class Config:
     rendezvous_addr: Optional[str] = None  # host:port of the KV server
     secret_key: Optional[str] = None
     coordinator_addr: Optional[str] = None  # jax.distributed coordinator
+
+    # --- hierarchical (multi-chip) eager allreduce ---
+    # The reference's HOROVOD_HIERARCHICAL_ALLREDUCE (NCCL
+    # reduce-scatter intra-node + allreduce across + allgather): on the
+    # eager multihost plane, payloads at or above the threshold stage
+    # sharded across EVERY local chip, cross-host-reduce 1/k of the
+    # bytes per chip, and all_gather back over local ICI.  "auto"
+    # (default) enables it for payloads >= threshold when >1 local
+    # device exists; "on" forces it for every size; "off" pins the
+    # one-device-per-host plane.
+    hierarchical_allreduce: str = "auto"  # auto | on | off
+    hierarchical_allreduce_threshold: int = 64 * 1024
 
     # --- misc parity knobs ---
     dynamic_process_sets: bool = False
@@ -152,6 +180,10 @@ class Config:
             rendezvous_addr=_env("RENDEZVOUS_ADDR"),
             secret_key=_env("SECRET_KEY"),
             coordinator_addr=_env("COORDINATOR_ADDR"),
+            hierarchical_allreduce=_parse_hier_mode(
+                _env("HIERARCHICAL_ALLREDUCE")),
+            hierarchical_allreduce_threshold=_env_int(
+                "HIERARCHICAL_ALLREDUCE_THRESHOLD", 64 * 1024),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             num_streams=_env_int("NUM_STREAMS", 1),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),

@@ -131,18 +131,20 @@ bool ParameterManager::Observe(uint64_t bytes, double secs) {
   if (cycles_seen_ > 0) {
     // Long application idle inside a window (eval pauses, data
     // stalls) is not the candidate's fault: wall time spanning it
-    // would deflate the bytes/sec score arbitrarily — discard the
-    // partial window and restart it at this observation.  The
-    // threshold must sit well ABOVE a normal compute gap between
-    // optimizer steps (which recurs every step and must stay inside
-    // the window, or no window would ever reach cycles_per_sample):
-    // seconds, not cycle times.
+    // would deflate the bytes/sec score arbitrarily.  EXCLUDE the
+    // idle from the scored denominator (shift the window start
+    // forward by the gap) rather than discarding the window — a
+    // workload whose steps are spaced beyond the threshold must
+    // still fill windows and record samples.  The threshold sits
+    // well above a normal compute gap between optimizer steps, which
+    // is steady-state wall time and must keep counting.
     double gap = std::chrono::duration<double>(now - last_obs_end_)
                      .count() - s;
     double idle_threshold = std::max(5.0, 50.0 * cycle_time_ms_ / 1e3);
     if (gap > idle_threshold) {
-      acc_bytes_ = max_secs_ = 0;
-      cycles_seen_ = 0;
+      sample_start_ +=
+          std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+              std::chrono::duration<double>(gap));
     }
   }
   if (cycles_seen_ == 0) {

@@ -11,11 +11,15 @@ a compiled XLA program over the GLOBAL device mesh — ICI/DCN on TPU
 pods, gloo on the CPU test world.
 
 Rank semantics: one Horovod rank per process (host), exactly the
-reference's model.  A process's collective input is ITS tensor; the
-eager payload plane is a one-device-per-process mesh (axis "proc",
-device 0 of every member — the NCCL one-accelerator-per-rank analog),
-so device payloads stage with at most one local device-to-device copy
-and no replication over sibling devices.  jit-path data parallelism
+reference's model.  A process's collective input is ITS tensor.  The
+eager payload plane has two gears: small payloads ride a
+one-device-per-process mesh (axis "proc", device 0 of every member),
+and payloads at or above ``HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD``
+ride a proc x local mesh spanning EVERY local chip — chunk j of the
+payload lives on local device j, cross-host reduction moves 1/k of the
+bytes per chip, and a local ``all_gather`` reassembles the result over
+intra-host ICI (the reference's NCCL hierarchical allreduce,
+``HOROVOD_HIERARCHICAL_ALLREDUCE``).  jit-path data parallelism
 (``jax/data_parallel.py``) keeps using every addressable device.
 
 Ordering contract: all member processes must issue the same global
@@ -96,16 +100,19 @@ def adasum_combine(v, axis_name: str, size: int):
 
 
 class GlobalMeshCollectives:
-    """Compiled XLA collectives over a one-device-per-process mesh.
+    """Compiled XLA collectives over the member processes' devices.
 
-    The eager payload plane is the reference's one-accelerator-per-rank
-    NCCL model (``ops/nccl_operations.cc``): each member process owns
-    exactly one mesh device (its first addressable device), payloads
-    stay device-resident end to end — ``jax.Array`` inputs are staged
-    with a device-to-device put (no host bounce), numpy inputs with a
+    The base plane is the reference's one-accelerator-per-rank NCCL
+    model (``ops/nccl_operations.cc``): each member process owns one
+    mesh device (its first addressable device), payloads stay
+    device-resident end to end — ``jax.Array`` inputs are staged with
+    a device-to-device put (no host bounce), numpy inputs with a
     single host-to-device transfer — and every collective is explicit
     HLO (``psum`` / ``all_gather`` / ``all_to_all`` / ``psum_scatter``
-    under ``shard_map``), not a host-staged emulation.
+    under ``shard_map``), not a host-staged emulation.  Large
+    allreduces additionally shard across every LOCAL chip
+    (``_hier_allreduce``), so all local ICI/DCN links carry payload
+    instead of chip 0's alone.
 
     Every method is a *collective program*: all member processes must
     call it with consistent negotiated arguments.  Executables are
@@ -139,12 +146,30 @@ class GlobalMeshCollectives:
         devs = [by_proc[p][0] for p in self.procs]
         self.mesh = Mesh(np.asarray(devs), ("proc",))
         self.device = devs[self.my_idx] if self.my_idx >= 0 else None
+        from ..common.config import Config as _Cfg
+        cfg = _Cfg.from_env()
+        # Multi-chip payload plane (reference hierarchical allreduce,
+        # SURVEY §2.2 NCCL row): a 2-D proc x local mesh over every
+        # member's local chips.  k is the least local device count
+        # across members (the mesh must be rectangular); k == 1
+        # degenerates to the one-device plane.
+        k = min(len(by_proc[p]) for p in self.procs)
+        self._hier_mode = cfg.hierarchical_allreduce
+        self._hier_threshold = int(cfg.hierarchical_allreduce_threshold)
+        self.local_size = k if self._hier_mode != "off" else 1
+        self.mesh2 = None
+        self.local_devices: list = []
+        if self.local_size > 1:
+            devs2 = np.asarray(
+                [[by_proc[p][j] for j in range(k)] for p in self.procs])
+            self.mesh2 = Mesh(devs2, ("proc", "local"))
+            self.local_devices = (list(devs2[self.my_idx])
+                                  if self.my_idx >= 0 else [])
         # Capacity-bounded LRU like the in-process engine (the
         # reference's HOROVOD_CACHE_CAPACITY): long jobs with varying
         # shapes must not grow compiled programs without bound.
-        from ..common.config import Config as _Cfg
         from .executable_cache import ExecutableCache
-        self._fns = ExecutableCache(_Cfg.from_env().cache_capacity)
+        self._fns = ExecutableCache(cfg.cache_capacity)
         # key -> lowered HLO text, populated when HVD_TPU_DUMP_HLO=1
         # (lets tests assert the real collective ops are emitted).
         self.hlo: Dict[tuple, str] = {}
@@ -224,12 +249,15 @@ class GlobalMeshCollectives:
             self._fns.put(key, fn)
         return fn
 
-    def _collective_jit(self, fn, n_args, out_spec):
+    def _collective_jit(self, fn, n_args, out_spec, mesh=None,
+                        in_spec=None):
         """shard_map + jit with every staged input donated."""
         import jax
         from jax.sharding import PartitionSpec as P
         sm = _shard_map()
-        kw = {"mesh": self.mesh, "in_specs": (P("proc"),) * n_args,
+        kw = {"mesh": mesh if mesh is not None else self.mesh,
+              "in_specs": (in_spec if in_spec is not None
+                           else P("proc"),) * n_args,
               "out_specs": out_spec}
         # The static replication checker cannot see through the
         # axis_index masking / per-process static slicing these
@@ -307,6 +335,19 @@ class GlobalMeshCollectives:
             # program with one combine per entry.
             return self._fused_allreduce_packed(
                 payloads, lengths, dtype, red_op, prescale, postscale)
+        if (len(lengths) == 1 and red_op != ADASUM
+                and self.local_size > 1
+                and (self._hier_mode == "on"
+                     or lengths[0] * np.dtype(dtype).itemsize
+                     >= self._hier_threshold)):
+            # Multi-chip hierarchical path: every local chip moves 1/k
+            # of the bytes cross-host instead of chip 0 moving all of
+            # them.  Adasum is excluded — its combine is dot-product
+            # based over the WHOLE vector, so per-chunk combines would
+            # change the math (it stays on the one-device plane).
+            return [self._hier_allreduce(
+                payloads[0], lengths[0], dtype, red_op, prescale,
+                postscale)]
         key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),
                red_op, float(prescale), float(postscale))
         size = self.size
@@ -324,6 +365,80 @@ class GlobalMeshCollectives:
                   for p, n in zip(payloads, lengths)]
         outs = self._compiled(key, build, staged)(*staged)
         return [self._replicated(o) for o in outs]
+
+    def _hier_allreduce(self, p, n: int, dtype, red_op, prescale,
+                        postscale):
+        """Hierarchical allreduce over the proc x local mesh — the
+        reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` (NCCL
+        reduce-scatter intra-node + cross-node allreduce + allgather,
+        SURVEY §2.2) with one-contribution-per-HOST rank semantics:
+
+        1. scatter (staging): the flat payload splits into k chunks,
+           chunk j committed to local device j — the intra-host
+           reduce-scatter degenerates to a split because each host has
+           exactly ONE contribution;
+        2. cross-host reduce: chunk j psums over the ``proc`` axis —
+           k parallel collectives, each moving n/k bytes over that
+           chip's own ICI/DCN links (the bandwidth win: all local
+           chips' links drive traffic instead of chip 0's alone);
+        3. ``all_gather`` over the ``local`` axis reassembles the full
+           reduced vector on every local chip — intra-host ICI.
+
+        Returns the reduced flat [n] device array (replica on this
+        process's first local device, like the one-device plane).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        k = self.local_size
+        chunk = -(-int(n) // k)
+        padded = chunk * k
+        np_dtype = np.dtype(dtype)
+        rows = []
+        if p is None:
+            for dev in self.local_devices:
+                with jax.default_device(dev):
+                    rows.append(jnp.zeros((1, 1, chunk), np_dtype))
+        elif _is_device_array(p):
+            flat = jnp.reshape(p, (int(n),))
+            if padded > n:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((padded - int(n),), np_dtype)])
+            for j, dev in enumerate(self.local_devices):
+                rows.append(jax.device_put(
+                    jax.lax.slice_in_dim(
+                        flat, j * chunk, (j + 1) * chunk
+                    ).reshape(1, 1, chunk), dev))
+        else:
+            self.host_stages += 1
+            flat = np.ascontiguousarray(np.asarray(p)).reshape(int(n))
+            if padded > n:
+                flat = np.concatenate(
+                    [flat, np.zeros((padded - int(n),), np_dtype)])
+            for j, dev in enumerate(self.local_devices):
+                rows.append(jax.device_put(
+                    flat[j * chunk:(j + 1) * chunk].reshape(1, 1, chunk),
+                    dev))
+        garr = jax.make_array_from_single_device_arrays(
+            (self.size, k, chunk),
+            NamedSharding(self.mesh2, P("proc", "local")), rows)
+
+        key = ("hier_allreduce", int(chunk), str(np_dtype), red_op,
+               float(prescale), float(postscale), k)
+
+        def build():
+            def fn(x):
+                r = self._reduce_block(x[0, 0], red_op, prescale,
+                                       postscale, self.size)
+                return jax.lax.all_gather(r, "local", tiled=True)
+            return self._collective_jit(
+                fn, 1, P(), mesh=self.mesh2, in_spec=P("proc", "local"))
+
+        out = self._replicated(
+            self._compiled(key, build, (garr,))(garr))
+        return out[:int(n)] if padded > n else out
 
     def _fused_allreduce_packed(self, payloads, lengths, dtype, red_op,
                                 prescale, postscale):
@@ -805,9 +920,11 @@ class MultihostEngine:
                                 self._watched.values())
             if compiling:
                 # The executor thread is mid-compile (local, always
-                # terminates): hold fire — a genuinely wedged earlier
-                # group is still caught the tick after compile ends.
-                strikes = 0
+                # terminates): hold fire for this tick, but KEEP the
+                # strike count — recurring cold compiles must pause
+                # evaluation, not reset it, or a workload that keeps
+                # compiling new shapes could postpone detection of a
+                # genuinely wedged group forever.
                 continue
             fired = False
             for wid, rec in items:

@@ -203,15 +203,15 @@ class ParameterManager:
         s = max(secs, 0.0)
         if self._cycles_seen > 0:
             # LONG application idle inside a window (eval pauses, data
-            # stalls) is not the candidate's fault — discard the
-            # partial window and restart it here.  The threshold sits
-            # well above a normal compute gap between optimizer steps
-            # (which recurs every step and must stay inside the window,
-            # or no window would ever fill): seconds, not cycle times.
+            # stalls) is not the candidate's fault — EXCLUDE it from
+            # the scored denominator (shift the window start forward)
+            # rather than discarding the window, so workloads whose
+            # steps are spaced beyond the threshold still fill windows
+            # and record samples.  Normal inter-step compute gaps stay
+            # below the threshold and keep counting as wall time.
             gap = (now - self._last_obs_end) - s
             if gap > max(5.0, 50.0 * self.cycle_time_ms / 1e3):
-                self._cycle_bytes = self._max_secs = 0.0
-                self._cycles_seen = 0
+                self._sample_t0 += gap
         if self._cycles_seen == 0:
             # observe() runs at cycle END; backdate by this cycle's
             # active time so the window covers every accumulated cycle.

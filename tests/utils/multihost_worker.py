@@ -176,6 +176,41 @@ def main():
         else:
             raise AssertionError(
                 "Adasum on a non-power-of-two world must error")
+    # Multi-chip eager plane (r5): payloads >= the hierarchical
+    # threshold shard across EVERY local chip — cross-host reduce
+    # moves 1/k of the bytes per chip, a local all_gather reassembles.
+    # Device-resident (no host staging), numerically exact, and the
+    # compiled program must SPAN all n*n_local devices with real
+    # reduce/gather HLO (not replication through device 0).
+    big_n = 32768  # 128 KiB f32 >= the 64 KiB default threshold
+    bout = hvd.allreduce(jnp.full((big_n,), float(r + 1), jnp.float32),
+                         op=hvd.Sum, name="hier_ar")
+    assert isinstance(bout, jax.Array), type(bout)
+    np.testing.assert_allclose(np.asarray(bout),
+                               sum(i + 1.0 for i in range(n)))
+    # A burst of large entries: whatever composition fuses rides the
+    # packed bucket, and the bucket (>= threshold) rides the
+    # hierarchical plane too.
+    bhs2 = [hvd.allreduce_async(
+        jnp.full((16384,), float(r + 1) * (i + 1), jnp.float32),
+        op=hvd.Sum, name="hier_burst.%d" % i) for i in range(3)]
+    tot = sum(j + 1.0 for j in range(n))
+    for i, h in enumerate(bhs2):
+        np.testing.assert_allclose(np.asarray(h.wait(60)),
+                                   np.full((16384,), tot * (i + 1)))
+    if n_local > 1:
+        assert mc.local_size == n_local, mc.local_size
+        hier = {k: v for k, v in mc.hlo.items()
+                if k[0] == "hier_allreduce"} \
+            if os.environ.get("HVD_TPU_DUMP_HLO") else None
+        if hier is not None:
+            assert hier, "large allreduce did not ride the hier plane"
+            htxt = "\n".join(hier.values())
+            assert "all_gather" in htxt, "no local all_gather leg"
+            assert "all_reduce" in htxt, "no cross-host reduce leg"
+            assert ("num_partitions = %d" % (n * n_local)) in htxt, (
+                "hier program does not span all %d devices"
+                % (n * n_local))
     assert mc.host_stages == before, (
         "device payloads transited the host: %d stagings"
         % (mc.host_stages - before))
