@@ -1,0 +1,177 @@
+"""Pod-day readiness: the whole north-star measurement in one command.
+
+BASELINE.md's target is ``hvd.allreduce`` bus bandwidth at >=90% of ICI
+on a real multi-chip slice — a number this box (one chip) cannot
+produce.  This script is the zero-improvisation entry point for the
+first hardware session: it runs every recorded harness in sequence —
+
+1. ``allreduce_bw.py`` with ``--link-gbps`` (efficiency_vs_link vs the
+   >=0.90 target),
+2. ``scaling_efficiency.py`` (the reference's ~90% weak-scaling story,
+   ``docs/benchmarks.rst``),
+3. ``bench.py`` (ResNet-50 + transformer tracked metrics),
+4. ``autotune_ab.py`` twice (defaults vs ``HOROVOD_AUTOTUNE=1``),
+
+and writes ONE JSON artifact in the ``BENCH_r*.json`` schema (metric /
+value / unit / vs_baseline at the top, full per-harness records under
+``sections``).
+
+    # pod (real chips; one process per host via the launcher if multihost)
+    python benchmarks/podcheck.py --link-gbps 90 --out PODCHECK.json
+
+    # CPU-world smoke of the artifact schema (what the test runs)
+    python benchmarks/podcheck.py --cpu-smoke --out /tmp/podcheck.json
+
+Each harness runs as a subprocess so its runtime choices (platform,
+device count, autotune env) stay isolated; this driver only parses the
+JSON lines they print.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TARGET_EFFICIENCY = 0.90
+
+
+def _run_json_lines(cmd, env=None, timeout=3600):
+    """Run ``cmd``; return (rc, [parsed JSON lines], raw tail)."""
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=e, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    except subprocess.TimeoutExpired:
+        return -1, [], "TIMEOUT after %ds" % timeout
+    out = proc.stdout.decode("utf-8", "replace")
+    recs = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                pass
+    return proc.returncode, recs, out[-2000:]
+
+
+def _section(name, rc, recs, tail, skipped=False, note=None):
+    sec = {"name": name, "ok": rc == 0 and not skipped,
+           "skipped": skipped, "records": recs}
+    if note:
+        sec["note"] = note
+    if rc != 0 and not skipped:
+        sec["rc"] = rc
+        sec["tail"] = tail
+    return sec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--link-gbps", type=float, default=90.0,
+                    help="per-chip ICI injection bandwidth (GB/s) for "
+                         "efficiency accounting; v5p ~90 per link")
+    ap.add_argument("--out", default=os.path.join(REPO, "PODCHECK.json"))
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="tiny CPU-world run validating the artifact "
+                         "schema (no chips needed; bench.py skipped)")
+    ap.add_argument("--sizes-mb", default=None,
+                    help="override allreduce_bw size sweep")
+    ap.add_argument("--skip-bench", action="store_true",
+                    help="omit the bench.py training-throughput section")
+    ap.add_argument("--skip-autotune", action="store_true")
+    args = ap.parse_args()
+
+    py = sys.executable
+    sections = []
+    t0 = time.time()
+
+    # 1. allreduce bus bandwidth -> efficiency_vs_link.
+    bw_cmd = [py, os.path.join(HERE, "allreduce_bw.py"),
+              "--link-gbps", str(args.link_gbps)]
+    if args.cpu_smoke:
+        bw_cmd += ["--cpu-devices", "8", "--sizes-mb", "0.25",
+                   "--iters", "3", "--warmup", "1"]
+    elif args.sizes_mb:
+        bw_cmd += ["--sizes-mb", args.sizes_mb]
+    rc, recs, tail = _run_json_lines(bw_cmd)
+    sections.append(_section("allreduce_bw", rc, recs, tail))
+    bw_summary = next(
+        (r for r in recs
+         if r.get("metric") == "allreduce_bus_bandwidth_peak"), {})
+
+    # 2. DP weak-scaling efficiency.
+    se_cmd = [py, os.path.join(HERE, "scaling_efficiency.py")]
+    if args.cpu_smoke:
+        se_cmd += ["--cpu-devices", "8", "--steps", "2",
+                   "--per-device-batch", "2", "--dim", "64",
+                   "--layers", "1"]
+    rc, recs, tail = _run_json_lines(se_cmd)
+    sections.append(_section("scaling_efficiency", rc, recs, tail))
+
+    # 3. Tracked training throughput (needs the real chip).
+    if args.cpu_smoke or args.skip_bench:
+        sections.append(_section(
+            "bench", 0, [], "", skipped=True,
+            note="bench.py needs a real TPU chip; run without "
+                 "--cpu-smoke on hardware"))
+    else:
+        rc, recs, tail = _run_json_lines(
+            [py, os.path.join(REPO, "bench.py")])
+        sections.append(_section("bench", rc, recs, tail))
+
+    # 4. Autotuner A/B: defaults vs HOROVOD_AUTOTUNE=1.
+    if args.skip_autotune:
+        sections.append(_section("autotune_ab", 0, [], "", skipped=True))
+    else:
+        ab_cmd = [py, os.path.join(HERE, "autotune_ab.py")]
+        if args.cpu_smoke:
+            ab_cmd += ["--cpu-devices", "8", "--steps", "5",
+                       "--warmup", "5", "--tensors", "4",
+                       "--sizes-kb", "4,16"]
+        arms = []
+        for arm_env in ({"HOROVOD_AUTOTUNE": "0"},
+                        {"HOROVOD_AUTOTUNE": "1"}):
+            rc, recs, tail = _run_json_lines(ab_cmd, env=arm_env)
+            arms.append({"env": arm_env, "rc": rc, "records": recs})
+        ok = all(a["rc"] == 0 for a in arms)
+        sections.append({"name": "autotune_ab", "ok": ok,
+                         "skipped": False, "arms": arms})
+
+    efficiency = bw_summary.get("efficiency_vs_link")
+    artifact = {
+        # BENCH schema head: the north-star number is the headline.
+        "metric": "allreduce_efficiency_vs_link",
+        "value": efficiency,
+        "unit": "fraction",
+        "vs_baseline": (round(efficiency / TARGET_EFFICIENCY, 4)
+                        if efficiency is not None else None),
+        "target": TARGET_EFFICIENCY,
+        "pass": (efficiency is not None
+                 and efficiency >= TARGET_EFFICIENCY),
+        "link_gbps": args.link_gbps,
+        "smoke": bool(args.cpu_smoke),
+        "wall_s": round(time.time() - t0, 1),
+        "sections": sections,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({k: artifact[k] for k in
+                      ("metric", "value", "unit", "vs_baseline",
+                       "target", "pass", "smoke")}))
+    print("podcheck artifact -> %s" % args.out)
+    # Smoke mode validates the schema, not the number (a 1-core CPU
+    # world cannot approach link bandwidth); hardware runs gate on it.
+    if not args.cpu_smoke and not artifact["pass"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
