@@ -146,6 +146,8 @@ def main():
                          "skipped": False, "arms": arms})
 
     efficiency = bw_summary.get("efficiency_vs_link")
+    sections_ok = all(s.get("ok") or s.get("skipped")
+                      for s in sections)
     artifact = {
         # BENCH schema head: the north-star number is the headline.
         "metric": "allreduce_efficiency_vs_link",
@@ -157,6 +159,7 @@ def main():
         "pass": (efficiency is not None
                  and efficiency >= TARGET_EFFICIENCY),
         "link_gbps": args.link_gbps,
+        "sections_ok": sections_ok,
         "smoke": bool(args.cpu_smoke),
         "wall_s": round(time.time() - t0, 1),
         "sections": sections,
@@ -167,6 +170,14 @@ def main():
                       ("metric", "value", "unit", "vs_baseline",
                        "target", "pass", "smoke")}))
     print("podcheck artifact -> %s" % args.out)
+    # A crashed harness must be loud, not buried in the JSON — the
+    # whole point is zero improvisation on pod day.
+    for s in sections:
+        if not (s.get("ok") or s.get("skipped")):
+            print("podcheck: section %r FAILED (rc=%s)"
+                  % (s["name"], s.get("rc")), file=sys.stderr)
+    if not sections_ok:
+        sys.exit(2)
     # Smoke mode validates the schema, not the number (a 1-core CPU
     # world cannot approach link bandwidth); hardware runs gate on it.
     if not args.cpu_smoke and not artifact["pass"]:

@@ -53,6 +53,13 @@ class ExecutableCache:
         self.put(key, built)
         return built
 
+    def keys(self):
+        """Snapshot of cached keys (observability: tests assert the
+        packed-bucket paths keep the executable count flat across
+        varying group compositions)."""
+        with self._lock:
+            return list(self._entries.keys())
+
     def clear(self):
         with self._lock:
             self._entries.clear()

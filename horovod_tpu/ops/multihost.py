@@ -51,6 +51,25 @@ LOG = logging.getLogger("horovod_tpu")
 
 from .xla_ops import uneven_chunks as _uneven_chunks
 
+# Above this many bytes, exact pow2 bucketing would waste up to 2x wire
+# bytes on padding; large payloads round up to the next multiple of it
+# instead (pad waste bounded by the threshold, still a small number of
+# size classes for the executable cache).
+_POW2_BUCKET_MAX_BYTES = 4 << 20
+
+
+def _size_class(n_elems: int, itemsize: int) -> int:
+    """Padded element count keying a packed collective executable:
+    power-of-two below ``_POW2_BUCKET_MAX_BYTES`` (the recompile-cliff
+    protection for shape-varying bursts), coarse linear steps above it
+    (bounded pad waste for big tensors)."""
+    from .engine import _bucket
+    step = max(_POW2_BUCKET_MAX_BYTES // max(int(itemsize), 1), 1)
+    n = max(int(n_elems), 1)
+    if n <= step:
+        return _bucket(n)
+    return -(-n // step) * step
+
 
 def _shard_map():
     import jax
@@ -220,6 +239,67 @@ class GlobalMeshCollectives:
         """This process's view of a replicated (P()) program output, as
         a single-device jax.Array — no host transfer."""
         return garr.addressable_shards[0].data
+
+    def _pack_flat(self, segments, total: int, bucket: int, np_dtype):
+        """One padded flat [bucket] buffer on this process's mesh
+        device.
+
+        ``segments`` is a list of (payload, start_elem, n_elems) flat
+        slices laid out back to back (payload None -> zeros); the
+        bucket padding keys the compiled program by SIZE CLASS instead
+        of exact composition — the reference's persistent fusion
+        buffer, shared by the packed allreduce and the per-op packed
+        paths.  Each DISTINCT payload flattens exactly once (device
+        payloads: one local reshape/device_put, no host transit; numpy
+        payloads: one host crossing, one ``host_stages`` bump), however
+        many segments slice it."""
+        import jax
+        import jax.numpy as jnp
+
+        flats: Dict[int, object] = {}
+
+        def flat_of(payload):
+            fid = id(payload)
+            f = flats.get(fid)
+            if f is None:
+                if _is_device_array(payload):
+                    f = jax.device_put(jnp.reshape(payload, (-1,)),
+                                       self.device)
+                else:
+                    self.host_stages += 1
+                    f = jnp.asarray(np.ascontiguousarray(
+                        np.asarray(payload)).reshape(-1))
+                flats[fid] = f
+            return f
+
+        parts = []
+        with jax.default_device(self.device):
+            for payload, start, n in segments:
+                if n == 0:
+                    continue
+                if payload is None:
+                    parts.append(jnp.zeros((n,), np_dtype))
+                else:
+                    f = flat_of(payload)
+                    parts.append(
+                        f if start == 0 and n == f.shape[0]
+                        else jax.lax.slice_in_dim(f, start, start + n))
+            if bucket > total:
+                parts.append(jnp.zeros((bucket - total,), np_dtype))
+            row = (jnp.concatenate(parts) if len(parts) > 1
+                   else parts[0] if parts
+                   else jnp.zeros((bucket,), np_dtype))
+            if row.dtype != np_dtype:
+                row = row.astype(np_dtype)
+        return row
+
+    def _stage_flat_padded(self, segments, total: int, bucket: int,
+                           np_dtype):
+        """``_pack_flat`` staged as one row of the proc-sharded global
+        array."""
+        return self._stage(
+            self._pack_flat(segments, total, bucket, np_dtype),
+            (bucket,), np_dtype)
 
     def _my_row(self, garr):
         """This process's row of a P('proc') program output."""
@@ -450,36 +530,15 @@ class GlobalMeshCollectives:
         burst negotiates different (n_1..n_k) tuples cycle to cycle, and
         a compiled program per composition recompiles endlessly (measured
         16-60x slowdowns on async bursts).  Packing the entries into one
-        power-of-two bucket keys the collective executable by bucket size
+        size-class bucket keys the collective executable by bucket size
         alone; the pack/unpack copies are cheap eager device ops, exactly
         the memcpy in/out the reference pays."""
-        import jax
-        import jax.numpy as jnp
-        from .engine import _bucket
-
-        total = int(sum(lengths))
-        bucket = _bucket(total)
         np_dtype = np.dtype(dtype)
-        parts = []
-        with jax.default_device(self.device):
-            for p, n in zip(payloads, lengths):
-                if p is None:
-                    parts.append(jnp.zeros((n,), np_dtype))
-                elif _is_device_array(p):
-                    # device_put: a payload committed to a DIFFERENT
-                    # local device must move to the mesh device or the
-                    # concatenate below rejects the mixed placement
-                    # (no-op for the common already-here case).
-                    parts.append(jax.device_put(
-                        jnp.reshape(p, (n,)), self.device))
-                else:
-                    self.host_stages += 1
-                    parts.append(jnp.asarray(np.ascontiguousarray(
-                        np.asarray(p)).reshape(n)))
-            if bucket > total:
-                parts.append(jnp.zeros((bucket - total,), np_dtype))
-            flat = (jnp.concatenate(parts) if len(parts) > 1
-                    else parts[0])
+        total = int(sum(lengths))
+        bucket = _size_class(total, np_dtype.itemsize)
+        flat = self._pack_flat(
+            [(p, 0, int(n)) for p, n in zip(payloads, lengths)],
+            total, bucket, np_dtype)
         out = self.fused_allreduce([flat], [bucket], np_dtype, red_op,
                                    prescale, postscale)[0]
         offs = np.concatenate([[0], np.cumsum(lengths)]).astype(int)
@@ -498,70 +557,84 @@ class GlobalMeshCollectives:
 
     def broadcast(self, local, root_idx: int):
         """Member ``root_idx``'s tensor to every process (masked psum:
-        cheaper than an all-gather for size > 2, and explicit HLO)."""
+        cheaper than an all-gather for size > 2, and explicit HLO).
+
+        The program takes a power-of-two flat bucket, so a burst of
+        varying shapes (``broadcast_parameters``: one op per layer)
+        reuses one executable per size class instead of compiling per
+        shape."""
         import jax
         import jax.numpy as jnp
 
         shape = tuple(np.shape(local))
-        dtype = (local.dtype if hasattr(local, "dtype")
-                 else np.asarray(local).dtype)
-        key = ("broadcast", str(np.dtype(dtype)), shape, int(root_idx))
+        dtype = np.dtype(local.dtype if hasattr(local, "dtype")
+                         else np.asarray(local).dtype)
+        n = int(np.prod(shape, dtype=np.int64))
+        # psum silently promotes bool to int32; ride the wire as uint8
+        # and cast back so broadcast preserves every dtype.
+        is_bool = dtype == np.bool_
+        wire = np.dtype(np.uint8) if is_bool else dtype
+        if is_bool:
+            local = (local.astype(jnp.uint8) if _is_device_array(local)
+                     else np.asarray(local).astype(np.uint8))
+        bucket = _size_class(n, wire.itemsize)
+        key = ("broadcast", str(wire), int(bucket), int(root_idx))
 
         def build():
             def fn(x):
                 idx = jax.lax.axis_index("proc")
                 v = jnp.where(idx == root_idx, x[0],
                               jnp.zeros_like(x[0]))
-                # psum silently promotes bool to int32; reduce in uint8
-                # and cast back so broadcast preserves every dtype.
-                if v.dtype == jnp.bool_:
-                    return jax.lax.psum(
-                        v.astype(jnp.uint8), "proc").astype(jnp.bool_)
                 return jax.lax.psum(v, "proc")
             from jax.sharding import PartitionSpec as P
             return self._collective_jit(fn, 1, P())
 
-        staged = self._stage(local, shape, dtype)
-        return self._replicated(
+        staged = self._stage_flat_padded([(local, 0, n)], n, bucket,
+                                         wire)
+        out = self._replicated(
             self._compiled(key, build, (staged,))(staged))
+        out = out[:n].reshape(shape) if bucket > n else out.reshape(shape)
+        return out.astype(jnp.bool_) if is_bool else out
 
     def allgather(self, local, rows_per_member: Sequence[int]):
         """Concat dim-0-ragged per-process tensors (reference
-        AllgatherOp): pad to the max row count, one ``lax.all_gather``,
-        static-slice the valid segments inside the program."""
+        AllgatherOp): each member's contribution flattens into a
+        power-of-two bucket, one ``lax.all_gather`` moves the buckets,
+        and the valid segments are sliced back out eagerly.  The
+        executable is keyed by (dtype, bucket) ALONE, so ragged bursts
+        whose row counts vary call to call (variable-length batches,
+        ``allgather_object``) reuse one program per size class —
+        the ``_fused_allreduce_packed`` recompile-cliff treatment."""
         import jax
         import jax.numpy as jnp
 
         rows = [int(r) for r in rows_per_member]
-        max_rows = max(rows) if rows else 0
         trailing = tuple(np.shape(local))[1:]
-        dtype = (local.dtype if hasattr(local, "dtype")
-                 else np.asarray(local).dtype)
-        pad = max_rows - int(np.shape(local)[0])
-        if pad:
-            if _is_device_array(local):
-                local = jnp.concatenate(
-                    [local, jnp.zeros((pad,) + trailing, dtype)])
-            else:
-                local = np.concatenate(
-                    [np.asarray(local),
-                     np.zeros((pad,) + trailing, dtype)])
-        key = ("allgather", str(np.dtype(dtype)), trailing, tuple(rows))
+        telems = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
+        dtype = np.dtype(local.dtype if hasattr(local, "dtype")
+                         else np.asarray(local).dtype)
+        lens = [r * telems for r in rows]
+        if not lens or max(lens) == 0:
+            with jax.default_device(self.device):
+                return jnp.zeros((0,) + trailing, dtype)
+        bucket = _size_class(max(lens), dtype.itemsize)
+        key = ("allgather", str(dtype), int(bucket))
         size = self.size
 
         def build():
             def fn(x):
-                g = jax.lax.all_gather(x[0], "proc")  # [size, max, ...]
-                if all(r == max_rows for r in rows):
-                    return g.reshape((size * max_rows,) + trailing)
-                return jnp.concatenate(
-                    [g[j, :rows[j]] for j in range(size)], axis=0)
+                return jax.lax.all_gather(x[0], "proc")  # [size, bucket]
             from jax.sharding import PartitionSpec as P
             return self._collective_jit(fn, 1, P())
 
-        staged = self._stage(local, (max_rows,) + trailing, dtype)
-        return self._replicated(
-            self._compiled(key, build, (staged,))(staged))
+        my_len = lens[self.my_idx]
+        staged = self._stage_flat_padded([(local, 0, my_len)], my_len,
+                                         bucket, dtype)
+        g = self._replicated(self._compiled(key, build, (staged,))(staged))
+        parts = [g[m, :lens[m]].reshape((rows[m],) + trailing)
+                 for m in range(size) if rows[m]]
+        return (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                else parts[0])
 
     def alltoall(self, local, splits_matrix: np.ndarray):
         """Member-major splits matrix routing (reference AlltoallOp) as
@@ -575,51 +648,53 @@ class GlobalMeshCollectives:
 
         sm = np.asarray(splits_matrix).reshape(self.size, self.size)
         trailing = tuple(np.shape(local))[1:]
-        dtype = (local.dtype if hasattr(local, "dtype")
-                 else np.asarray(local).dtype)
+        telems = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
+        dtype = np.dtype(local.dtype if hasattr(local, "dtype")
+                         else np.asarray(local).dtype)
         size = self.size
         c = int(sm.max()) if sm.size else 0
-        my_rows = int(np.shape(local)[0])
         recv_splits = [int(sm[j, self.my_idx]) for j in range(size)]
-        recv_total = int(sum(recv_splits))
         if c == 0:
             with jax.default_device(self.device):
                 return jnp.zeros((0,) + trailing, dtype), recv_splits
-        key = ("alltoall", str(np.dtype(dtype)), trailing,
-               tuple(int(v) for v in sm.reshape(-1)))
+        # Every exchange block pads to one power-of-two bucket derived
+        # from the NEGOTIATED matrix max (identical on all members), so
+        # the executable is keyed by (dtype, block) alone — varying
+        # splits matrices (MoE routing shifts every step) reuse one
+        # program per size class instead of compiling per matrix.
+        block = _size_class(c * telems, dtype.itemsize)
+        key = ("alltoall", str(dtype), int(block))
         my_idx = self.my_idx
         offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)
 
         def build():
             def fn(x):
-                y = x[0]  # [my_rows, ...]
-                # Pack [size, c, ...]: dest j's segment padded to c.
-                # Static per-process offsets — per-shard code, so
-                # differing constants across processes are fine; the
-                # exchanged block shape is identical everywhere.
-                segs = []
-                for j in range(size):
-                    cnt = int(sm[my_idx, j])
-                    seg = jax.lax.slice_in_dim(y, offs[j],
-                                               offs[j] + cnt, axis=0)
-                    if cnt < c:
-                        seg = jnp.concatenate(
-                            [seg, jnp.zeros((c - cnt,) + trailing,
-                                            y.dtype)])
-                    segs.append(seg)
-                packed = jnp.stack(segs)  # [size, c, ...]
-                w = jax.lax.all_to_all(packed, "proc", split_axis=0,
-                                       concat_axis=0)  # [size, c, ...]
-                out = jnp.concatenate(
-                    [w[j, :recv_splits[j]] for j in range(size)]
-                    ) if recv_total else w[:1, :0].reshape(
-                        (0,) + trailing)
-                return out[None]  # [1, recv_total, ...]
+                y = x[0].reshape(size, block)
+                w = jax.lax.all_to_all(y, "proc", split_axis=0,
+                                       concat_axis=0)  # [size, block]
+                return w.reshape(1, size * block)
             from jax.sharding import PartitionSpec as P
             return self._collective_jit(fn, 1, P("proc"))
 
-        staged = self._stage(local, (my_rows,) + trailing, dtype)
-        out = self._my_row(self._compiled(key, build, (staged,))(staged))
+        # Segment layout: dest j's rows (slice from my payload), padded
+        # to the uniform block.
+        segments = []
+        for j in range(size):
+            seg_elems = int(sm[my_idx, j]) * telems
+            segments.append((local, int(offs[j]) * telems, seg_elems))
+            if seg_elems < block:
+                segments.append((None, 0, block - seg_elems))
+        staged = self._stage_flat_padded(segments, size * block,
+                                         size * block, dtype)
+        w = self._my_row(self._compiled(key, build, (staged,))(staged))
+        parts = [w[j * block:j * block + recv_splits[j] * telems]
+                 .reshape((recv_splits[j],) + trailing)
+                 for j in range(size) if recv_splits[j]]
+        if not parts:
+            with jax.default_device(self.device):
+                return jnp.zeros((0,) + trailing, dtype), recv_splits
+        out = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+               else parts[0])
         return out, recv_splits
 
     def reducescatter(self, local, red_op: str = SUM):
@@ -631,27 +706,25 @@ class GlobalMeshCollectives:
         import jax.numpy as jnp
 
         shape = tuple(np.shape(local))
-        dtype = (local.dtype if hasattr(local, "dtype")
-                 else np.asarray(local).dtype)
+        dtype = np.dtype(local.dtype if hasattr(local, "dtype")
+                         else np.asarray(local).dtype)
         d0 = shape[0]
         trailing = shape[1:]
+        telems = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
         size = self.size
         rows, offs = _uneven_chunks(d0, size)
         c = rows[0] if rows else 0  # largest chunk (earlier ranks larger)
-        key = ("reducescatter", str(np.dtype(dtype)), shape, red_op)
+        # Member-major packed buffer: member m's chunk flattens and
+        # pads to one power-of-two segment, so the executable is keyed
+        # by (dtype, segment, op) — shape-varying bursts reuse one
+        # program per size class (the packed-fusion-bucket treatment).
+        seg = _size_class(max(c * telems, 1), dtype.itemsize)
+        key = ("reducescatter", str(dtype), int(seg), red_op)
         my_idx = self.my_idx
 
         def build():
             def fn(x):
-                y = x[0]  # [d0, ...]
-                if d0 != size * c:
-                    y = jnp.concatenate([
-                        seg for j in range(size) for seg in (
-                            [jax.lax.slice_in_dim(
-                                y, offs[j], offs[j] + rows[j], axis=0)]
-                            + ([jnp.zeros((c - rows[j],) + trailing,
-                                          y.dtype)]
-                               if rows[j] < c else []))])
+                y = x[0]  # [size*seg]
                 if red_op in (SUM, AVERAGE):
                     w = jax.lax.psum_scatter(
                         y, "proc", scatter_dimension=0, tiled=True)
@@ -669,14 +742,22 @@ class GlobalMeshCollectives:
                 else:
                     r = self._reduce_block(y, red_op, 1.0, 1.0, size)
                     w = jax.lax.slice_in_dim(
-                        r, my_idx * c, (my_idx + 1) * c, axis=0)
-                return w[None]  # [1, c, ...]
+                        r, my_idx * seg, (my_idx + 1) * seg)
+                return w[None]  # [1, seg]
             from jax.sharding import PartitionSpec as P
             return self._collective_jit(fn, 1, P("proc"))
 
-        staged = self._stage(local, shape, dtype)
+        segments = []
+        for m in range(size):
+            n_m = rows[m] * telems
+            segments.append((local, int(offs[m]) * telems, n_m))
+            if n_m < seg:
+                segments.append((None, 0, seg - n_m))
+        staged = self._stage_flat_padded(segments, size * seg,
+                                         size * seg, dtype)
         out = self._my_row(self._compiled(key, build, (staged,))(staged))
-        return out[:rows[my_idx]]
+        my_n = rows[my_idx] * telems
+        return out[:my_n].reshape((rows[my_idx],) + trailing)
 
 
 class MultihostEngine:

@@ -250,6 +250,38 @@ def main():
         np.testing.assert_allclose(
             np.asarray(res), np.full((5 + i,), tot * (i + 1)))
 
+    # Packed per-op programs (r5): a burst of VARYING compositions per
+    # op must reuse ONE executable per size class — the allreduce
+    # packed-bucket recompile-cliff treatment extended to allgather /
+    # alltoall / reducescatter / broadcast.  Shapes below all land in
+    # the same power-of-two bucket, so the cache may grow by at most
+    # one key per op family.
+    def _op_keys(op):
+        return sum(1 for kk in mc._fns.keys() if kk[0] == op)
+    cache_before = {op: _op_keys(op) for op in (
+        "allgather", "alltoall", "reducescatter", "broadcast")}
+    for i in range(5):
+        g = hvd.allgather(jnp.full((r + 1 + i, 2), float(r), jnp.float32),
+                          name="cag.%d" % i)
+        assert np.asarray(g).shape == (sum(j + 1 + i for j in range(n)),
+                                       2)
+        spl = [1 + (i + j + r) % 3 for j in range(n)]
+        a2, rcv = hvd.alltoall(
+            jnp.ones((sum(spl), 2), jnp.float32), splits=spl,
+            name="ca2a.%d" % i)
+        assert np.asarray(a2).shape == (sum(rcv), 2)
+        rs = hvd.reducescatter(jnp.ones((n + i, 2), jnp.float32),
+                               op=hvd.Sum, name="crs.%d" % i)
+        np.testing.assert_allclose(np.asarray(rs), float(n))
+        bc = hvd.broadcast(jnp.full((3 + 2 * i,), float(r), jnp.float32),
+                           root_rank=0, name="cbc.%d" % i)
+        np.testing.assert_allclose(np.asarray(bc), 0.0)
+    for op, before_ct in cache_before.items():
+        added = _op_keys(op) - before_ct
+        assert added <= 1, (
+            "packed %s burst grew the executable cache by %d keys "
+            "(recompile cliff)" % (op, added))
+
     # barrier + process-set-scoped collective on even ranks.
     hvd.barrier()
     ps = hvd.add_process_set([i for i in range(0, n, 2)])
