@@ -37,6 +37,16 @@ def main():
                     help="fused qkv + gate projections (A/B lever; "
                          "measured rejection at d1024 — see "
                          "docs/benchmarks.md — so off by default)")
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "full", "dots", "dots_no_batch"],
+                    help="layer remat policy (A/B lever)")
+    ap.add_argument("--opt-split", type=int, default=0,
+                    help="compile backward and optimizer update as TWO "
+                         "programs (anti-lever: measures what fusing "
+                         "the update into the step is worth)")
+    ap.add_argument("--collective-matmul", type=int, default=0,
+                    help="latency-hiding TP matmul ring (no-op at "
+                         "tp=1; single-chip neutrality check)")
     args = ap.parse_args()
     if args.d_model % args.head_dim:
         raise SystemExit("--head-dim %d does not divide --d-model %d"
@@ -57,7 +67,10 @@ def main():
         n_heads=args.d_model // args.head_dim,
         n_kv_heads=args.d_model // args.head_dim,
         d_ff=args.d_model * 3, max_seq=args.seq,
-        fused_qkv=bool(args.fused), fused_gate=bool(args.fused))
+        fused_qkv=bool(args.fused), fused_gate=bool(args.fused),
+        remat=args.remat != "none",
+        remat_policy=args.remat if args.remat != "none" else "full",
+        collective_matmul=bool(args.collective_matmul))
     mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
                 ("dp", "sp", "tp"))
 
@@ -66,7 +79,9 @@ def main():
         rng.randint(0, cfg.vocab_size, (args.batch, args.seq)),
         jnp.int32)
     params_host = init_params(jax.random.PRNGKey(0), cfg)
-    build, shard_batch = make_train_step(cfg, mesh, optax.adam(1e-3))
+    build, shard_batch = make_train_step(
+        cfg, mesh, optax.adam(1e-3),
+        split_optimizer=bool(args.opt_split))
     step, params, opt_state = build(params_host)
     batch = shard_batch({"tokens": tokens, "targets": tokens})
     fetch = jax.jit(lambda v: v.astype(jnp.float32))

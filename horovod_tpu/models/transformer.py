@@ -51,6 +51,12 @@ class TransformerConfig:
     dtype: str = "bfloat16"          # activation dtype (MXU-native)
     param_dtype: str = "float32"
     remat: bool = False
+    # Remat policy when remat=True: "full" recomputes everything in
+    # the backward (default jax.checkpoint), "dots" saves matmul
+    # outputs and recomputes only elementwise work, "dots_no_batch"
+    # saves only no-batch-dim dots (weights-side products).  Measured
+    # per-policy on the flagship config in docs/benchmarks.md.
+    remat_policy: str = "full"
     # MoE (0 experts = dense).
     n_experts: int = 0
     top_k: int = 2
@@ -83,11 +89,21 @@ class TransformerConfig:
     logits_dtype: str = "auto"
     # lax.scan unroll factor over the layer stack (1 = no unroll).
     scan_unroll: int = 1
+    # Latency-hiding TP matmuls (parallel/collective_matmul.py): the
+    # row-parallel wo / w2 products run as an overlapped
+    # matmul+reduce-scatter ring followed by a tiled all_gather (same
+    # bytes as the plain psum, but the reduce leg hides behind MXU
+    # work).  No-op at tp=1, so single-chip programs are unchanged.
+    collective_matmul: bool = False
 
     def __post_init__(self):
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError("sp_mode must be 'ring' or 'ulysses', "
                              "got %r" % (self.sp_mode,))
+        if self.remat_policy not in ("full", "dots", "dots_no_batch"):
+            raise ValueError("remat_policy must be 'full', 'dots' or "
+                             "'dots_no_batch', got %r"
+                             % (self.remat_policy,))
         if self.logits_dtype not in ("auto", "bf16", "f32"):
             raise ValueError("logits_dtype must be 'auto', 'bf16' or "
                              "'f32', got %r" % (self.logits_dtype,))
@@ -299,9 +315,25 @@ def _attention_block(x, lp, cfg: TransformerConfig, cos, sin, sp_size):
     else:
         attn = local_attention(q, k, v, causal=True)
     attn = attn.reshape(b, s, -1)
-    out = attn @ lp["wo"].astype(x.dtype)
     # Row-sharded wo: partial sums live on each tp shard.
-    return lax.psum(out, cfg.tp_axis)
+    return _row_parallel_product(attn, lp["wo"].astype(x.dtype), cfg)
+
+
+def _row_parallel_product(x, w, cfg: TransformerConfig):
+    """``psum(x @ w, tp)`` for a row-sharded weight, optionally as the
+    latency-hiding matmul+reduce-scatter ring + tiled all_gather
+    (``cfg.collective_matmul``): identical math and total bytes, but
+    the reduce leg overlaps the MXU work instead of serializing after
+    it.  Plain psum at tp=1 or when rows do not divide the axis."""
+    b, s, _ = x.shape
+    tp = lax.axis_size(cfg.tp_axis)
+    if cfg.collective_matmul and tp > 1 and (b * s) % tp == 0:
+        from ..parallel.collective_matmul import matmul_reduce_scatter
+        flat = x.reshape(b * s, x.shape[-1])
+        part = matmul_reduce_scatter(flat, w, cfg.tp_axis)
+        full = lax.all_gather(part, cfg.tp_axis, tiled=True)
+        return full.reshape(b, s, w.shape[-1])
+    return lax.psum(x @ w, cfg.tp_axis)
 
 
 def _dense_ffn(h, lp, cfg: TransformerConfig):
@@ -312,8 +344,7 @@ def _dense_ffn(h, lp, cfg: TransformerConfig):
     else:
         a = jax.nn.silu(h @ lp["w1"].astype(h.dtype))
         g = h @ lp["w3"].astype(h.dtype)
-    out = (a * g) @ lp["w2"].astype(h.dtype)
-    return lax.psum(out, cfg.tp_axis)
+    return _row_parallel_product(a * g, lp["w2"].astype(h.dtype), cfg)
 
 
 def _moe_block(h, lp, cfg: TransformerConfig, sp_size):
@@ -339,6 +370,12 @@ def forward(params, tokens, cfg: TransformerConfig):
 
     x = _sharded_embed_lookup(params["embed"], tokens, cfg.tp_axis)
     x = x.astype(cfg.act_dtype)
+    if cfg.collective_matmul:
+        # The RS+AG ring's all_gather output is vma-varying over tp
+        # (identical values, but the tracker cannot prove it); the
+        # scan carry must enter with the same varying axes.
+        from ..parallel.ring_attention import pvary_missing
+        x = pvary_missing(x, (cfg.tp_axis,))
 
     layers = params["layers"]
     if cfg.fused_qkv:
@@ -364,7 +401,16 @@ def forward(params, tokens, cfg: TransformerConfig):
             aux = aux + a
         return (x, aux), None
 
-    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    if cfg.remat:
+        pol = {"full": None,
+               "dots": jax.checkpoint_policies.dots_saveable,
+               "dots_no_batch":
+                   jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+               }[cfg.remat_policy]
+        layer_fn = (jax.checkpoint(layer, policy=pol) if pol is not None
+                    else jax.checkpoint(layer))
+    else:
+        layer_fn = layer
     # The MoE aux accumulator acquires V:(dp, sp) from the routed
     # tokens; the carry must enter with the same varying axes under
     # vma tracking (guarded no-op in untracked traces).
@@ -435,13 +481,21 @@ def opt_spec_tree(opt_state, params_host, specs):
 
 
 def make_train_step(cfg: TransformerConfig, mesh, optimizer,
-                    donate: bool = True):
+                    donate: bool = True, split_optimizer: bool = False):
     """Jitted SPMD train step over ``mesh`` (axes dp/sp/tp as configured).
 
-    Returns (step, shard_params, shard_batch, init_opt):
-      step(params, opt_state, batch) -> (params, opt_state, loss).
+    Returns ``(build, shard_batch)``; ``build(params_host)`` returns
+    ``(step, params, opt_state)`` with
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
     Gradients are psum'ed over (dp, sp) — tp/ep-sharded leaves stay
     sharded, the framework's DP story fused into the compiled program.
+
+    ``split_optimizer=True`` compiles the backward and the optimizer
+    update as TWO programs called back to back — the anti-lever: it
+    exists to MEASURE what fusing the update into the step is worth
+    (the fused default lets XLA overlap the elementwise update with
+    the tail of the backward and skip materializing the full gradient
+    pytree between programs).
     """
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -451,7 +505,7 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer,
                   "targets": P(cfg.dp_axis, cfg.sp_axis)}
     opt_specs = None  # filled after init
 
-    def local_step(params, opt_state, batch):
+    def local_grad(params, batch):
         # vma-tracked AD (check_vma=True below) differentiates the
         # dp/sp pmean in loss_fn with the exact collective transposes,
         # so the per-shard grads ARE the global-batch gradient — no
@@ -459,10 +513,19 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer,
         # grads over (dp, sp) on top of already-combined cotangents,
         # scaling the update by dp*sp: r4 correctness fix, verified by
         # the sharded-vs-single-device gradient test.)
-        loss, grads = jax.value_and_grad(
+        return jax.value_and_grad(
             lambda p: loss_fn(p, batch, cfg))(params)
+
+    def local_update(params, opt_state, grads):
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        return optax.apply_updates(params, updates), opt_state
+
+    def local_step(params, opt_state, batch):
+        # Composed from the same two pieces the split path jits
+        # separately, so the fused/split A/B always measures program
+        # structure, never diverged math.
+        loss, grads = local_grad(params, batch)
+        params, opt_state = local_update(params, opt_state, grads)
         return params, opt_state, loss
 
     def _opt_spec_tree(opt_state, params_host):
@@ -479,6 +542,24 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer,
                                         NamedSharding(mesh, s))
             if hasattr(x, "shape") else x,
             opt_state, o_specs)
+        if split_optimizer:
+            g_mapped = jax.shard_map(
+                local_grad, mesh=mesh,
+                in_specs=(specs, batch_spec),
+                out_specs=(P(), specs), check_vma=True)
+            u_mapped = jax.shard_map(
+                local_update, mesh=mesh,
+                in_specs=(specs, o_specs, specs),
+                out_specs=(specs, o_specs), check_vma=True)
+            g_step = jax.jit(g_mapped)
+            u_step = jax.jit(u_mapped,
+                             donate_argnums=(0, 1, 2) if donate else ())
+
+            def step(params, opt_state, batch):
+                loss, grads = g_step(params, batch)
+                params, opt_state = u_step(params, opt_state, grads)
+                return params, opt_state, loss
+            return step, params, opt_state
         mapped = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(specs, o_specs, batch_spec),

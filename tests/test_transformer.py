@@ -113,6 +113,67 @@ def test_remat_matches_no_remat(hvd_world):
                                rtol=1e-4)
 
 
+def test_split_optimizer_matches_fused_step(hvd_world):
+    """The split-two-programs anti-lever (backward and optimizer
+    update jitted separately) must produce the same loss and params as
+    the fused step on a real dp/sp/tp mesh — otherwise the fusion A/B
+    it exists for measures diverged math, not program structure."""
+    cfg = _cfg()
+    params_host = init_params(jax.random.PRNGKey(9), cfg)
+    rng = np.random.RandomState(9)
+    batch_np = _batch(rng, 4, 16)
+    mesh = _mesh((2, 2, 2), ("dp", "sp", "tp"))
+
+    def run(split):
+        build, shard_batch = make_train_step(
+            cfg, mesh, optax.adam(1e-2), donate=False,
+            split_optimizer=split)
+        step, params, opt_state = build(params_host)
+        loss = None
+        for _ in range(2):
+            params, opt_state, loss = step(
+                params, opt_state, shard_batch(batch_np))
+        pn = float(optax.global_norm(jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.float32), params)))
+        return float(loss), pn
+
+    l_f, p_f = run(False)
+    l_s, p_s = run(True)
+    np.testing.assert_allclose(l_s, l_f, rtol=1e-5)
+    np.testing.assert_allclose(p_s, p_f, rtol=1e-5)
+
+
+def test_collective_matmul_matches_psum(hvd_world):
+    """The latency-hiding TP matmul ring (collective_matmul=True wires
+    parallel/collective_matmul.py into the wo / w2 row-parallel
+    products) must be numerically exact vs the plain psum form, for
+    loss AND gradients, on a real tp>1 mesh (VERDICT r4 Next #3: the
+    component stops being dead inventory)."""
+    cfg = _cfg(collective_matmul=True)
+    cfg_plain = _cfg(collective_matmul=False)
+    params = init_params(jax.random.PRNGKey(7), cfg_plain)
+    rng = np.random.RandomState(7)
+    batch = _batch(rng, 4, 16)
+    mesh = _mesh((2, 2, 2), ("dp", "sp", "tp"))
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.models.transformer import param_specs
+
+    def loss_and_gradnorm(c):
+        bspec = {"tokens": P("dp", "sp"), "targets": P("dp", "sp")}
+        f = jax.jit(jax.shard_map(
+            jax.value_and_grad(lambda p, b: loss_fn(p, b, c)),
+            mesh=mesh, in_specs=(param_specs(c), bspec),
+            out_specs=(P(), param_specs(c)), check_vma=True))
+        loss, g = f(params, batch)
+        return float(loss), float(optax.global_norm(
+            jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), g)))
+
+    l_cm, g_cm = loss_and_gradnorm(cfg)
+    l_ps, g_ps = loss_and_gradnorm(cfg_plain)
+    np.testing.assert_allclose(l_cm, l_ps, rtol=1e-5)
+    np.testing.assert_allclose(g_cm, g_ps, rtol=1e-4)
+
+
 def test_sharded_gradients_match_single_device(hvd_world):
     """Loss AND gradients must be mesh-invariant under the vma-tracked
     step (r4: the previous check_vma=False form psum'ed grads over
