@@ -44,12 +44,18 @@ def resolve_coordinator(config, rank: int, size: int) -> str:
         from ..runner.http_client import RendezvousClient
         client = RendezvousClient(config.rendezvous_addr,
                                   secret=config.secret_key)
+        # The KV outlives elastic world changes: version the key by
+        # the world round (driver epoch), or a re-rendezvoused worker
+        # reads the PREVIOUS world's dead coordinator address and the
+        # new jax runtime never forms.
+        key = ("jax_coordinator:%s"
+               % os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
         if rank == 0:
             host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
             addr = "%s:%d" % (host, _free_port())
-            client.put("jax_coordinator", addr)
+            client.put(key, addr)
             return addr
-        return client.get_blocking("jax_coordinator", timeout=120.0)
+        return client.get_blocking(key, timeout=120.0)
     # Single-host default: a port derived from the launcher's port base,
     # clear of the tcp-core range [base, base+size).
     base = int(os.environ.get("HOROVOD_PORT_BASE", "29600"))
@@ -69,6 +75,17 @@ def init_jax_distributed(config, rank: int, size: int):
                  or str(jax.config.jax_platforms or ""))
     if "cpu" in platforms.split(","):
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # Elastic survival: without this, the coordination service's error
+    # propagation hard-terminates every healthy process the moment a
+    # member dies (absl FATAL in the client) — recovery from member
+    # death is impossible.  With it, survivors keep running; a wedged
+    # collective is the execution watchdog's job
+    # (HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS), and the elastic driver
+    # re-forms the world.
+    try:
+        jax.config.update("jax_enable_recoverability", True)
+    except Exception:  # noqa: BLE001 - older jax without the option
+        pass
     coordinator = resolve_coordinator(config, rank, size)
     LOG.info("multihost: joining jax.distributed at %s as %d/%d",
              coordinator, rank, size)
@@ -100,4 +117,21 @@ def shutdown_jax_distributed():
             jax.distributed.shutdown()
         except Exception:  # noqa: BLE001 - best-effort teardown
             pass
+        # In-process elastic rejoin: the XLA backend cache still holds
+        # clients built for the OLD world (gloo collectives with the
+        # previous process set baked in), and jax.distributed.initialize
+        # refuses to run once any backend exists.  Clearing the cache
+        # lets the next init form the resized world; live jax.Arrays
+        # from the old world become invalid, which is why elastic state
+        # commits store host (numpy) copies.
+        try:
+            import jax.extend.backend as _jeb
+            _jeb.clear_backends()
+        except Exception:  # noqa: BLE001 - version-dependent API
+            try:
+                from jax._src import api as _api
+                _api.clear_backends()
+            except Exception:  # noqa: BLE001
+                LOG.warning("could not clear XLA backends; in-process "
+                            "elastic rejoin may fail to re-initialize")
         init_jax_distributed._done = False

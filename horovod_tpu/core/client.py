@@ -414,6 +414,15 @@ class TcpCore:
             return None
         return self._poll_buf.raw[:n]
 
+    def stopped(self) -> bool:
+        """True once the background loop aborted (negotiation failure /
+        peer disconnect): pending work was failed core-side and no
+        further cycles will run."""
+        try:
+            return bool(self._lib.hvd_tcp_stopped())
+        except AttributeError:  # stale .so without the symbol
+            return False
+
     def external_done(self, handle: int, ok: bool = True,
                       error: str = ""):
         self._lib.hvd_tcp_external_done(handle, 1 if ok else 0,

@@ -33,6 +33,8 @@ int hvd_tcp_is_initialized() {
   return CoreState::Get().initialized() ? 1 : 0;
 }
 
+int hvd_tcp_stopped() { return CoreState::Get().stopped() ? 1 : 0; }
+
 void hvd_tcp_request_shutdown() { CoreState::Get().RequestShutdown(); }
 void hvd_tcp_wait_shutdown() { CoreState::Get().WaitShutdown(); }
 

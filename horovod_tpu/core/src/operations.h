@@ -43,6 +43,9 @@ class CoreState {
   void RequestShutdown();
   void WaitShutdown();
   bool initialized() const { return initialized_; }
+  // True once the background loop aborted (negotiation failure, peer
+  // disconnect): pending work was failed and no further cycles run.
+  bool stopped() const { return stopped_; }
   int rank() const { return rank_; }
   int size() const { return size_; }
 

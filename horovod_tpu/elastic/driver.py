@@ -86,18 +86,29 @@ class ElasticDriver:
             return {"ok": True}
         if kind == "rendezvous":
             return self._handle_rendezvous(
-                (req["host"], int(req["slot"])))
+                (req["host"], int(req["slot"])),
+                int(req.get("min_epoch", 0)))
         if kind == "ping":
             return {"ok": True, "epoch": self._epoch}
         if self._extra_handler is not None:
             return self._extra_handler(req)
         return {"error": "unknown request %r" % kind}
 
-    def _handle_rendezvous(self, slot: Slot) -> Dict:
+    def _handle_rendezvous(self, slot: Slot, min_epoch: int = 0) -> Dict:
         with self._lock:
             if (self._shutdown.is_set() or slot in self._stopped
                     or self._registry.is_blacklisted(slot[0])):
                 return {"status": "stop"}
+            if min_epoch > self._epoch:
+                # The worker's world broke in a way the driver cannot
+                # observe (every process still alive: a transport
+                # reset, a watchdog fire).  Its demand for a newer
+                # epoch IS the world-change signal — record it; the
+                # discovery loop re-forms the world (same membership
+                # is fine, the new epoch is what re-bootstraps it).
+                self._rebuild_wanted = max(
+                    getattr(self, "_rebuild_wanted", 0), min_epoch)
+                return {"status": "wait"}
             if not self._target:
                 # Below min_np: hold workers until discovery refills the
                 # world (their in-memory state survives the wait).
@@ -183,8 +194,11 @@ class ElasticDriver:
             else:
                 self._below_min_since = None
             if (new_target == self._target and self._published
-                    and all(_alive(s) for s in new_target)):
+                    and all(_alive(s) for s in new_target)
+                    and getattr(self, "_rebuild_wanted", 0)
+                    <= self._epoch):
                 return
+            self._rebuild_wanted = 0
             self._epoch += 1
             self._target = new_target
             self._ready = set()
@@ -325,6 +339,8 @@ class ElasticDriver:
                 result = HostUpdateResult.NO_UPDATE
             if result != HostUpdateResult.NO_UPDATE:
                 self._recompute_world("discovery update")
+            elif getattr(self, "_rebuild_wanted", 0) > self._epoch:
+                self._recompute_world("worker-reported broken world")
             self._shutdown.wait(self.discovery_interval)
 
     def _check_procs(self) -> bool:

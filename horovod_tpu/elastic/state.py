@@ -160,16 +160,17 @@ class JaxState(ObjectState):
         self.save()
 
 
-def _reset_and_reinit():
+def _reset_and_reinit(min_epoch=None):
     """Tear down the old world and join the new one (reference:
-    shutdown → driver re-rendezvous → init)."""
+    shutdown → driver re-rendezvous → init).  ``min_epoch`` refuses
+    stale assignments (see WorkerNotificationManager.rendezvous)."""
     try:
         basics.shutdown()
     except Exception:  # noqa: BLE001 — old world may already be broken
         LOG.debug("shutdown of old world failed", exc_info=True)
     nm = notification_manager()
     if nm.active:
-        info = nm.rendezvous()
+        info = nm.rendezvous(min_epoch=min_epoch)
         install_assignment(info)
     basics.init()
 
@@ -204,12 +205,20 @@ def run(func):
                 skip_sync = exc.skip_sync
             except WorkerStopped:
                 raise
+            # The world this worker just left is broken or superseded:
+            # only an assignment from a NEWER driver epoch is
+            # acceptable (a stale one would re-init a world containing
+            # the dead member and block until the runtime's init
+            # deadline kills the survivor).
+            import os as _os
+            need_epoch = int(_os.environ.get(
+                "HOROVOD_ELASTIC_EPOCH", "0")) + 1
             # Re-rendezvous with backoff-on-failure: init itself can
             # race a second world change.
             deadline = time.monotonic() + 600.0
             while True:
                 try:
-                    _reset_and_reinit()
+                    _reset_and_reinit(min_epoch=need_epoch)
                     break
                 except WorkerStopped:
                     raise

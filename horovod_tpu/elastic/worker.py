@@ -96,19 +96,31 @@ class WorkerNotificationManager:
         ep, self._pending_epoch = self._pending_epoch, None
         return ep
 
-    def rendezvous(self, timeout: Optional[float] = None
-                   ) -> Dict[str, Any]:
+    def rendezvous(self, timeout: Optional[float] = None,
+                   min_epoch: Optional[int] = None) -> Dict[str, Any]:
         """Poll the driver until it hands this (host, slot) a rank
-        assignment for the current epoch (or tells it to stop)."""
+        assignment for the current epoch (or tells it to stop).
+
+        ``min_epoch`` gates acceptance: a worker re-rendezvousing
+        because its WORLD BROKE (a member died) must not rejoin the
+        stale epoch — the driver may not have noticed the failure yet,
+        and re-initializing the old world would block on dead members
+        until the runtime's init deadline kills the survivor.  Poll
+        until the driver publishes a newer epoch instead."""
         secret = os.environ.get("HOROVOD_SECRET_KEY", "")
         deadline = time.monotonic() + (timeout or float(
             os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")))
         while True:
             try:
-                resp = services.send_message(
-                    _driver_addr(), secret,
-                    {"kind": "rendezvous", "host": self.host,
-                     "slot": self.slot})
+                msg = {"kind": "rendezvous", "host": self.host,
+                       "slot": self.slot}
+                if min_epoch is not None:
+                    # Tell the driver WHY a stale epoch is refused:
+                    # for breaks it cannot observe (all processes
+                    # alive), this demand is its only world-change
+                    # signal.
+                    msg["min_epoch"] = min_epoch
+                resp = services.send_message(_driver_addr(), secret, msg)
             except (ConnectionError, OSError, socket.timeout) as exc:
                 # Transient RPC failure: retry until the deadline; a
                 # persistently unreachable driver is a job failure, not
@@ -120,6 +132,15 @@ class WorkerNotificationManager:
                 continue
             status = resp.get("status")
             if status == "go":
+                if (min_epoch is not None
+                        and resp.get("epoch", 0) < min_epoch):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "elastic rendezvous: driver never advanced "
+                            "past epoch %d for worker %s:%d"
+                            % (min_epoch - 1, self.host, self.slot))
+                    time.sleep(0.5)
+                    continue
                 # New epoch assignment supersedes any pending update
                 # notification for an older epoch.
                 if (self._pending_epoch is not None
@@ -161,4 +182,14 @@ def install_assignment(info: Dict[str, Any]):
     os.environ["HOROVOD_CROSS_SIZE"] = str(info["cross_size"])
     os.environ["HOROVOD_PORT_BASE"] = str(info["port_base"])
     os.environ["HOROVOD_RENDEZVOUS_ADDR"] = info["rendezvous_addr"]
-    os.environ["HOROVOD_CONTROLLER"] = "tcp"
+    # World-round marker: re-used by resolve_coordinator to version the
+    # jax-coordinator KV entry, so a re-rendezvoused world never reads
+    # the PREVIOUS world's (dead) coordinator address.
+    os.environ["HOROVOD_ELASTIC_EPOCH"] = str(info.get("epoch", 0))
+    # Preserve the launcher's payload-plane choice: a --multihost world
+    # must re-init the device plane (jax.distributed + multihost
+    # engine) after every re-rendezvous, not silently fall to the TCP
+    # plane (r5 fix: this line used to pin "tcp" unconditionally, so
+    # elastic multihost workers never ran device collectives at all).
+    if os.environ.get("HOROVOD_CONTROLLER") != "multihost":
+        os.environ["HOROVOD_CONTROLLER"] = "tcp"

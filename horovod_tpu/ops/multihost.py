@@ -934,6 +934,17 @@ class MultihostEngine:
         while not self._shutdown:
             rec = self.core.wait_negotiated(wait_ms)
             if rec is None:
+                # A stopped control plane (negotiation failure / peer
+                # disconnect) will never negotiate the parked payloads:
+                # fail them loudly instead of letting callers hang —
+                # this is what lets elastic recovery proceed on worlds
+                # where no execution watchdog is configured.
+                if (self._failed is None and not self._shutdown
+                        and self.core.stopped()):
+                    self._poison(HorovodInternalError(
+                        "control plane stopped (negotiation failed — "
+                        "a member disconnected); failing pending "
+                        "collectives"))
                 continue
             try:
                 self._execute(parse_negotiated_record(rec))
@@ -1039,6 +1050,21 @@ class MultihostEngine:
         device program a dead member never joined will wedge the
         runtime thread forever, but callers get a loud diagnostic
         instead of hanging with it."""
+        self._poison(lambda records: HorovodInternalError(
+            "device execution watchdog: negotiated group(s) %s did not "
+            "complete within %.1fs (HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS)"
+            "; a member process likely died between negotiation and "
+            "dispatch — failing outstanding handles" % (
+                sorted({rec["g"]["op_type"] + str(rec["names"])
+                        for rec in records.values()}),
+                self._exec_timeout)))
+
+    def _poison(self, exc_or_factory):
+        """Fail every watched group and parked payload and reject new
+        work — shared by the execution watchdog and the control-plane-
+        stopped sweep.  A callable argument receives the ONE records
+        snapshot that is actually failed, so the diagnostic can never
+        name a group this sweep did not kill."""
         with self._watch_lock:
             records = {w: r for w, r in self._watched.items()
                        if w not in self._killed_wids}
@@ -1047,14 +1073,8 @@ class MultihostEngine:
             # repeat completion on already-failed handles — and the
             # fire loop never re-fires them.
             self._killed_wids.update(records)
-        groups = sorted({rec["g"]["op_type"] + str(rec["names"])
-                         for rec in records.values()})
-        exc = HorovodInternalError(
-            "device execution watchdog: negotiated group(s) %s did not "
-            "complete within %.1fs (HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS)"
-            "; a member process likely died between negotiation and "
-            "dispatch — failing outstanding handles" % (
-                groups, self._exec_timeout))
+        exc = (exc_or_factory(records) if callable(exc_or_factory)
+               else exc_or_factory)
         LOG.error("%s", exc)
         # _failed is set under the SAME lock that guards _enqueue's
         # check + park, so a racing enqueue either raises or lands in

@@ -30,30 +30,31 @@ import os
 import subprocess
 import sys
 
-# Approximate serial durations (seconds) recorded on the 1-core build
-# box, 2026-07-31.  Files not listed default to 10 s; exact values only
-# matter for balance, not correctness.
+# MEASURED serial durations (seconds): junitxml sums from the recorded
+# green 2-shard run of 2026-08-01 (tests/README.md), 1-core box,
+# HVD_TPU_TEST_TIMEOUT_SCALE=2.  junit time excludes per-file
+# collection/import (~5-10 s on this box), so small files floor at 5;
+# exact values only matter for balance, not correctness.  Re-record
+# with ``--record-durations``.
 RECORDED_SECONDS = {
-    "test_tf_adapter.py": 205,
-    "test_tcp_core.py": 150,
-    "test_elastic.py": 140,
-    "test_multihost.py": 130,
-    "test_bench_smoke.py": 345,
-    "test_torch_adapter.py": 120,
-    "test_platform_contract.py": 90,
-    "test_basics.py": 80,
-    "test_keras_adapter.py": 60,
-    "test_transformer.py": 55,
-    "test_bert.py": 40,
-    "test_spark_estimators.py": 45,
-    "test_runner.py": 45,
-    "test_collectives.py": 30,
-    "test_sequence_parallel.py": 25,
+    "test_bench_smoke.py": 275,
+    "test_elastic.py": 220,  # measured 101 + the r5 watchdog-recovery
+    "test_tcp_core.py": 114,
+    "test_platform_contract.py": 99,
+    "test_torch_adapter.py": 98,
+    "test_tf_adapter.py": 97,
+    "test_transformer.py": 92,
+    "test_multihost.py": 76,
+    "test_runner.py": 49,
+    "test_spark_estimators.py": 48,
+    "test_basics.py": 40,
+    "test_bert.py": 36,
     "test_pallas_kernels.py": 25,
-    "test_moe_pipeline.py": 20,
-    "test_jax_adapter.py": 20,
-    "test_zero.py": 15,
-    "test_pallas_bn.py": 15,
+    "test_moe_pipeline.py": 19,
+    "test_collectives.py": 11,
+    "test_podcheck.py": 10,
+    "test_pallas_bn.py": 8,
+    "test_sequence_parallel.py": 5,
 }
 
 
@@ -77,6 +78,9 @@ def main():
                     help="k/M — run shard k (0-based) of M")
     ap.add_argument("--list", action="store_true",
                     help="print the file partition and exit")
+    ap.add_argument("--record-durations", action="store_true",
+                    help="write junitxml and print measured per-file "
+                         "seconds in RECORDED_SECONDS form")
     ap.add_argument("rest", nargs=argparse.REMAINDER,
                     help="extra pytest args after --")
     args = ap.parse_args()
@@ -97,10 +101,34 @@ def main():
     # Disjoint spawn-port ranges per shard (mirrors the xdist handling
     # in tests/utils/spawn.py).
     env["HVD_TPU_TEST_PORT_SHARD"] = str(k)
+    xml = None
+    if args.record_durations:
+        xml = os.path.join(here, ".shard%d_durations.xml" % k)
+        rest = rest + ["--junitxml", xml]
     cmd = [sys.executable, "-m", "pytest", *shards[k], *rest]
     print("shard %d/%d: %d files (~%ds serial)" % (
         k, m, len(shards[k]), loads[k]), flush=True)
-    return subprocess.call(cmd, env=env, cwd=os.path.dirname(here))
+    rc = subprocess.call(cmd, env=env, cwd=os.path.dirname(here))
+    if xml and os.path.exists(xml):
+        _print_file_durations(xml)
+    return rc
+
+
+def _print_file_durations(xml_path):
+    """Aggregate junitxml per-test times into per-FILE seconds — the
+    measured values for RECORDED_SECONDS."""
+    import collections
+    import xml.etree.ElementTree as ET
+    per_file = collections.Counter()
+    for case in ET.parse(xml_path).getroot().iter("testcase"):
+        cls = case.get("classname", "")
+        mod = next((p for p in cls.split(".")
+                    if p.startswith("test_")), None)
+        per_file[(mod + ".py") if mod else "?"] += \
+            float(case.get("time", 0))
+    print("# measured per-file seconds (junitxml sum):")
+    for fname, secs in sorted(per_file.items(), key=lambda kv: -kv[1]):
+        print('    "%s": %d,' % (fname, round(secs)))
 
 
 if __name__ == "__main__":

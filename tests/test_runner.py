@@ -3,6 +3,8 @@ exec, rendezvous auth, and a real static end-to-end run on localhost
 (reference: test/single/test_run.py + test/integration/test_static_run.py)."""
 
 import os
+
+from tests.utils.spawn import scaled_timeout
 import subprocess
 import sys
 import time
@@ -84,7 +86,7 @@ def test_package_import_is_framework_free(tmp_path):
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = "%s%s%s" % (tmp_path, os.pathsep, REPO)
     proc = subprocess.run([sys.executable, "-c", code],
-                          capture_output=True, text=True, timeout=120,
+                          capture_output=True, text=True, timeout=scaled_timeout(120),
                           env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "LAZY_OK" in proc.stdout
@@ -226,7 +228,7 @@ def test_static_run_end_to_end():
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "3",
          sys.executable, "-c", script],
-        capture_output=True, text=True, timeout=180, env=_worker_env(),
+        capture_output=True, text=True, timeout=scaled_timeout(180), env=_worker_env(),
         cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for r in range(3):
@@ -245,7 +247,7 @@ def test_static_run_failure_tears_down_world():
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
          sys.executable, "-c", script],
-        capture_output=True, text=True, timeout=120, env=_worker_env(),
+        capture_output=True, text=True, timeout=scaled_timeout(120), env=_worker_env(),
         cwd=REPO)
     assert proc.returncode != 0
     assert time.monotonic() - t0 < 60

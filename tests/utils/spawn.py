@@ -12,6 +12,23 @@ import socket
 import subprocess
 import sys
 
+
+def scaled_timeout(seconds: float) -> float:
+    """Spawn/rendezvous timeouts scaled by HVD_TPU_TEST_TIMEOUT_SCALE.
+
+    Timeouts here are calibrated for an idle 1-core box; any
+    contention (a parallel judge workload, concurrent shards) tips
+    spawn-heavy tests into timeout flakes (r4: two such).  One knob
+    scales every harness-level timeout rather than re-tuning each
+    call site: ``HVD_TPU_TEST_TIMEOUT_SCALE=2 pytest ...``.
+    """
+    try:
+        scale = float(os.environ.get("HVD_TPU_TEST_TIMEOUT_SCALE", "1"))
+    except ValueError:
+        scale = 1.0
+    return seconds * max(scale, 0.1)
+
+
 _SLOT_PORTS = 1200  # ports per (worker, shard) slot
 _SLOT_COUNT = 31    # 27100 + 31*1200 = 64300 < 65535
 _BASE_FLOOR = 27100
@@ -68,6 +85,7 @@ def free_port_block(size, extra_offsets=()):
 def spawn_world(worker, size, extra_env=None, timeout=240, retry=True,
                 extra_port_offsets=(), pop_env=()):
     """Run `worker` as `size` rank processes; returns [(rc, out, err)]."""
+    timeout = scaled_timeout(timeout)
     base = free_port_block(size, extra_port_offsets)
     procs = []
     for rank in range(size):
