@@ -40,6 +40,12 @@ def main():
     ap.add_argument("--tensors", type=int, default=16,
                     help="tensors per step")
     ap.add_argument("--cpu-devices", type=int, default=None)
+    ap.add_argument("--grouped", type=int, default=0,
+                    help="issue each step as ONE grouped_allreduce of "
+                         "all tensors — the DistributedOptimizer "
+                         "grouped-bucket BURST shape (one negotiation "
+                         "+ one fused device program per step) — "
+                         "instead of per-tensor asyncs")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -74,6 +80,13 @@ def main():
             grads.append(rng.randn(n, elems).astype(np.float32))
 
     def step(s):
+        if args.grouped:
+            # One atomic negotiated group; the device plane packs it
+            # into one bucket-keyed program — per-step the tuner sees
+            # a single observation, the traffic shape it was blind to
+            # in the r4 A/B.
+            return hvd.grouped_allreduce(grads, op=hvd.Sum,
+                                         name="gg")[0]
         hs = [hvd.allreduce_async(g, op=hvd.Sum, name="g%d" % i)
               for i, g in enumerate(grads)]
         out = None
@@ -99,6 +112,7 @@ def main():
             "value": round(args.steps / dt, 2),
             "unit": "steps/sec",
             "autotune": os.environ.get("HOROVOD_AUTOTUNE", "0"),
+            "grouped": bool(args.grouped),
             "tensors": args.tensors,
             "bytes_per_step": total_bytes,
             "ranks": n,
