@@ -476,6 +476,20 @@ void CoreState::PerformOperation(const Response& r) {
     w.u32(static_cast<uint32_t>(entries.size()));
     for (size_t i = 0; i < entries.size(); ++i) {
       w.str(r.tensor_names[i]);
+      if (!entries[i] && !join_requested_) {
+        // A record entry's handle is LOCAL: it may only be absent on
+        // a rank that itself joined (zero contribution by design).
+        // Missing on a non-joined rank means the control plane
+        // negotiated a tensor this rank never parked — the executor
+        // would silently zero-fill and corrupt the reduction.  Keep
+        // the record flowing (peers are already committed to the
+        // program) but make the moment loud and attributable.
+        LOG_ERROR << "external entry '" << r.tensor_names[i]
+                  << "' negotiated ready but missing from the local "
+                  << "tensor queue on non-joined rank " << rank_
+                  << "; its zero fill will corrupt the reduction "
+                  << "(control-plane race — please report)";
+      }
       w.i64(entries[i] ? entries[i]->handle : -1);
       if (entries[i])
         timeline_.ActivityStart(r.tensor_names[i], "EXEC_EXTERNAL");

@@ -44,7 +44,7 @@ def _on_tpu() -> bool:
 
 def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr,
                        l_scr, acc_scr, *, block_q: int, block_k: int,
-                       causal: bool, scale: float):
+                       causal: bool):
     # grid = (bh, nq, nk): K/V stream through VMEM one block per inner
     # step (double-buffered by the Pallas pipeline); the online-softmax
     # state (m, l, acc) persists in VMEM scratch across the inner axis.
@@ -67,9 +67,12 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr,
     def _update():
         # matmuls stay in the input dtype (bf16 hits the MXU at full
         # rate; accumulation is f32 via preferred_element_type)
+        # q arrives PRE-SCALED by 1/sqrt(d) (one cheap (BH,S,D) pass
+        # outside the kernel) — a per-block (BQ,BK) scale multiply
+        # here would cost ~16x more VPU work over the whole grid.
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+            preferred_element_type=jnp.float32)          # (BQ, BK)
         if causal:
             rows = j * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -114,11 +117,10 @@ def _flash_attention_fwd_flat(q, k, v, *, causal: bool, block_q: int,
     """(BH, S, D) → ((BH, S, D) output, (BH, S, 1) lse), D lane-padded."""
     from jax.experimental.pallas import tpu as pltpu
     bh, seq, d = q.shape
-    scale = 1.0 / math.sqrt(d)
     grid = (bh, seq // block_q, seq // block_k)
     kernel = functools.partial(
         _flash_attn_kernel, block_q=block_q, block_k=block_k,
-        causal=causal, scale=scale)
+        causal=causal)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -223,8 +225,13 @@ def _plan(s: int, d: int):
     block_k = _env_block("HVD_TPU_FLASH_BLOCK_K",
                          (1024, 512, 256, 128, 64))
     d_pad = max(128, ((d + 127) // 128) * 128)
-    scale_fix = math.sqrt(d_pad / d)  # kernels scale by 1/sqrt(d_pad)
-    return block_q, block_k, d_pad, scale_fix
+    # The FULL attention scale folds into one pre-multiply of q (the
+    # kernels do no scaling at all): one (BH,S,D) pass replaces a
+    # (BQ,BK) pass per grid block (~16x more elements at seq 2048,
+    # d 128) in the fwd and both bwd kernels.  Padding needs no
+    # correction precisely because the kernels don't scale.
+    pre_scale = 1.0 / math.sqrt(d)
+    return block_q, block_k, d_pad, pre_scale
 
 
 def _to_flat(x, d_pad):
@@ -252,12 +259,12 @@ def _flash_attention_impl(q, k, v, causal):
 
 def _flash_fwd(q, k, v, causal):
     b, s, h, d = q.shape
-    block_q, block_k, d_pad, scale_fix = _plan(s, d)
+    block_q, block_k, d_pad, pre_scale = _plan(s, d)
     if block_q is None or block_k is None:
         out = _reference_attention(q, k, v, causal)
         return out, (q, k, v, None, None)
     out, lse = _flash_attention_fwd_flat(
-        _to_flat(q * scale_fix, d_pad), _to_flat(k, d_pad),
+        _to_flat(q * pre_scale, d_pad), _to_flat(k, d_pad),
         _to_flat(v, d_pad), causal=causal, block_q=block_q,
         block_k=block_k, interpret=not _on_tpu())
     out = out[:, :, :d].reshape(b, h, s, d)
@@ -267,7 +274,7 @@ def _flash_fwd(q, k, v, causal):
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, block_q: int, block_k: int,
-                         causal: bool, scale: float):
+                         causal: bool):
     # grid = (bh, nq, nk): K/V stream along the inner axis while this
     # q block's dq accumulates in VMEM scratch (mirror of the fwd).
     j = pl.program_id(1)
@@ -284,9 +291,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
     @pl.when(block_live)
     def _update():
+        # q pre-scaled by 1/sqrt(d): s needs no per-block multiply.
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # (BQ, BK)
+            preferred_element_type=jnp.float32)             # (BQ, BK)
         # softmax from saved stats: p = exp(s - lse)
         p = jnp.exp(s - lse_ref[0])
         if causal:
@@ -298,7 +306,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             g_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # (BQ, BK)
-        ds = p * (dp - delta_ref[0]) * scale
+        # ds carries NO scale: the caller folds 1/sqrt(d) into the
+        # final (BH,S,D) dq multiply — one pass instead of one per
+        # (BQ,BK) block.
+        ds = p * (dp - delta_ref[0])
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (BQ, D)
@@ -310,8 +321,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          block_q: int, block_k: int, causal: bool,
-                          scale: float):
+                          block_q: int, block_k: int, causal: bool):
     # grid = (bh, nk, nq): Q/G stream along the inner axis while this
     # k block's dk/dv accumulate in VMEM scratch.
     t = pl.program_id(1)
@@ -329,9 +339,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref,
 
     @pl.when(block_live)
     def _update():
+        # q pre-scaled by 1/sqrt(d): s needs no per-block multiply.
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # (BQ, BK)
+            preferred_element_type=jnp.float32)             # (BQ, BK)
         p = jnp.exp(s - lse_ref[0])
         if causal:
             rows = j * block_q + jax.lax.broadcasted_iota(
@@ -345,7 +356,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref,
         dp = jax.lax.dot_general(
             g_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # (BQ, BK)
-        ds = p * (dp - delta_ref[0]) * scale
+        # ds @ q_prescaled == scale * (ds_raw @ q): with q carrying
+        # 1/sqrt(d), dk needs NO scale anywhere.
+        ds = p * (dp - delta_ref[0])
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (BK, D)
@@ -363,13 +376,12 @@ def _flash_attention_bwd_flat(q, k, v, g, lse, delta, *, causal: bool,
     returns (dq, dk, dv) with dq still in the fwd's q scaling."""
     from jax.experimental.pallas import tpu as pltpu
     bh, seq, d = q.shape
-    scale = 1.0 / math.sqrt(d)
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0))
     rowspec = pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale),
+                          block_k=block_k, causal=causal),
         grid=(bh, seq // block_q, seq // block_k),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=pl.BlockSpec((1, block_q, d),
@@ -387,7 +399,7 @@ def _flash_attention_bwd_flat(q, k, v, g, lse, delta, *, causal: bool,
     rowspec2 = pl.BlockSpec((1, block_q, 1), lambda i, t, j: (i, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale),
+                          block_k=block_k, causal=causal),
         grid=(bh, seq // block_k, seq // block_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[
@@ -449,7 +461,7 @@ def _flash_bwd(causal, res, g):
         # A/B escape hatch (docs/benchmarks.md records the comparison).
         return _flash_bwd_chunked(causal, (q, k, v), g)
     b, s, h, d = q.shape
-    block_q, block_k, d_pad, scale_fix = _plan(s, d)
+    block_q, block_k, d_pad, pre_scale = _plan(s, d)
     # delta = rowsum(g ⊙ o): the softmax-jacobian correction term,
     # cheap in XLA (one elementwise pass).  Unit lane dim to match the
     # lse layout.
@@ -457,12 +469,16 @@ def _flash_bwd(causal, res, g):
                     * jnp.swapaxes(o, 1, 2).astype(jnp.float32),
                     axis=-1).reshape(b * h, s, 1)
     dq, dk, dv = _flash_attention_bwd_flat(
-        _to_flat(q * scale_fix, d_pad), _to_flat(k, d_pad),
+        _to_flat(q * pre_scale, d_pad), _to_flat(k, d_pad),
         _to_flat(v, d_pad), _to_flat(g, d_pad), lse, delta,
         causal=causal, block_q=block_q, block_k=block_k,
         interpret=not _on_tpu())
-    # fwd pre-scaled q by scale_fix, so d(loss)/d(q) = dq_flat*scale_fix
-    return (_from_flat(dq, b, h, d, q) * scale_fix,
+    # The kernels differentiate w.r.t. the PRE-SCALED q, so
+    # d(loss)/d(q) = dq_flat * pre_scale; dk comes out exact with no
+    # correction (ds^T @ q_prescaled == scale * ds_raw^T @ q).  The
+    # scale multiply runs in f32 BEFORE the final dtype cast so dq
+    # picks up one rounding, not two.
+    return (_from_flat(dq.astype(jnp.float32) * pre_scale, b, h, d, q),
             _from_flat(dk, b, h, d, k),
             _from_flat(dv, b, h, d, v))
 
