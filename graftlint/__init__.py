@@ -1,0 +1,42 @@
+"""graftlint — repo-specific static analysis for the payload plane.
+
+The codebase states its concurrency and configuration invariants in
+comments ("only the executor thread touches this", "guarded by
+``_lock``", "env vars are the single source of configuration") but the
+round-5 advisor findings and the double-shard queue-race flake
+(tests/README.md) are all instances of those invariants drifting with
+no mechanical check.  Horovod's own correctness story (arXiv:1802.05799)
+hangs on a background coordination thread whose state-sharing rules are
+exactly this class of invariant; as the engine grows multi-stream, the
+"safe today because one thread" assumptions break silently unless a
+checker enforces them.
+
+Three rule families, all AST-based (no third-party deps):
+
+* ``ownership`` — thread-ownership / lock-discipline over the engine,
+  multihost, and elastic classes, driven by lightweight annotations
+  (``# graftlint: owned-by=<thread>``, ``# graftlint:
+  guarded-by=<lock>`` on attributes; ``# graftlint: thread=<name>``,
+  ``requires-lock=<lock>`` on methods).  Flags unannotated shared
+  mutable state touched from more than one thread entry point, writes
+  outside the guarding lock, and dispatch-scoped state stored on
+  instances (the ``compile_notify`` pattern).
+* ``env-drift`` — every ``HOROVOD_*``/``HVD_TPU_*`` key read in
+  ``common/config.py`` must be documented (PARITY.md / docs/), read
+  once, and direct ``os.environ`` reads of the same key must not carry
+  contradictory defaults.
+* ``host-bounce`` — ``np.*`` payload conversions, ``.item()``, and
+  ``jax.device_get`` inside functions marked ``# graftlint: hot-path``
+  (the eager payload plane) must be suppressed with a cited issue or
+  removed.
+
+Run: ``python -m graftlint [paths...]`` (defaults to ``horovod_tpu/``).
+Suppress a single line with ``# graftlint: disable=<check> issue=<REF>
+-- <reason>``; suppressions without an issue citation (or that no
+longer suppress anything) are themselves findings, so the zero-findings
+baseline stays honest.
+"""
+
+from .core import Finding, LintConfig, run_paths  # noqa: F401
+
+__version__ = "1.0"

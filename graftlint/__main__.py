@@ -1,0 +1,51 @@
+"""``python -m graftlint [paths...]`` — run the suite, exit 0/1.
+
+Default path is the package's repo root ``horovod_tpu/`` tree, so the
+CI line and the tier-1 test are both just ``python -m graftlint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import LintConfig, run_paths
+from .rules import ALL_CHECKS
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="repo-specific concurrency & invariant static "
+                    "analysis for the payload plane")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: the repo's "
+                             "horovod_tpu/ tree)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print every check id and exit")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the summary line")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for check, desc in ALL_CHECKS:
+            print("%-22s %s" % (check, desc))
+        return 0
+
+    cfg = LintConfig()
+    paths = args.paths or [cfg.resolve("horovod_tpu")]
+    findings = run_paths(paths, cfg)
+    for f in findings:
+        print(f.render(cfg.repo_root))
+    if not args.quiet:
+        print("graftlint: %d finding(s) over %s"
+              % (len(findings),
+                 [os.path.relpath(p, cfg.repo_root) for p in
+                  map(os.path.abspath, paths)]),
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

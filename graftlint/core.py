@@ -1,0 +1,326 @@
+"""Shared infrastructure: source model, annotations, suppressions,
+findings, and the rule runner.
+
+Annotations ride in comments so they survive every Python tool in the
+pipeline (black, pytest, coverage) and carry zero runtime cost:
+
+``# graftlint: key=value key2=value2 flag`` — tokens after the marker
+are either ``key=value`` pairs or bare flags.  Recognized keys are rule
+specific (``owned-by``, ``guarded-by`` on attribute lines; ``thread``,
+``requires-lock`` on ``def`` lines; bare ``hot-path`` on ``def``
+lines).
+
+Suppressions: ``# graftlint: disable=<check-id> issue=<REF> -- reason``
+disables one check on that line only.  A suppression missing the issue
+citation, or one that suppresses nothing, is a finding itself
+(``bad-suppression`` / ``unused-suppression``) — the acceptance bar is
+*zero findings with every suppression explained*, not silence.
+
+Source files are cached per run: several rules scan the same modules
+(the engine files carry both ownership annotations and hot-path
+markers), and suppression "used" bookkeeping must span all of them
+before the hygiene pass decides a suppression is dead.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+MARKER = "graftlint:"
+
+# Annotation vocabulary, validated for EVERY scanned file in the
+# hygiene pass (not just ownership-rule files): a typo'd key or flag
+# silently disables whatever rule it was meant to drive, so it must be
+# a finding wherever it appears.
+KNOWN_KEYS = frozenset({"owned-by", "guarded-by", "thread",
+                        "requires-lock"})
+KNOWN_FLAGS = frozenset({"hot-path"})
+
+# Matches the issue citation inside a suppression: issue=<ref> where the
+# ref names a tracker entry (ISSUE-1, GH-123, ROADMAP:multistream, ...).
+_ISSUE_RE = re.compile(r"^[A-Za-z][\w.\-]*[:#\-]\S+$|^[A-Za-z]+-\d+$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    check: str
+    message: str
+
+    def render(self, root: Optional[str] = None) -> str:
+        p = os.path.relpath(self.path, root) if root else self.path
+        return "%s:%d: [%s] %s" % (p, self.line, self.check, self.message)
+
+
+@dataclasses.dataclass
+class Annotation:
+    """Parsed ``# graftlint: ...`` comment on one line."""
+
+    line: int
+    pairs: Dict[str, str]
+    flags: List[str]
+    raw: str
+    attached: bool = False  # an ownership attribute note bound to it
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    check: str
+    issue: Optional[str]
+    reason: Optional[str]
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed Python source: AST + per-line graftlint comments."""
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = path
+        if text is None:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.annotations: Dict[int, Annotation] = {}
+        self.suppressions: Dict[int, List[Suppression]] = {}
+        self.parse_errors: List[Finding] = []
+        # Check ids some rule actually evaluated for this file; the
+        # hygiene pass only calls a suppression "unused" when its check
+        # ran here (a scoped `python -m graftlint horovod_tpu/elastic`
+        # must not flag hot-path suppressions it never evaluated).
+        self.checked: Set[str] = set()
+        self._scan_comments()
+
+    # -- comment scanning --------------------------------------------------
+
+    def _scan_comments(self):
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenError:  # pragma: no cover - ast parsed OK
+            comments = []
+        for line, comment in comments:
+            body = comment.lstrip("#").strip()
+            if not body.startswith(MARKER):
+                continue
+            rest = body[len(MARKER):].strip()
+            if rest.startswith("disable="):
+                self._parse_suppression(line, rest)
+            else:
+                self._parse_annotation(line, rest)
+
+    def _parse_annotation(self, line: int, rest: str):
+        pairs: Dict[str, str] = {}
+        flags: List[str] = []
+        for tok in rest.split():
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                pairs[k] = v
+            else:
+                flags.append(tok)
+        self.annotations[line] = Annotation(line, pairs, flags, rest)
+
+    def _parse_suppression(self, line: int, rest: str):
+        # disable=<check> issue=<REF> -- <free-text reason>
+        head, _, reason = rest.partition("--")
+        reason = reason.strip() or None
+        check = None
+        issue = None
+        for tok in head.split():
+            if tok.startswith("disable="):
+                check = tok[len("disable="):]
+            elif tok.startswith("issue="):
+                issue = tok[len("issue="):]
+        sup = Suppression(line, check or "", issue, reason)
+        self.suppressions.setdefault(line, []).append(sup)
+        if not check:
+            self.parse_errors.append(Finding(
+                self.path, line, "bad-suppression",
+                "suppression missing disable=<check-id>"))
+        if not issue or not _ISSUE_RE.match(issue):
+            self.parse_errors.append(Finding(
+                self.path, line, "bad-suppression",
+                "suppression must cite an issue (issue=<REF>): %r"
+                % rest))
+        elif not reason:
+            self.parse_errors.append(Finding(
+                self.path, line, "bad-suppression",
+                "suppression must carry a reason after '--': %r" % rest))
+
+    def def_annotation(self, node) -> Optional[Annotation]:
+        """Annotation on a def line, or anywhere in the signature span
+        (multi-line signatures put the comment where it fits)."""
+        end = node.body[0].lineno if node.body else node.lineno + 1
+        for line in range(node.lineno, end):
+            ann = self.annotations.get(line)
+            if ann is not None:
+                return ann
+        return None
+
+    # -- suppression application ------------------------------------------
+
+    def suppressed(self, line: int, check: str) -> bool:
+        for sup in self.suppressions.get(line, []):
+            if sup.check == check:
+                sup.used = True
+                return True
+        return False
+
+    def hygiene_findings(self) -> List[Finding]:
+        out = list(self.parse_errors)
+        for line, ann in sorted(self.annotations.items()):
+            for key in ann.pairs:
+                if key not in KNOWN_KEYS:
+                    out.append(Finding(
+                        self.path, line, "bad-annotation",
+                        "unknown annotation key %r (known: %s)"
+                        % (key, sorted(KNOWN_KEYS))))
+            for flag in ann.flags:
+                if flag not in KNOWN_FLAGS:
+                    out.append(Finding(
+                        self.path, line, "bad-annotation",
+                        "unknown annotation flag %r (known: %s)"
+                        % (flag, sorted(KNOWN_FLAGS))))
+        for sups in self.suppressions.values():
+            for sup in sups:
+                if sup.check and not sup.used \
+                        and sup.check in self.checked:
+                    out.append(Finding(
+                        self.path, sup.line, "unused-suppression",
+                        "suppression for %r no longer matches any "
+                        "finding on this line; delete it" % sup.check))
+        if "ownership-shared" in self.checked:
+            for ann in self.annotations.values():
+                if (("owned-by" in ann.pairs
+                     or "guarded-by" in ann.pairs)
+                        and not ann.attached):
+                    out.append(Finding(
+                        self.path, ann.line, "bad-annotation",
+                        "ownership annotation attaches to no "
+                        "self-attribute assignment on this line: %r"
+                        % ann.raw))
+        return out
+
+
+# -- per-run source cache --------------------------------------------------
+
+_CACHE: Dict[str, Tuple[Optional["SourceFile"], List[Finding]]] = {}
+
+
+def reset_cache():
+    _CACHE.clear()
+
+
+def get_source(path: str) -> Tuple[Optional[SourceFile], List[Finding]]:
+    """Load (or reuse) a SourceFile; load errors are returned every
+    call but emitted once by the hygiene pass."""
+    path = os.path.abspath(path)
+    hit = _CACHE.get(path)
+    if hit is None:
+        try:
+            hit = (SourceFile(path), [])
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            hit = (None, [Finding(path, getattr(exc, "lineno", 1) or 1,
+                                  "parse-error", str(exc))])
+        _CACHE[path] = hit
+    return hit
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Repo-specific wiring: which files carry which invariants.
+
+    Defaults point at the live tree (repo root inferred from this
+    package's location); tests override every field to aim rules at
+    fixtures.
+    """
+
+    repo_root: str = dataclasses.field(
+        default_factory=lambda: os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    # ownership rule: files whose classes carry thread/lock annotations.
+    ownership_files: Sequence[str] = (
+        "horovod_tpu/ops/engine.py",
+        "horovod_tpu/ops/multihost.py",
+        "horovod_tpu/elastic/worker.py",
+        "horovod_tpu/elastic/driver.py",
+        "horovod_tpu/elastic/state.py",
+        "horovod_tpu/elastic/discovery.py",
+        "horovod_tpu/elastic/registration.py",
+        "horovod_tpu/elastic/sampler.py",
+    )
+    # env-drift rule: the Config module and the docs that must mention
+    # every key it reads.
+    config_file: str = "horovod_tpu/common/config.py"
+    doc_files: Sequence[str] = ("PARITY.md", "docs", "README.md")
+    env_scan_root: str = "horovod_tpu"
+    # host-bounce rule scans every file under these roots for functions
+    # annotated hot-path.
+    hot_path_roots: Sequence[str] = ("horovod_tpu/ops",)
+
+    def resolve(self, rel: str) -> str:
+        return os.path.join(self.repo_root, rel)
+
+
+def iter_py_files(root: str):
+    if os.path.isfile(root):
+        if root.endswith(".py"):
+            yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_paths(paths: Sequence[str],
+              config: Optional[LintConfig] = None) -> List[Finding]:
+    """Run every rule whose scope intersects ``paths``.
+
+    ``paths`` narrows the ownership/host-bounce scan; the env-drift rule
+    runs whenever a path covers the config module or the scan root (its
+    cross-file nature means per-file narrowing would lie).
+    """
+    from .rules import env_drift, host_bounce, ownership
+
+    cfg = config or LintConfig()
+    abs_paths = [os.path.abspath(p) for p in paths]
+    reset_cache()
+
+    def in_scope(rel: str) -> bool:
+        target = os.path.abspath(cfg.resolve(rel))
+        for p in abs_paths:
+            if target == p or target.startswith(p.rstrip(os.sep) + os.sep) \
+                    or p.startswith(target.rstrip(os.sep) + os.sep):
+                return True
+        return False
+
+    findings: List[Finding] = []
+    own_files = [f for f in cfg.ownership_files if in_scope(f)]
+    if own_files:
+        findings += ownership.check_files(
+            [cfg.resolve(f) for f in own_files])
+    if in_scope(cfg.config_file) or in_scope(cfg.env_scan_root):
+        findings += env_drift.check(cfg)
+    hb_roots = [r for r in cfg.hot_path_roots if in_scope(r)]
+    if hb_roots:
+        findings += host_bounce.check_roots(
+            [cfg.resolve(r) for r in hb_roots])
+    for src, errs in _CACHE.values():
+        findings += errs
+        if src is not None:
+            findings += src.hygiene_findings()
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings

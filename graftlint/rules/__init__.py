@@ -1,0 +1,18 @@
+"""Rule registry.
+
+Each rule module exposes a ``CHECKS`` tuple of (check-id, description)
+pairs — ``python -m graftlint --list-rules`` renders them — plus its
+entry point (``check_files`` / ``check_roots`` / ``check``).
+"""
+
+from . import env_drift, host_bounce, ownership  # noqa: F401
+
+ALL_CHECKS = (
+    ownership.CHECKS + env_drift.CHECKS + host_bounce.CHECKS + (
+        ("bad-suppression",
+         "suppression missing disable=/issue= citation or reason"),
+        ("unused-suppression",
+         "suppression that no longer matches any finding"),
+        ("bad-annotation", "unknown graftlint annotation key/flag"),
+        ("parse-error", "file failed to parse"),
+    ))

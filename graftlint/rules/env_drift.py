@@ -1,0 +1,203 @@
+"""Env-var configuration drift checker.
+
+``common/config.py`` is the single source of runtime configuration (its
+own module docstring says so, mirroring the reference's
+``env_parser.cc``): every ``HOROVOD_*``/``HVD_TPU_*`` knob is read once
+there, and the docs are the contract reference users migrate against.
+Three ways that story drifts, each mechanically checkable:
+
+* **`env-undocumented`** — a key read in config.py whose ``HOROVOD_*``
+  name (or ``HVD_TPU_*`` alias) appears in none of the doc files
+  (PARITY.md, docs/, README.md).  A knob nobody can discover is a knob
+  that will be re-invented under a second name.
+* **`env-duplicate-read`** — the same key parsed twice in config.py.
+  Two reads means two defaults the moment one call site is edited; the
+  snapshot must read each key exactly once.
+* **`env-default-conflict`** — direct ``os.environ.get(key, default)``
+  reads (bootstrap paths that legitimately run before ``hvd.init()``)
+  disagreeing with each other about the same key's default.  Defaults
+  are compared numerically when both parse as numbers ("600" == 600.0).
+
+Config-module defaults are deliberately NOT compared against direct
+reads: bootstrap context can differ by design (elastic re-rendezvous
+defaults ``HOROVOD_CONTROLLER`` to ``tcp``; ``Config`` defaults it to
+``auto``), and the direct-vs-direct check is the one that catches a
+copy-paste fork of the same bootstrap constant.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, LintConfig, get_source, iter_py_files
+
+CHECKS = (
+    ("env-undocumented",
+     "config key read in config.py but mentioned in no doc file"),
+    ("env-duplicate-read", "config key parsed more than once in config.py"),
+    ("env-default-conflict",
+     "direct os.environ reads of one key with contradictory defaults"),
+)
+
+_ENV_HELPERS = {"_env", "_env_int", "_env_float", "_env_bool", "opt_int"}
+_PREFIXES = ("HOROVOD_", "HVD_TPU_")
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _default_repr(node) -> Optional[str]:
+    """Literal default as a comparable string; None when absent or not
+    a literal (computed defaults are out of scope)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        return repr(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant):
+        return repr(-node.operand.value)
+    return None
+
+
+def _normalize(default: str) -> str:
+    try:
+        return repr(float(ast.literal_eval(default)))
+    except (ValueError, TypeError, SyntaxError):
+        return default
+
+
+def config_keys(path: str) -> List[Tuple[str, int]]:
+    """(key-suffix, line) for every ``_env*``/``opt_int`` read."""
+    src, _ = get_source(path)
+    if src is None:
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in _ENV_HELPERS and node.args:
+            key = _const_str(node.args[0])
+            if key is not None:
+                out.append((key, node.lineno))
+    return out
+
+
+def _is_environ(node) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "environ"
+    return isinstance(node, ast.Attribute) and node.attr == "environ"
+
+
+def direct_reads(root: str) -> List[Tuple[str, Optional[str], str, int]]:
+    """(full-key, default-literal, path, line) for every direct
+    ``os.environ`` get/[]/setdefault of a ``HOROVOD_*``/``HVD_TPU_*``
+    key with a constant name."""
+    out = []
+    for path in iter_py_files(root):
+        src, _ = get_source(path)
+        if src is None:
+            continue
+        for node in ast.walk(src.tree):
+            key = default = None
+            line = 0
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "setdefault") \
+                    and _is_environ(node.func.value) and node.args:
+                key = _const_str(node.args[0])
+                default = _default_repr(
+                    node.args[1] if len(node.args) > 1 else None)
+                line = node.lineno
+            elif isinstance(node, ast.Subscript) \
+                    and _is_environ(node.value):
+                sl = node.slice
+                key = _const_str(sl)
+                line = node.lineno
+            if key is not None and key.startswith(_PREFIXES):
+                out.append((key, default, path, line))
+    return out
+
+
+def _doc_text(cfg: LintConfig) -> str:
+    chunks = []
+    for rel in cfg.doc_files:
+        path = cfg.resolve(rel)
+        if os.path.isdir(path):
+            for dirpath, _dirs, files in os.walk(path):
+                for fn in sorted(files):
+                    if fn.endswith((".md", ".rst", ".txt")):
+                        with open(os.path.join(dirpath, fn), "r",
+                                  encoding="utf-8", errors="replace") as f:
+                            chunks.append(f.read())
+        elif os.path.isfile(path):
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def check(cfg: LintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    config_path = cfg.resolve(cfg.config_file)
+    src, _ = get_source(config_path)
+    if src is not None:
+        src.checked.update(("env-undocumented", "env-duplicate-read"))
+    keys = config_keys(config_path)
+    docs = _doc_text(cfg)
+
+    seen: Dict[str, int] = {}
+    for key, line in keys:
+        if key in seen:
+            if src is None or not src.suppressed(
+                    line, "env-duplicate-read"):
+                findings.append(Finding(
+                    config_path, line, "env-duplicate-read",
+                    "config key %r already parsed at line %d; one "
+                    "snapshot read per key" % (key, seen[key])))
+            continue
+        seen[key] = line
+        documented = any(
+            re.search(r"\b%s\b" % re.escape(p + key), docs)
+            for p in _PREFIXES)
+        if not documented:
+            if src is None or not src.suppressed(
+                    line, "env-undocumented"):
+                findings.append(Finding(
+                    config_path, line, "env-undocumented",
+                    "HOROVOD_%s (alias HVD_TPU_%s) is read here but "
+                    "documented nowhere in %s" % (
+                        key, key, list(cfg.doc_files))))
+
+    by_key: Dict[str, List[Tuple[str, str, int]]] = {}
+    for key, default, path, line in direct_reads(
+            cfg.resolve(cfg.env_scan_root)):
+        fsrc, _ = get_source(path)
+        if fsrc is not None:
+            fsrc.checked.add("env-default-conflict")
+        if default is not None:
+            by_key.setdefault(key, []).append((default, path, line))
+    for key, sites in sorted(by_key.items()):
+        norms = {_normalize(d) for d, _p, _l in sites}
+        if len(norms) <= 1:
+            continue
+        canonical = sites[0]
+        for default, path, line in sites[1:]:
+            if _normalize(default) == _normalize(canonical[0]):
+                continue
+            fsrc, _ = get_source(path)
+            if fsrc is not None and fsrc.suppressed(
+                    line, "env-default-conflict"):
+                continue
+            findings.append(Finding(
+                path, line, "env-default-conflict",
+                "%s defaults to %s here but %s at %s:%d — contradictory "
+                "bootstrap defaults" % (
+                    key, default, canonical[0],
+                    os.path.relpath(canonical[1], cfg.repo_root),
+                    canonical[2])))
+    return findings

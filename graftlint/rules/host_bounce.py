@@ -1,0 +1,99 @@
+"""Host-bounce checker for eager payload-plane hot paths.
+
+The payload plane's contract (module docstrings of ``ops/multihost.py``
+and ``ops/engine.py``): device payloads stay device-resident end to
+end; the host boundary is crossed only at documented staging/conversion
+points.  A stray ``np.asarray(payload)``, ``.item()``, or
+``jax.device_get`` on the dispatch path silently serializes a device
+sync into every collective — the exact regression class the round-5
+bench hunted by hand.
+
+Functions annotated ``# graftlint: hot-path`` on their ``def`` line are
+scanned (nested closures included — the traced ``build()`` bodies are
+part of the path).  Flagged calls:
+
+* ``jax.device_get(...)`` / bare ``device_get(...)``
+* ``<x>.item()`` / ``<x>.tolist()`` / ``<x>.numpy()``
+* ``np.<fn>(...)`` / ``numpy.<fn>(...)`` for any fn outside the
+  metadata whitelist (``dtype``/``shape``/``prod``/``cumsum``/... —
+  calls that only ever touch negotiated shapes, never payload bytes).
+
+Documented crossings stay, suppressed with a cited issue::
+
+    self.host_stages += 1
+    row = jax.device_put(  # graftlint: disable=host-bounce issue=ISSUE-1 -- documented numpy staging point, counted by host_stages
+        np.ascontiguousarray(...), ...)
+
+so the zero-findings baseline *is* the inventory of host crossings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, get_source, iter_py_files
+
+CHECKS = (
+    ("host-bounce",
+     "host transfer (np payload call / .item() / device_get) inside a "
+     "hot-path function"),
+)
+
+CHECK = "host-bounce"
+
+# np.* helpers that only touch metadata (dtypes, shapes, negotiated
+# length vectors), never payload buffers.
+METADATA_OK = frozenset({
+    "dtype", "shape", "ndim", "prod", "issubdtype", "result_type",
+    "cumsum", "iinfo", "finfo", "isscalar",
+})
+
+_BLOCKING_METHODS = frozenset({"item", "tolist", "numpy"})
+
+
+def _flag_calls(src, func_node, func_name) -> List[Finding]:
+    findings = []
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        msg = None
+        if isinstance(f, ast.Name) and f.id == "device_get":
+            msg = "device_get blocks on a device->host transfer"
+        elif isinstance(f, ast.Attribute):
+            if f.attr == "device_get":
+                msg = "device_get blocks on a device->host transfer"
+            elif f.attr in _BLOCKING_METHODS and not node.args:
+                msg = ".%s() forces a device sync + host copy" % f.attr
+            elif (isinstance(f.value, ast.Name)
+                  and f.value.id in ("np", "numpy")
+                  and f.attr not in METADATA_OK):
+                msg = ("np.%s materializes host memory on the payload "
+                       "path" % f.attr)
+        if msg and not src.suppressed(node.lineno, CHECK):
+            findings.append(Finding(
+                src.path, node.lineno, CHECK,
+                "%s in hot-path %s()" % (msg, func_name)))
+    return findings
+
+
+def check_roots(roots) -> List[Finding]:
+    findings: List[Finding] = []
+    for root in roots:
+        for path in iter_py_files(root):
+            src, _errs = get_source(path)
+            if src is None:
+                continue
+            src.checked.add(CHECK)
+            if not src.annotations:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                ann = src.def_annotation(node)
+                if ann is None or "hot-path" not in ann.flags:
+                    continue
+                findings += _flag_calls(src, node, node.name)
+    return findings

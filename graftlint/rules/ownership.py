@@ -1,0 +1,448 @@
+"""Thread-ownership / lock-discipline checker.
+
+Scope: the classes of the engine, multihost, and elastic modules — the
+code where the reference's background-coordination-thread model
+(arXiv:1802.05799 §3) lives in this repo.  The analysis is class-local
+and annotation-driven:
+
+* **Thread contexts.**  A method runs in one or more *contexts*: the
+  name of a thread entry point it is reachable from, ``caller`` (any
+  externally-invoked method), or ``init`` (``__init__``, before any
+  thread exists).  Entry points are methods passed as
+  ``threading.Thread(target=self.X)`` (context named by the Thread's
+  ``name=`` kwarg or the method) or annotated ``# graftlint:
+  thread=<name>`` (for callbacks dispatched by helper servers the class
+  does not spawn itself).  Contexts propagate through ``self.m()``
+  calls and ``self.m`` references to a fixpoint.
+
+* **`ownership-shared`** — an instance attribute written after
+  ``__init__`` and touched from more than one non-init context must
+  carry ``# graftlint: owned-by=<thread>`` or ``guarded-by=<lock>`` on
+  its initialising assignment.  ``owned-by=any`` declares a reviewed,
+  deliberately unsynchronized slot (GIL-atomic monotonic flags).
+
+* **`lock-discipline`** — every post-init write to a ``guarded-by=L``
+  attribute must be lexically inside ``with self.L:`` (or the method
+  must be annotated ``# graftlint: requires-lock=L`` — the
+  caller-holds-the-lock convention).  ``threading.Condition(self.B)``
+  aliases are resolved, so ``with self._wake:`` satisfies
+  ``guarded-by=_lock`` when ``_wake`` wraps ``_lock``.  Reads are NOT
+  checked: the codebase's deliberate racy reads (poison-flag fast
+  paths) are documented at the read site, and flagging them would bury
+  the write-side signal.
+
+* **`owned-by`** — any access to an ``owned-by=T`` attribute from a
+  method whose context set is not within {T, init}.
+
+* **`dispatch-scoped`** — the ``compile_notify`` pattern: a method that
+  assigns an attribute on a *non-self* object and also resets it
+  (``obj.cb = x; ...; obj.cb = None``) is using shared instance state
+  as an implicit call argument; per-dispatch data must be threaded
+  through the call instead (two executors dispatching through one
+  instance would cross their callbacks).
+
+Known limits (deliberate): no cross-class dataflow, no aliased-local
+writes (``rec = self._watched[w]; rec["k"] = v``), no ``.acquire()``
+tracking — ``with`` blocks only.  The rules pay for themselves on the
+annotated hot classes; they are not a proof system.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, SourceFile, get_source
+
+CHECKS = (
+    ("ownership-shared",
+     "mutable attribute shared across thread contexts without "
+     "owned-by/guarded-by annotation"),
+    ("lock-discipline",
+     "write to a guarded-by attribute outside its lock"),
+    ("owned-by", "access to an owned-by attribute from a foreign thread"),
+    ("dispatch-scoped",
+     "per-dispatch state parked on a shared instance (set then reset "
+     "to None in one method)"),
+)
+
+# Container methods that mutate in place; calls through a self attribute
+# count as writes to it.
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "add", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+
+class _Access:
+    __slots__ = ("attr", "line", "held", "is_write")
+
+    def __init__(self, attr, line, held, is_write):
+        self.attr = attr
+        self.line = line
+        self.held = held
+        self.is_write = is_write
+
+
+class _MethodFacts:
+    def __init__(self, name: str):
+        self.name = name
+        self.accesses: List[_Access] = []
+        self.calls: Set[str] = set()
+        # (base local name, attr) -> {"set": line|None, "reset": line|None}
+        self.foreign: Dict[Tuple[str, str], Dict[str, Optional[int]]] = {}
+        self.spawns: List[Tuple[str, Optional[str]]] = []
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Collects attribute accesses with the lexically-held lock set."""
+
+    def __init__(self, facts: _MethodFacts, held0: frozenset):
+        self.facts = facts
+        self.held = held0
+        self._skip_refs: Set[int] = set()
+
+    # -- lock tracking -----------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        added = []
+        for item in node.items:
+            ctx = item.context_expr
+            if (isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == "self"):
+                added.append(ctx.attr)
+                self._skip_refs.add(id(ctx))
+        if added:
+            prev, self.held = self.held, self.held | frozenset(added)
+            self.generic_visit(node)
+            self.held = prev
+        else:
+            self.generic_visit(node)
+
+    # -- nested defs: run later, the definition-site lock is NOT held ------
+
+    def _visit_nested(self, node):
+        prev, self.held = self.held, frozenset()
+        self.generic_visit(node)
+        self.held = prev
+
+    def visit_FunctionDef(self, node):
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_nested(node)
+
+    def visit_Lambda(self, node):
+        self._visit_nested(node)
+
+    # -- writes ------------------------------------------------------------
+
+    def _self_attr(self, node) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _record_target(self, tgt, value=None):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._record_target(el, value)
+            return
+        attr = self._self_attr(tgt)
+        if attr is not None:
+            self.facts.accesses.append(
+                _Access(attr, tgt.lineno, self.held, True))
+            self._skip_refs.add(id(tgt))
+            return
+        if isinstance(tgt, ast.Subscript):
+            attr = self._self_attr(tgt.value)
+            if attr is not None:
+                self.facts.accesses.append(
+                    _Access(attr, tgt.lineno, self.held, True))
+            return
+        # Foreign-instance attribute write: obj.attr = value
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id != "self"):
+            key = (tgt.value.id, tgt.attr)
+            slot = self.facts.foreign.setdefault(
+                key, {"set": None, "reset": None})
+            is_none = (isinstance(value, ast.Constant)
+                       and value.value is None)
+            slot["reset" if is_none else "set"] = tgt.lineno
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._record_target(tgt, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_target(node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_target(node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            t = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+            attr = self._self_attr(t)
+            if attr is not None:
+                self.facts.accesses.append(
+                    _Access(attr, tgt.lineno, self.held, True))
+        self.generic_visit(node)
+
+    # -- calls / thread spawns ---------------------------------------------
+
+    def _is_thread_ctor(self, func) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id == "Thread"
+        return isinstance(func, ast.Attribute) and func.attr == "Thread"
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if self._is_thread_ctor(func):
+            target = None
+            tname = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    m = self._self_attr(kw.value)
+                    if m is not None:
+                        target = m
+                        self._skip_refs.add(id(kw.value))
+                elif kw.arg == "name" and isinstance(kw.value,
+                                                     ast.Constant):
+                    tname = str(kw.value.value)
+            if target is not None:
+                self.facts.spawns.append((target, tname))
+        if isinstance(func, ast.Attribute):
+            base_attr = self._self_attr(func.value)
+            if base_attr is not None and func.attr in MUTATORS:
+                self.facts.accesses.append(
+                    _Access(base_attr, node.lineno, self.held, True))
+            if (isinstance(func.value, ast.Name)
+                    and func.value.id == "self"):
+                self.facts.calls.add(func.attr)
+                self._skip_refs.add(id(func))
+        self.generic_visit(node)
+
+    # -- reads / bare method references ------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if id(node) not in self._skip_refs:
+            attr = self._self_attr(node)
+            if attr is not None:
+                if isinstance(node.ctx, ast.Load):
+                    self.facts.accesses.append(
+                        _Access(attr, node.lineno, self.held, False))
+                    # A bare self.m reference can be a callback: treat
+                    # as a call edge too (resolved against real method
+                    # names later).
+                    self.facts.calls.add(attr)
+        self.generic_visit(node)
+
+
+class _ClassAnalysis:
+    def __init__(self, src: SourceFile, node: ast.ClassDef):
+        self.src = src
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        self.aliases: Dict[str, str] = {}
+        self.facts: Dict[str, _MethodFacts] = {}
+        self.attr_notes: Dict[str, Tuple[str, str, int]] = {}
+        self.findings: List[Finding] = []
+        self._collect()
+
+    # -- collection --------------------------------------------------------
+
+    def _method_annotation(self, m: ast.FunctionDef):
+        return self.src.def_annotation(m)
+
+    def _collect(self):
+        init = self.methods.get("__init__")
+        if init is not None:
+            for stmt in ast.walk(init):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                v = stmt.value
+                if (isinstance(v, ast.Call)
+                        and ((isinstance(v.func, ast.Attribute)
+                              and v.func.attr == "Condition")
+                             or (isinstance(v.func, ast.Name)
+                                 and v.func.id == "Condition"))
+                        and v.args
+                        and isinstance(v.args[0], ast.Attribute)
+                        and isinstance(v.args[0].value, ast.Name)
+                        and v.args[0].value.id == "self"):
+                    for tgt in stmt.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            self.aliases[tgt.attr] = v.args[0].attr
+        for name, m in self.methods.items():
+            facts = _MethodFacts(name)
+            held0 = frozenset()
+            ann = self._method_annotation(m)
+            if ann is not None and "requires-lock" in ann.pairs:
+                held0 = frozenset([ann.pairs["requires-lock"]])
+            vis = _MethodVisitor(facts, held0)
+            for stmt in m.body:
+                vis.visit(stmt)
+            facts.calls &= set(self.methods)
+            self.facts[name] = facts
+        # Attribute annotations: owned-by / guarded-by comments attach
+        # to the self-attribute written on that line.
+        line_writes: Dict[int, Set[str]] = {}
+        for facts in self.facts.values():
+            for acc in facts.accesses:
+                if acc.is_write:
+                    line_writes.setdefault(acc.line, set()).add(acc.attr)
+        for line, ann in self.src.annotations.items():
+            for key in ("owned-by", "guarded-by"):
+                if key not in ann.pairs:
+                    continue
+                attrs = line_writes.get(line)
+                if not attrs:
+                    continue  # other class's line; hygiene pass flags
+                ann.attached = True
+                for attr in attrs:
+                    self.attr_notes[attr] = (key, ann.pairs[key], line)
+
+    # -- contexts ----------------------------------------------------------
+
+    def _contexts(self) -> Dict[str, Set[str]]:
+        ctx: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        entry_names: Set[str] = set()
+        if "__init__" in ctx:
+            ctx["__init__"].add("init")
+        for facts in self.facts.values():
+            for target, tname in facts.spawns:
+                if target in ctx:
+                    ann = self._method_annotation(self.methods[target])
+                    label = (ann.pairs.get("thread") if ann else None) \
+                        or tname or target
+                    ctx[target].add(label)
+                    entry_names.add(target)
+        for name, m in self.methods.items():
+            ann = self._method_annotation(m)
+            if ann is not None and "thread" in ann.pairs:
+                ctx[name].add(ann.pairs["thread"])
+                entry_names.add(name)
+        for name in self.methods:
+            if (name not in entry_names and name != "__init__"
+                    and not name.startswith("__")
+                    and not name.startswith("_")):
+                ctx[name].add("caller")
+        changed = True
+        while changed:
+            changed = False
+            for name, facts in self.facts.items():
+                for callee in facts.calls:
+                    if callee in ctx and not ctx[name] <= ctx[callee]:
+                        ctx[callee] |= ctx[name]
+                        changed = True
+            if not changed:
+                # Private methods reachable from nothing are externally
+                # driven (tests, subclasses): give them caller context
+                # and re-propagate.
+                for name in self.methods:
+                    if not ctx[name] and name != "__init__":
+                        ctx[name].add("caller")
+                        changed = True
+        return ctx
+
+    # -- checks ------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        ctx = self._contexts()
+        has_threads = any(len(c - {"init", "caller"}) > 0
+                          for c in ctx.values())
+        by_attr: Dict[str, List[Tuple[str, _Access]]] = {}
+        for name, facts in self.facts.items():
+            for acc in facts.accesses:
+                by_attr.setdefault(acc.attr, []).append((name, acc))
+        for attr, accesses in sorted(by_attr.items()):
+            note = self.attr_notes.get(attr)
+            post_init_writes = [
+                (m, a) for m, a in accesses
+                if a.is_write and m != "__init__"]
+            if note is None:
+                if not has_threads or not post_init_writes:
+                    continue
+                touched = set()
+                for m, _a in accesses:
+                    touched |= ctx[m] - {"init"}
+                if len(touched) > 1:
+                    m0, a0 = post_init_writes[0]
+                    if not self.src.suppressed(a0.line,
+                                              "ownership-shared"):
+                        self.findings.append(Finding(
+                            self.src.path, a0.line, "ownership-shared",
+                            "%s.%s is written in %s() and touched from "
+                            "threads %s with no owned-by/guarded-by "
+                            "annotation" % (
+                                self.node.name, attr, m0,
+                                sorted(touched))))
+                continue
+            kind, value, _line = note
+            if kind == "guarded-by":
+                lock = self.aliases.get(value, value)
+                for m, a in post_init_writes:
+                    held = {self.aliases.get(h, h) for h in a.held}
+                    if lock not in held and not self.src.suppressed(
+                            a.line, "lock-discipline"):
+                        self.findings.append(Finding(
+                            self.src.path, a.line, "lock-discipline",
+                            "%s.%s is guarded-by=%s but %s() writes it "
+                            "outside 'with self.%s'" % (
+                                self.node.name, attr, value, m, value)))
+            elif kind == "owned-by" and value != "any":
+                for m, a in accesses:
+                    if m == "__init__":
+                        continue
+                    extra = ctx[m] - {"init", value}
+                    if extra and not self.src.suppressed(
+                            a.line, "owned-by"):
+                        self.findings.append(Finding(
+                            self.src.path, a.line, "owned-by",
+                            "%s.%s is owned-by=%s but %s() (threads %s) "
+                            "%s it" % (
+                                self.node.name, attr, value, m,
+                                sorted(ctx[m]),
+                                "writes" if a.is_write else "reads")))
+        # Dispatch-scoped state on foreign instances.
+        for name, facts in self.facts.items():
+            for (base, attr), slot in sorted(facts.foreign.items()):
+                if slot["set"] is not None and slot["reset"] is not None:
+                    line = slot["set"]
+                    if not self.src.suppressed(line, "dispatch-scoped"):
+                        self.findings.append(Finding(
+                            self.src.path, line, "dispatch-scoped",
+                            "%s() parks per-dispatch state on shared "
+                            "instance %r (%s.%s set here, reset to None "
+                            "at line %d); thread it through the call "
+                            "instead" % (name, base, base, attr,
+                                         slot["reset"])))
+        return self.findings
+
+
+def check_files(paths) -> List[Finding]:
+    # Unknown annotation keys/flags are validated by the core hygiene
+    # pass over every scanned file, not here.
+    findings: List[Finding] = []
+    for path in paths:
+        src, _errs = get_source(path)
+        if src is None:
+            continue
+        src.checked.update(c for c, _ in CHECKS)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings += _ClassAnalysis(src, node).run()
+    return findings

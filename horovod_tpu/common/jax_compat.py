@@ -1,0 +1,76 @@
+"""Older-jax API shims, installed by importing this module.
+
+This codebase is written against the vma-era jax API:
+``jax.shard_map(..., check_vma=...)`` and ``jax.lax.axis_size``.  On
+older installs, shard_map either lives under ``jax.experimental`` or,
+if already promoted to the jax module, still spells today's
+``check_vma`` kwarg ``check_rep`` — and ``lax.axis_size`` does not
+exist.  Importing this module aliases translating wrappers onto the
+jax modules so every direct call site works on both API generations.
+
+Imported for its side effect (``# noqa: F401``) by ``ops/xla_ops.py``
+and by every module that uses ``jax.shard_map``/``lax.axis_size``
+without importing the engine (``parallel/*``, ``models/*``,
+``jax/zero.py``): the package ``__init__`` is deliberately lazy, so a
+user importing ``horovod_tpu.parallel.ring_attention`` standalone must
+still get the shims.
+
+Both installs are idempotent (re-import is a no-op), gated on the API
+shape — not on version strings or mere attribute presence: a
+``jax.shard_map`` that exists but lacks ``check_vma`` still needs the
+wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect as _inspect
+
+import jax
+from jax import lax
+
+_shard_map_base = getattr(jax, "shard_map", None)
+if _shard_map_base is None:
+    from jax.experimental.shard_map import shard_map as _shard_map_base
+
+_SM_PARAMS = _inspect.signature(_shard_map_base).parameters
+
+if "check_vma" not in _SM_PARAMS:
+
+    @functools.wraps(_shard_map_base)
+    def _shard_map_vma(*args, **kwargs):
+        had_vma = "check_vma" in kwargs
+        kwargs.pop("check_vma", None)
+        if had_vma and "check_rep" in _SM_PARAMS \
+                and "check_rep" not in kwargs:
+            # Translate a vma-era call: the old replication checker
+            # predates the vma system and false-positives on the
+            # psum-under-custom-spec patterns here (it is a static
+            # lint, not semantics) — disable it rather than emulate.
+            # An explicit caller-passed check_rep is respected: this
+            # wrapper replaces jax.shard_map process-wide, and user
+            # code asking for the checker must keep it.
+            kwargs["check_rep"] = False
+        return _shard_map_base(*args, **kwargs)
+
+    jax.shard_map = _shard_map_vma
+
+if not hasattr(jax, "typeof"):
+    # Same-era compat: ``jax.typeof`` (the value's abstract type, which
+    # vma-aware code probes for a ``.vma`` attribute) was previously
+    # spelled ``jax.core.get_aval``.  The returned aval has no ``vma``
+    # on this generation — call sites already treat that as
+    # "no tracking" via getattr default / try-except.
+    def _typeof_compat(x):
+        return jax.core.get_aval(x)
+
+    jax.typeof = _typeof_compat
+
+if not hasattr(lax, "axis_size"):
+    # Same-era compat: before ``lax.axis_size`` existed, the size of a
+    # mapped axis was spelled ``psum(1, axis)`` (constant-folded, so
+    # this stays static inside jit).
+    def _axis_size_compat(axis_name):
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = _axis_size_compat

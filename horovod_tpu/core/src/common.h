@@ -10,6 +10,27 @@
 #include <string>
 #include <vector>
 
+// Thread-safety annotations — the C++ half of the ownership story the
+// Python-side graftlint rules enforce with `# graftlint: guarded-by=`
+// comments.  Under clang they expand to the real -Wthread-safety
+// analysis attributes; under g++ (the Makefile default) they compile
+// away and serve as checked documentation (`clang++ -Wthread-safety
+// -fsyntax-only src/*.cc` runs the analysis without changing the
+// build).  Names follow the clang/abseil convention so the annotations
+// read familiarly: GUARDED_BY(mu) on data members, EXCLUDES(mu) on
+// functions that acquire mu themselves (callers must NOT hold it),
+// REQUIRES(mu) on functions whose caller must already hold it.
+#if defined(__clang__) && defined(__has_attribute)
+#define HVD_THREAD_ANNOTATION__(x) __attribute__((x))
+#else
+#define HVD_THREAD_ANNOTATION__(x)  // no-op outside clang
+#endif
+#define GUARDED_BY(x) HVD_THREAD_ANNOTATION__(guarded_by(x))
+#define PT_GUARDED_BY(x) HVD_THREAD_ANNOTATION__(pt_guarded_by(x))
+#define REQUIRES(...) \
+  HVD_THREAD_ANNOTATION__(requires_capability(__VA_ARGS__))
+#define EXCLUDES(...) HVD_THREAD_ANNOTATION__(locks_excluded(__VA_ARGS__))
+
 namespace hvdtpu {
 
 enum class StatusType : int32_t {

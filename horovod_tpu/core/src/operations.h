@@ -55,9 +55,10 @@ class CoreState {
 
   // Handle API.
   // status: 0 = pending, 1 = ok, 2 = error.
-  int Poll(int32_t handle);
-  std::shared_ptr<TensorTableEntry> GetEntry(int32_t handle);
-  void Release(int32_t handle);
+  int Poll(int32_t handle) EXCLUDES(handles_mu_);
+  std::shared_ptr<TensorTableEntry> GetEntry(int32_t handle)
+      EXCLUDES(handles_mu_);
+  void Release(int32_t handle) EXCLUDES(handles_mu_);
 
   // External-payload (device collective) protocol: negotiated groups
   // are queued in response order — identical on every rank — for the
@@ -67,9 +68,11 @@ class CoreState {
   // WaitNegotiated blocks up to timeout_ms for a record instead of
   // making the executor poll-sleep (halves eager collective latency:
   // the executor wakes the moment negotiation finishes).
-  int NextNegotiated(uint8_t* buf, int buflen);
-  int WaitNegotiated(uint8_t* buf, int buflen, int timeout_ms);
-  void ExternalDone(int32_t handle, const Status& s);
+  int NextNegotiated(uint8_t* buf, int buflen) EXCLUDES(negotiated_mu_);
+  int WaitNegotiated(uint8_t* buf, int buflen, int timeout_ms)
+      EXCLUDES(negotiated_mu_);
+  void ExternalDone(int32_t handle, const Status& s)
+      EXCLUDES(handles_mu_);
 
   // Device-plane autotune feedback: the multihost executor reports
   // (bytes, seconds-to-completion) per allreduce group, replacing the
@@ -111,15 +114,23 @@ class CoreState {
   bool hierarchical_allgather_ = false;
   std::vector<int32_t> host_of_;  // world rank -> host-group id
 
+  // Handle table: written by enqueueing caller threads and read by
+  // pollers and the external executor's Release path.
   std::mutex handles_mu_;
-  std::map<int32_t, std::shared_ptr<TensorTableEntry>> handles_;
-  int32_t next_handle_ = 0;
-  std::shared_ptr<TensorTableEntry> join_entry_;
+  std::map<int32_t, std::shared_ptr<TensorTableEntry>> handles_
+      GUARDED_BY(handles_mu_);
+  int32_t next_handle_ GUARDED_BY(handles_mu_) = 0;
+  std::shared_ptr<TensorTableEntry> join_entry_ GUARDED_BY(handles_mu_);
 
+  // Negotiated-group mailbox: the background loop pushes response
+  // records, the external (XLA) executor thread pops them — the
+  // Python multihost engine's wait_negotiated blocks on this cv.
   std::mutex negotiated_mu_;
   std::condition_variable negotiated_cv_;
-  std::deque<std::vector<uint8_t>> negotiated_groups_;
-  int PopNegotiatedLocked(uint8_t* buf, int buflen);
+  std::deque<std::vector<uint8_t>> negotiated_groups_
+      GUARDED_BY(negotiated_mu_);
+  int PopNegotiatedLocked(uint8_t* buf, int buflen)
+      REQUIRES(negotiated_mu_);
 
   std::thread background_;
   std::atomic<bool> shutdown_requested_{false};
@@ -133,10 +144,10 @@ class CoreState {
   // during the pause starts the next cycle immediately instead of
   // paying up to a full cycle_time of latency (the dominant fixed cost
   // of a synchronous eager collective).
-  void WakeLoop();
+  void WakeLoop() EXCLUDES(wake_mu_);
   std::mutex wake_mu_;
   std::condition_variable wake_cv_;
-  uint64_t enqueue_seq_ = 0;  // guarded by wake_mu_
+  uint64_t enqueue_seq_ GUARDED_BY(wake_mu_) = 0;
 };
 
 }  // namespace hvdtpu

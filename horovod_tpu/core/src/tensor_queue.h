@@ -38,24 +38,32 @@ struct TensorTableEntry {
   void PublishDone() { done.store(true, std::memory_order_release); }
 };
 
+// Shared between every enqueueing caller thread, the background
+// coordination loop, and the external-payload executor: all access to
+// the table and the new-entries list goes through mu_.  The entries
+// themselves publish completion lock-free (see TensorTableEntry) — the
+// double-shard queue-race diagnostic in operations.cc watches exactly
+// the invariant these annotations state.
 class TensorQueue {
  public:
   // Returns false if a pending tensor with this name already exists
   // (duplicate-name protection, as in the reference).
-  bool Add(std::shared_ptr<TensorTableEntry> entry);
+  bool Add(std::shared_ptr<TensorTableEntry> entry) EXCLUDES(mu_);
   // Requests not yet sent to the coordinator (drains the "new" list).
-  std::vector<Request> DrainNewRequests();
-  std::shared_ptr<TensorTableEntry> Lookup(const std::string& name);
-  void Remove(const std::string& name);
+  std::vector<Request> DrainNewRequests() EXCLUDES(mu_);
+  std::shared_ptr<TensorTableEntry> Lookup(const std::string& name)
+      EXCLUDES(mu_);
+  void Remove(const std::string& name) EXCLUDES(mu_);
   // Fail every pending entry (shutdown / fatal negotiation error).
-  void AbortAll(const Status& reason);
-  std::vector<std::string> PendingNames();
-  size_t size();
+  void AbortAll(const Status& reason) EXCLUDES(mu_);
+  std::vector<std::string> PendingNames() EXCLUDES(mu_);
+  size_t size() EXCLUDES(mu_);
 
  private:
   std::mutex mu_;
-  std::unordered_map<std::string, std::shared_ptr<TensorTableEntry>> table_;
-  std::deque<std::string> new_entries_;
+  std::unordered_map<std::string, std::shared_ptr<TensorTableEntry>>
+      table_ GUARDED_BY(mu_);
+  std::deque<std::string> new_entries_ GUARDED_BY(mu_);
 };
 
 }  // namespace hvdtpu

@@ -58,26 +58,37 @@ class ElasticDriver:
         self._server = MessageServer(self._handle, self._secret)
         self._kv = RendezvousServer(secret=self._secret)
 
+        # World state below is shared between the run() reap loop
+        # ("caller"), the discovery thread, and the message-server
+        # thread (_handle) — every write goes through self._lock (an
+        # RLock: _publish_epoch runs inside _handle_rendezvous's
+        # critical section).
         self._lock = threading.RLock()
-        self._epoch = 0
-        self._target: List[Slot] = []
-        self._ready: set = set()
-        self._published = False
-        self._assignments: Dict[Slot, Dict] = {}
-        self._port_base = 0
-        self._procs: Dict[Slot, safe_shell_exec.ManagedProcess] = {}
-        self._worker_addrs: Dict[Slot, Tuple[str, int]] = {}
-        self._stopped: set = set()       # slots told/forced to stop
-        self._succeeded: set = set()     # slots whose proc exited 0
-        self._spawn_attempts: Dict[Slot, float] = {}  # retry throttle
-        self._pending_spawns: set = set()  # spawn RPC in flight off-lock
+        self._epoch = 0  # graftlint: guarded-by=_lock
+        self._target: List[Slot] = []  # graftlint: guarded-by=_lock
+        self._ready: set = set()  # graftlint: guarded-by=_lock
+        self._published = False  # graftlint: guarded-by=_lock
+        self._assignments: Dict[Slot, Dict] = {}  # graftlint: guarded-by=_lock
+        self._port_base = 0  # graftlint: guarded-by=_lock
+        self._procs: Dict[Slot, safe_shell_exec.ManagedProcess] = {}  # graftlint: guarded-by=_lock
+        self._worker_addrs: Dict[Slot, Tuple[str, int]] = {}  # graftlint: guarded-by=_lock
+        # slots told/forced to stop; slots whose proc exited 0;
+        # per-slot spawn retry throttle; spawn RPCs in flight off-lock.
+        self._stopped: set = set()  # graftlint: guarded-by=_lock
+        self._succeeded: set = set()  # graftlint: guarded-by=_lock
+        self._spawn_attempts: Dict[Slot, float] = {}  # graftlint: guarded-by=_lock
+        self._pending_spawns: set = set()  # graftlint: guarded-by=_lock
         self._shutdown = threading.Event()
-        self._below_min_since: Optional[float] = None
+        self._below_min_since: Optional[float] = None  # graftlint: guarded-by=_lock
+        # Highest epoch a worker has demanded via min_epoch (its world
+        # broke in a way the driver cannot observe); the discovery loop
+        # rebuilds when it passes the current epoch.
+        self._rebuild_wanted = 0  # graftlint: guarded-by=_lock
         self._rc = 0
 
     # -- message service ---------------------------------------------------
 
-    def _handle(self, req: Dict) -> Dict:
+    def _handle(self, req: Dict) -> Dict:  # graftlint: thread=msg-server
         kind = req.get("kind")
         if kind == "register":
             slot = (req["host"], int(req["slot"]))
@@ -106,8 +117,8 @@ class ElasticDriver:
                 # epoch IS the world-change signal — record it; the
                 # discovery loop re-forms the world (same membership
                 # is fine, the new epoch is what re-bootstraps it).
-                self._rebuild_wanted = max(
-                    getattr(self, "_rebuild_wanted", 0), min_epoch)
+                self._rebuild_wanted = max(self._rebuild_wanted,
+                                           min_epoch)
                 return {"status": "wait"}
             if not self._target:
                 # Below min_np: hold workers until discovery refills the
@@ -122,7 +133,7 @@ class ElasticDriver:
                 return dict(self._assignments[slot], status="go")
             return {"status": "wait"}
 
-    def _publish_epoch(self):
+    def _publish_epoch(self):  # graftlint: requires-lock=_lock
         """All target slots checked in: assign ranks and open the world
         (caller holds the lock)."""
         self._kv.reset()
@@ -195,8 +206,7 @@ class ElasticDriver:
                 self._below_min_since = None
             if (new_target == self._target and self._published
                     and all(_alive(s) for s in new_target)
-                    and getattr(self, "_rebuild_wanted", 0)
-                    <= self._epoch):
+                    and self._rebuild_wanted <= self._epoch):
                 return
             self._rebuild_wanted = 0
             self._epoch += 1
@@ -339,7 +349,9 @@ class ElasticDriver:
                 result = HostUpdateResult.NO_UPDATE
             if result != HostUpdateResult.NO_UPDATE:
                 self._recompute_world("discovery update")
-            elif getattr(self, "_rebuild_wanted", 0) > self._epoch:
+            elif self._rebuild_wanted > self._epoch:
+                # Racy read (no lock): a just-raised demand is caught
+                # on the next tick at the latest.
                 self._recompute_world("worker-reported broken world")
             self._shutdown.wait(self.discovery_interval)
 

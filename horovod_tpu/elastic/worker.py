@@ -190,6 +190,11 @@ def install_assignment(info: Dict[str, Any]):
     # must re-init the device plane (jax.distributed + multihost
     # engine) after every re-rendezvous, not silently fall to the TCP
     # plane (r5 fix: this line used to pin "tcp" unconditionally, so
-    # elastic multihost workers never ran device collectives at all).
-    if os.environ.get("HOROVOD_CONTROLLER") != "multihost":
-        os.environ["HOROVOD_CONTROLLER"] = "tcp"
+    # elastic multihost workers never ran device collectives at all —
+    # and until this round it still clobbered every NON-multihost
+    # explicit value).  Default to tcp ONLY when the launcher set
+    # nothing: elastic worlds need a deterministic controller (the
+    # Config default "auto" could diverge across re-spawned workers),
+    # but an explicit value is the launcher's call and must survive
+    # every re-rendezvous.
+    os.environ.setdefault("HOROVOD_CONTROLLER", "tcp")

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common import jax_compat  # noqa: F401 - installs shard_map/axis_size shims
 from ..parallel.moe import MoeConfig, moe_ffn
 from ..parallel.ring_attention import local_attention, ring_attention
 

@@ -129,12 +129,16 @@ class CollectiveEngine:
         self.timeline = timeline
         self._resolve_process_set = process_set_resolver
         self.cache = ExecutableCache(config.cache_capacity)
-        self._collectives: Dict[int, MeshCollectives] = {}
-        self._queue: List[_Entry] = []
+        # Process-set mesh memo: populated lazily from BOTH the caller
+        # plane (enqueue path) and the cycle thread.
+        self._collectives: Dict[int, MeshCollectives] = {}  # graftlint: guarded-by=_lock
+        self._queue: List[_Entry] = []  # graftlint: guarded-by=_lock
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._shutdown = False
-        self._cycle_count = 0
+        # Poison/stop flag: set under the lock so the notify in
+        # shutdown() can't race the cycle thread's wait predicate.
+        self._shutdown = False  # graftlint: guarded-by=_lock
+        self._cycle_count = 0  # graftlint: owned-by=hvd-tpu-cycle
         self.stall_inspector = StallInspector(
             warning_secs=config.stall_warning_secs,
             shutdown_secs=config.stall_shutdown_secs,
@@ -143,7 +147,7 @@ class CollectiveEngine:
         # Ranks marked out-of-data (reference JoinOp): they contribute
         # zeros to allreduces until every rank has joined.  Ordered so
         # finalize can report the LAST rank to join, like the core.
-        self._joined: List[int] = []
+        self._joined: List[int] = []  # graftlint: guarded-by=_lock
         self._thread = threading.Thread(
             target=self._loop, name="hvd-tpu-cycle", daemon=True)
         self._thread.start()
@@ -187,18 +191,23 @@ class CollectiveEngine:
     # -- process-set meshes ------------------------------------------------
 
     def collectives_for(self, process_set_id: int) -> MeshCollectives:
-        mc = self._collectives.get(process_set_id)
-        if mc is None:
-            ranks = self._resolve_process_set(process_set_id)
-            devs = (self.devices if ranks is None
-                    else [self.devices[r] for r in ranks])
-            mc = MeshCollectives(devs, cache=self.cache,
-                                 name="ps%d" % process_set_id)
-            self._collectives[process_set_id] = mc
-        return mc
+        # Reached from the caller plane (enqueue_alltoall sizing) AND
+        # the cycle thread (_run_cycle): memoize under the lock so two
+        # racing first-touches can't build two meshes for one set.
+        with self._lock:
+            mc = self._collectives.get(process_set_id)
+            if mc is None:
+                ranks = self._resolve_process_set(process_set_id)
+                devs = (self.devices if ranks is None
+                        else [self.devices[r] for r in ranks])
+                mc = MeshCollectives(devs, cache=self.cache,
+                                     name="ps%d" % process_set_id)
+                self._collectives[process_set_id] = mc
+            return mc
 
     def invalidate_process_set(self, process_set_id: int):
-        self._collectives.pop(process_set_id, None)
+        with self._lock:
+            self._collectives.pop(process_set_id, None)
 
     # -- enqueue API -------------------------------------------------------
 

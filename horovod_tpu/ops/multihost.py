@@ -71,14 +71,6 @@ def _size_class(n_elems: int, itemsize: int) -> int:
     return -(-n // step) * step
 
 
-def _shard_map():
-    import jax
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
-    return shard_map
-
-
 def _is_device_array(x) -> bool:
     import jax
     return isinstance(x, jax.Array)
@@ -192,11 +184,6 @@ class GlobalMeshCollectives:
         # key -> lowered HLO text, populated when HVD_TPU_DUMP_HLO=1
         # (lets tests assert the real collective ops are emitted).
         self.hlo: Dict[tuple, str] = {}
-        # Invoked after a COLD build+compile completes (set by the
-        # engine around a dispatch): lets the execution watchdog
-        # restart its clock so compile time is never charged to the
-        # watched execution window.
-        self.compile_notify = None
         # Count of host (numpy) stagings — device payloads must never
         # bump this (the device-residency contract, testable).
         self.host_stages = 0
@@ -305,13 +292,19 @@ class GlobalMeshCollectives:
         """This process's row of a P('proc') program output."""
         return garr.addressable_shards[0].data[0]
 
-    def _compiled(self, key, build, example_args=None):
+    def _compiled(self, key, build, example_args=None, notify=None):
+        """``notify`` is the per-dispatch cold-compile callback,
+        threaded through the call chain from the engine's dispatch (it
+        brackets AOT compiles so the execution watchdog never charges
+        compile time to the watched window).  It is an explicit
+        argument, NOT instance state: two executors dispatching through
+        one mesh object must not cross their callbacks."""
         fn = self._fns.lookup(key)
         if fn is None:
             fn = build()
             import os
-            if self.compile_notify is not None:
-                self.compile_notify("begin")
+            if notify is not None:
+                notify("begin")
             try:
                 if example_args is not None:
                     # AOT lower+compile HERE (not lazily at the first
@@ -324,8 +317,8 @@ class GlobalMeshCollectives:
                         self.hlo[key] = lowered.as_text()
                     fn = lowered.compile()
             finally:
-                if self.compile_notify is not None:
-                    self.compile_notify("end")
+                if notify is not None:
+                    notify("end")
             self._fns.put(key, fn)
         return fn
 
@@ -334,22 +327,17 @@ class GlobalMeshCollectives:
         """shard_map + jit with every staged input donated."""
         import jax
         from jax.sharding import PartitionSpec as P
-        sm = _shard_map()
-        kw = {"mesh": mesh if mesh is not None else self.mesh,
-              "in_specs": (in_spec if in_spec is not None
-                           else P("proc"),) * n_args,
-              "out_specs": out_spec}
-        # The static replication checker cannot see through the
+        # The static replication/vma checker cannot see through the
         # axis_index masking / per-process static slicing these
         # programs use; the negotiation contract guarantees consistent
-        # collectives, so disable it (kwarg name varies by version).
-        import inspect
-        params = inspect.signature(sm).parameters
-        if "check_vma" in params:
-            kw["check_vma"] = False
-        elif "check_rep" in params:
-            kw["check_rep"] = False
-        mapped = sm(fn, **kw)
+        # collectives, so disable it.  jax.shard_map is always the
+        # vma-era API here: xla_ops (imported above) installs a
+        # translating shim on older jax.
+        mapped = jax.shard_map(
+            fn, mesh=mesh if mesh is not None else self.mesh,
+            in_specs=(in_spec if in_spec is not None
+                      else P("proc"),) * n_args,
+            out_specs=out_spec, check_vma=False)
         return jax.jit(mapped, donate_argnums=tuple(range(n_args)))
 
     @staticmethod
@@ -386,7 +374,7 @@ class GlobalMeshCollectives:
 
     def fused_allreduce(self, payloads: Sequence, lengths: Sequence[int],
                         dtype, red_op: str = SUM, prescale: float = 1.0,
-                        postscale: float = 1.0) -> List:
+                        postscale: float = 1.0, notify=None) -> List:  # graftlint: hot-path
         """One compiled program reducing a negotiated fusion group.
 
         ``payloads[i]`` is this process's flat contribution for entry i
@@ -414,7 +402,8 @@ class GlobalMeshCollectives:
             # so fused Adasum groups compile the direct multi-input
             # program with one combine per entry.
             return self._fused_allreduce_packed(
-                payloads, lengths, dtype, red_op, prescale, postscale)
+                payloads, lengths, dtype, red_op, prescale, postscale,
+                notify)
         if (len(lengths) == 1 and red_op != ADASUM
                 and self.local_size > 1
                 and (self._hier_mode == "on"
@@ -427,7 +416,7 @@ class GlobalMeshCollectives:
             # change the math (it stays on the one-device plane).
             return [self._hier_allreduce(
                 payloads[0], lengths[0], dtype, red_op, prescale,
-                postscale)]
+                postscale, notify)]
         key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),
                red_op, float(prescale), float(postscale))
         size = self.size
@@ -443,11 +432,11 @@ class GlobalMeshCollectives:
 
         staged = [self._stage(p, (n,), dtype)
                   for p, n in zip(payloads, lengths)]
-        outs = self._compiled(key, build, staged)(*staged)
+        outs = self._compiled(key, build, staged, notify)(*staged)
         return [self._replicated(o) for o in outs]
 
     def _hier_allreduce(self, p, n: int, dtype, red_op, prescale,
-                        postscale):
+                        postscale, notify=None):  # graftlint: hot-path
         """Hierarchical allreduce over the proc x local mesh — the
         reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` (NCCL
         reduce-scatter intra-node + cross-node allreduce + allgather,
@@ -493,10 +482,10 @@ class GlobalMeshCollectives:
                     ).reshape(1, 1, chunk), dev))
         else:
             self.host_stages += 1
-            flat = np.ascontiguousarray(np.asarray(p)).reshape(int(n))
+            flat = np.ascontiguousarray(np.asarray(p)).reshape(int(n))  # graftlint: disable=host-bounce issue=ISSUE-1 -- documented numpy staging point for host-typed payloads, counted by host_stages
             if padded > n:
-                flat = np.concatenate(
-                    [flat, np.zeros((padded - int(n),), np_dtype)])
+                flat = np.concatenate(  # graftlint: disable=host-bounce issue=ISSUE-1 -- pads the already-host-staged payload before device_put
+                    [flat, np.zeros((padded - int(n),), np_dtype)])  # graftlint: disable=host-bounce issue=ISSUE-1 -- zero-pad of the host-staged payload
             for j, dev in enumerate(self.local_devices):
                 rows.append(jax.device_put(
                     flat[j * chunk:(j + 1) * chunk].reshape(1, 1, chunk),
@@ -517,11 +506,12 @@ class GlobalMeshCollectives:
                 fn, 1, P(), mesh=self.mesh2, in_spec=P("proc", "local"))
 
         out = self._replicated(
-            self._compiled(key, build, (garr,))(garr))
+            self._compiled(key, build, (garr,), notify)(garr))
         return out[:int(n)] if padded > n else out
 
     def _fused_allreduce_packed(self, payloads, lengths, dtype, red_op,
-                                prescale, postscale):
+                                prescale, postscale,
+                                notify=None):  # graftlint: hot-path
         """Multi-entry fusion via a bucket-padded flat buffer — the
         reference's fusion buffer (MemcpyInFusionBuffer / 64 MB
         persistent buffer, SURVEY §2.1 row 8) in XLA form.
@@ -540,8 +530,8 @@ class GlobalMeshCollectives:
             [(p, 0, int(n)) for p, n in zip(payloads, lengths)],
             total, bucket, np_dtype)
         out = self.fused_allreduce([flat], [bucket], np_dtype, red_op,
-                                   prescale, postscale)[0]
-        offs = np.concatenate([[0], np.cumsum(lengths)]).astype(int)
+                                   prescale, postscale, notify)[0]
+        offs = np.concatenate([[0], np.cumsum(lengths)]).astype(int)  # graftlint: disable=host-bounce issue=ISSUE-1 -- offsets over negotiated lengths, never payload bytes
         return [out[offs[i]:offs[i] + lengths[i]]
                 for i in range(len(lengths))]
 
@@ -555,7 +545,8 @@ class GlobalMeshCollectives:
         return self.fused_allreduce([local_flat], [n], dtype, red_op,
                                     prescale, postscale)[0]
 
-    def broadcast(self, local, root_idx: int):
+    def broadcast(self, local, root_idx: int,
+                  notify=None):  # graftlint: hot-path
         """Member ``root_idx``'s tensor to every process (masked psum:
         cheaper than an all-gather for size > 2, and explicit HLO).
 
@@ -568,7 +559,7 @@ class GlobalMeshCollectives:
 
         shape = tuple(np.shape(local))
         dtype = np.dtype(local.dtype if hasattr(local, "dtype")
-                         else np.asarray(local).dtype)
+                         else np.asarray(local).dtype)  # graftlint: disable=host-bounce issue=ISSUE-1 -- dtype probe; asarray branch reached only for host-typed inputs
         n = int(np.prod(shape, dtype=np.int64))
         # psum silently promotes bool to int32; ride the wire as uint8
         # and cast back so broadcast preserves every dtype.
@@ -576,7 +567,7 @@ class GlobalMeshCollectives:
         wire = np.dtype(np.uint8) if is_bool else dtype
         if is_bool:
             local = (local.astype(jnp.uint8) if _is_device_array(local)
-                     else np.asarray(local).astype(np.uint8))
+                     else np.asarray(local).astype(np.uint8))  # graftlint: disable=host-bounce issue=ISSUE-1 -- bool wire-cast; np branch reached only for host-typed inputs
         bucket = _size_class(n, wire.itemsize)
         key = ("broadcast", str(wire), int(bucket), int(root_idx))
 
@@ -592,11 +583,12 @@ class GlobalMeshCollectives:
         staged = self._stage_flat_padded([(local, 0, n)], n, bucket,
                                          wire)
         out = self._replicated(
-            self._compiled(key, build, (staged,))(staged))
+            self._compiled(key, build, (staged,), notify)(staged))
         out = out[:n].reshape(shape) if bucket > n else out.reshape(shape)
         return out.astype(jnp.bool_) if is_bool else out
 
-    def allgather(self, local, rows_per_member: Sequence[int]):
+    def allgather(self, local, rows_per_member: Sequence[int],
+                  notify=None):  # graftlint: hot-path
         """Concat dim-0-ragged per-process tensors (reference
         AllgatherOp): each member's contribution flattens into a
         power-of-two bucket, one ``lax.all_gather`` moves the buckets,
@@ -612,7 +604,7 @@ class GlobalMeshCollectives:
         trailing = tuple(np.shape(local))[1:]
         telems = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
         dtype = np.dtype(local.dtype if hasattr(local, "dtype")
-                         else np.asarray(local).dtype)
+                         else np.asarray(local).dtype)  # graftlint: disable=host-bounce issue=ISSUE-1 -- dtype probe; asarray branch reached only for host-typed inputs
         lens = [r * telems for r in rows]
         if not lens or max(lens) == 0:
             with jax.default_device(self.device):
@@ -630,13 +622,15 @@ class GlobalMeshCollectives:
         my_len = lens[self.my_idx]
         staged = self._stage_flat_padded([(local, 0, my_len)], my_len,
                                          bucket, dtype)
-        g = self._replicated(self._compiled(key, build, (staged,))(staged))
+        g = self._replicated(
+            self._compiled(key, build, (staged,), notify)(staged))
         parts = [g[m, :lens[m]].reshape((rows[m],) + trailing)
                  for m in range(size) if rows[m]]
         return (jnp.concatenate(parts, axis=0) if len(parts) > 1
                 else parts[0])
 
-    def alltoall(self, local, splits_matrix: np.ndarray):
+    def alltoall(self, local, splits_matrix: np.ndarray,
+                 notify=None):  # graftlint: hot-path
         """Member-major splits matrix routing (reference AlltoallOp) as
         real ``lax.all_to_all`` HLO: each send segment is padded to the
         matrix max so every exchange block is uniform, one all-to-all
@@ -646,11 +640,11 @@ class GlobalMeshCollectives:
         import jax
         import jax.numpy as jnp
 
-        sm = np.asarray(splits_matrix).reshape(self.size, self.size)
+        sm = np.asarray(splits_matrix).reshape(self.size, self.size)  # graftlint: disable=host-bounce issue=ISSUE-1 -- negotiated splits matrix (control metadata), never payload bytes
         trailing = tuple(np.shape(local))[1:]
         telems = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
         dtype = np.dtype(local.dtype if hasattr(local, "dtype")
-                         else np.asarray(local).dtype)
+                         else np.asarray(local).dtype)  # graftlint: disable=host-bounce issue=ISSUE-1 -- dtype probe; asarray branch reached only for host-typed inputs
         size = self.size
         c = int(sm.max()) if sm.size else 0
         recv_splits = [int(sm[j, self.my_idx]) for j in range(size)]
@@ -665,7 +659,7 @@ class GlobalMeshCollectives:
         block = _size_class(c * telems, dtype.itemsize)
         key = ("alltoall", str(dtype), int(block))
         my_idx = self.my_idx
-        offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)
+        offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)  # graftlint: disable=host-bounce issue=ISSUE-1 -- offsets over the negotiated splits row, never payload bytes
 
         def build():
             def fn(x):
@@ -686,7 +680,8 @@ class GlobalMeshCollectives:
                 segments.append((None, 0, block - seg_elems))
         staged = self._stage_flat_padded(segments, size * block,
                                          size * block, dtype)
-        w = self._my_row(self._compiled(key, build, (staged,))(staged))
+        w = self._my_row(
+            self._compiled(key, build, (staged,), notify)(staged))
         parts = [w[j * block:j * block + recv_splits[j] * telems]
                  .reshape((recv_splits[j],) + trailing)
                  for j in range(size) if recv_splits[j]]
@@ -697,7 +692,8 @@ class GlobalMeshCollectives:
                else parts[0])
         return out, recv_splits
 
-    def reducescatter(self, local, red_op: str = SUM):
+    def reducescatter(self, local, red_op: str = SUM,
+                      notify=None):  # graftlint: hot-path
         """Reduce then scatter dim-0 shards as real ``psum_scatter``
         HLO (uneven chunks follow the reference's earlier-ranks-larger
         split: each chunk is padded to the largest inside the program,
@@ -707,7 +703,7 @@ class GlobalMeshCollectives:
 
         shape = tuple(np.shape(local))
         dtype = np.dtype(local.dtype if hasattr(local, "dtype")
-                         else np.asarray(local).dtype)
+                         else np.asarray(local).dtype)  # graftlint: disable=host-bounce issue=ISSUE-1 -- dtype probe; asarray branch reached only for host-typed inputs
         d0 = shape[0]
         trailing = shape[1:]
         telems = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
@@ -755,7 +751,8 @@ class GlobalMeshCollectives:
                 segments.append((None, 0, seg - n_m))
         staged = self._stage_flat_padded(segments, size * seg,
                                          size * seg, dtype)
-        out = self._my_row(self._compiled(key, build, (staged,))(staged))
+        out = self._my_row(
+            self._compiled(key, build, (staged,), notify)(staged))
         my_n = rows[my_idx] * telems
         return out[:my_n].reshape((rows[my_idx],) + trailing)
 
@@ -776,11 +773,16 @@ class MultihostEngine:
         self.config = config
         self.timeline = timeline
         self._resolve_process_set = process_set_resolver
-        self._collectives: Dict[int, GlobalMeshCollectives] = {}
+        # Process-set mesh memo: reached from the caller plane
+        # (enqueue_alltoall sizing) and the executor thread.
+        self._collectives: Dict[int, GlobalMeshCollectives] = {}  # graftlint: guarded-by=_lock
         self._lock = threading.Lock()
         # core handle -> (py handle, local payload ndarray, orig shape)
-        self._pending: Dict[int, tuple] = {}
-        self._shutdown = False
+        self._pending: Dict[int, tuple] = {}  # graftlint: guarded-by=_lock
+        # Monotonic False->True poison flag, read racily by the drain /
+        # watchdog loops as their while-predicate (GIL-atomic; a late
+        # read costs one extra bounded wait, never a hang).
+        self._shutdown = False  # graftlint: owned-by=any
         # Two-stage pipeline (the reference's background loop negotiates
         # cycle N+1 while N's NCCL kernels run async, SURVEY §3.2): the
         # drain thread only stages + dispatches compiled programs (XLA
@@ -796,14 +798,14 @@ class MultihostEngine:
         # overlapped on device.  Only the drain thread touches it.
         self._depth = max(1, int(getattr(config, "max_inflight_groups",
                                          4)))
-        self._inflight_outs: List = []
+        self._inflight_outs: List = []  # graftlint: owned-by=hvd-tpu-multihost-exec
         self._done_q: "queue_mod.Queue" = queue_mod.Queue(
             maxsize=self._depth)
         # Groups routed through the completion thread and not yet
         # finished (guarded by _lock): the drain thread completes a
         # device-only group inline ONLY when this is zero, so handle
         # resolution order always follows negotiation order.
-        self._host_inflight = 0
+        self._host_inflight = 0  # graftlint: guarded-by=_lock
         # Execution-phase watchdog (the device-plane analog of the
         # stall inspector): dispatched groups register here; a group
         # that outlives stall_warning_secs logs a warning, and — when
@@ -813,11 +815,13 @@ class MultihostEngine:
         # negotiation leaves the runtime wedged; callers must not hang
         # with it).
         self._watch_lock = threading.Lock()
-        self._watched: Dict[int, dict] = {}
-        self._killed_wids: set = set()
-        self._watch_seq = 0
-        self._last_progress = time.monotonic()
-        self._failed: Optional[Exception] = None
+        self._watched: Dict[int, dict] = {}  # graftlint: guarded-by=_watch_lock
+        self._killed_wids: set = set()  # graftlint: guarded-by=_watch_lock
+        self._watch_seq = 0  # graftlint: guarded-by=_watch_lock
+        self._last_progress = time.monotonic()  # graftlint: guarded-by=_watch_lock
+        # Set under _lock so the poison is atomic with the pending-map
+        # sweep; read racily as a fast-path check (reads unchecked).
+        self._failed: Optional[Exception] = None  # graftlint: guarded-by=_lock
         # HOROVOD_STALL_CHECK_DISABLE silences the warning path here
         # exactly like the negotiation-phase inspector; the explicit
         # timeout knob remains a separate opt-in.
@@ -843,15 +847,22 @@ class MultihostEngine:
     # -- process-set meshes ------------------------------------------------
 
     def collectives_for(self, process_set_id: int) -> GlobalMeshCollectives:
-        mc = self._collectives.get(process_set_id)
-        if mc is None:
-            ranks = self._resolve_process_set(process_set_id)
-            mc = GlobalMeshCollectives(ranks, name="ps%d" % process_set_id)
-            self._collectives[process_set_id] = mc
-        return mc
+        # Reached from the caller plane (enqueue_alltoall sizing) AND
+        # the executor thread (_execute): memoize under the lock so two
+        # racing first-touches can't build two global meshes (and two
+        # compiled-program caches) for one set.
+        with self._lock:
+            mc = self._collectives.get(process_set_id)
+            if mc is None:
+                ranks = self._resolve_process_set(process_set_id)
+                mc = GlobalMeshCollectives(
+                    ranks, name="ps%d" % process_set_id)
+                self._collectives[process_set_id] = mc
+            return mc
 
     def invalidate_process_set(self, process_set_id: int):
-        self._collectives.pop(process_set_id, None)
+        with self._lock:
+            self._collectives.pop(process_set_id, None)
 
     # -- enqueue API (per-rank tensor semantics) ---------------------------
 
@@ -1008,18 +1019,17 @@ class MultihostEngine:
                 items = [(w, r) for w, r in self._watched.items()
                          if w not in self._killed_wids]
                 idle = now - self._last_progress
-                compiling = any(r.get("compiling") for r in
-                                self._watched.values())
-            if compiling:
-                # The executor thread is mid-compile (local, always
-                # terminates): hold fire for this tick, but KEEP the
-                # strike count — recurring cold compiles must pause
-                # evaluation, not reset it, or a workload that keeps
-                # compiling new shapes could postpone detection of a
-                # genuinely wedged group forever.
-                continue
             fired = False
             for wid, rec in items:
+                if rec.get("compiling"):
+                    # THIS record's own dispatch is mid-compile (local
+                    # work, always terminates; its clock restarts at
+                    # compile end) — don't charge compile time to its
+                    # watched window.  Only the compiling record is
+                    # skipped: a workload that keeps cold-compiling new
+                    # shapes must not defer detection of an UNRELATED
+                    # group that wedged after its own dispatch.
+                    continue
                 age = now - rec["start"]
                 if (self._exec_warn and age > self._exec_warn
                         and not rec["warned"]):
@@ -1108,11 +1118,15 @@ class MultihostEngine:
         # Register BEFORE dispatch — on worlds where the compiled call
         # itself blocks until peers join (CPU gloo), a wedged dispatch
         # must already be watched.  Cold compiles run AOT inside
-        # _compiled and report back via compile_notify, which restarts
-        # this group's clock: compile time (local, legitimately long)
-        # is never charged to the watched execution window.
+        # _compiled and report back via the per-dispatch ``notify``
+        # callback, which restarts THIS group's clock: compile time
+        # (local, legitimately long) is never charged to the watched
+        # execution window.  The callback is threaded through the
+        # dispatch call — never parked on the shared mesh object, where
+        # a second executor would cross callbacks (graftlint
+        # dispatch-scoped).
         wid = self._watch_register(g, names, taken, entries)
-        mc.compile_notify = lambda phase: self._watch_compile(wid, phase)
+        notify = lambda phase: self._watch_compile(wid, phase)  # noqa: E731
         try:
             # Per-tensor timeline span (reference: the EXEC_* phases the
             # native executors record) + an xprof TraceAnnotation so the
@@ -1123,13 +1137,11 @@ class MultihostEngine:
             with jax.profiler.TraceAnnotation(
                     "hvd.mh.%s[%d]" % (g["op_type"], len(entries))):
                 finalize, needs_host, rep = self._dispatch_group(
-                    g, mc, taken)
+                    g, mc, taken, notify)
         except Exception as exc:  # noqa: BLE001
             if not self._watch_clear(wid):
                 self._complete_error(g, names, taken, entries, exc)
             return
-        finally:
-            mc.compile_notify = None
         with self._lock:
             route_q = needs_host or self._host_inflight > 0
             if route_q:
@@ -1269,14 +1281,17 @@ class MultihostEngine:
         return host.reshape(shape) if shape is not None else host
 
     def _dispatch_group(self, g: dict, mc: GlobalMeshCollectives,
-                        taken: List[tuple]):
+                        taken: List[tuple],
+                        notify=None):  # graftlint: hot-path
         """Issue the group's compiled collective (async XLA dispatch)
         and return ``(finalize, needs_host, rep)``: a finalize() ->
         results closure, whether it blocks on a host fetch (numpy-typed
         entries), and one representative output array of the dispatched
         program (for the drain thread's pipeline-depth window).
         Blocking finalizes run only on the completion thread;
-        device-resident ones may complete inline."""
+        device-resident ones may complete inline.  ``notify`` is this
+        dispatch's cold-compile bracket, threaded down to
+        ``mc._compiled``."""
         op = g["op_type"]
         dtype = g["dtype"]
         if op == "allreduce":
@@ -1296,7 +1311,7 @@ class MultihostEngine:
             lengths = [int(n) for n in g["aux_sizes"]]
             outs = mc.fused_allreduce(
                 [arr for _, arr in taken], lengths, dtype,
-                g["red_op"], g["prescale"], g["postscale"])
+                g["red_op"], g["prescale"], g["postscale"], notify)
             needs_host = any(arr is None or not _is_device_array(arr)
                              for _, arr in taken)
 
@@ -1308,7 +1323,7 @@ class MultihostEngine:
                 import jax.numpy as jnp
                 to_host = [i for i, (_, arr) in enumerate(taken)
                            if arr is None or not _is_device_array(arr)]
-                fetched = dict(zip(to_host, jax.device_get(
+                fetched = dict(zip(to_host, jax.device_get(  # graftlint: disable=host-bounce issue=ISSUE-1 -- THE documented batched fetch for numpy-typed entries; runs on the completion thread only
                     [outs[i] for i in to_host]))) if to_host else {}
                 results = []
                 for i, ((py, arr), out, ln) in enumerate(
@@ -1316,7 +1331,7 @@ class MultihostEngine:
                     shape = arr.shape if arr is not None else (ln,)
                     if i in fetched:
                         results.append(
-                            np.asarray(fetched[i]).reshape(shape))
+                            np.asarray(fetched[i]).reshape(shape))  # graftlint: disable=host-bounce issue=ISSUE-1 -- reshape of already-fetched host data, no device sync
                     else:
                         results.append(jnp.reshape(out, shape))
                 return results
@@ -1324,7 +1339,7 @@ class MultihostEngine:
         (py, arr) = taken[0]
         needs_host = arr is None or not _is_device_array(arr)
         if op == "allgather":
-            out = mc.allgather(arr, g["aux_sizes"])
+            out = mc.allgather(arr, g["aux_sizes"], notify)
             return (lambda: [self._match(out, arr)]), needs_host, out
         if op == "broadcast":
             # root_rank is a GLOBAL rank; map to member index.
@@ -1332,14 +1347,15 @@ class MultihostEngine:
             members = ranks if ranks is not None else list(
                 range(mc.size))
             root_idx = members.index(g["root_rank"])
-            out = mc.broadcast(arr, root_idx)
+            out = mc.broadcast(arr, root_idx, notify)
             return (lambda: [self._match(out, arr)]), needs_host, out
         if op == "alltoall":
-            out, recv = mc.alltoall(arr, np.asarray(g["aux_sizes"]))
+            out, recv = mc.alltoall(arr, np.asarray(g["aux_sizes"]),  # graftlint: disable=host-bounce issue=ISSUE-1 -- negotiated splits metadata, never payload bytes
+                                    notify)
             return ((lambda: [(self._match(out, arr), recv)]),
                     needs_host, out)
         if op == "reducescatter":
-            out = mc.reducescatter(arr, g["red_op"])
+            out = mc.reducescatter(arr, g["red_op"], notify)
             return (lambda: [self._match(out, arr)]), needs_host, out
         raise NotImplementedError("multihost op %r" % op)
 

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..common import jax_compat  # noqa: F401 - installs jax.typeof shim
+
 _NEG_INF = -1e30
 
 

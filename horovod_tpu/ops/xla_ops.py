@@ -17,7 +17,6 @@ device holds exactly its own contribution, then runs the collective.
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -25,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common import jax_compat  # noqa: F401 - installs older-jax shims
 
 from .executable_cache import ExecutableCache
 

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common import jax_compat  # noqa: F401 - installs lax.axis_size shim
+
 NEG_INF = -1e30
 
 

@@ -1,0 +1,18 @@
+"""FIXTURE (clean): one read per key, every key documented."""
+import os
+
+
+def _env(name, default=None):
+    v = os.environ.get("HVD_TPU_" + name)
+    if v is None:
+        v = os.environ.get("HOROVOD_" + name)
+    return default if v is None else v
+
+
+def _env_float(name, default):
+    v = _env(name)
+    return float(v) if v is not None else default
+
+
+FUSION = _env("FUSION_THRESHOLD", "64")
+CYCLE = _env_float("CYCLE_TIME", 5.0)

@@ -1,0 +1,4 @@
+"""FIXTURE: direct read, string default."""
+import os
+
+TIMEOUT = os.environ.get("HOROVOD_PING_TIMEOUT", "600")

@@ -1,0 +1,5 @@
+"""FIXTURE (clean): same key, numerically identical default ("600" vs
+600) — the comparison is numeric, not textual."""
+import os
+
+TIMEOUT = int(os.environ.get("HOROVOD_PING_TIMEOUT", 600))

@@ -1,0 +1,14 @@
+"""FIXTURE (flags env-undocumented + env-duplicate-read)."""
+import os
+
+
+def _env(name, default=None):
+    v = os.environ.get("HVD_TPU_" + name)
+    if v is None:
+        v = os.environ.get("HOROVOD_" + name)
+    return default if v is None else v
+
+
+FUSION = _env("FUSION_THRESHOLD", "64")
+GHOST = _env("GHOST_KNOB")                  # documented nowhere
+FUSION_AGAIN = _env("FUSION_THRESHOLD", "128")  # second read, new default

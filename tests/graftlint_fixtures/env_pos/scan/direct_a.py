@@ -1,0 +1,4 @@
+"""FIXTURE: bootstrap-path direct read, default 600."""
+import os
+
+TIMEOUT = os.environ.get("HOROVOD_PING_TIMEOUT", "600")

@@ -1,0 +1,5 @@
+"""FIXTURE (flags env-default-conflict): same key, contradictory
+default."""
+import os
+
+TIMEOUT = os.environ.get("HOROVOD_PING_TIMEOUT", "900")

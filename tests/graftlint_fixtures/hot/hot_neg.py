@@ -1,0 +1,16 @@
+"""FIXTURE (clean): metadata-whitelisted np calls, a documented
+crossing with a cited suppression, and host calls outside any
+hot-path annotation."""
+import numpy as np
+
+
+def shape_math(lengths):  # graftlint: hot-path
+    return int(np.prod(lengths, dtype=np.int64))
+
+
+def staged(payload):  # graftlint: hot-path
+    return np.asarray(payload)  # graftlint: disable=host-bounce issue=GL-1 -- documented staging point, counted by host_stages
+
+
+def cold_path(payload):
+    return np.asarray(payload)  # not annotated: out of scope

@@ -1,0 +1,19 @@
+"""FIXTURE (flags host-bounce): payload np call, .item(), and
+device_get inside hot-path functions (nested closure included)."""
+import numpy as np
+
+
+def stage(payload):  # graftlint: hot-path
+    return np.asarray(payload)
+
+
+def fetch(x):  # graftlint: hot-path
+    return x.item()
+
+
+def dispatch(outs):  # graftlint: hot-path
+    import jax
+
+    def finalize():
+        return jax.device_get(outs)
+    return finalize

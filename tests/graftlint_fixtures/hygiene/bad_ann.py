@@ -1,0 +1,9 @@
+"""FIXTURE (flags bad-annotation twice): a typo'd annotation key and
+an ownership annotation attached to no self-attribute write."""
+
+FLAG = True  # graftlint: guarded-by=_lock
+
+
+class C:
+    def __init__(self):
+        self.x = 1  # graftlint: gurded-by=_lock

@@ -1,0 +1,8 @@
+"""FIXTURE (flags bad-suppression): suppression without an issue
+citation — silencing a finding without a tracker entry is itself a
+finding."""
+import numpy as np
+
+
+def stage(p):  # graftlint: hot-path
+    return np.asarray(p)  # graftlint: disable=host-bounce -- a reason but no issue ref

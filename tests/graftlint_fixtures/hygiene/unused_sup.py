@@ -1,0 +1,8 @@
+"""FIXTURE (flags unused-suppression): the suppressed check matches
+nothing on the line (np.prod is metadata-whitelisted)."""
+import numpy as np
+
+
+def ok(lengths):  # graftlint: hot-path
+    n = int(np.prod(lengths))  # graftlint: disable=host-bounce issue=GL-2 -- nothing here to suppress
+    return n

@@ -1,0 +1,10 @@
+"""FIXTURE (clean): the per-dispatch callback threads through the call
+instead of riding shared instance state."""
+
+
+class Engine:
+    def _execute(self, mc, wid):
+        mc.dispatch(notify=lambda phase: self._watch_compile(wid, phase))
+
+    def _watch_compile(self, wid, phase):
+        pass

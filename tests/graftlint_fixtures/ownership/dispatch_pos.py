@@ -1,0 +1,17 @@
+"""FIXTURE (flags dispatch-scoped): the reverted ``compile_notify``
+pattern from ops/multihost.py — per-dispatch callback parked on the
+shared mesh object and reset after the call.  If the real fix is ever
+reverted, the live tree reproduces exactly this shape and the
+zero-findings baseline test fails."""
+
+
+class Engine:
+    def _execute(self, mc, wid):
+        mc.compile_notify = lambda phase: self._watch_compile(wid, phase)
+        try:
+            mc.dispatch()
+        finally:
+            mc.compile_notify = None
+
+    def _watch_compile(self, wid, phase):
+        pass

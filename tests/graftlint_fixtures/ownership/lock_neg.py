@@ -1,0 +1,18 @@
+"""FIXTURE (clean): guarded writes via the Condition alias and the
+requires-lock (caller-holds-it) convention."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._n = 0  # graftlint: guarded-by=_lock
+        threading.Thread(target=self._tick, name="ticker").start()
+
+    def _tick(self):
+        with self._wake:  # Condition wrapping _lock satisfies the guard
+            self._n += 1
+
+    def bump_locked(self):  # graftlint: requires-lock=_lock
+        self._n += 1

@@ -1,0 +1,17 @@
+"""FIXTURE (flags lock-discipline): ``_n`` is guarded-by=_lock but the
+ticker thread writes it outside ``with self._lock``."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # graftlint: guarded-by=_lock
+        threading.Thread(target=self._tick, name="ticker").start()
+
+    def _tick(self):
+        self._n += 1
+
+    def bump(self):
+        with self._lock:
+            self._n += 1

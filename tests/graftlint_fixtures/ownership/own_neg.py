@@ -1,0 +1,19 @@
+"""FIXTURE (clean): same sharing as own_pos but annotated guarded-by
+and every write under the lock."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0  # graftlint: guarded-by=_lock
+        self._thread = threading.Thread(target=self._loop, name="worker")
+        self._thread.start()
+
+    def _loop(self):
+        with self._lock:
+            self._state = 1
+
+    def poke(self):
+        with self._lock:
+            self._state = 2

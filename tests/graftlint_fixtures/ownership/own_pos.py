@@ -1,0 +1,17 @@
+"""FIXTURE (flags ownership-shared): ``_state`` is written after
+__init__ and touched from two thread contexts with no annotation."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0
+        self._thread = threading.Thread(target=self._loop, name="worker")
+        self._thread.start()
+
+    def _loop(self):
+        self._state = 1
+
+    def poke(self):
+        self._state = 2

@@ -1,0 +1,12 @@
+"""FIXTURE (clean): the owned attribute is touched only by its owner
+thread (and __init__)."""
+import threading
+
+
+class Loop:
+    def __init__(self):
+        self._beat = 0  # graftlint: owned-by=pulse
+        threading.Thread(target=self._run, name="pulse").start()
+
+    def _run(self):
+        self._beat += 1

@@ -1,0 +1,15 @@
+"""FIXTURE (flags owned-by): ``_beat`` is owned by the pulse thread but
+a caller-facing method reads it."""
+import threading
+
+
+class Loop:
+    def __init__(self):
+        self._beat = 0  # graftlint: owned-by=pulse
+        threading.Thread(target=self._run, name="pulse").start()
+
+    def _run(self):
+        self._beat += 1
+
+    def read_beat(self):
+        return self._beat

@@ -1,0 +1,202 @@
+"""graftlint self-tests: the real-tree zero-findings baseline (this is
+the tier-1 gate the CI line mirrors) plus positive/negative fixtures
+per rule under ``tests/graftlint_fixtures/``.
+
+The fixture configs aim every rule at the fixture tree via
+``LintConfig`` overrides, so these tests are hermetic: they neither
+depend on nor mutate the live annotations.
+"""
+
+import os
+import subprocess
+import sys
+
+from graftlint.core import LintConfig, run_paths
+from graftlint.rules import ALL_CHECKS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "graftlint_fixtures")
+
+
+def _checks(findings):
+    return [f.check for f in findings]
+
+
+def _fmt(findings):
+    return "\n".join(f.render(REPO) for f in findings)
+
+
+def _ownership_cfg(*names):
+    """Config aiming ONLY the ownership rule at fixture files."""
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=tuple(os.path.join("ownership", n) for n in names),
+        config_file="absent/config.py", doc_files=(),
+        env_scan_root="absent", hot_path_roots=())
+
+
+def _run_ownership(*names):
+    cfg = _ownership_cfg(*names)
+    return run_paths([os.path.join(FIX, "ownership", n) for n in names],
+                     cfg)
+
+
+# -- the baseline gate -----------------------------------------------------
+
+def test_real_tree_zero_findings():
+    """The acceptance bar: the live tree lints clean.  Reverting the
+    compile_notify fix (or any annotated invariant) fails THIS test —
+    dispatch_pos.py mirrors the exact reverted shape the ownership
+    rule would flag."""
+    findings = run_paths([os.path.join(REPO, "horovod_tpu")],
+                         LintConfig(repo_root=REPO))
+    assert findings == [], "graftlint must be clean on the real tree:\n" \
+        + _fmt(findings)
+
+
+def test_cli_exits_zero_on_real_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "graftlint"], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_lists_every_rule():
+    proc = subprocess.run(
+        [sys.executable, "-m", "graftlint", "--list-rules"], cwd=REPO,
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for check, _desc in ALL_CHECKS:
+        assert check in proc.stdout
+
+
+# -- ownership / lock discipline -------------------------------------------
+
+def test_ownership_shared_flags_unannotated_shared_attr():
+    findings = _run_ownership("own_pos.py")
+    assert "ownership-shared" in _checks(findings), _fmt(findings)
+
+
+def test_ownership_shared_passes_annotated_locked_attr():
+    assert _run_ownership("own_neg.py") == []
+
+
+def test_lock_discipline_flags_unlocked_write():
+    findings = _run_ownership("lock_pos.py")
+    assert _checks(findings) == ["lock-discipline"], _fmt(findings)
+
+
+def test_lock_discipline_accepts_condition_alias_and_requires_lock():
+    assert _run_ownership("lock_neg.py") == []
+
+
+def test_owned_by_flags_foreign_thread_read():
+    findings = _run_ownership("owned_pos.py")
+    assert "owned-by" in _checks(findings), _fmt(findings)
+
+
+def test_owned_by_passes_owner_only_access():
+    assert _run_ownership("owned_neg.py") == []
+
+
+def test_dispatch_scoped_flags_reverted_compile_notify_pattern():
+    """dispatch_pos.py is the compile_notify revert, verbatim in shape:
+    per-dispatch callback parked on the shared mesh object."""
+    findings = _run_ownership("dispatch_pos.py")
+    assert _checks(findings) == ["dispatch-scoped"], _fmt(findings)
+    assert "compile_notify" in findings[0].message
+
+
+def test_dispatch_scoped_passes_threaded_callback():
+    assert _run_ownership("dispatch_neg.py") == []
+
+
+# -- env drift -------------------------------------------------------------
+
+def _env_cfg(which):
+    root = os.path.join(FIX, which)
+    return root, LintConfig(
+        repo_root=root, ownership_files=(), config_file="config.py",
+        doc_files=("docs.md",), env_scan_root="scan", hot_path_roots=())
+
+
+def test_env_drift_flags_undocumented_duplicate_and_conflict():
+    root, cfg = _env_cfg("env_pos")
+    checks = _checks(run_paths([root], cfg))
+    assert "env-undocumented" in checks      # GHOST_KNOB
+    assert "env-duplicate-read" in checks    # FUSION_THRESHOLD twice
+    assert "env-default-conflict" in checks  # PING_TIMEOUT 600 vs 900
+
+
+def test_env_drift_passes_documented_single_reads():
+    root, cfg = _env_cfg("env_neg")
+    findings = run_paths([root], cfg)
+    # "600" (str) vs 600 (int) must compare numerically equal, and the
+    # HVD_TPU_ alias form counts as documentation.
+    assert findings == [], _fmt(findings)
+
+
+# -- host bounce -----------------------------------------------------------
+
+def _hot_cfg(name):
+    return LintConfig(
+        repo_root=FIX, ownership_files=(), config_file="absent/config.py",
+        doc_files=(), env_scan_root="absent",
+        hot_path_roots=(os.path.join("hot", name),))
+
+
+def test_host_bounce_flags_np_item_and_device_get():
+    findings = run_paths([os.path.join(FIX, "hot", "hot_pos.py")],
+                         _hot_cfg("hot_pos.py"))
+    assert _checks(findings) == ["host-bounce"] * 3, _fmt(findings)
+
+
+def test_host_bounce_passes_metadata_and_cited_suppression():
+    findings = run_paths([os.path.join(FIX, "hot", "hot_neg.py")],
+                         _hot_cfg("hot_neg.py"))
+    assert findings == [], _fmt(findings)
+
+
+# -- suppression / annotation hygiene --------------------------------------
+
+def _hygiene_cfg(name, ownership=False):
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=((os.path.join("hygiene", name),)
+                         if ownership else ()),
+        config_file="absent/config.py", doc_files=(),
+        env_scan_root="absent",
+        hot_path_roots=(() if ownership
+                        else (os.path.join("hygiene", name),)))
+
+
+def test_suppression_without_issue_is_a_finding():
+    findings = run_paths([os.path.join(FIX, "hygiene", "bad_sup.py")],
+                         _hygiene_cfg("bad_sup.py"))
+    checks = _checks(findings)
+    assert "bad-suppression" in checks, _fmt(findings)
+    # The uncited suppression still silences host-bounce on its line;
+    # what remains is the citation violation itself.
+    assert "host-bounce" not in checks
+
+
+def test_unused_suppression_is_a_finding():
+    findings = run_paths([os.path.join(FIX, "hygiene", "unused_sup.py")],
+                         _hygiene_cfg("unused_sup.py"))
+    assert _checks(findings) == ["unused-suppression"], _fmt(findings)
+
+
+def test_unknown_key_and_dangling_annotation_are_findings():
+    findings = run_paths([os.path.join(FIX, "hygiene", "bad_ann.py")],
+                         _hygiene_cfg("bad_ann.py", ownership=True))
+    checks = _checks(findings)
+    assert checks.count("bad-annotation") == 2, _fmt(findings)
+
+
+def test_scoped_run_does_not_flag_out_of_scope_suppressions():
+    """A narrowed run (only the ownership fixtures) must not call the
+    hot-path suppressions in hygiene/ 'unused' — their check never ran
+    there."""
+    findings = _run_ownership("own_neg.py")
+    assert findings == [], _fmt(findings)
