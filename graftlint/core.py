@@ -267,6 +267,12 @@ class LintConfig:
     # host-bounce rule scans every file under these roots for functions
     # annotated hot-path.
     hot_path_roots: Sequence[str] = ("horovod_tpu/ops",)
+    # faultline rule: the canonical site registry, the Python trees
+    # whose faultline.site()/armed() plants it validates, and the
+    # native-core trees scanned for fault::Point()/Armed() plants.
+    faultline_module: str = "horovod_tpu/common/faultline.py"
+    faultline_roots: Sequence[str] = ("horovod_tpu",)
+    faultline_cc_roots: Sequence[str] = ("horovod_tpu/core/src",)
 
     def resolve(self, rel: str) -> str:
         return os.path.join(self.repo_root, rel)
@@ -293,7 +299,7 @@ def run_paths(paths: Sequence[str],
     runs whenever a path covers the config module or the scan root (its
     cross-file nature means per-file narrowing would lie).
     """
-    from .rules import env_drift, host_bounce, ownership
+    from .rules import env_drift, faultline_sites, host_bounce, ownership
 
     cfg = config or LintConfig()
     abs_paths = [os.path.abspath(p) for p in paths]
@@ -318,6 +324,9 @@ def run_paths(paths: Sequence[str],
     if hb_roots:
         findings += host_bounce.check_roots(
             [cfg.resolve(r) for r in hb_roots])
+    if in_scope(cfg.faultline_module) \
+            or any(in_scope(r) for r in cfg.faultline_roots):
+        findings += faultline_sites.check(cfg)
     for src, errs in _CACHE.values():
         findings += errs
         if src is not None:

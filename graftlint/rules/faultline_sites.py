@@ -1,0 +1,220 @@
+"""Fault-injection site registry checker.
+
+``common/faultline.py`` holds the ONE canonical table of injection
+sites (``SITES``); sites are planted as ``faultline.site("name")`` /
+``faultline.armed("name")`` in Python and ``fault::Point("name")`` /
+``fault::Armed("name")`` in the native core.  The plane is only as
+trustworthy as its registry — a typo'd or unregistered site is a fault
+test that injects nothing — so four drifts are mechanically findings:
+
+* **`fault-site-unregistered`** — a planted name absent from ``SITES``
+  (Python raises at runtime for these, but only when the site is
+  actually reached; the C++ side cannot check the table at all).
+* **`fault-site-duplicate`** — one name fired (``site``/``Point``) at
+  more than one code location.  A site names ONE seam; two plants make
+  ``HVD_TPU_FAULT`` ambiguous.  ``armed``/``Armed`` guards at the same
+  seam are exempt — guard + fire is the restructured-seam pattern.
+* **`fault-site-undocumented`** — a registered site mentioned in no
+  doc file (docs/configuration.md carries the site table).
+* **`fault-site-orphan`** — a registered site planted nowhere, in
+  either language: dead registry weight that documents behavior the
+  tree cannot exhibit.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, LintConfig, get_source, iter_py_files
+
+CHECKS = (
+    ("fault-site-unregistered",
+     "faultline site planted but absent from the canonical SITES table"),
+    ("fault-site-duplicate",
+     "faultline site fired at more than one code location"),
+    ("fault-site-undocumented",
+     "registered faultline site mentioned in no doc file"),
+    ("fault-site-orphan",
+     "registered faultline site planted nowhere"),
+)
+
+_CC_CALL_RE = re.compile(r'fault::(Point|Armed)\("([^"]+)"\)')
+
+
+def registry_sites(path: str) -> Dict[str, int]:
+    """name -> line of every key in faultline.py's ``SITES`` dict."""
+    src, _ = get_source(path)
+    if src is None:
+        return {}
+    for node in ast.walk(src.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "SITES"
+                   for t in targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            out = {}
+            for key in node.value.keys:
+                if isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str):
+                    out[key.value] = key.lineno
+            return out
+    return {}
+
+
+def _call_site_name(node) -> Optional[Tuple[str, bool]]:
+    """(site-name, fires) for a faultline call node, else None.
+    ``fires`` is False for ``armed`` guards (they don't count toward
+    the one-seam-per-name uniqueness check)."""
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    func = node.func
+    attr = None
+    if isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id == "faultline":
+        attr = func.attr
+    elif isinstance(func, ast.Name):
+        attr = func.id
+    if attr not in ("site", "armed"):
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, attr == "site"
+    return None
+
+
+def py_plants(root: str, skip: str) -> List[Tuple[str, str, int, bool]]:
+    """(name, path, line, fires) for every Python plant under ``root``,
+    skipping the registry module itself (its own defs/internal calls
+    are not plants)."""
+    out = []
+    for path in iter_py_files(root):
+        if os.path.abspath(path) == os.path.abspath(skip):
+            continue
+        src, _ = get_source(path)
+        if src is None:
+            continue
+        src.checked.update(("fault-site-unregistered",
+                            "fault-site-duplicate"))
+        for node in ast.walk(src.tree):
+            hit = _call_site_name(node)
+            if hit is not None:
+                out.append((hit[0], path, node.lineno, hit[1]))
+    return out
+
+
+def cc_plants(root: str) -> List[Tuple[str, str, int, bool]]:
+    """(name, path, line, fires) for every native-core plant."""
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != ".git"]
+        for fn in sorted(filenames):
+            if not fn.endswith((".cc", ".h")):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r", encoding="utf-8",
+                          errors="replace") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for i, line in enumerate(text.splitlines(), 1):
+                for m in _CC_CALL_RE.finditer(line):
+                    out.append((m.group(2), path, i,
+                                m.group(1) == "Point"))
+    return out
+
+
+def _doc_text(cfg: LintConfig) -> str:
+    chunks = []
+    for rel in cfg.doc_files:
+        path = cfg.resolve(rel)
+        if os.path.isdir(path):
+            for dirpath, _dirs, files in os.walk(path):
+                for fn in sorted(files):
+                    if fn.endswith((".md", ".rst", ".txt")):
+                        with open(os.path.join(dirpath, fn), "r",
+                                  encoding="utf-8",
+                                  errors="replace") as f:
+                            chunks.append(f.read())
+        elif os.path.isfile(path):
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def check(cfg: LintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    module_path = cfg.resolve(cfg.faultline_module)
+    # A tree without the registry module (fixture configs aimed at
+    # other rules) has no registry to drift from; plants found below
+    # are then all unregistered.
+    registry: Dict[str, int] = {}
+    if os.path.isfile(module_path):
+        registry = registry_sites(module_path)
+        reg_src, _ = get_source(module_path)
+        if reg_src is not None:
+            reg_src.checked.update(("fault-site-undocumented",
+                                    "fault-site-orphan"))
+
+    plants: List[Tuple[str, str, int, bool]] = []
+    for root in cfg.faultline_roots:
+        plants += py_plants(cfg.resolve(root), module_path)
+    for root in cfg.faultline_cc_roots:
+        plants += cc_plants(cfg.resolve(root))
+
+    def suppressed(path, line, check_id):
+        src, _ = get_source(path) if path.endswith(".py") else (None, [])
+        return src is not None and src.suppressed(line, check_id)
+
+    fired_at: Dict[str, Tuple[str, int]] = {}
+    planted = set()
+    for name, path, line, fires in plants:
+        planted.add(name)
+        if name not in registry and not suppressed(
+                path, line, "fault-site-unregistered"):
+            findings.append(Finding(
+                path, line, "fault-site-unregistered",
+                "faultline site %r is not in the canonical SITES table "
+                "(%s); register and document it" % (
+                    name, cfg.faultline_module)))
+        if not fires:
+            continue
+        prev = fired_at.get(name)
+        if prev is None:
+            fired_at[name] = (path, line)
+        elif not suppressed(path, line, "fault-site-duplicate"):
+            findings.append(Finding(
+                path, line, "fault-site-duplicate",
+                "faultline site %r already fired at %s:%d — a site "
+                "names ONE seam" % (
+                    name, os.path.relpath(prev[0], cfg.repo_root),
+                    prev[1])))
+
+    docs = _doc_text(cfg)
+    for name, line in sorted(registry.items()):
+        if name not in docs and not suppressed(
+                module_path, line, "fault-site-undocumented"):
+            findings.append(Finding(
+                module_path, line, "fault-site-undocumented",
+                "site %r is registered but documented in none of %s"
+                % (name, list(cfg.doc_files))))
+        if name not in planted and not suppressed(
+                module_path, line, "fault-site-orphan"):
+            findings.append(Finding(
+                module_path, line, "fault-site-orphan",
+                "site %r is registered but planted nowhere (Python or "
+                "C++)" % name))
+    return findings

@@ -1,0 +1,248 @@
+"""Fault-injection plane: named injection sites at the failure-critical
+seams.
+
+The reference's fault-tolerance story is *provable* because its failure
+semantics were exercised by killing real workers in integration tests;
+this module makes every previously-intermittent race a deterministic
+test.  A site is a named point in a failure-critical seam (enqueue
+ordering, negotiated-record drain, shutdown barrier, elastic
+rendezvous/rejoin); tests arm a site through one env var and the code
+at the seam misbehaves on demand:
+
+    HVD_TPU_FAULT=<site>:<action>[:<arg>][@<cond>=<val>...][,<spec>...]
+
+Actions:
+
+* ``delay`` — sleep ``arg`` seconds (default 0.25) at the site.
+* ``drop``  — ``site()`` returns True: the caller skips the guarded
+  operation (e.g. a negotiated record is popped but never dispatched —
+  the member-died-after-negotiation failure, injected).
+* ``die``   — ``os._exit(arg)`` (default 43): an instant, uncatchable
+  process death at the seam.
+* ``wedge`` — sleep ``arg`` seconds (default 3600), never returning on
+  any realistic test timescale: the alive-but-stuck failure.
+
+Conditions select which process fires (the env travels to every member
+of a spawned world): ``@rank=1`` / ``@slot=0`` / ``@host=127.0.0.2`` /
+``@epoch=1`` compare against ``HOROVOD_RANK`` /
+``HOROVOD_ELASTIC_SLOT`` / ``HOROVOD_HOSTNAME`` /
+``HOROVOD_ELASTIC_EPOCH`` at fire time, so an elastic respawn (new
+epoch) stops firing and the world can prove *recovery*, not just
+death.
+
+Every site name must be registered in :data:`SITES` — the one
+canonical table — and documented in ``docs/configuration.md``; the
+graftlint ``fault-site-*`` rule enforces registration, uniqueness (one
+seam per name) and documentation for both the Python plants and the
+C++ plants (``core/src/fault.cc`` parses the same env syntax for the
+sites inside the native core).
+
+Parsing is strict: an unknown site, action or condition key raises at
+first use.  A fault plane that silently ignores a typo'd spec is a
+test that tests nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+LOG = logging.getLogger("horovod_tpu.faultline")
+
+# The canonical site table: every injection point in the tree (Python
+# AND C++ — the native core's plants in core/src/*.cc are registered
+# here too, the graftlint rule cross-checks both languages against this
+# one table).  Keep docs/configuration.md's site list in sync.
+SITES: Dict[str, str] = {
+    "core.enqueue.pre_insert":
+        "C++ core, CoreState::Enqueue: after the handle is parked, "
+        "before the tensor-queue insert makes the Request visible to "
+        "the controller (post-fix seam; a delay here must be harmless)",
+    "core.enqueue.legacy_order":
+        "C++ core, CoreState::Enqueue: arming this REVERSES the "
+        "enqueue ordering to the pre-fix race (Request visible to the "
+        "controller before the handle is parked); the action fires in "
+        "the vulnerability window",
+    "engine.cycle.pre":
+        "in-process engine, CollectiveEngine._run_cycle entry: before "
+        "a negotiated batch executes",
+    "mh.enqueue.pre_register":
+        "multihost engine, MultihostEngine._enqueue: inside the engine "
+        "lock, before the control-plane registration (enqueue+park "
+        "atomicity window)",
+    "mh.drain.record":
+        "multihost engine, executor drain loop: a negotiated record "
+        "was popped but not yet dispatched (drop = negotiated-but-"
+        "never-dispatched member, the watchdog scenario)",
+    "hvd.shutdown.pre_barrier":
+        "common/multihost.py shutdown_jax_distributed: before the "
+        "synchronized teardown barrier",
+    "hvd.shutdown.post_barrier":
+        "common/multihost.py shutdown_jax_distributed: after the "
+        "barrier, before jax.distributed.shutdown()",
+    "elastic.rendezvous.poll":
+        "elastic worker, WorkerNotificationManager.rendezvous: top of "
+        "each driver poll iteration (drop = skip this poll)",
+    "elastic.rejoin.reinit":
+        "elastic state, run() retry loop: before each "
+        "_reset_and_reinit attempt",
+    "elastic.state.commit":
+        "elastic state, State.commit entry: the per-batch checkpoint "
+        "seam (die here = mid-training hardware failure)",
+}
+
+ACTIONS = ("delay", "drop", "die", "wedge")
+
+# Sites whose plant honors site()'s return value (the guarded
+# operation is actually skipped on True).  ``drop`` anywhere else is
+# rejected at parse time: it would fire, return True into the void,
+# and the test arming it would pass vacuously — exactly the silent
+# no-op this module exists to forbid.
+DROP_SITES = frozenset({
+    "mh.drain.record",
+    "elastic.rendezvous.poll",
+})
+
+_COND_ENV = {
+    "rank": "HOROVOD_RANK",
+    "slot": "HOROVOD_ELASTIC_SLOT",
+    "host": "HOROVOD_HOSTNAME",
+    "epoch": "HOROVOD_ELASTIC_EPOCH",
+}
+
+_DEFAULT_ARG = {"delay": 0.25, "die": 43.0, "wedge": 3600.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    site: str
+    action: str
+    arg: float
+    conds: Tuple[Tuple[str, str], ...] = ()
+
+    def conditions_met(self) -> bool:
+        for key, want in self.conds:
+            if os.environ.get(_COND_ENV[key]) != want:
+                return False
+        return True
+
+
+def parse(text: str) -> Dict[str, Spec]:
+    """Parse an ``HVD_TPU_FAULT`` value; strict (raises ValueError)."""
+    specs: Dict[str, Spec] = {}
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, _, cond_text = raw.partition("@")
+        parts = head.split(":")
+        if len(parts) < 2 or len(parts) > 3:
+            raise ValueError(
+                "HVD_TPU_FAULT spec %r: expected "
+                "<site>:<action>[:<arg>][@cond=val...]" % raw)
+        site_name, action = parts[0].strip(), parts[1].strip()
+        if site_name not in SITES:
+            raise ValueError(
+                "HVD_TPU_FAULT names unknown site %r (known: %s)"
+                % (site_name, sorted(SITES)))
+        if action not in ACTIONS:
+            raise ValueError(
+                "HVD_TPU_FAULT site %r: unknown action %r (known: %s)"
+                % (site_name, action, list(ACTIONS)))
+        if action == "drop" and site_name not in DROP_SITES:
+            raise ValueError(
+                "HVD_TPU_FAULT site %r does not implement drop (skip) "
+                "semantics; drop-capable sites: %s"
+                % (site_name, sorted(DROP_SITES)))
+        arg = _DEFAULT_ARG.get(action, 0.0)
+        if len(parts) == 3 and parts[2].strip():
+            try:
+                arg = float(parts[2])
+            except ValueError:
+                raise ValueError(
+                    "HVD_TPU_FAULT site %r: non-numeric arg %r"
+                    % (site_name, parts[2]))
+        conds = []
+        if cond_text:
+            for tok in cond_text.split("@"):
+                key, eq, val = tok.partition("=")
+                key = key.strip()
+                if not eq or key not in _COND_ENV:
+                    raise ValueError(
+                        "HVD_TPU_FAULT site %r: bad condition %r "
+                        "(known keys: %s)"
+                        % (site_name, tok, sorted(_COND_ENV)))
+                conds.append((key, val.strip()))
+        if site_name in specs:
+            raise ValueError(
+                "HVD_TPU_FAULT arms site %r twice" % site_name)
+        specs[site_name] = Spec(site_name, action, arg, tuple(conds))
+    return specs
+
+
+_cache: Optional[Dict[str, Spec]] = None
+_cache_env: Optional[str] = None
+
+
+def _specs() -> Dict[str, Spec]:
+    """Parsed specs for the current env value (re-parsed when the env
+    changes — tests arm and disarm within one process)."""
+    global _cache, _cache_env
+    env = os.environ.get("HVD_TPU_FAULT")
+    if env != _cache_env:
+        _cache = parse(env) if env else {}
+        _cache_env = env
+    return _cache or {}
+
+
+def reset():
+    """Drop the parse cache (tests)."""
+    global _cache, _cache_env
+    _cache = None
+    _cache_env = None
+
+
+def armed(name: str) -> Optional[Spec]:
+    """The spec arming ``name`` in this process right now, else None.
+    Does NOT fire the action — callers that restructure a seam when it
+    is armed (``core.enqueue.legacy_order``'s Python analogs) check
+    here and fire :func:`site` inside the restructured window."""
+    if name not in SITES:
+        raise KeyError(
+            "faultline.site(%r): not in the canonical SITES table; "
+            "register it (and document it) before planting" % name)
+    spec = _specs().get(name)
+    if spec is None or not spec.conditions_met():
+        return None
+    return spec
+
+
+def site(name: str) -> bool:
+    """Fire the injection point ``name``.
+
+    Returns True when the caller must SKIP the guarded operation
+    (action ``drop``); otherwise executes the armed action (delay /
+    die / wedge) as a side effect and returns False.  Unarmed sites
+    cost one dict lookup.
+    """
+    spec = armed(name)
+    if spec is None:
+        return False
+    LOG.warning("faultline: site %s firing action=%s arg=%s",
+                name, spec.action, spec.arg)
+    if spec.action == "delay":
+        time.sleep(spec.arg)
+        return False
+    if spec.action == "drop":
+        return True
+    if spec.action == "die":
+        os._exit(int(spec.arg))
+    # wedge: alive but stuck — sleep in slices so a debugger can still
+    # attach and the arg bounds the worst case.
+    deadline = time.monotonic() + spec.arg
+    while time.monotonic() < deadline:
+        time.sleep(min(1.0, deadline - time.monotonic()))
+    return False

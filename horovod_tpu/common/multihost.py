@@ -24,7 +24,17 @@ import os
 import socket
 from typing import Optional
 
+from . import faultline
+
 LOG = logging.getLogger("horovod_tpu")
+
+
+def _is_elastic_world() -> bool:
+    """True for workers launched by the elastic driver (it exports
+    ``HOROVOD_ELASTIC=1``; the driver address doubles as the marker for
+    programmatic launches)."""
+    return (os.environ.get("HOROVOD_ELASTIC") == "1"
+            or bool(os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")))
 
 
 def _free_port() -> int:
@@ -82,15 +92,64 @@ def init_jax_distributed(config, rank: int, size: int):
     # collective is the execution watchdog's job
     # (HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS), and the elastic driver
     # re-forms the world.
-    try:
-        jax.config.update("jax_enable_recoverability", True)
-    except Exception:  # noqa: BLE001 - older jax without the option
-        pass
+    #
+    # Scoped to ELASTIC worlds only: recoverability also removes the
+    # runtime's synchronized shutdown barrier, so in a static world the
+    # first rank to exit after jax.distributed.shutdown() FATALed the
+    # survivors mid-teardown (the r6 MULTICHIP RED).  Static worlds
+    # keep the runtime's exit propagation — a member death should kill
+    # the world there, loudly and everywhere; elastic worlds get
+    # survival plus the explicit teardown barrier below.
+    recoverable = False
+    if _is_elastic_world():
+        try:
+            jax.config.update("jax_enable_recoverability", True)
+            recoverable = True
+        except Exception:  # noqa: BLE001 - older jax without the option
+            pass
     coordinator = resolve_coordinator(config, rank, size)
     LOG.info("multihost: joining jax.distributed at %s as %d/%d",
              coordinator, rank, size)
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=size, process_id=rank)
+    kwargs = {}
+    if _is_elastic_world() and not recoverable:
+        # Elastic world on a jax without recoverability: the
+        # coordination service's own failure detector would PUSH a
+        # fatal error into every surviving client the moment a member
+        # misses heartbeats (LOG(FATAL) in the runtime client's
+        # default callbacks — the survivor dies mid-recovery, killed
+        # by the payload plane's bookkeeping).  Failure detection is
+        # Horovod's job here (stall inspector, device-exec watchdog,
+        # elastic driver), so disarm the runtime's: heartbeat
+        # tolerance far beyond any job's rejoin window.  Worlds WITH
+        # recoverability keep defaults (the runtime then degrades
+        # gracefully by design), as do static worlds (member death
+        # should kill the world loudly — reference semantics).
+        kwargs = dict(service_max_missing_heartbeats=100000,
+                      client_max_missing_heartbeats=100000)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=size, process_id=rank,
+                                   **kwargs)
+    except TypeError:
+        if not kwargs:
+            raise
+        # Public wrapper without the heartbeat knobs (e.g. jax 0.4.x):
+        # the private State.initialize has carried them for longer —
+        # same module the teardown barrier uses.  Last resort is the
+        # armed-detector default, loudly.
+        try:
+            from jax._src import distributed as _dist
+            _dist.global_state.initialize(
+                coordinator_address=coordinator, num_processes=size,
+                process_id=rank, **kwargs)
+        except (ImportError, AttributeError, TypeError):
+            LOG.warning(
+                "this jax cannot disarm the coordination service's "
+                "failure detector; if a member dies, runtime error "
+                "propagation may kill elastic survivors mid-recovery")
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=size,
+                                       process_id=rank)
     init_jax_distributed._done = True
     # Verify the world actually formed.  A backend plugin (or any JAX
     # computation before hvd.init()) can pre-initialize the runtime, in
@@ -109,14 +168,110 @@ def init_jax_distributed(config, rank: int, size: int):
             % (got, size))
 
 
+def _teardown_barrier() -> bool:
+    """Synchronized teardown: every member reaches this coordination-
+    service barrier before ANY member starts ``jax.distributed.
+    shutdown()`` — the reference's exit-propagation discipline (no rank
+    exits the world while a peer is still inside it).  Bounded: a dead
+    member must not hang teardown, so the barrier times out
+    (``HOROVOD_SHUTDOWN_BARRIER_TIMEOUT`` seconds; elastic worlds
+    default shorter — a broken world is torn down on every
+    re-rendezvous and must not serialize recovery on barrier waits).
+
+    Returns True when the world is SYNCHRONIZED for teardown (every
+    member at the barrier, or no barrier applicable) and False when a
+    member failed to show — the caller must then ABANDON the runtime
+    instead of disconnecting from it (see shutdown_jax_distributed).
+    """
+    default = "5" if _is_elastic_world() else "30"
+    try:
+        timeout_s = float(os.environ.get(
+            "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT", default))
+    except ValueError:
+        timeout_s = float(default)
+    if timeout_s <= 0:
+        return True  # barrier disabled: legacy direct-shutdown path
+    try:
+        from jax._src import distributed as _dist
+        client = getattr(_dist.global_state, "client", None)
+        if client is None:
+            return True
+        # Version the barrier id by the elastic epoch: coordination-
+        # service barriers are one-shot per id, and an in-process
+        # rejoin tears worlds down repeatedly.
+        barrier_id = ("hvd_tpu_shutdown:%s"
+                      % os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+        client.wait_at_barrier(barrier_id, int(timeout_s * 1000))
+        return True
+    except (ImportError, AttributeError):
+        # jax without the private distributed module / wait_at_barrier:
+        # no barrier to fail means no broken-world evidence — take the
+        # legacy direct-shutdown path, never the abandon path.
+        return True
+    except Exception as exc:  # noqa: BLE001 - dead/wedged member
+        LOG.warning("teardown barrier did not complete (%s); a member "
+                    "is dead or wedged — abandoning the distributed "
+                    "runtime instead of disconnecting", exc)
+        return False
+
+
+# Abandoned runtime objects, kept alive deliberately: letting the
+# client/service of a BROKEN world be destroyed (or calling their
+# shutdown) runs the coordination-service disconnect, and a disconnect
+# with a dead member is a LOG(FATAL) in the runtime client
+# (xla pjrt distributed client.h "Terminating process...") — the exact
+# survivor-killed-mid-teardown failure the barrier exists to prevent.
+# Growth is bounded by the number of in-process world re-formations.
+_ABANDONED_RUNTIMES: list = []
+
+
+def _abandon_jax_distributed():
+    """Drop jax's global distributed state WITHOUT the disconnect RPC
+    so a later ``jax.distributed.initialize`` (elastic rejoin, new
+    epoch, new coordinator port) can form a fresh world.
+
+    The abandoned objects are made IMMORTAL (an extra C-level
+    reference): their destructors run the same disconnect/shutdown
+    paths we are avoiding, and interpreter finalization would
+    otherwise trigger them after gRPC's own teardown — observed as a
+    LOG(FATAL) that turns a cleanly-finished worker into rc=-6 at the
+    last instant.  A leaked client/service pair per in-process world
+    re-formation is the price of surviving a broken world on runtimes
+    without recoverability."""
+    try:
+        import ctypes
+
+        from jax._src import distributed as _dist
+        gs = _dist.global_state
+        for obj in (getattr(gs, "client", None),
+                    getattr(gs, "service", None)):
+            if obj is not None:
+                ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+                _ABANDONED_RUNTIMES.append(obj)
+        gs.client = None
+        gs.service = None
+        gs.preemption_sync_manager = None
+        gs.coordinator_address = None
+    except Exception:  # noqa: BLE001 - version-dependent internals
+        LOG.warning("could not abandon the jax distributed state; "
+                    "elastic rejoin may fail to re-initialize",
+                    exc_info=True)
+
+
 def shutdown_jax_distributed():
     import jax
 
     if getattr(init_jax_distributed, "_done", False):
-        try:
-            jax.distributed.shutdown()
-        except Exception:  # noqa: BLE001 - best-effort teardown
-            pass
+        faultline.site("hvd.shutdown.pre_barrier")
+        synchronized = _teardown_barrier()
+        faultline.site("hvd.shutdown.post_barrier")
+        if synchronized:
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        else:
+            _abandon_jax_distributed()
         # In-process elastic rejoin: the XLA backend cache still holds
         # clients built for the OLD world (gloo collectives with the
         # previous process set baked in), and jax.distributed.initialize

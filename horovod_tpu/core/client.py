@@ -185,6 +185,11 @@ def parse_negotiated_record(rec: bytes) -> dict:
     }
     g["aux_sizes"] = [i64() for _ in range(u32())]
     g["entries"] = [{"name": s(), "handle": i64()} for _ in range(u32())]
+    # Trailing fail-fast field: non-empty when the core refused to
+    # zero-fill (a negotiated entry was missing on this non-joined
+    # rank); the executor error-completes the group and poisons the
+    # engine instead of running the record.
+    g["error"] = s() if off < len(rec) else ""
     return g
 
 

@@ -1,0 +1,177 @@
+#include "fault.h"
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "logging.h"
+
+namespace hvdtpu {
+namespace fault {
+
+namespace {
+
+struct Spec {
+  std::string action;
+  double arg = 0.0;
+  // (env var, expected value) pairs, evaluated at fire time.
+  std::vector<std::pair<std::string, std::string>> conds;
+};
+
+const char* CondEnv(const std::string& key) {
+  if (key == "rank") return "HOROVOD_RANK";
+  if (key == "slot") return "HOROVOD_ELASTIC_SLOT";
+  if (key == "host") return "HOROVOD_HOSTNAME";
+  if (key == "epoch") return "HOROVOD_ELASTIC_EPOCH";
+  return nullptr;
+}
+
+// Malformed specs are the Python side's job to reject loudly (it
+// validates against the canonical SITES table); here a bad token is
+// logged and skipped so the core never aborts on an env it merely
+// shares.
+std::unordered_map<std::string, Spec> ParseEnv() {
+  std::unordered_map<std::string, Spec> out;
+  const char* env = std::getenv("HVD_TPU_FAULT");
+  if (!env) return out;
+  std::string text(env);
+  size_t pos = 0;
+  while (pos <= text.size()) {
+    size_t comma = text.find(',', pos);
+    std::string raw = text.substr(
+        pos, comma == std::string::npos ? comma : comma - pos);
+    pos = comma == std::string::npos ? text.size() + 1 : comma + 1;
+    if (raw.empty()) continue;
+    std::string head = raw, cond_text;
+    size_t at = raw.find('@');
+    if (at != std::string::npos) {
+      head = raw.substr(0, at);
+      cond_text = raw.substr(at + 1);
+    }
+    // head = site:action[:arg]
+    size_t c1 = head.find(':');
+    if (c1 == std::string::npos) {
+      LOG_WARNING << "HVD_TPU_FAULT: malformed spec '" << raw << "'";
+      continue;
+    }
+    std::string site = head.substr(0, c1);
+    size_t c2 = head.find(':', c1 + 1);
+    Spec spec;
+    spec.action = head.substr(
+        c1 + 1, c2 == std::string::npos ? c2 : c2 - c1 - 1);
+    if (spec.action == "delay") spec.arg = 0.25;
+    else if (spec.action == "die") spec.arg = 43.0;
+    else if (spec.action == "wedge") spec.arg = 3600.0;
+    if (c2 != std::string::npos) {
+      // Mirror the Python parse: an empty/non-numeric arg keeps the
+      // action default instead of silently becoming 0 (a 'die' arg of
+      // 0 would turn an injected death into a clean-success exit).
+      std::string arg_s = head.substr(c2 + 1);
+      char* end = nullptr;
+      double v = std::strtod(arg_s.c_str(), &end);
+      if (!arg_s.empty() && end && *end == '\0') spec.arg = v;
+      else if (!arg_s.empty())
+        LOG_WARNING << "HVD_TPU_FAULT: non-numeric arg '" << arg_s
+                    << "' for site " << site << "; keeping default";
+    }
+    size_t cpos = 0;
+    bool bad = false;
+    while (!cond_text.empty() && cpos <= cond_text.size()) {
+      size_t next = cond_text.find('@', cpos);
+      std::string tok = cond_text.substr(
+          cpos, next == std::string::npos ? next : next - cpos);
+      cpos = next == std::string::npos ? cond_text.size() + 1 : next + 1;
+      if (tok.empty()) continue;
+      size_t eq = tok.find('=');
+      const char* var = eq == std::string::npos
+                            ? nullptr : CondEnv(tok.substr(0, eq));
+      if (!var) {
+        LOG_WARNING << "HVD_TPU_FAULT: bad condition '" << tok << "'";
+        bad = true;
+        break;
+      }
+      spec.conds.emplace_back(var, tok.substr(eq + 1));
+    }
+    if (!bad) out[site] = std::move(spec);
+  }
+  return out;
+}
+
+// Cache keyed by the CURRENT env value: the Python side re-parses
+// whenever HVD_TPU_FAULT changes ("tests arm and disarm within one
+// process"), and a C++ cache frozen at first use would let an
+// in-process test arm a core site into a vacuous no-op.  Guarded —
+// enqueueing caller threads and the background loop both reach this.
+std::mutex specs_mu;
+std::string specs_env;
+std::unordered_map<std::string, Spec> specs_map;
+bool specs_init = false;
+
+// Copies the armed spec out (the cached map can be re-parsed by a
+// concurrent lookup the moment the lock drops); false when unarmed.
+bool Lookup(const char* site, Spec* out) {
+  const char* env = std::getenv("HVD_TPU_FAULT");
+  if (env == nullptr) {
+    // Unarmed fast path (the production case): no string copy, just
+    // an empty-cache reset under the lock.
+    std::lock_guard<std::mutex> lk(specs_mu);
+    if (!specs_init || !specs_env.empty()) {
+      specs_map.clear();
+      specs_env.clear();
+      specs_init = true;
+    }
+    return false;
+  }
+  std::lock_guard<std::mutex> lk(specs_mu);
+  std::string cur(env);
+  if (!specs_init || cur != specs_env) {
+    specs_map = ParseEnv();
+    specs_env = std::move(cur);
+    specs_init = true;
+  }
+  auto it = specs_map.find(site);
+  if (it == specs_map.end()) return false;
+  for (const auto& c : it->second.conds) {
+    const char* v = std::getenv(c.first.c_str());
+    if (!v || c.second != v) return false;
+  }
+  *out = it->second;
+  return true;
+}
+
+}  // namespace
+
+bool Armed(const char* site) {
+  Spec spec;
+  return Lookup(site, &spec);
+}
+
+bool Point(const char* site) {
+  Spec spec;
+  if (!Lookup(site, &spec)) return false;
+  LOG_WARNING << "faultline: site " << site << " firing action="
+              << spec.action << " arg=" << spec.arg;
+  if (spec.action == "delay") {
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(spec.arg));
+    return false;
+  }
+  if (spec.action == "drop") return true;
+  if (spec.action == "die") _exit(static_cast<int>(spec.arg));
+  if (spec.action == "wedge") {
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(spec.arg));
+  }
+  return false;
+}
+
+}  // namespace fault
+}  // namespace hvdtpu

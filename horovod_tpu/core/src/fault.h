@@ -1,0 +1,26 @@
+// Fault-injection hook for the native core: the C++ half of
+// horovod_tpu/common/faultline.py.  Sites planted here parse the SAME
+// HVD_TPU_FAULT env syntax (<site>:<action>[:<arg>][@cond=val...],
+// comma-separated; actions delay/drop/die/wedge; conditions rank/
+// slot/host/epoch against the HOROVOD_* env) so one spec drives both
+// languages.  Site names must be registered in faultline.py's SITES
+// table and documented in docs/configuration.md — the graftlint
+// fault-site rule scans fault::Point/fault::Armed calls in this tree.
+#ifndef HVD_TPU_FAULT_H
+#define HVD_TPU_FAULT_H
+
+namespace hvdtpu {
+namespace fault {
+
+// True when `site` is armed for this process (conditions evaluated
+// now).  Does not fire the action.
+bool Armed(const char* site);
+
+// Fire `site`: executes delay/die/wedge as a side effect; returns
+// true when the caller must SKIP the guarded operation (action drop).
+bool Point(const char* site);
+
+}  // namespace fault
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_FAULT_H

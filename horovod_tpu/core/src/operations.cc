@@ -5,6 +5,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "fault.h"
 #include "logging.h"
 
 namespace hvdtpu {
@@ -144,6 +145,7 @@ Status CoreState::Initialize(int rank, int size,
   // the new background loop.
   shutdown_requested_ = false;
   join_requested_ = false;
+  fatal_ = Status::OK();
   {
     std::lock_guard<std::mutex> lk(negotiated_mu_);
     negotiated_groups_.clear();
@@ -195,19 +197,59 @@ int32_t CoreState::Enqueue(Request req, const void* data, int64_t nbytes) {
   timeline_.ActivityStart(entry->request.name,
                           std::string("NEGOTIATE_") +
                               OpTypeName(entry->request.op_type));
-  if (!queue_.Add(entry)) {
-    entry->BeginComplete();
-    entry->status = Status::InvalidArgument(
-        "A collective for tensor '" + entry->request.name +
-        "' is already pending; names must be unique among in-flight ops");
-    entry->PublishDone();
+  if (fault::Armed("core.enqueue.legacy_order")) {
+    // Injected pre-fix ordering: the tensor-queue insert makes the
+    // Request visible to the controller BEFORE the handle is parked.
+    // A fast negotiation lands in PerformOperation while handle is
+    // still -1, reproducing the once-intermittent zero-fill race
+    // deterministically (the fail-fast record build turns it into an
+    // error completion, which the injection test asserts).
+    bool added = queue_.Add(entry);
+    fault::Point("core.enqueue.legacy_order");
+    int32_t h;
+    {
+      std::lock_guard<std::mutex> lk(handles_mu_);
+      h = next_handle_++;
+      handles_[h] = entry;
+    }
+    entry->handle = h;
+    if (!added) {
+      if (entry->BeginComplete()) {
+        entry->status = Status::InvalidArgument(
+            "A collective for tensor '" + entry->request.name +
+            "' is already pending; names must be unique among "
+            "in-flight ops");
+        entry->PublishDone();
+      }
+    }
+    WakeLoop();
+    return h;
   }
+  // Fixed ordering: park the entry (handle assigned + registered)
+  // BEFORE the tensor-queue insert makes the Request visible to the
+  // controller.  A Request the controller can negotiate now always
+  // names a fully-parked local entry — the executor can never observe
+  // handle == -1 for a tensor this rank announced.
   int32_t h;
   {
     std::lock_guard<std::mutex> lk(handles_mu_);
     h = next_handle_++;
     entry->handle = h;
     handles_[h] = entry;
+  }
+  fault::Point("core.enqueue.pre_insert");
+  if (!queue_.Add(entry)) {
+    // Guarded: the entry is already in handles_, so a concurrent
+    // fatal_/shutdown sweep may have won the completion election —
+    // an unguarded write here would race a poller that already
+    // observed done.
+    if (entry->BeginComplete()) {
+      entry->status = Status::InvalidArgument(
+          "A collective for tensor '" + entry->request.name +
+          "' is already pending; names must be unique among in-flight "
+          "ops");
+      entry->PublishDone();
+    }
   }
   WakeLoop();
   return h;
@@ -384,6 +426,20 @@ void CoreState::BackgroundLoop() {
         }
       }
       PerformOperation(r);
+      if (!fatal_.ok()) {
+        // Failure-semantics violation (missing negotiated entry on a
+        // non-joined rank): fail everything loudly and stop — exactly
+        // the negotiation-failure teardown, with a better diagnosis.
+        queue_.AbortAll(fatal_);
+        std::lock_guard<std::mutex> lk(handles_mu_);
+        for (auto& kv : handles_)
+          if (kv.second->BeginComplete()) {
+            kv.second->status = fatal_;
+            kv.second->PublishDone();
+          }
+        stopped_ = true;
+        return;
+      }
       // External (device-payload) groups execute asynchronously on
       // the XLA plane: the cycle wall time says nothing about them.
       // Their bytes/seconds arrive via AutotuneObserve from the
@@ -463,6 +519,31 @@ void CoreState::PerformOperation(const Response& r) {
     // instead of moving bytes here.  The record is self-describing so
     // a joined rank with no local entries can still participate with a
     // zero contribution.
+    //
+    // Fail-fast invariant: a record entry's handle is LOCAL and may
+    // only be absent (or unparked, handle < 0) on a rank that itself
+    // joined.  Missing on a non-joined rank means the control plane
+    // negotiated a tensor this rank never parked — executing the
+    // record would zero-fill this rank's contribution and silently
+    // corrupt the reduction.  Instead the record carries an error
+    // message; the executor error-completes the group's entries and
+    // poisons the engine (Horovod's promise: complete correctly
+    // everywhere or fail loudly, never a wrong number).
+    std::string record_error;
+    if (!join_requested_) {
+      for (size_t i = 0; i < entries.size(); ++i) {
+        if (!entries[i] || entries[i]->handle < 0) {
+          record_error =
+              "external entry '" + r.tensor_names[i] +
+              "' negotiated ready but missing from the local tensor "
+              "queue on non-joined rank " + std::to_string(rank_) +
+              "; refusing to zero-fill the reduction (control-plane "
+              "race) — failing the group loudly";
+          LOG_ERROR << record_error;
+          break;
+        }
+      }
+    }
     Writer w;
     w.u8(static_cast<uint8_t>(r.op_type));
     w.u8(static_cast<uint8_t>(r.dtype));
@@ -476,30 +557,43 @@ void CoreState::PerformOperation(const Response& r) {
     w.u32(static_cast<uint32_t>(entries.size()));
     for (size_t i = 0; i < entries.size(); ++i) {
       w.str(r.tensor_names[i]);
-      if (!entries[i] && !join_requested_) {
-        // A record entry's handle is LOCAL: it may only be absent on
-        // a rank that itself joined (zero contribution by design).
-        // Missing on a non-joined rank means the control plane
-        // negotiated a tensor this rank never parked — the executor
-        // would silently zero-fill and corrupt the reduction.  Keep
-        // the record flowing (peers are already committed to the
-        // program) but make the moment loud and attributable.
-        LOG_ERROR << "external entry '" << r.tensor_names[i]
-                  << "' negotiated ready but missing from the local "
-                  << "tensor queue on non-joined rank " << rank_
-                  << "; its zero fill will corrupt the reduction "
-                  << "(control-plane race — please report)";
-      }
       w.i64(entries[i] ? entries[i]->handle : -1);
-      if (entries[i])
+      if (entries[i] && record_error.empty())
         timeline_.ActivityStart(r.tensor_names[i], "EXEC_EXTERNAL");
     }
+    // Trailing error field (empty = healthy record); the Python
+    // parser (core/client.py parse_negotiated_record) reads it after
+    // the entries.
+    w.str(record_error);
     {
       std::lock_guard<std::mutex> lk(negotiated_mu_);
       negotiated_groups_.push_back(std::move(w.buf));
     }
     negotiated_cv_.notify_one();
     return;
+  }
+
+  // Host-payload path, same invariant: a missing entry on a non-joined
+  // rank would be memset-zero-filled into the fusion buffer below.
+  // Structurally impossible after the enqueue-ordering fix (a Request
+  // is only visible once its entry is fully parked), so any occurrence
+  // is a core bug — abort the world loudly rather than corrupt it.
+  if (!join_requested_) {
+    for (size_t i = 0; i < entries.size(); ++i) {
+      if (!entries[i]) {
+        Status err = Status::UnknownError(
+            "entry '" + r.tensor_names[i] +
+            "' negotiated ready but missing from the local tensor "
+            "queue on non-joined rank " + std::to_string(rank_) +
+            "; refusing to zero-fill the reduction (control-plane "
+            "race) — aborting");
+        LOG_ERROR << err.reason();
+        for (auto& e : entries)
+          if (e) CompleteEntry(e, err);
+        fatal_ = err;
+        return;
+      }
+    }
   }
 
   switch (r.op_type) {

@@ -132,6 +132,13 @@ class CoreState {
   int PopNegotiatedLocked(uint8_t* buf, int buflen)
       REQUIRES(negotiated_mu_);
 
+  // Fatal failure-semantics violation observed by PerformOperation
+  // (a negotiated entry missing on a non-joined rank): the background
+  // loop aborts everything after the current response instead of
+  // letting a zero-filled contribution corrupt the reduction.  Only
+  // the background thread touches it.
+  Status fatal_ = Status::OK();
+
   std::thread background_;
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> join_requested_{false};

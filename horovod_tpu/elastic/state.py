@@ -19,14 +19,16 @@ from __future__ import annotations
 import copy
 import functools
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..common import basics
+from ..common import basics, faultline
 from ..ops.engine import HorovodInternalError
 from .worker import (HostsUpdatedInterrupt, WorkerStopped,
+                     arm_last_resort_exit, elastic_timeout,
                      install_assignment, notification_manager)
 
 LOG = logging.getLogger("horovod_tpu.elastic")
@@ -48,6 +50,7 @@ class State:
             cb()
 
     def commit(self):
+        faultline.site("elastic.state.commit")
         self.save()
         self.check_host_updates()
 
@@ -160,17 +163,20 @@ class JaxState(ObjectState):
         self.save()
 
 
-def _reset_and_reinit(min_epoch=None):
+def _reset_and_reinit(min_epoch=None, timeout=None):
     """Tear down the old world and join the new one (reference:
     shutdown → driver re-rendezvous → init).  ``min_epoch`` refuses
-    stale assignments (see WorkerNotificationManager.rendezvous)."""
+    stale assignments (see WorkerNotificationManager.rendezvous);
+    ``timeout`` caps the rendezvous poll — the caller passes the
+    REMAINDER of its one end-to-end deadline, so retries never reset
+    the clock."""
     try:
         basics.shutdown()
     except Exception:  # noqa: BLE001 — old world may already be broken
         LOG.debug("shutdown of old world failed", exc_info=True)
     nm = notification_manager()
     if nm.active:
-        info = nm.rendezvous(min_epoch=min_epoch)
+        info = nm.rendezvous(timeout=timeout, min_epoch=min_epoch)
         install_assignment(info)
     basics.init()
 
@@ -210,22 +216,50 @@ def run(func):
             # acceptable (a stale one would re-init a world containing
             # the dead member and block until the runtime's init
             # deadline kills the survivor).
-            import os as _os
-            need_epoch = int(_os.environ.get(
+            need_epoch = int(os.environ.get(
                 "HOROVOD_ELASTIC_EPOCH", "0")) + 1
             # Re-rendezvous with backoff-on-failure: init itself can
-            # race a second world change.
-            deadline = time.monotonic() + 600.0
+            # race a second world change.  ONE monotonic deadline
+            # (HOROVOD_ELASTIC_TIMEOUT) spans every retry, backoff and
+            # rendezvous poll in the rejoin — each attempt gets only
+            # the REMAINDER, so the total can never exceed the
+            # configured timeout (the r6 verdict found workers alive
+            # 13x past it: a hardcoded 600 s outer loop around
+            # env-bounded inner polls).
+            deadline = time.monotonic() + elastic_timeout()
             while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    arm_last_resort_exit("rejoin deadline")
+                    raise TimeoutError(
+                        "elastic rejoin did not form a world within "
+                        "HOROVOD_ELASTIC_TIMEOUT=%.0fs"
+                        % elastic_timeout())
+                # The deadline must bound the work INSIDE the attempt
+                # too: rendezvous honors `timeout`, but a wedged
+                # shutdown/init (jax.distributed.initialize against a
+                # half-formed world blocks for minutes) — or an
+                # injected wedge at the rejoin site — would escape
+                # it.  Arm the last-resort exit BEFORE the attempt,
+                # cancelled on any outcome that returns control here.
+                watchdog = arm_last_resort_exit(
+                    "rejoin attempt overran the deadline",
+                    delay=remaining)
                 try:
-                    _reset_and_reinit(min_epoch=need_epoch)
+                    faultline.site("elastic.rejoin.reinit")
+                    _reset_and_reinit(min_epoch=need_epoch,
+                                      timeout=remaining)
                     break
                 except WorkerStopped:
                     raise
                 except Exception as exc:  # noqa: BLE001
                     if time.monotonic() > deadline:
+                        arm_last_resort_exit("rejoin deadline")
                         raise
                     LOG.warning("re-init failed (%s); retrying", exc)
                     time.sleep(1.0)
+                finally:
+                    if watchdog is not None:
+                        watchdog.cancel()
 
     return wrapper

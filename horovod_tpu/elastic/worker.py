@@ -18,12 +18,58 @@ from __future__ import annotations
 import logging
 import os
 import socket
+import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..common import faultline
 from ..runner import services
 
 LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+def elastic_timeout() -> float:
+    """The ONE rejoin deadline, from the env the driver exports.
+    Single parse point for every consumer (rendezvous polls, the
+    state.py rejoin loop) so a malformed value degrades the same way
+    everywhere."""
+    try:
+        return float(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+    except ValueError:
+        return 600.0
+
+
+def arm_last_resort_exit(reason: str, code: int = 70,
+                         delay: float = 0.0):
+    """Deadline enforcement of last resort: a worker whose elastic
+    deadline expired must actually die, even when teardown wedges (an
+    atexit ``hvd.shutdown()`` joining threads blocked on a dead peer's
+    socket — the way workers were observed alive 13x past
+    ``HOROVOD_ELASTIC_TIMEOUT``).  Arms a daemon timer that
+    ``os._exit``s after ``delay`` + ``HOROVOD_ELASTIC_EXIT_GRACE``
+    seconds; the grace window is for normal exception propagation and
+    cleanup to finish first (0 disables).  Returns the timer (or None
+    when disabled) so a bounded-work caller can ``cancel()`` it on
+    success — the rejoin loop arms one around each attempt, because a
+    wedged ``init`` inside the attempt would otherwise escape the
+    deadline entirely."""
+    try:
+        grace = float(os.environ.get("HOROVOD_ELASTIC_EXIT_GRACE", "10"))
+    except ValueError:
+        grace = 10.0
+    if grace <= 0:
+        return None
+
+    def _die():
+        LOG.error("elastic deadline exceeded (%s) and the process is "
+                  "still alive %.0fs past it; os._exit(%d) as last "
+                  "resort", reason, grace, code)
+        os._exit(code)
+
+    t = threading.Timer(delay + grace, _die)
+    t.daemon = True
+    t.start()
+    return t
 
 
 class HostsUpdatedInterrupt(RuntimeError):
@@ -108,9 +154,17 @@ class WorkerNotificationManager:
         until the runtime's init deadline kills the survivor.  Poll
         until the driver publishes a newer epoch instead."""
         secret = os.environ.get("HOROVOD_SECRET_KEY", "")
-        deadline = time.monotonic() + (timeout or float(
-            os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")))
+        deadline = time.monotonic() + (timeout or elastic_timeout())
         while True:
+            if faultline.site("elastic.rendezvous.poll"):
+                # Injected dropped poll: the deadline still applies.
+                if time.monotonic() > deadline:
+                    arm_last_resort_exit("rendezvous poll deadline")
+                    raise TimeoutError(
+                        "elastic rendezvous timed out for worker %s:%d"
+                        % (self.host, self.slot))
+                time.sleep(0.25)
+                continue
             try:
                 msg = {"kind": "rendezvous", "host": self.host,
                        "slot": self.slot}
@@ -126,6 +180,7 @@ class WorkerNotificationManager:
                 # persistently unreachable driver is a job failure, not
                 # a clean stop (exit 0 would read as success).
                 if time.monotonic() > deadline:
+                    arm_last_resort_exit("driver unreachable")
                     raise TimeoutError(
                         "elastic driver unreachable: %s" % exc)
                 time.sleep(1.0)
@@ -135,6 +190,7 @@ class WorkerNotificationManager:
                 if (min_epoch is not None
                         and resp.get("epoch", 0) < min_epoch):
                     if time.monotonic() > deadline:
+                        arm_last_resort_exit("stale-epoch rendezvous")
                         raise TimeoutError(
                             "elastic rendezvous: driver never advanced "
                             "past epoch %d for worker %s:%d"
@@ -148,8 +204,14 @@ class WorkerNotificationManager:
                     self._pending_epoch = None
                 return resp
             if status == "stop":
+                # No last-resort timer on a clean stop: the caller may
+                # legitimately run post-stop work (final checkpoint,
+                # eval report) longer than the grace window, and the
+                # driver's process-group terminate plus the test
+                # suite's orphan reaper already cover a wedged stop.
                 raise WorkerStopped()
             if time.monotonic() > deadline:
+                arm_last_resort_exit("rendezvous deadline")
                 raise TimeoutError(
                     "elastic rendezvous timed out for worker %s:%d"
                     % (self.host, self.slot))

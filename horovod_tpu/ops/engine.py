@@ -30,6 +30,7 @@ import jax.profiler
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import faultline
 from ..common.config import Config
 from ..utils.stall_inspector import StallInspector
 from ..utils.timeline import Timeline
@@ -316,6 +317,7 @@ class CollectiveEngine:
                     e.handle._set_error(exc)
 
     def _run_cycle(self, batch: List[_Entry]):
+        faultline.site("engine.cycle.pre")
         # Group allreduces for fusion: (process set, dtype, red_op, scales).
         fuse_groups: Dict[tuple, List[_Entry]] = {}
         singles: List[_Entry] = []

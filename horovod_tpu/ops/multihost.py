@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..common import faultline
 from ..common.config import Config
 from ..utils.timeline import Timeline
 from . import xla_ops
@@ -889,6 +890,7 @@ class MultihostEngine:
                 raise HorovodInternalError(
                     "multihost engine disabled after watchdog "
                     "failure: %s" % self._failed)
+            faultline.site("mh.enqueue.pre_register")
             ch = self.core.enqueue_external(
                 name, op_type, tuple(arr.shape), np.dtype(arr.dtype),
                 **kw)
@@ -956,6 +958,13 @@ class MultihostEngine:
                         "control plane stopped (negotiation failed — "
                         "a member disconnected); failing pending "
                         "collectives"))
+                continue
+            if faultline.site("mh.drain.record"):
+                # Injected negotiated-but-never-dispatched member: the
+                # record is consumed and dropped, peers wedge inside
+                # their compiled program — the execution watchdog's
+                # scenario, on demand.
+                LOG.error("faultline: dropping negotiated record")
                 continue
             try:
                 self._execute(parse_negotiated_record(rec))
@@ -1107,11 +1116,23 @@ class MultihostEngine:
         resolution) to the completion thread — the drain loop is free
         to pop and dispatch group N+1 while N's program runs on
         device."""
-        mc = self.collectives_for(g["process_set_id"])
         entries = g["entries"]
         taken = [self._take(e["handle"]) if e["handle"] >= 0
                  else (None, None) for e in entries]
         names = [e["name"] for e in entries]
+        if g.get("error"):
+            # Fail-fast record: the core refused to zero-fill a
+            # negotiated entry missing on this non-joined rank.  Every
+            # rank of the group must fail loudly, never complete with
+            # a corrupted reduction: error-complete this group's
+            # handles, then poison the engine (peers wedge inside the
+            # program this rank never joins; their watchdog/stopped
+            # sweep turns that into the same loud error).
+            exc = HorovodInternalError(g["error"])
+            self._complete_error(g, names, taken, entries, exc)
+            self._poison(exc)
+            return
+        mc = self.collectives_for(g["process_set_id"])
         if self._failed is not None:
             self._complete_error(g, names, taken, entries, self._failed)
             return

@@ -1,0 +1,6 @@
+// Fixture native plant: guard + fire at one seam is NOT a duplicate.
+void Seam() {
+  if (fault::Armed("c.core")) {
+    fault::Point("c.core");
+  }
+}

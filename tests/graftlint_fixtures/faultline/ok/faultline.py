@@ -1,0 +1,7 @@
+"""Fixture registry: every site planted, documented, unique."""
+
+SITES = {
+    "a.one": "python seam one",
+    "b.two": "python seam two",
+    "c.core": "native-core seam (guard + fire pair)",
+}

@@ -1,0 +1,12 @@
+"""Fixture plants: one fire per site; an armed() guard is no plant."""
+
+from somewhere import faultline
+
+
+def seam_one():
+    faultline.site("a.one")
+
+
+def seam_two():
+    if faultline.armed("b.two"):
+        faultline.site("b.two")

@@ -1,0 +1,2 @@
+// Fixture native plant of an unregistered site.
+void Seam() { fault::Point("cc.unregistered"); }

@@ -1,0 +1,7 @@
+"""Fixture registry with every drift the rule must flag."""
+
+SITES = {
+    "a.one": "planted twice -> duplicate",
+    "u.undoc": "planted but missing from docs -> undocumented",
+    "d.orphan": "planted nowhere -> orphan",
+}

@@ -1,0 +1,19 @@
+"""Fixture plants: duplicate fire, unregistered name."""
+
+from somewhere import faultline
+
+
+def seam_one():
+    faultline.site("a.one")
+
+
+def seam_one_again():
+    faultline.site("a.one")  # duplicate: one seam per name
+
+
+def undocumented():
+    faultline.site("u.undoc")
+
+
+def typo():
+    faultline.site("zz.unregistered")

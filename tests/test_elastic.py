@@ -478,3 +478,135 @@ train(state)
         md.stop()
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "DONE rank=0 size=1" in proc.stdout, proc.stdout
+
+
+def test_elastic_die_injection_recovery(tmp_path):
+    """The worker-kill recovery scenario driven by the fault plane
+    instead of a hand-written os._exit: HVD_TPU_FAULT arms a `die` at
+    the commit seam, conditioned on the victim host, so EVERY worker
+    runs identical user code and the injection env alone picks the
+    casualty.  The driver must reap the rc, blacklist the host, and
+    the survivor must restore from commit and finish alone."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+@elastic.run
+def train(state):
+    while state.batch < 6:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.total += float(np.asarray(out)[0])
+        state.batch += 1
+        state.commit()
+    print("DONE rank=%d size=%d batch=%d"
+          % (hvd.rank(), hvd.size(), state.batch), flush=True)
+
+train(state)
+""")
+    env = _env()
+    env["HVD_TPU_FAULT"] = "elastic.state.commit:die:21@host=127.0.0.2"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(240),
+        env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DONE rank=0 size=1 batch=6" in proc.stdout, proc.stdout
+
+
+def test_elastic_unformable_world_worker_deadline(tmp_path):
+    """ISSUE 2 acceptance: a permanently-unformable world leaves NO
+    worker alive past HOROVOD_ELASTIC_TIMEOUT + eps.  The driver is
+    SIGKILLed (no cleanup) and one worker SIGKILLed, so the survivor's
+    collective fails and its rejoin faces an unreachable driver
+    forever.  Pre-fix the rejoin retry loop reset its clock around a
+    hardcoded 600 s deadline (workers observed alive 13x past the
+    env); post-fix ONE monotonic deadline spans every retry and a
+    last-resort os._exit covers a wedged teardown."""
+    import signal
+
+    timeout_s = 6.0
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+print("WORKER_PID %d %s" % (
+    os.getpid(), os.environ.get("HOROVOD_HOSTNAME", "?")), flush=True)
+
+@elastic.run
+def train(state):
+    while True:
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                      name="b%d" % state.batch)
+        state.batch += 1
+        if state.batch == 3:
+            print("TRAINING %d" % hvd.rank(), flush=True)
+        time.sleep(0.05)
+        state.commit()
+
+train(state)
+""")
+    env = _env()
+    env["HOROVOD_ELASTIC_EXIT_GRACE"] = "5"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         "--elastic-timeout", str(timeout_s),
+         sys.executable, str(script)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO, start_new_session=True)
+
+    pids = {}        # host -> worker pid
+    training = set()
+    lines = []
+
+    def read_output():
+        for line in iter(proc.stdout.readline, ""):
+            lines.append(line)
+            if "WORKER_PID" in line:
+                tail = line.split("WORKER_PID", 1)[1].split()
+                pids[tail[1]] = int(tail[0])
+            if "TRAINING" in line:
+                training.add(line.split("TRAINING", 1)[1].split()[0])
+
+    t = threading.Thread(target=read_output, daemon=True)
+    t.start()
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    survivor = None
+    try:
+        deadline = time.monotonic() + scaled_timeout(120)
+        while (len(pids) < 2 or len(training) < 2) \
+                and time.monotonic() < deadline:
+            assert proc.poll() is None, "".join(lines)
+            time.sleep(0.2)
+        assert len(pids) == 2 and len(training) == 2, "".join(lines)
+        survivor, victim = pids["127.0.0.1"], pids["127.0.0.2"]
+        # Driver dies uncleanly (no worker teardown), then the peer:
+        # the survivor is on its own with an unreachable driver.
+        os.kill(proc.pid, signal.SIGKILL)
+        os.kill(victim, signal.SIGKILL)
+        t0 = time.monotonic()
+        budget = scaled_timeout(timeout_s + 5 + 15)  # timeout+grace+eps
+        while alive(survivor) and time.monotonic() - t0 < budget:
+            time.sleep(0.25)
+        gone_after = time.monotonic() - t0
+        assert not alive(survivor), (
+            "survivor pid %d still alive %.1fs after the world became "
+            "unformable (HOROVOD_ELASTIC_TIMEOUT=%s):\n%s"
+            % (survivor, gone_after, timeout_s, "".join(lines)))
+    finally:
+        for pid in list(pids.values()) + [proc.pid]:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait(timeout=30)

@@ -1,0 +1,141 @@
+"""Fault-injection plane unit tests: spec parsing, action semantics,
+condition gating, and the C++ hook's env compatibility."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.common import faultline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SITE = "engine.cycle.pre"  # any registered site works for unit tests
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_FAULT", raising=False)
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+# -- parsing ---------------------------------------------------------------
+
+def test_parse_multiple_specs_with_args_and_conditions():
+    specs = faultline.parse(
+        "%s:delay:0.5@rank=1, elastic.state.commit:die:17@host=h@epoch=2"
+        % SITE)
+    assert specs[SITE].action == "delay"
+    assert specs[SITE].arg == 0.5
+    assert specs[SITE].conds == (("rank", "1"),)
+    die = specs["elastic.state.commit"]
+    assert die.action == "die" and die.arg == 17.0
+    assert die.conds == (("host", "h"), ("epoch", "2"))
+
+
+def test_parse_defaults_per_action():
+    specs = faultline.parse("%s:delay,mh.drain.record:drop" % SITE)
+    assert specs[SITE].arg == 0.25
+    assert specs["mh.drain.record"].arg == 0.0
+
+
+@pytest.mark.parametrize("bad", [
+    "nope.unknown:delay",          # unknown site
+    "%s:explode" % SITE,           # unknown action
+    "%s" % SITE,                   # missing action
+    "%s:delay:abc" % SITE,         # non-numeric arg
+    "%s:delay@color=red" % SITE,   # unknown condition key
+    "%s:delay,%s:delay" % (SITE, SITE),  # armed twice
+    "%s:drop" % SITE,              # drop at a site without skip
+])
+def test_parse_is_strict(bad):
+    with pytest.raises(ValueError):
+        faultline.parse(bad)
+
+
+def test_site_requires_registration():
+    with pytest.raises(KeyError):
+        faultline.site("never.registered")
+
+
+# -- firing ----------------------------------------------------------------
+
+def test_unarmed_site_is_a_noop():
+    assert faultline.site(SITE) is False
+
+
+DROP_SITE = "mh.drain.record"  # a site whose plant honors drop
+
+
+def test_drop_returns_true(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop" % DROP_SITE)
+    assert faultline.site(DROP_SITE) is True
+
+
+def test_delay_sleeps(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:delay:0.2" % SITE)
+    t0 = time.monotonic()
+    assert faultline.site(SITE) is False
+    assert time.monotonic() - t0 >= 0.2
+
+
+def test_condition_gates_by_env(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop@rank=1" % DROP_SITE)
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    # unset env: condition unmet
+    assert faultline.site(DROP_SITE) is False
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    assert faultline.site(DROP_SITE) is False
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    assert faultline.site(DROP_SITE) is True
+
+
+def test_rearm_within_one_process(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop" % DROP_SITE)
+    assert faultline.site(DROP_SITE) is True
+    monkeypatch.delenv("HVD_TPU_FAULT")
+    assert faultline.site(DROP_SITE) is False  # env change re-parses
+
+
+def test_die_exits_the_process():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from horovod_tpu.common import faultline\n"
+         "faultline.site('%s')\n"
+         "print('UNREACHED')" % SITE],
+        env=dict(os.environ, HVD_TPU_FAULT="%s:die:17" % SITE,
+                 PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 17, proc.stdout + proc.stderr
+    assert "UNREACHED" not in proc.stdout
+
+
+# -- the C++ hook parses the same env --------------------------------------
+
+def test_cpp_hook_die_action(tmp_path):
+    """fault::Point in the native core honors the same spec syntax:
+    arm core.enqueue.pre_insert with die and the first enqueue kills
+    the process with the spec's exit code."""
+    from horovod_tpu.core.client import core_library_available
+    if not core_library_available():
+        pytest.skip("native core unavailable")
+    script = (
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init(controller='tcp')\n"
+        "hvd.allreduce(np.ones(2, np.float32), name='x')\n"
+        "print('UNREACHED')\n")
+    worker = tmp_path / "worker.py"
+    worker.write_text(script)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               HOROVOD_RANK="0", HOROVOD_SIZE="1",
+               HOROVOD_PORT_BASE="28911",
+               HVD_TPU_FAULT="core.enqueue.pre_insert:die:19")
+    proc = subprocess.run([sys.executable, str(worker)], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 19, proc.stdout + proc.stderr
+    assert "UNREACHED" not in proc.stdout

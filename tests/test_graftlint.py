@@ -200,3 +200,68 @@ def test_scoped_run_does_not_flag_out_of_scope_suppressions():
     there."""
     findings = _run_ownership("own_neg.py")
     assert findings == [], _fmt(findings)
+
+
+# -- faultline site registry ------------------------------------------------
+
+def _faultline_cfg(variant):
+    base = os.path.join("faultline", variant)
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(os.path.join(base, "docs.md"),),
+        env_scan_root="absent", hot_path_roots=(),
+        faultline_module=os.path.join(base, "faultline.py"),
+        faultline_roots=(os.path.join(base, "tree"),),
+        faultline_cc_roots=(os.path.join(base, "cc"),))
+
+
+def _run_faultline(variant):
+    return run_paths([os.path.join(FIX, "faultline", variant)],
+                     _faultline_cfg(variant))
+
+
+def test_faultline_registered_documented_unique_is_clean():
+    """Guard + fire at one seam (armed()/fault::Armed + site()/
+    fault::Point) is the canonical pattern, not a duplicate."""
+    findings = _run_faultline("ok")
+    assert findings == [], _fmt(findings)
+
+
+def test_faultline_flags_unregistered_site_in_both_languages():
+    checks = _checks(_run_faultline("pos"))
+    # zz.unregistered (python) + cc.unregistered (native core)
+    assert checks.count("fault-site-unregistered") == 2, \
+        _fmt(_run_faultline("pos"))
+
+
+def test_faultline_flags_duplicate_fire():
+    findings = _run_faultline("pos")
+    dups = [f for f in findings if f.check == "fault-site-duplicate"]
+    assert len(dups) == 1 and "a.one" in dups[0].message, _fmt(findings)
+
+
+def test_faultline_flags_undocumented_registered_site():
+    findings = _run_faultline("pos")
+    undoc = [f for f in findings
+             if f.check == "fault-site-undocumented"]
+    assert len(undoc) == 1 and "u.undoc" in undoc[0].message, \
+        _fmt(findings)
+
+
+def test_faultline_flags_orphan_registered_site():
+    findings = _run_faultline("pos")
+    orphans = [f for f in findings if f.check == "fault-site-orphan"]
+    assert len(orphans) == 1 and "d.orphan" in orphans[0].message, \
+        _fmt(findings)
+
+
+def test_faultline_real_tree_registry_matches_runtime_table():
+    """The rule parses SITES statically; the runtime module must agree
+    (a drift here means the lint is checking a different table than
+    the one HVD_TPU_FAULT validates against)."""
+    from graftlint.rules.faultline_sites import registry_sites
+    from horovod_tpu.common import faultline as fl
+    parsed = registry_sites(
+        os.path.join(REPO, "horovod_tpu", "common", "faultline.py"))
+    assert set(parsed) == set(fl.SITES)

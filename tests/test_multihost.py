@@ -90,6 +90,106 @@ def test_execution_watchdog_fails_survivors_loudly():
     assert rc1 != 0 and "MH_WATCHDOG_OK" not in out1, (rc1, out1)
 
 
+SHUTDOWN_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "utils", "multihost_shutdown_worker.py")
+
+
+@pytest.mark.parametrize("ordering", ["rank0_exits_first",
+                                      "rank0_exits_last"])
+def test_multihost_shutdown_ordering(ordering):
+    # ISSUE 2 acceptance: hvd.init -> collective -> hvd.shutdown with
+    # BOTH exit orderings is rc=0 on all ranks.  The synchronized
+    # teardown barrier makes the ordering irrelevant: no rank starts
+    # jax.distributed.shutdown() until every rank reached the barrier,
+    # and a process exiting early can no longer FATAL a peer still
+    # inside teardown (the r6 MULTICHIP RED).  Exit skew is 2 s —
+    # far beyond the window the coordination service needs to notice a
+    # missing peer.
+    late = "1" if ordering == "rank0_exits_first" else "0"
+    outs = _spawn_multihost(2, local_devices=2, extra_env={
+        "TEST_EXIT_DELAY_RANK%s" % late: "2.0",
+    }, worker=SHUTDOWN_WORKER)
+    _assert_ok(outs, marker="MH_SHUTDOWN_OK")
+
+
+def test_multihost_shutdown_skewed_arrival():
+    # One rank reaches teardown 1.5 s late (injected at the pre-barrier
+    # fault site): the punctual rank must WAIT at the barrier, not run
+    # ahead into jax.distributed.shutdown() and exit under its peer.
+    outs = _spawn_multihost(2, local_devices=2, extra_env={
+        "HVD_TPU_FAULT": "hvd.shutdown.pre_barrier:delay:1.5@rank=0",
+    }, worker=SHUTDOWN_WORKER)
+    _assert_ok(outs, marker="MH_SHUTDOWN_OK")
+
+
+FAULT_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "utils", "multihost_fault_worker.py")
+
+
+def test_enqueue_legacy_order_fails_loudly_not_wrong():
+    # The once-intermittent control-plane race, now deterministic:
+    # core.enqueue.legacy_order reverses rank 1's enqueue to the
+    # pre-fix ordering (Request visible to the controller BEFORE the
+    # handle is parked) and holds the vulnerability window open 3 s.
+    # Negotiation completes inside the window, so rank 1's negotiated
+    # record names an unparked entry.  Pre-PR that zero-filled the
+    # reduction (silent corruption, tests/README.md's "known
+    # intermittent"); now the core refuses: the record carries an
+    # error, the engine poisons itself, and EVERY rank either verifies
+    # the correct sum or raises HorovodInternalError.  rank 0's side is
+    # covered by the execution watchdog (it dispatched a program rank 1
+    # never joins).  The 3 s window dwarfs any plausible negotiation
+    # latency (the background loop is a C++ thread, not GIL-bound;
+    # a 2-rank negotiation is one localhost round-trip), so the race
+    # fires deterministically even on a loaded 1-core box.
+    outs = _spawn_multihost(2, local_devices=1, extra_env={
+        "HVD_TPU_FAULT": "core.enqueue.legacy_order:delay:3.0@rank=1",
+        "HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS": "6",
+    }, worker=FAULT_WORKER)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc in (0, 3), \
+            "rank %d neither correct nor loud (rc=%d):\n%s\n%s" % (
+                rank, rc, out, err)
+        if rc == 0:
+            assert "FAULT_OK %d" % rank in out, out
+        else:
+            assert "FAULT_LOUD %d" % rank in out, out
+    # The injected rank itself must have failed loudly, not silently.
+    assert outs[1][0] == 3, outs[1][1] + outs[1][2]
+    assert "refusing to zero-fill" in (outs[1][1] + outs[1][2])
+
+
+def test_enqueue_fixed_order_delay_is_harmless():
+    # A 500 ms delay at the FIXED ordering's seam (handle parked,
+    # Request not yet visible): nothing can negotiate an unparked
+    # entry, so the world completes correctly on every rank — the
+    # ordering fix's proof point.
+    outs = _spawn_multihost(2, local_devices=1, extra_env={
+        "HVD_TPU_FAULT": "core.enqueue.pre_insert:delay:0.5@rank=1",
+    }, worker=FAULT_WORKER)
+    _assert_ok(outs, marker="FAULT_OK")
+
+
+def test_drain_drop_injection_trips_watchdog():
+    # mh.drain.record:drop on rank 1 = a member that negotiates but
+    # never dispatches (the alive-but-absent failure the execution
+    # watchdog exists for), injected instead of hand-rolled in a
+    # bespoke worker: rank 0 must fail loudly within the watchdog
+    # window, never hang and never return a wrong value.
+    outs = _spawn_multihost(2, local_devices=1, extra_env={
+        "HVD_TPU_FAULT": "mh.drain.record:drop@rank=1",
+        "HOROVOD_DEVICE_EXEC_TIMEOUT_SECONDS": "6",
+    }, worker=FAULT_WORKER)
+    rc0, out0, err0 = outs[0]
+    assert rc0 == 3, "rank 0 should fail loudly (rc=%d):\n%s\n%s" % (
+        rc0, out0, err0)
+    assert "FAULT_LOUD 0" in out0, out0
+    # Rank 1 dropped the record: its own handle never resolves and the
+    # engine poisons on watchdog/stopped sweep — loud there too.
+    rc1, out1, _err1 = outs[1]
+    assert rc1 != 0 and "FAULT_OK" not in out1, (rc1, out1)
+
+
 def test_init_detects_preinitialized_runtime(monkeypatch):
     # A pre-initialized JAX backend makes jax.distributed.initialize a
     # silent no-op: every rank would train alone while believing it is

@@ -1,0 +1,52 @@
+"""Fail-fast worker for the enqueue-ordering injection tests: one
+allreduce whose result is either verified CORRECT (exit 0, marker
+FAULT_OK) or failed LOUDLY with HorovodInternalError (exit 3, marker
+FAULT_LOUD).  Any other outcome — a silently wrong reduction above all
+— is a plain failure (assertion, rc 1).
+
+The spawning test arms HVD_TPU_FAULT (e.g. core.enqueue.legacy_order,
+the pre-fix enqueue ordering) and asserts the world never completes
+with a corrupted value: loud errors are the acceptable failure mode,
+wrong numbers never are."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("TEST_LOCAL_DEVICES", "2")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.engine import HorovodInternalError
+
+
+def main():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    try:
+        out = hvd.allreduce(np.full((8,), float(r + 1), np.float32),
+                            op=hvd.Sum, name="inj")
+    except HorovodInternalError as exc:
+        print("FAULT_LOUD %d: %s" % (r, exc), flush=True)
+        # Loud failure is a legitimate outcome under injection; the
+        # world is poisoned, so skip hvd.shutdown()'s collective
+        # teardown and exit with the designated code.
+        os._exit(3)
+    expected = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(np.asarray(out), expected)
+    hvd.shutdown()
+    print("FAULT_OK %d" % r, flush=True)
+
+
+if __name__ == "__main__":
+    main()

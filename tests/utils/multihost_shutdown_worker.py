@@ -1,0 +1,46 @@
+"""Shutdown-ordering worker: hvd.init -> collective -> hvd.shutdown,
+with per-rank exit skew so the spawning test exercises BOTH exit
+orderings (rank 0 gone first while peers still tear down, and rank 0
+last).  The synchronized-teardown barrier in shutdown_jax_distributed
+must make every ordering exit rc=0 on every rank — pre-fix, the first
+process exit could FATAL survivors inside jax.distributed.shutdown().
+
+Env: TEST_EXIT_DELAY_RANK<r> seconds between hvd.shutdown returning
+and process exit (one rank's process lingers); teardown-ARRIVAL skew
+is injected via the hvd.shutdown.pre_barrier faultline site instead."""
+
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("TEST_LOCAL_DEVICES", "2")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    out = hvd.allreduce(np.full((4,), float(r + 1), np.float32),
+                        op=hvd.Sum, name="sd")
+    expected = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(np.asarray(out), expected)
+    hvd.shutdown()
+    time.sleep(float(os.environ.get("TEST_EXIT_DELAY_RANK%d" % r, "0")))
+    print("MH_SHUTDOWN_OK %d" % r, flush=True)
+
+
+if __name__ == "__main__":
+    main()

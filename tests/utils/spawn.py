@@ -8,9 +8,24 @@ hangs the rendezvous rather than failing fast).
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
+
+
+def kill_proc_tree(proc):
+    """SIGKILL a spawned worker's whole process group (it leads one:
+    spawn_world starts each rank with ``start_new_session=True``), then
+    the process itself as a fallback."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    try:
+        proc.kill()
+    except OSError:
+        pass
 
 
 def scaled_timeout(seconds: float) -> float:
@@ -100,16 +115,21 @@ def spawn_world(worker, size, extra_env=None, timeout=240, retry=True,
             "HOROVOD_CYCLE_TIME": "1",
         })
         env.update(extra_env or {})
+        # Each rank leads its own process group (start_new_session) so
+        # teardown can kill the whole tree: a worker that itself forked
+        # (an elastic driver's children, a wedged grandchild) must not
+        # outlive the test that spawned it.
         procs.append(subprocess.Popen(
             [sys.executable, worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            start_new_session=True))
     outs = []
     for p in procs:
         try:
             out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
-                q.kill()
+                kill_proc_tree(q)
             for q in procs:
                 try:
                     q.communicate(timeout=10)
