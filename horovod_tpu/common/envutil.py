@@ -1,0 +1,43 @@
+"""Tolerant typed env reads for bootstrap paths.
+
+Knobs consumed before (or outside) the ``hvd.init()`` ``Config``
+snapshot — launcher, elastic driver, RPC retry layer — parse the
+environment directly.  This is the ONE parse shape they share: a
+malformed value degrades to the documented default with a warning
+(a typo'd knob must never turn into a crashed launcher or, worse, an
+instant-timeout loop), and an optional floor clamps nonsense like
+negative retry counts.  Keeping the shape here stops the
+fallback/clamp behavior from drifting between hand-rolled copies.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+LOG = logging.getLogger("horovod_tpu.env")
+
+
+def _parse(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        LOG.warning("ignoring malformed %s=%r; using default %s",
+                    name, raw, default)
+        return default
+
+
+def env_float(name: str, default: float,
+              minimum: Optional[float] = None) -> float:
+    value = _parse(name, float(default), float)
+    return value if minimum is None else max(minimum, value)
+
+
+def env_int(name: str, default: int,
+            minimum: Optional[int] = None) -> int:
+    value = _parse(name, int(default), int)
+    return value if minimum is None else max(minimum, value)

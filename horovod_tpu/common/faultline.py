@@ -30,6 +30,16 @@ of a spawned world): ``@rank=1`` / ``@slot=0`` / ``@host=127.0.0.2`` /
 epoch) stops firing and the world can prove *recovery*, not just
 death.
 
+Two counting keys gate a spec by HOW OFTEN it has already fired in
+this process (counted per site at :func:`site`, not at
+:func:`armed`): ``@times=N`` fires at most N times then disarms, and
+``@after=N`` skips the first N otherwise-eligible fires before arming.
+Together they express the transient-fault window the self-healing
+paths absorb — ``@after=5@times=3`` is "healthy, then three flakes,
+then healthy again" — which is exactly the drop-and-recover shape the
+retry/backoff and discovery-streak tests need (a drop that fires
+forever only ever proves the escalation boundary).
+
 Every site name must be registered in :data:`SITES` — the one
 canonical table — and documented in ``docs/configuration.md``; the
 graftlint ``fault-site-*`` rule enforces registration, uniqueness (one
@@ -47,6 +57,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -92,6 +103,21 @@ SITES: Dict[str, str] = {
     "elastic.state.commit":
         "elastic state, State.commit entry: the per-batch checkpoint "
         "seam (die here = mid-training hardware failure)",
+    "runner.rpc.request":
+        "runner control-plane RPC, request_with_retry: each attempt of "
+        "a retried rendezvous-KV or message-service call (drop = the "
+        "attempt fails with a synthetic transient connection reset, "
+        "exercising the backoff path; a drop without @times proves "
+        "retry exhaustion)",
+    "elastic.discovery.run":
+        "elastic driver, HostManager.update_available_hosts entry: one "
+        "discovery pass (drop = the pass raises DiscoveryFailure, a "
+        "transient discovery flake; the driver keeps the last good "
+        "host view up to HOROVOD_DISCOVERY_FAILURE_THRESHOLD)",
+    "driver.spawn.attempt":
+        "elastic driver, _spawn_workers: one worker-spawn attempt for "
+        "one slot (drop = the carrier declines the spawn, exercising "
+        "the exponential respawn backoff)",
 }
 
 ACTIONS = ("delay", "drop", "die", "wedge")
@@ -104,6 +130,9 @@ ACTIONS = ("delay", "drop", "die", "wedge")
 DROP_SITES = frozenset({
     "mh.drain.record",
     "elastic.rendezvous.poll",
+    "runner.rpc.request",
+    "elastic.discovery.run",
+    "driver.spawn.attempt",
 })
 
 _COND_ENV = {
@@ -122,6 +151,11 @@ class Spec:
     action: str
     arg: float
     conds: Tuple[Tuple[str, str], ...] = ()
+    # Fire-count gates, evaluated against the per-process counter of
+    # eligible fires at this site: skip the first ``after`` fires, then
+    # fire at most ``times`` times (None = no bound).
+    times: Optional[int] = None
+    after: int = 0
 
     def conditions_met(self) -> bool:
         for key, want in self.conds:
@@ -166,25 +200,52 @@ def parse(text: str) -> Dict[str, Spec]:
                     "HVD_TPU_FAULT site %r: non-numeric arg %r"
                     % (site_name, parts[2]))
         conds = []
+        times: Optional[int] = None
+        after = 0
         if cond_text:
             for tok in cond_text.split("@"):
                 key, eq, val = tok.partition("=")
                 key = key.strip()
+                if eq and key in ("times", "after"):
+                    try:
+                        count = int(val)
+                    except ValueError:
+                        count = -1
+                    if count < 0:
+                        raise ValueError(
+                            "HVD_TPU_FAULT site %r: @%s wants a "
+                            "non-negative integer, got %r"
+                            % (site_name, key, val))
+                    if key == "times":
+                        times = count
+                    else:
+                        after = count
+                    continue
                 if not eq or key not in _COND_ENV:
                     raise ValueError(
                         "HVD_TPU_FAULT site %r: bad condition %r "
                         "(known keys: %s)"
-                        % (site_name, tok, sorted(_COND_ENV)))
+                        % (site_name, tok,
+                           sorted(_COND_ENV) + ["after", "times"]))
                 conds.append((key, val.strip()))
         if site_name in specs:
             raise ValueError(
                 "HVD_TPU_FAULT arms site %r twice" % site_name)
-        specs[site_name] = Spec(site_name, action, arg, tuple(conds))
+        specs[site_name] = Spec(site_name, action, arg, tuple(conds),
+                                times, after)
     return specs
 
 
 _cache: Optional[Dict[str, Spec]] = None
 _cache_env: Optional[str] = None
+# Per-site count of eligible site() fires in this process, feeding the
+# @times/@after gates.  Re-arming (env change) starts a new experiment,
+# so the counters reset with the parse cache.  Locked: sites fire from
+# arbitrary threads (discovery loop, reap loop, notify path can all
+# hit runner.rpc.request concurrently) and a lost increment would make
+# a bounded flake window fire once too often.
+_fired: Dict[str, int] = {}
+_fired_lock = threading.Lock()
 
 
 def _specs() -> Dict[str, Spec]:
@@ -195,14 +256,16 @@ def _specs() -> Dict[str, Spec]:
     if env != _cache_env:
         _cache = parse(env) if env else {}
         _cache_env = env
+        _fired.clear()
     return _cache or {}
 
 
 def reset():
-    """Drop the parse cache (tests)."""
+    """Drop the parse cache and fire counters (tests)."""
     global _cache, _cache_env
     _cache = None
     _cache_env = None
+    _fired.clear()
 
 
 def armed(name: str) -> Optional[Spec]:
@@ -231,6 +294,13 @@ def site(name: str) -> bool:
     spec = armed(name)
     if spec is None:
         return False
+    if spec.times is not None or spec.after:
+        with _fired_lock:
+            n = _fired.get(name, 0)
+            _fired[name] = n + 1
+        if n < spec.after or (spec.times is not None
+                              and n >= spec.after + spec.times):
+            return False
     LOG.warning("faultline: site %s firing action=%s arg=%s",
                 name, spec.action, spec.arg)
     if spec.action == "delay":

@@ -11,9 +11,35 @@ the role of hosts appearing and disappearing).
 
 from __future__ import annotations
 
+import logging
 import subprocess
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import faultline
+from ..common.envutil import env_float
+
+LOG = logging.getLogger("horovod_tpu.elastic.discovery")
+
+DEFAULT_SCRIPT_TIMEOUT_S = 60.0
+
+
+class DiscoveryFailure(RuntimeError):
+    """One discovery pass failed TRANSIENTLY (script non-zero rc,
+    script timeout, injected flake).  The driver absorbs a bounded
+    streak of these (``HOROVOD_DISCOVERY_FAILURE_THRESHOLD``), keeping
+    the last good host view; only a persistent streak escalates to the
+    fail-fast path."""
+
+
+def _script_timeout_from_env() -> float:
+    """Per-run discovery-script timeout: HOROVOD_DISCOVERY_SCRIPT_TIMEOUT
+    (seconds, default 60).  One read point — keep bootstrap defaults
+    from forking.  Non-positive / malformed values degrade to the
+    default rather than turning every pass into an instant timeout."""
+    timeout = env_float("HOROVOD_DISCOVERY_SCRIPT_TIMEOUT",
+                        DEFAULT_SCRIPT_TIMEOUT_S)
+    return timeout if timeout > 0 else DEFAULT_SCRIPT_TIMEOUT_S
 
 
 class HostUpdateResult:
@@ -45,16 +71,28 @@ class HostDiscoveryScript(HostDiscovery):
     """Runs the user-provided discovery script; each stdout line is
     ``hostname`` or ``hostname:slots`` (reference format)."""
 
-    def __init__(self, discovery_script: str, default_slots: int = 1):
+    def __init__(self, discovery_script: str, default_slots: int = 1,
+                 timeout: Optional[float] = None):
         self._script = discovery_script
         self._default_slots = default_slots
+        # Per-run script deadline; None defers to the env at call time
+        # so a launcher-exported HOROVOD_DISCOVERY_SCRIPT_TIMEOUT
+        # applies without re-constructing the discovery object.
+        self._timeout = timeout
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
-        out = subprocess.run(
-            self._script, shell=True, capture_output=True, text=True,
-            timeout=60)
+        timeout = (self._timeout if self._timeout is not None
+                   else _script_timeout_from_env())
+        try:
+            out = subprocess.run(
+                self._script, shell=True, capture_output=True,
+                text=True, timeout=timeout)
+        except subprocess.TimeoutExpired as exc:
+            raise DiscoveryFailure(
+                "host discovery script %r timed out after %.1fs"
+                % (self._script, timeout)) from exc
         if out.returncode != 0:
-            raise RuntimeError(
+            raise DiscoveryFailure(
                 "host discovery script %r failed (rc=%d): %s"
                 % (self._script, out.returncode, out.stderr.strip()))
         hosts: Dict[str, int] = {}
@@ -63,8 +101,19 @@ class HostDiscoveryScript(HostDiscovery):
             if not line or line.startswith("#"):
                 continue
             if ":" in line:
-                host, slots = line.rsplit(":", 1)
-                hosts[host.strip()] = int(slots)
+                host, slots_text = line.rsplit(":", 1)
+                try:
+                    slots = int(slots_text.strip())
+                except ValueError:
+                    # One malformed line must not kill the whole
+                    # discovery pass (and with it the current world
+                    # view): skip it loudly.
+                    LOG.warning(
+                        "discovery script %r: skipping malformed line "
+                        "%r (slots is not an integer)",
+                        self._script, line)
+                    continue
+                hosts[host.strip()] = slots
             else:
                 hosts[line] = self._default_slots
         return hosts
@@ -158,7 +207,14 @@ class HostManager:
             return dict(self._current)
 
     def update_available_hosts(self) -> int:
-        """Re-run discovery; returns a HostUpdateResult flag."""
+        """Re-run discovery; returns a HostUpdateResult flag.  Raises
+        (``DiscoveryFailure`` or whatever the backend raises) with the
+        current view UNCHANGED — the caller decides how many failures
+        to absorb before distrusting it."""
+        if faultline.site("elastic.discovery.run"):
+            raise DiscoveryFailure(
+                "injected discovery flake (faultline "
+                "elastic.discovery.run)")
         found = self._discovery.find_available_hosts_and_slots()
         found = {h: s for h, s in found.items()
                  if s > 0 and not self._is_blacklisted(h)}
@@ -182,6 +238,14 @@ class HostManager:
         with self._lock:
             self._current = {h: s for h, s in self._current.items()
                              if not self._is_blacklisted(h)}
+
+    def invalidate(self):
+        """Forget the current host view (discovery escalation: after a
+        persistent failure streak the view is stale beyond trust — an
+        empty view routes the driver onto the below-min_np fail-fast
+        deadline instead of running indefinitely on fiction)."""
+        with self._lock:
+            self._current = {}
 
     def ordered_slots(self, max_np: Optional[int] = None
                       ) -> List[Tuple[str, int]]:

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import faultline
+from ..common.envutil import env_int
 from ..runner import safe_shell_exec, util
 from ..runner.http_server import RendezvousServer
 from ..runner.services import MessageServer, send_message
@@ -32,6 +34,16 @@ LOG = logging.getLogger("horovod_tpu.elastic.driver")
 
 Slot = Tuple[str, int]
 
+DEFAULT_DISCOVERY_FAILURE_THRESHOLD = 3
+
+
+def _discovery_failure_threshold_from_env() -> int:
+    """Consecutive discovery failures the driver absorbs on the last
+    good host view before escalating: HOROVOD_DISCOVERY_FAILURE_THRESHOLD
+    (default 3).  One read point."""
+    return env_int("HOROVOD_DISCOVERY_FAILURE_THRESHOLD",
+                   DEFAULT_DISCOVERY_FAILURE_THRESHOLD, minimum=1)
+
 
 class ElasticDriver:
     def __init__(self, command: List[str], discovery: HostDiscovery,
@@ -39,9 +51,13 @@ class ElasticDriver:
                  env: Optional[Dict[str, str]] = None,
                  elastic_timeout: float = 600.0,
                  discovery_interval: float = 1.0,
-                 failure_threshold: int = 1,
+                 failure_threshold: Optional[int] = None,
+                 blacklist_cooldown: Optional[float] = None,
+                 discovery_failure_threshold: Optional[int] = None,
                  start_timeout: float = 120.0,
-                 ssh_port: int = 22):
+                 ssh_port: int = 22,
+                 respawn_backoff_base: float = 1.0,
+                 respawn_backoff_cap: float = 30.0):
         self.command = command
         self.min_np = max(1, min_np)
         self.max_np = max_np
@@ -50,8 +66,23 @@ class ElasticDriver:
         self.discovery_interval = discovery_interval
         self.start_timeout = start_timeout
         self.ssh_port = ssh_port
+        # Per-slot respawn throttle: exponential backoff between spawn
+        # retries (carrier declined / spawn failed), so a slot that
+        # cannot start does not hammer a struggling host at a fixed
+        # rate.  Reset when a spawn succeeds.
+        self.respawn_backoff_base = max(0.0, respawn_backoff_base)
+        self.respawn_backoff_cap = max(self.respawn_backoff_base,
+                                       respawn_backoff_cap)
+        self.discovery_failure_threshold = (
+            discovery_failure_threshold
+            if discovery_failure_threshold is not None
+            else _discovery_failure_threshold_from_env())
 
-        self._registry = WorkerStateRegistry(failure_threshold)
+        # None = launcher env decides (HOROVOD_HOST_FAILURE_THRESHOLD /
+        # HOROVOD_BLACKLIST_COOLDOWN); an explicit argument wins.
+        self._registry = WorkerStateRegistry.from_env(
+            failure_threshold=failure_threshold,
+            cooldown_secs=blacklist_cooldown)
         self._extra_handler = None  # platform hook for extra msg kinds
         self._hosts = HostManager(discovery, self._registry.is_blacklisted)
         self._secret = util.make_secret()
@@ -77,7 +108,11 @@ class ElasticDriver:
         self._stopped: set = set()  # graftlint: guarded-by=_lock
         self._succeeded: set = set()  # graftlint: guarded-by=_lock
         self._spawn_attempts: Dict[Slot, float] = {}  # graftlint: guarded-by=_lock
+        self._spawn_backoff: Dict[Slot, float] = {}  # graftlint: guarded-by=_lock
         self._pending_spawns: set = set()  # graftlint: guarded-by=_lock
+        # Consecutive failed discovery passes; owned by the discovery
+        # thread (run()'s startup loop finishes before it starts).
+        self._discovery_failures = 0
         self._shutdown = threading.Event()
         self._below_min_since: Optional[float] = None  # graftlint: guarded-by=_lock
         # Highest epoch a worker has demanded via min_epoch (its world
@@ -242,10 +277,16 @@ class ElasticDriver:
         # Notify outside the lock (network).
         for slot, addr in addrs:
             try:
+                # One bounded retry: a worker mid-GC deserves a second
+                # attempt, a dead one should not stall the recompute —
+                # the reap loop owns dead-worker handling.  The deadline
+                # must exceed one full socket timeout or the retry
+                # could never actually run.
                 send_message(addr, self._secret, {
                     "kind": "notify",
                     "payload": {"type": "hosts_updated",
-                                "epoch": self._epoch}}, timeout=5.0)
+                                "epoch": self._epoch}}, timeout=5.0,
+                    retries=1, deadline=12.0)
             except Exception:  # noqa: BLE001 — worker may be dead
                 pass
         # Terminate stopped procs off-lock too (AgentProc.terminate is
@@ -308,7 +349,17 @@ class ElasticDriver:
         for slot in slots:
             host, idx = slot
             try:
-                mp = self._make_worker_proc(slot, self._worker_env(slot))
+                if faultline.site("driver.spawn.attempt"):
+                    # Injected declined spawn: same shape as a carrier
+                    # refusing the slot — the reap loop retries with
+                    # exponential backoff.
+                    LOG.warning("spawn attempt for %s:%d dropped "
+                                "(faultline driver.spawn.attempt)",
+                                host, idx)
+                    mp = None
+                else:
+                    mp = self._make_worker_proc(
+                        slot, self._worker_env(slot))
             finally:
                 # Cleared before install so a failure can't wedge the
                 # slot; install below re-checks under the same lock.
@@ -326,6 +377,9 @@ class ElasticDriver:
                 if not stale:
                     self._procs[slot] = mp
                     self._succeeded.discard(slot)
+                    # A successful spawn resets the slot's respawn
+                    # backoff to the base interval.
+                    self._spawn_backoff.pop(slot, None)
             if stale:
                 # The pending guard means no replacement proc can exist
                 # for this slot, so terminating the carrier (for agent
@@ -340,19 +394,57 @@ class ElasticDriver:
 
     # -- monitoring --------------------------------------------------------
 
+    def _discovery_tick(self):
+        """One discovery pass with flake tolerance: a transient failure
+        keeps the last good host view; a streak reaching
+        ``discovery_failure_threshold`` escalates by invalidating the
+        view — the world goes below ``min_np`` and the existing elastic
+        deadline fails the run LOUDLY unless discovery recovers first
+        (a later successful pass re-adds the hosts and the world
+        re-forms)."""
+        result = None
+        try:
+            result = self._hosts.update_available_hosts()
+        except Exception as exc:  # noqa: BLE001 — counted, bounded
+            self._discovery_failures += 1
+            if self._discovery_failures < self.discovery_failure_threshold:
+                LOG.warning(
+                    "host discovery failed (%d/%d consecutive; keeping "
+                    "last good host view): %s",
+                    self._discovery_failures,
+                    self.discovery_failure_threshold, exc)
+            elif self._discovery_failures == \
+                    self.discovery_failure_threshold:
+                LOG.error(
+                    "host discovery failed %d consecutive times: %s — "
+                    "escalating: the host view is no longer trusted; "
+                    "the run fails via the elastic deadline (%.0fs) "
+                    "unless discovery recovers",
+                    self._discovery_failures, exc, self.elastic_timeout)
+                self._hosts.invalidate()
+                self._recompute_world("discovery escalation")
+                return
+            else:
+                LOG.warning(
+                    "host discovery still failing (%d consecutive): %s",
+                    self._discovery_failures, exc)
+        if result is not None and self._discovery_failures:
+            LOG.info("host discovery recovered after %d failure(s)",
+                     self._discovery_failures)
+            self._discovery_failures = 0
+        if result is not None and result != HostUpdateResult.NO_UPDATE:
+            self._recompute_world("discovery update")
+        elif self._rebuild_wanted > self._epoch:
+            # Racy read (no lock): a just-raised demand is caught on
+            # the next tick at the latest.  Checked on FAILED ticks
+            # too: a worker-reported broken world (min_epoch demand is
+            # its only signal) must not wait out a discovery flake
+            # streak before being serviced.
+            self._recompute_world("worker-reported broken world")
+
     def _discovery_loop(self):
         while not self._shutdown.is_set():
-            try:
-                result = self._hosts.update_available_hosts()
-            except Exception as exc:  # noqa: BLE001
-                LOG.warning("host discovery failed: %s", exc)
-                result = HostUpdateResult.NO_UPDATE
-            if result != HostUpdateResult.NO_UPDATE:
-                self._recompute_world("discovery update")
-            elif self._rebuild_wanted > self._epoch:
-                # Racy read (no lock): a just-raised demand is caught
-                # on the next tick at the latest.
-                self._recompute_world("worker-reported broken world")
+            self._discovery_tick()
             self._shutdown.wait(self.discovery_interval)
 
     def _check_procs(self) -> bool:
@@ -380,17 +472,24 @@ class ElasticDriver:
             # Retry target slots with no process: a platform carrier may
             # have declined the spawn (agent busy / not yet registered);
             # without this the run would wait forever on a slot nothing
-            # is driving.  Throttled per slot — each attempt can be a
-            # network RPC.
+            # is driving.  Throttled per slot with exponential backoff —
+            # each attempt can be a network RPC, and a slot that keeps
+            # failing to start should lean on its host progressively
+            # less (the backoff resets when a spawn succeeds).
             now = time.monotonic()
             to_spawn = []
             for slot in self._target:
+                wait = self._spawn_backoff.get(
+                    slot, self.respawn_backoff_base)
                 if slot not in self._procs and slot not in self._stopped \
                         and slot not in self._succeeded \
                         and slot not in self._pending_spawns \
                         and slot[0] not in failed_hosts \
-                        and now - self._spawn_attempts.get(slot, 0) >= 1.0:
+                        and now - self._spawn_attempts.get(slot, 0) >= wait:
                     self._spawn_attempts[slot] = now
+                    self._spawn_backoff[slot] = min(
+                        max(wait, self.respawn_backoff_base) * 2,
+                        self.respawn_backoff_cap)
                     self._pending_spawns.add(slot)
                     to_spawn.append(slot)
             target = list(self._target)
@@ -402,7 +501,11 @@ class ElasticDriver:
             return True
         for host in set(failed_hosts):
             if self._registry.record_failure(host):
-                LOG.warning("blacklisting host %s", host)
+                cooldown = self._registry.cooldown_for(host)
+                LOG.warning(
+                    "blacklisting host %s (%s)", host,
+                    "cooldown %.1fs, then eligible to rejoin" % cooldown
+                    if cooldown else "permanently: no cooldown configured")
         if failed_hosts:
             self._hosts.blacklist_refresh()
             self._recompute_world("worker failure")

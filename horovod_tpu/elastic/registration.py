@@ -4,25 +4,72 @@ Reference parity: ``horovod/runner/elastic/registration.py``
 (WorkerStateRegistry) — records per-host failures observed by the
 driver; hosts whose workers fail are blacklisted so rediscovery does
 not re-add them, and slot assignment skips them.
+
+Cooldown semantics (upstream analog: ``HOROVOD_BLACKLIST_COOLDOWN_RANGE``):
+
+* ``cooldown_secs=0`` (the default) means a blacklist entry is
+  **permanent** — reference parity; a host that failed stays out for
+  the life of the job.
+* ``cooldown_secs>0`` (``HOROVOD_BLACKLIST_COOLDOWN``): once the
+  cooldown elapses the entry expires, the host re-enters discovery and
+  rejoins through the normal re-rendezvous.  Each *repeat* blacklist of
+  the same host doubles its cooldown (capped at ``cooldown_cap``,
+  default 16x the base) — a transiently bad host rejoins quickly, a
+  persistently bad one asymptotically leaves the world.  A recorded
+  success (a worker on the host ran to clean exit) resets the doubling.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+from ..common.envutil import env_float, env_int
+
+LOG = logging.getLogger("horovod_tpu.elastic.registry")
+
+# Repeat-blacklist cooldown doubling is capped at this multiple of the
+# base cooldown unless the caller passes an explicit cap.
+DEFAULT_COOLDOWN_CAP_MULTIPLE = 16
 
 
 class WorkerStateRegistry:
     def __init__(self, failure_threshold: int = 1,
-                 cooldown_secs: float = 0.0):
+                 cooldown_secs: float = 0.0,
+                 cooldown_cap: Optional[float] = None):
         # failure_threshold: failures before a host is blacklisted
         # (reference blacklists on first failure by default).
         self._failures: Dict[str, int] = {}
         self._blacklist: Dict[str, float] = {}
+        # Times each host has ENTERED the blacklist: drives the
+        # repeat-failure cooldown doubling.
+        self._blacklist_count: Dict[str, int] = {}
         self._threshold = max(1, failure_threshold)
-        self._cooldown = cooldown_secs
+        self._cooldown = max(0.0, cooldown_secs)
+        self._cooldown_cap = (
+            max(self._cooldown, cooldown_cap)
+            if cooldown_cap is not None
+            else self._cooldown * DEFAULT_COOLDOWN_CAP_MULTIPLE)
         self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, failure_threshold: Optional[int] = None,
+                 cooldown_secs: Optional[float] = None
+                 ) -> "WorkerStateRegistry":
+        """Registry wired to the launcher env — the ONE read point for
+        ``HOROVOD_HOST_FAILURE_THRESHOLD`` (default 1: first failure
+        blacklists, reference behavior) and
+        ``HOROVOD_BLACKLIST_COOLDOWN`` (seconds, default 0 =
+        permanent).  Explicit arguments win over the env."""
+        if failure_threshold is None:
+            failure_threshold = env_int(
+                "HOROVOD_HOST_FAILURE_THRESHOLD", 1, minimum=1)
+        if cooldown_secs is None:
+            cooldown_secs = env_float(
+                "HOROVOD_BLACKLIST_COOLDOWN", 0.0, minimum=0.0)
+        return cls(failure_threshold, cooldown_secs)
 
     def record_failure(self, host: str) -> bool:
         """Record a worker failure on ``host``; returns True if the host
@@ -30,23 +77,56 @@ class WorkerStateRegistry:
         with self._lock:
             self._failures[host] = self._failures.get(host, 0) + 1
             if self._failures[host] >= self._threshold:
+                if host not in self._blacklist:
+                    self._blacklist_count[host] = \
+                        self._blacklist_count.get(host, 0) + 1
                 self._blacklist[host] = time.monotonic()
                 return True
             return False
 
     def record_success(self, host: str):
+        """A worker on ``host`` ran to clean exit: clear its failure
+        streak and reset its cooldown doubling.  This never lifts —
+        or weakens — an ACTIVE blacklist entry: a straggler exiting 0
+        while its host is blacklisted must not collapse a doubled
+        cooldown back to the base, and with ``cooldown_secs=0`` a
+        blacklisted host stays out permanently (only cooldown expiry
+        readmits)."""
         with self._lock:
+            if host in self._blacklist:
+                return
             self._failures.pop(host, None)
+            self._blacklist_count.pop(host, None)
+
+    def cooldown_for(self, host: str) -> float:
+        """Effective cooldown for ``host``'s current/next blacklist
+        entry: base doubled per repeat blacklist, capped; 0 = permanent."""
+        with self._lock:
+            return self._cooldown_for_locked(host)
+
+    def _cooldown_for_locked(self, host: str) -> float:
+        if not self._cooldown:
+            return 0.0
+        repeats = max(1, self._blacklist_count.get(host, 1))
+        return min(self._cooldown * (2 ** (repeats - 1)),
+                   self._cooldown_cap)
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
             ts = self._blacklist.get(host)
             if ts is None:
                 return False
-            if self._cooldown and time.monotonic() - ts > self._cooldown:
-                # Cooldown elapsed: give the host another chance.
+            cooldown = self._cooldown_for_locked(host)
+            if cooldown and time.monotonic() - ts > cooldown:
+                # Cooldown elapsed: give the host another chance.  The
+                # failure streak resets too (it must re-earn the
+                # threshold), but the blacklist COUNT survives so a
+                # repeat failure re-blacklists with a doubled cooldown.
                 del self._blacklist[host]
                 self._failures.pop(host, None)
+                LOG.info("host %s blacklist cooldown (%.1fs) expired; "
+                         "eligible to rejoin via discovery", host,
+                         cooldown)
                 return False
             return True
 

@@ -17,13 +17,13 @@ from __future__ import annotations
 
 import logging
 import os
-import socket
 import threading
 import time
 from typing import Any, Dict, Optional
 
 from ..common import faultline
 from ..runner import services
+from ..runner.http_client import is_transient, jittered
 
 LOG = logging.getLogger("horovod_tpu.elastic")
 
@@ -117,10 +117,12 @@ class WorkerNotificationManager:
         secret = os.environ.get("HOROVOD_SECRET_KEY", "")
         self._server = services.MessageServer(self._handle, secret)
         port = self._server.start()
+        # retries=None: a registration lost to a transient flake would
+        # cost this worker every future host-update notification.
         services.send_message(
             _driver_addr(), secret,
             {"kind": "register", "host": self.host, "slot": self.slot,
-             "port": port, "pid": os.getpid()})
+             "port": port, "pid": os.getpid()}, retries=None)
         LOG.debug("worker %s:%d notification service on port %d",
                   self.host, self.slot, port)
 
@@ -174,16 +176,26 @@ class WorkerNotificationManager:
                     # alive), this demand is its only world-change
                     # signal.
                     msg["min_epoch"] = min_epoch
-                resp = services.send_message(_driver_addr(), secret, msg)
-            except (ConnectionError, OSError, socket.timeout) as exc:
-                # Transient RPC failure: retry until the deadline; a
-                # persistently unreachable driver is a job failure, not
-                # a clean stop (exit 0 would read as success).
+                # retries=None: opt in to the env-tuned retry/backoff —
+                # the rendezvous poll IS the self-healing path, and its
+                # outer loop still owns the hard deadline.
+                resp = services.send_message(_driver_addr(), secret,
+                                             msg, retries=None)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                # Transient RPC failure (the send's own bounded
+                # retry/backoff already exhausted): keep polling until
+                # the deadline; a persistently unreachable driver is a
+                # job failure, not a clean stop (exit 0 would read as
+                # success).  Fatal failures (auth rejection) raise.
+                if not is_transient(exc):
+                    raise
                 if time.monotonic() > deadline:
                     arm_last_resort_exit("driver unreachable")
                     raise TimeoutError(
                         "elastic driver unreachable: %s" % exc)
-                time.sleep(1.0)
+                # Jittered: N orphaned workers must not hammer a
+                # recovering driver in lockstep.
+                time.sleep(jittered(1.0))
                 continue
             status = resp.get("status")
             if status == "go":
@@ -195,7 +207,7 @@ class WorkerNotificationManager:
                             "elastic rendezvous: driver never advanced "
                             "past epoch %d for worker %s:%d"
                             % (min_epoch - 1, self.host, self.slot))
-                    time.sleep(0.5)
+                    time.sleep(jittered(0.5))
                     continue
                 # New epoch assignment supersedes any pending update
                 # notification for an older epoch.
@@ -215,7 +227,9 @@ class WorkerNotificationManager:
                 raise TimeoutError(
                     "elastic rendezvous timed out for worker %s:%d"
                     % (self.host, self.slot))
-            time.sleep(0.25)
+            # Jittered wait-state poll: workers parked on "wait"
+            # otherwise synchronize their polls against the driver.
+            time.sleep(jittered(0.25))
 
     def shutdown(self):
         if self._server is not None:

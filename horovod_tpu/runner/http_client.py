@@ -1,14 +1,142 @@
 """Rendezvous KV client (reference: horovod/runner/http/http_client.py):
-PUT/GET against the launcher's RendezvousServer with HMAC auth."""
+PUT/GET against the launcher's RendezvousServer with HMAC auth.
+
+Also home of the runner control plane's shared retry/backoff layer
+(``request_with_retry``): transient failures — connection refused or
+reset, timeouts, server 5xx — are absorbed with exponential backoff and
+full jitter up to a bounded retry budget and per-call deadline, while
+fatal ones (HMAC-auth 403, client errors) raise immediately.  The
+message service (``runner/services.py``) routes its sends through the
+same helper, so ``HVD_TPU_FAULT=runner.rpc.request:drop...`` covers
+every retried control-plane RPC from one seam.
+"""
 
 from __future__ import annotations
 
+import errno
+import http.client
+import logging
+import random
+import socket
 import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import Callable, Optional, TypeVar
 
+from ..common import faultline
+from ..common.envutil import env_float, env_int
 from .http_server import SECRET_HEADER, compute_digest
+
+LOG = logging.getLogger("horovod_tpu.runner.rpc")
+
+T = TypeVar("T")
+
+# Errno set treated as transient on a bare OSError: the peer (or the
+# network to it) is momentarily gone, not wrong — including LOCAL
+# resource pressure (fd exhaustion, ephemeral-port depletion from
+# per-poll connections in TIME_WAIT), which passes as fast as the
+# kernel recycles resources.
+_TRANSIENT_ERRNOS = frozenset({
+    errno.ECONNREFUSED, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.EPIPE, errno.EHOSTUNREACH, errno.ENETUNREACH,
+    errno.EHOSTDOWN, errno.ENETDOWN,
+    errno.ETIMEDOUT, errno.EAGAIN,
+    errno.EADDRNOTAVAIL, errno.EADDRINUSE,
+    errno.EMFILE, errno.ENFILE, errno.ENOBUFS,
+})
+
+# Per-sleep cap on the backoff (the deadline bounds the total anyway).
+_BACKOFF_CAP_S = 5.0
+
+
+def rpc_retry_config() -> "tuple[int, float, float]":
+    """(max_retries, initial_backoff_s, deadline_s) from the env.
+
+    The ONE read point for the retry knobs so bootstrap defaults cannot
+    fork across call sites (graftlint env-default-conflict discipline):
+    ``HOROVOD_RPC_MAX_RETRIES`` (default 3 retries after the first
+    attempt), ``HOROVOD_RPC_RETRY_BACKOFF`` (default 0.1 s, doubled per
+    failure with full jitter), ``HOROVOD_RPC_DEADLINE`` (default 30 s
+    wall budget per retried call)."""
+    return (env_int("HOROVOD_RPC_MAX_RETRIES", 3, minimum=0),
+            env_float("HOROVOD_RPC_RETRY_BACKOFF", 0.1, minimum=0.0),
+            env_float("HOROVOD_RPC_DEADLINE", 30.0, minimum=0.0))
+
+
+def jittered(seconds: float) -> float:
+    """Full jitter over [0.5x, 1.5x): the ONE place the control
+    plane's desynchronization window is defined — N peers sleeping the
+    same nominal interval must not re-converge on one server in
+    lockstep."""
+    return seconds * (0.5 + random.random())
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether a control-plane RPC failure is worth retrying.
+
+    Transient: connection refused/reset/aborted, closed peers,
+    timeouts, DNS hiccups, torn HTTP responses, and server-side 5xx
+    (the handler crashed; the server itself is alive).  Fatal: auth
+    rejections (HTTP 403, bad MAC ``PermissionError``) and every other
+    client error — retrying those hammers a server that already gave a
+    definitive answer."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500
+    if isinstance(exc, urllib.error.URLError):
+        reason = exc.reason
+        if isinstance(reason, BaseException):
+            return is_transient(reason)
+        return True  # opaque urllib failure: assume the network burped
+    if isinstance(exc, PermissionError):
+        return False  # HMAC rejection: retrying cannot help
+    if isinstance(exc, (ConnectionError, TimeoutError, socket.timeout,
+                        socket.gaierror)):
+        return True
+    if isinstance(exc, http.client.HTTPException):
+        return True  # torn response from a dying/restarting server
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+def request_with_retry(attempt: Callable[[], T], what: str = "rpc",
+                       max_retries: Optional[int] = None,
+                       backoff: Optional[float] = None,
+                       deadline: Optional[float] = None) -> T:
+    """Run ``attempt`` until it returns, retrying transient failures
+    with exponential backoff + full jitter, bounded by both a retry
+    count and a wall-clock deadline.  Non-transient exceptions (and the
+    last transient one once the budget is spent) propagate unchanged —
+    exhaustion escalates to the caller's fail-fast path, it never
+    downgrades the error."""
+    env_retries, env_backoff, env_deadline = rpc_retry_config()
+    retries = env_retries if max_retries is None else max(0, max_retries)
+    base = env_backoff if backoff is None else max(0.0, backoff)
+    budget = env_deadline if deadline is None else max(0.0, deadline)
+    give_up_at = time.monotonic() + budget
+    failures = 0
+    while True:
+        try:
+            if faultline.site("runner.rpc.request"):
+                raise ConnectionResetError(
+                    "injected transient RPC failure (faultline "
+                    "runner.rpc.request) in %s" % what)
+            return attempt()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not is_transient(exc):
+                raise
+            failures += 1
+            now = time.monotonic()
+            if failures > retries or now >= give_up_at:
+                LOG.warning("%s failed after %d attempt(s), giving up "
+                            "(retries=%d deadline=%.1fs): %s",
+                            what, failures, retries, budget, exc)
+                raise
+            sleep = min(base * (2 ** (failures - 1)), _BACKOFF_CAP_S)
+            sleep = min(jittered(sleep), max(0.0, give_up_at - now))
+            LOG.debug("%s transient failure %d/%d (%s); retrying in "
+                      "%.3fs", what, failures, retries, exc, sleep)
+            time.sleep(sleep)
 
 
 class RendezvousClient:
@@ -25,24 +153,34 @@ class RendezvousClient:
     def put(self, key: str, value: str):
         path = "/" + key.lstrip("/")
         body = value.encode()
-        req = urllib.request.Request(self.base + path, data=body,
-                                     method="PUT",
-                                     headers=self._headers(body))
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            if resp.status != 200:
-                raise RuntimeError("rendezvous PUT failed: %d" % resp.status)
+
+        def attempt():
+            req = urllib.request.Request(self.base + path, data=body,
+                                         method="PUT",
+                                         headers=self._headers(body))
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(
+                        "rendezvous PUT failed: %d" % resp.status)
+
+        request_with_retry(attempt, what="rendezvous PUT %s" % key)
 
     def get(self, key: str) -> Optional[str]:
         path = "/" + key.lstrip("/")
-        req = urllib.request.Request(self.base + path, method="GET",
-                                     headers=self._headers(path.encode()))
-        try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                return resp.read().decode()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+
+        def attempt():
+            req = urllib.request.Request(self.base + path, method="GET",
+                                         headers=self._headers(
+                                             path.encode()))
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.read().decode()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None  # a missing key is an answer, not a fault
+                raise
+
+        return request_with_retry(attempt, what="rendezvous GET %s" % key)
 
     def get_blocking(self, key: str, timeout: float = 60.0,
                      interval: float = 0.1) -> str:
@@ -53,10 +191,19 @@ class RendezvousClient:
                 return v
             if time.monotonic() > deadline:
                 raise TimeoutError("rendezvous key %r never appeared" % key)
-            time.sleep(interval)
+            # Jittered poll: at world bootstrap N workers poll one KV
+            # server for the same key — a fixed interval phase-locks
+            # their polls into synchronized request bursts.
+            time.sleep(jittered(interval))
 
     def delete(self, key: str):
         path = "/" + key.lstrip("/")
-        req = urllib.request.Request(self.base + path, method="DELETE",
-                                     headers=self._headers(path.encode()))
-        urllib.request.urlopen(req, timeout=10)
+
+        def attempt():
+            req = urllib.request.Request(self.base + path,
+                                         method="DELETE",
+                                         headers=self._headers(
+                                             path.encode()))
+            urllib.request.urlopen(req, timeout=10)
+
+        request_with_retry(attempt, what="rendezvous DELETE %s" % key)

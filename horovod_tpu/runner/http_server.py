@@ -14,9 +14,12 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+
+LOG = logging.getLogger("horovod_tpu.runner.rendezvous")
 
 SECRET_HEADER = "X-Hvd-Secret"
 
@@ -37,25 +40,46 @@ class _KvHandler(BaseHTTPRequestHandler):
         given = self.headers.get(SECRET_HEADER, "")
         return hmac.compare_digest(given, compute_digest(secret, payload))
 
-    def do_PUT(self):
-        length = int(self.headers.get("Content-Length", "0"))
-        body = self.rfile.read(length)
-        if not self._authorized(body):
-            self.send_response(403)
+    def _server_error(self, exc: Exception):
+        """A handler exception is OUR fault, not the client's: answer
+        500 so the client's retry layer classifies it as transient and
+        backs off, instead of a torn connection it cannot tell apart
+        from an auth drop."""
+        LOG.warning("rendezvous handler failed on %s %s: %s",
+                    self.command, self.path, exc)
+        try:
+            self.send_response(500)
             self.end_headers()
+        except Exception:  # noqa: BLE001 — socket already gone
+            pass
+
+    def do_PUT(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            if not self._authorized(body):
+                self.send_response(403)
+                self.end_headers()
+                return
+            with self.server.lock:  # type: ignore[attr-defined]
+                self.server.store[self.path] = body  # type: ignore
+        except Exception as exc:  # noqa: BLE001 — report as 5xx
+            self._server_error(exc)
             return
-        with self.server.lock:  # type: ignore[attr-defined]
-            self.server.store[self.path] = body  # type: ignore
         self.send_response(200)
         self.end_headers()
 
     def do_GET(self):
-        if not self._authorized(self.path.encode()):
-            self.send_response(403)
-            self.end_headers()
+        try:
+            if not self._authorized(self.path.encode()):
+                self.send_response(403)
+                self.end_headers()
+                return
+            with self.server.lock:  # type: ignore[attr-defined]
+                value = self.server.store.get(self.path)  # type: ignore
+        except Exception as exc:  # noqa: BLE001 — report as 5xx
+            self._server_error(exc)
             return
-        with self.server.lock:  # type: ignore[attr-defined]
-            value = self.server.store.get(self.path)  # type: ignore
         if value is None:
             self.send_response(404)
             self.end_headers()
@@ -66,12 +90,16 @@ class _KvHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_DELETE(self):
-        if not self._authorized(self.path.encode()):
-            self.send_response(403)
-            self.end_headers()
+        try:
+            if not self._authorized(self.path.encode()):
+                self.send_response(403)
+                self.end_headers()
+                return
+            with self.server.lock:  # type: ignore[attr-defined]
+                self.server.store.pop(self.path, None)  # type: ignore
+        except Exception as exc:  # noqa: BLE001 — report as 5xx
+            self._server_error(exc)
             return
-        with self.server.lock:  # type: ignore[attr-defined]
-            self.server.store.pop(self.path, None)  # type: ignore
         self.send_response(200)
         self.end_headers()
 

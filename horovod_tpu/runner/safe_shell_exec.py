@@ -77,6 +77,14 @@ class ManagedProcess:
             os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+        # Confirm the death: a caller that exits right after terminate()
+        # (driver teardown) must not orphan a killed-but-not-yet-reaped
+        # child on a loaded box — SIGKILL delivery is asynchronous.
+        deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.05)
 
 
 def execute(command: List[str], env: Optional[Dict[str, str]] = None,

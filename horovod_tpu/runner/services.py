@@ -95,10 +95,35 @@ class MessageServer:
 
 
 def send_message(addr: Tuple[str, int], secret: str, obj: Any,
-                 timeout: float = 10.0) -> Any:
-    with socket.create_connection(addr, timeout=timeout) as sock:
-        sock.sendall(_pack(secret, obj))
-        return _unpack(secret, sock)
+                 timeout: float = 10.0,
+                 retries: Optional[int] = 0,
+                 deadline: Optional[float] = None) -> Any:
+    """One authenticated request/response exchange, routed through the
+    runner's shared retry/backoff layer (``http_client.
+    request_with_retry``): transient transport failures — refused or
+    reset connections, timeouts, a peer that died mid-reply — can be
+    retried with exponential backoff + jitter; auth rejections
+    (``PermissionError``) are fatal immediately.
+
+    ``retries`` defaults to 0 (single attempt): most callers are
+    liveness probes or teardown paths whose OWN failure counters are
+    calibrated for one-attempt semantics — a dead peer must read as
+    dead at the caller's cadence, not after a hidden in-call retry
+    storm.  Callers that want the self-healing behavior opt in with an
+    explicit count, or ``retries=None`` for the ``HOROVOD_RPC_*`` env
+    defaults."""
+    from .http_client import request_with_retry
+
+    def attempt():
+        with socket.create_connection(addr, timeout=timeout) as sock:
+            sock.sendall(_pack(secret, obj))
+            return _unpack(secret, sock)
+
+    what = "message %r to %s:%d" % (
+        obj.get("kind") if isinstance(obj, dict) else type(obj).__name__,
+        addr[0], addr[1])
+    return request_with_retry(attempt, what=what, max_retries=retries,
+                              deadline=deadline)
 
 
 class TaskService:

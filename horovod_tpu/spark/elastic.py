@@ -280,7 +280,7 @@ def run_elastic(fn: Callable, args: tuple = (),
                 max_np: Optional[int] = None,
                 elastic_timeout: float = 600.0,
                 start_timeout: float = 120.0,
-                failure_threshold: int = 1,
+                failure_threshold: Optional[int] = None,
                 extra_env: Optional[Dict[str, str]] = None,
                 verbose: int = 1) -> List[Any]:
     """Run ``fn`` elastically on Spark executors (reference

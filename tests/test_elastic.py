@@ -77,6 +77,209 @@ def test_worker_state_registry_threshold():
     assert reg2.blacklisted_hosts() == ["x"]
 
 
+def test_worker_state_registry_cooldown_zero_is_permanent():
+    # Satellite of the cooldown wiring: the default (0) must still mean
+    # "blacklisted forever" (reference parity) — record_success clears
+    # the failure streak but never lifts an active blacklist entry.
+    reg = WorkerStateRegistry(failure_threshold=1, cooldown_secs=0.0)
+    assert reg.record_failure("h")
+    assert reg.is_blacklisted("h")
+    time.sleep(0.05)
+    assert reg.is_blacklisted("h")
+    reg.record_success("h")
+    assert reg.is_blacklisted("h")
+    assert reg.cooldown_for("h") == 0.0
+
+
+def test_worker_state_registry_cooldown_expiry_readmits():
+    reg = WorkerStateRegistry(failure_threshold=1, cooldown_secs=0.1)
+    assert reg.record_failure("h")
+    assert reg.is_blacklisted("h")
+    time.sleep(0.15)
+    assert not reg.is_blacklisted("h")
+    assert reg.blacklisted_hosts() == []
+    # The failure streak reset with the expiry: the host must re-earn
+    # the threshold before it is blacklisted again.
+    reg2 = WorkerStateRegistry(failure_threshold=2, cooldown_secs=0.1)
+    reg2.record_failure("h")
+    assert reg2.record_failure("h")
+    time.sleep(0.15)
+    assert not reg2.is_blacklisted("h")
+    assert not reg2.record_failure("h")  # 1/2 again, not 3/2
+
+
+def test_worker_state_registry_reblacklist_doubles_cooldown():
+    reg = WorkerStateRegistry(failure_threshold=1, cooldown_secs=10.0)
+    assert reg.record_failure("h")
+    assert reg.cooldown_for("h") == 10.0
+    # Force expiry without sleeping: age the entry past the cooldown.
+    for expected in (20.0, 40.0, 80.0, 160.0, 160.0):  # capped at 16x
+        reg._blacklist["h"] = time.monotonic() - 10 * 160.0
+        assert not reg.is_blacklisted("h")  # expired -> readmitted
+        assert reg.record_failure("h")      # repeat failure
+        assert reg.cooldown_for("h") == expected
+    # A straggler exiting 0 while the host is STILL blacklisted must
+    # not weaken the doubled cooldown (or clear the streak).
+    reg.record_success("h")
+    assert reg.cooldown_for("h") == 160.0
+    # A recorded success after readmission resets the doubling.
+    reg._blacklist.pop("h")
+    reg.record_success("h")
+    assert reg.cooldown_for("h") == 10.0
+
+
+def test_worker_state_registry_from_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_HOST_FAILURE_THRESHOLD", "3")
+    monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN", "42.5")
+    reg = WorkerStateRegistry.from_env()
+    assert reg._threshold == 3
+    assert reg._cooldown == 42.5
+    # Explicit arguments win over the env.
+    reg = WorkerStateRegistry.from_env(failure_threshold=1,
+                                       cooldown_secs=0.0)
+    assert reg._threshold == 1 and reg._cooldown == 0.0
+    # Malformed env degrades to the defaults, not a crash.
+    monkeypatch.setenv("HOROVOD_HOST_FAILURE_THRESHOLD", "lots")
+    monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN", "soon")
+    reg = WorkerStateRegistry.from_env()
+    assert reg._threshold == 1 and reg._cooldown == 0.0
+
+
+def test_discovery_script_timeout_is_transient(tmp_path, monkeypatch):
+    from horovod_tpu.elastic.discovery import DiscoveryFailure
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\nsleep 30\n")
+    script.chmod(0o755)
+    # Constructor argument.
+    disc = HostDiscoveryScript(str(script), timeout=0.2)
+    with pytest.raises(DiscoveryFailure):
+        disc.find_available_hosts_and_slots()
+    # Env wiring (HOROVOD_DISCOVERY_SCRIPT_TIMEOUT) when no argument.
+    monkeypatch.setenv("HOROVOD_DISCOVERY_SCRIPT_TIMEOUT", "0.2")
+    disc = HostDiscoveryScript(str(script))
+    with pytest.raises(DiscoveryFailure):
+        disc.find_available_hosts_and_slots()
+
+
+def test_discovery_script_nonzero_rc_is_transient(tmp_path):
+    from horovod_tpu.elastic.discovery import DiscoveryFailure
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\nexit 7\n")
+    script.chmod(0o755)
+    with pytest.raises(DiscoveryFailure):
+        HostDiscoveryScript(str(script)).find_available_hosts_and_slots()
+
+
+def test_discovery_script_malformed_slots_skipped(tmp_path):
+    # One bad line must not kill the whole pass (it used to raise
+    # ValueError and lose the tick): skip it, keep the good hosts.
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\necho host1:4\necho host2:abc\n"
+                      "echo host3\n")
+    script.chmod(0o755)
+    disc = HostDiscoveryScript(str(script), default_slots=2)
+    assert disc.find_available_hosts_and_slots() == {
+        "host1": 4, "host3": 2}
+
+
+class _FlakyDiscovery(FixedHosts):
+    """FixedHosts that raises DiscoveryFailure while ``failing``."""
+
+    def __init__(self, hosts):
+        super().__init__(hosts)
+        self.failing = False
+
+    def find_available_hosts_and_slots(self):
+        from horovod_tpu.elastic.discovery import DiscoveryFailure
+        if self.failing:
+            raise DiscoveryFailure("flaking")
+        return super().find_available_hosts_and_slots()
+
+
+def _make_driver(discovery, **kwargs):
+    from horovod_tpu.elastic.driver import ElasticDriver
+    return ElasticDriver(["true"], discovery, min_np=1, max_np=None,
+                         **kwargs)
+
+
+def _close_driver(driver):
+    # The constructor binds both server sockets without starting their
+    # serve loops; close the sockets directly (stop() would block on a
+    # shutdown handshake the never-started loop cannot answer).
+    driver._server._server.server_close()
+    driver._kv._httpd.server_close()
+
+
+def test_discovery_failure_streak_tolerance_and_escalation():
+    disc = _FlakyDiscovery({"a": 1})
+    driver = _make_driver(disc, discovery_failure_threshold=3)
+    reasons = []
+    driver._recompute_world = reasons.append
+    try:
+        driver._discovery_tick()
+        assert driver._hosts.current_hosts == {"a": 1}
+        assert reasons == ["discovery update"]
+        # Failures below the threshold keep the last good view.
+        disc.failing = True
+        driver._discovery_tick()
+        driver._discovery_tick()
+        assert driver._hosts.current_hosts == {"a": 1}
+        assert reasons == ["discovery update"]
+        # The threshold-th consecutive failure escalates: the view is
+        # invalidated and the world recomputes onto the below-min_np
+        # fail-fast deadline.
+        driver._discovery_tick()
+        assert driver._hosts.current_hosts == {}
+        assert reasons == ["discovery update", "discovery escalation"]
+        # Recovery after escalation re-forms the world.
+        disc.failing = False
+        driver._discovery_tick()
+        assert driver._hosts.current_hosts == {"a": 1}
+        assert driver._discovery_failures == 0
+        assert reasons[-1] == "discovery update"
+    finally:
+        _close_driver(driver)
+
+
+def test_discovery_success_resets_failure_streak():
+    disc = _FlakyDiscovery({"a": 1})
+    driver = _make_driver(disc, discovery_failure_threshold=3)
+    driver._recompute_world = lambda reason: None
+    try:
+        driver._discovery_tick()
+        disc.failing = True
+        driver._discovery_tick()
+        driver._discovery_tick()
+        disc.failing = False
+        driver._discovery_tick()  # streak broken
+        assert driver._discovery_failures == 0
+        disc.failing = True
+        driver._discovery_tick()
+        driver._discovery_tick()
+        # 2 < 3: the earlier near-miss streak must not carry over.
+        assert driver._hosts.current_hosts == {"a": 1}
+    finally:
+        _close_driver(driver)
+
+
+def test_respawn_backoff_grows_and_caps():
+    driver = _make_driver(FixedHosts({"127.0.0.1": 1}),
+                          respawn_backoff_base=0.02,
+                          respawn_backoff_cap=0.08)
+    driver._make_worker_proc = lambda slot, env: None  # carrier declines
+    slot = ("127.0.0.1", 0)
+    try:
+        driver._target = [slot]
+        backoffs = []
+        for _ in range(4):
+            time.sleep(0.1)  # > cap: every call is an eligible attempt
+            driver._check_procs()
+            backoffs.append(driver._spawn_backoff[slot])
+        assert backoffs == [0.04, 0.08, 0.08, 0.08]
+    finally:
+        _close_driver(driver)
+
+
 def test_elastic_sampler_shard_and_resume():
     s = ElasticSampler(dataset_size=10, shuffle=False)
     # Uninitialized world -> single rank sees everything.
@@ -512,6 +715,156 @@ train(state)
         env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "DONE rank=0 size=1 batch=6" in proc.stdout, proc.stdout
+
+
+def test_elastic_blacklist_cooldown_rejoin(tmp_path):
+    """Blacklist cooldown, end to end: a die-injected host is
+    blacklisted, the survivor resumes alone, the cooldown expires, the
+    host re-enters discovery, its worker respawns and rejoins via the
+    normal re-rendezvous, and the run finishes with the FULL world.
+    The injection fires only in world epoch 1 (@epoch=1), so the
+    respawned worker on the same host proves recovery, not death."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+state.extra = 0
+
+@elastic.run
+def train(state):
+    while hvd.size() < 2 or state.extra < 3:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.batch += 1
+        if hvd.size() >= 2:
+            state.extra += 1
+        time.sleep(0.05)
+        state.commit()
+    print("DONE rank=%d size=%d" % (hvd.rank(), hvd.size()), flush=True)
+
+train(state)
+""")
+    env = _env()
+    env["HVD_TPU_FAULT"] = \
+        "elastic.state.commit:die:21@host=127.0.0.2@epoch=1"
+    env["HOROVOD_BLACKLIST_COOLDOWN"] = "3"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "1",
+         "--max-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # The host was blacklisted with a cooldown, expired, and rejoined:
+    # BOTH ranks finish in a size-2 world.
+    for r in range(2):
+        assert "DONE rank=%d size=2" % r in proc.stdout, \
+            proc.stdout + proc.stderr
+    assert "blacklisting host 127.0.0.2" in proc.stderr, proc.stderr
+    assert "cooldown" in proc.stderr, proc.stderr
+
+
+def test_elastic_discovery_flake_recovery(tmp_path):
+    """A bounded discovery-flake window (drop @after=2 @times=2, under
+    the default HOROVOD_DISCOVERY_FAILURE_THRESHOLD=3) is absorbed on
+    the last good host view: the world never changes and the run
+    completes cleanly."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+@elastic.run
+def train(state):
+    while state.batch < 40:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.batch += 1
+        time.sleep(0.05)
+        state.commit()
+    print("DONE rank=%d size=%d batch=%d"
+          % (hvd.rank(), hvd.size(), state.batch), flush=True)
+
+train(state)
+""")
+    env = _env()
+    env["HVD_TPU_FAULT"] = "elastic.discovery.run:drop@after=2@times=2"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(2):
+        assert "DONE rank=%d size=2 batch=40" % r in proc.stdout, \
+            proc.stdout + proc.stderr
+    assert "keeping last good host view" in proc.stderr, proc.stderr
+
+
+def test_elastic_discovery_escalation_fails_fast(tmp_path):
+    """The escalation boundary: discovery fails PERSISTENTLY (drop with
+    no @times bound), the failure streak crosses the threshold, the
+    driver discards the host view, and the run dies LOUDLY via the
+    elastic below-min_np deadline — no hang, no indefinite training on
+    a stale world view."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+@elastic.run
+def train(state):
+    while True:
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                      name="b%d" % state.batch)
+        state.batch += 1
+        time.sleep(0.05)
+        state.commit()
+
+train(state)
+""")
+    env = _env()
+    env["HVD_TPU_FAULT"] = "elastic.discovery.run:drop@after=4"
+    env["HOROVOD_ELASTIC_EXIT_GRACE"] = "5"
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         "--elastic-timeout", "6",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(240),
+        env=env, cwd=REPO)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "escalating" in proc.stderr, proc.stderr
+    assert "below min_np" in proc.stderr, proc.stderr
+    assert time.monotonic() - t0 < scaled_timeout(180)
+
+
+def test_elastic_spawn_drop_respawn_backoff_recovers(tmp_path):
+    """driver.spawn.attempt drop: both initial spawn attempts are
+    declined by injection; the reap loop's exponential respawn backoff
+    retries them and the world still forms and finishes."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+@elastic.run
+def train(state):
+    while state.batch < 3:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.batch += 1
+        state.commit()
+    print("DONE rank=%d size=%d" % (hvd.rank(), hvd.size()), flush=True)
+
+train(state)
+""")
+    env = _env()
+    env["HVD_TPU_FAULT"] = "driver.spawn.attempt:drop@times=2"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(2):
+        assert "DONE rank=%d size=2" % r in proc.stdout, \
+            proc.stdout + proc.stderr
+    assert "dropped (faultline driver.spawn.attempt)" in proc.stderr, \
+        proc.stderr
 
 
 def test_elastic_unformable_world_worker_deadline(tmp_path):

@@ -94,6 +94,57 @@ def test_condition_gates_by_env(monkeypatch):
     assert faultline.site(DROP_SITE) is True
 
 
+def test_times_bounds_fires(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop@times=2" % DROP_SITE)
+    assert [faultline.site(DROP_SITE) for _ in range(4)] == [
+        True, True, False, False]
+
+
+def test_after_skips_then_fires(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop@after=2" % DROP_SITE)
+    assert [faultline.site(DROP_SITE) for _ in range(4)] == [
+        False, False, True, True]
+
+
+def test_after_and_times_window(monkeypatch):
+    # healthy, then flaky, then healthy again — the drop-and-recover
+    # shape the self-healing tests arm.
+    monkeypatch.setenv("HVD_TPU_FAULT",
+                       "%s:drop@after=1@times=2" % DROP_SITE)
+    assert [faultline.site(DROP_SITE) for _ in range(5)] == [
+        False, True, True, False, False]
+
+
+def test_counting_keys_compose_with_env_conditions(monkeypatch):
+    # Ineligible calls (condition unmet) must not consume the window.
+    monkeypatch.setenv("HVD_TPU_FAULT",
+                       "%s:drop@rank=1@times=1" % DROP_SITE)
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    assert faultline.site(DROP_SITE) is False
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    assert faultline.site(DROP_SITE) is True
+    assert faultline.site(DROP_SITE) is False  # window consumed
+
+
+def test_rearm_resets_fire_counters(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop@times=1" % DROP_SITE)
+    assert faultline.site(DROP_SITE) is True
+    assert faultline.site(DROP_SITE) is False
+    # A changed env value is a new experiment: counters restart.
+    monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop@times=1 " % DROP_SITE)
+    assert faultline.site(DROP_SITE) is True
+
+
+@pytest.mark.parametrize("bad", [
+    "%s:drop@times=x" % DROP_SITE,
+    "%s:drop@times=-1" % DROP_SITE,
+    "%s:drop@after=nope" % DROP_SITE,
+])
+def test_counting_keys_parse_strictly(bad):
+    with pytest.raises(ValueError):
+        faultline.parse(bad)
+
+
 def test_rearm_within_one_process(monkeypatch):
     monkeypatch.setenv("HVD_TPU_FAULT", "%s:drop" % DROP_SITE)
     assert faultline.site(DROP_SITE) is True

@@ -139,6 +139,191 @@ def test_safe_shell_exec_streams_and_kills():
         GRACEFUL_TERMINATION_TIME_S + 2
 
 
+def test_rpc_transient_classification():
+    # Transient: the peer (or the path to it) is momentarily gone.
+    import urllib.error
+    from horovod_tpu.runner.http_client import is_transient
+    assert is_transient(ConnectionRefusedError("refused"))
+    assert is_transient(ConnectionResetError("reset"))
+    assert is_transient(TimeoutError("slow"))
+    assert is_transient(
+        urllib.error.URLError(ConnectionRefusedError("refused")))
+    assert is_transient(
+        urllib.error.HTTPError("u", 500, "handler died", {}, None))
+    assert is_transient(
+        urllib.error.HTTPError("u", 503, "overloaded", {}, None))
+    # Local resource pressure (fd / ephemeral-port exhaustion from
+    # per-poll connections) passes as the kernel recycles — retry.
+    import errno
+    assert is_transient(OSError(errno.EMFILE, "too many open files"))
+    assert is_transient(OSError(errno.EADDRNOTAVAIL, "no free ports"))
+    # Fatal: the server answered, and the answer is "no".
+    assert not is_transient(
+        urllib.error.HTTPError("u", 403, "bad secret", {}, None))
+    assert not is_transient(
+        urllib.error.HTTPError("u", 400, "bad request", {}, None))
+    assert not is_transient(PermissionError("bad MAC"))
+    assert not is_transient(ValueError("not an rpc failure at all"))
+
+
+def test_request_with_retry_absorbs_transient_failures():
+    from horovod_tpu.runner.http_client import request_with_retry
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("flake")
+        return "ok"
+
+    assert request_with_retry(flaky, backoff=0.01) == "ok"
+    assert len(calls) == 3
+
+
+def test_request_with_retry_never_retries_fatal():
+    from horovod_tpu.runner.http_client import request_with_retry
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise PermissionError("auth rejection")
+
+    with pytest.raises(PermissionError):
+        request_with_retry(fatal, backoff=0.01)
+    assert len(calls) == 1
+
+
+def test_request_with_retry_exhaustion_raises_last_error():
+    from horovod_tpu.runner.http_client import request_with_retry
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise ConnectionRefusedError("down for good")
+
+    with pytest.raises(ConnectionRefusedError):
+        request_with_retry(always_down, max_retries=2, backoff=0.01)
+    assert len(calls) == 3  # first attempt + 2 retries
+
+
+def test_request_with_retry_respects_deadline():
+    from horovod_tpu.runner.http_client import request_with_retry
+
+    def always_down():
+        raise ConnectionRefusedError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        request_with_retry(always_down, max_retries=1000,
+                           backoff=0.05, deadline=0.3)
+    assert time.monotonic() - t0 < 5.0
+
+
+class _FlakyStore(dict):
+    """KV store whose first N writes raise (server-side handler crash
+    → the server answers 500, which the client must retry)."""
+
+    def __init__(self, failures: int):
+        super().__init__()
+        self.failures = failures
+
+    def __setitem__(self, key, value):
+        if self.failures > 0:
+            self.failures -= 1
+            raise RuntimeError("injected store failure")
+        dict.__setitem__(self, key, value)
+
+
+def test_rendezvous_5xx_is_retried(monkeypatch):
+    # A crashing PUT handler answers 500 (not a torn connection); the
+    # client's retry layer absorbs it and the write lands.
+    monkeypatch.setenv("HOROVOD_RPC_RETRY_BACKOFF", "0.01")
+    server = RendezvousServer(secret="s")
+    port = server.start()
+    try:
+        server._httpd.store = _FlakyStore(failures=2)
+        client = RendezvousClient("127.0.0.1:%d" % port, secret="s")
+        client.put("addr/0", "1.2.3.4:5")
+        assert client.get("addr/0") == "1.2.3.4:5"
+    finally:
+        server.stop()
+
+
+def test_rendezvous_auth_403_fails_immediately(monkeypatch):
+    # An HMAC rejection is fatal: no backoff sleep may happen on the
+    # way to the raise (retrying an auth failure hammers the server
+    # with requests it already refused).
+    import urllib.error
+
+    def no_sleep(_secs):
+        raise AssertionError("403 must not be retried")
+
+    server = RendezvousServer(secret="right")
+    port = server.start()
+    try:
+        monkeypatch.setattr(time, "sleep", no_sleep)
+        bad = RendezvousClient("127.0.0.1:%d" % port, secret="wrong")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            bad.put("addr/0", "x")
+        assert err.value.code == 403
+    finally:
+        monkeypatch.undo()
+        server.stop()
+
+
+def test_rpc_drop_and_recover_end_to_end():
+    """Self-healing RPC plane, certified by injection: every process's
+    first two control-plane RPC attempts fail with a synthetic
+    connection reset (HVD_TPU_FAULT runner.rpc.request, @times=2), and
+    the run must still complete — the retry/backoff layer absorbs the
+    transient window."""
+    script = (
+        "import horovod_tpu as hvd, numpy as np\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,"
+        " name='t')\n"
+        "np.testing.assert_allclose(np.asarray(out), 2.0)\n"
+        "print('RANK_OK', hvd.rank())\n"
+        "hvd.shutdown()\n")
+    env = _worker_env()
+    env["HVD_TPU_FAULT"] = "runner.rpc.request:drop@times=2"
+    env["HOROVOD_RPC_RETRY_BACKOFF"] = "0.05"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=scaled_timeout(180),
+        env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(2):
+        assert "RANK_OK %d" % r in proc.stdout
+
+
+def test_rpc_retry_exhaustion_fails_loudly():
+    """The escalation boundary: with the drop armed permanently, the
+    bounded retry budget exhausts and the run FAILS (non-zero rc,
+    bounded wall time) — transient-fault absorption never downgrades a
+    persistent fault into a hang."""
+    script = (
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "print('UNREACHED')\n")
+    env = _worker_env()
+    env["HVD_TPU_FAULT"] = "runner.rpc.request:drop"
+    env["HOROVOD_RPC_MAX_RETRIES"] = "2"
+    env["HOROVOD_RPC_RETRY_BACKOFF"] = "0.05"
+    env["HOROVOD_RPC_DEADLINE"] = "5"
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=scaled_timeout(120),
+        env=env, cwd=REPO)
+    assert proc.returncode != 0
+    assert "UNREACHED" not in proc.stdout
+    assert "injected transient RPC failure" in proc.stdout + proc.stderr
+    assert time.monotonic() - t0 < scaled_timeout(90)
+
+
 def test_rendezvous_kv_and_auth():
     server = RendezvousServer(secret="topsecret")
     port = server.start()
