@@ -11,6 +11,9 @@ first hardware session: it runs every recorded harness in sequence —
    ``docs/benchmarks.rst``),
 3. ``bench.py`` (ResNet-50 + transformer tracked metrics),
 4. ``autotune_ab.py`` twice (defaults vs ``HOROVOD_AUTOTUNE=1``),
+5. ``allreduce_bw.py --eager --op allgather`` twice (hierarchical
+   plane off vs on — the multi-chip legs now cover all five eager
+   collectives, so the pod recipe A/Bs one NON-allreduce op too),
 
 and writes ONE JSON artifact in the ``BENCH_r*.json`` schema (metric /
 value / unit / vs_baseline at the top, full per-harness records under
@@ -144,6 +147,27 @@ def main():
         ok = all(a["rc"] == 0 for a in arms)
         sections.append({"name": "autotune_ab", "ok": ok,
                          "skipped": False, "arms": arms})
+
+    # 5. Hier-plane A/B on a NON-allreduce op (VERDICT r5 Next #5 done
+    #    criterion): eager allgather with the hierarchical multi-chip
+    #    legs off vs on.  On a pod the delta attributes the hier
+    #    allgather leg directly; the CPU smoke validates the schema.
+    hier_cmd = [py, os.path.join(HERE, "allreduce_bw.py"), "--eager",
+                "--op", "allgather", "--link-gbps",
+                str(args.link_gbps)]
+    if args.cpu_smoke:
+        hier_cmd += ["--cpu-devices", "4", "--sizes-mb", "0.1",
+                     "--iters", "2", "--warmup", "1"]
+    elif args.sizes_mb:
+        hier_cmd += ["--sizes-mb", args.sizes_mb]
+    arms = []
+    for arm_env in ({"HOROVOD_HIERARCHICAL_ALLREDUCE": "off"},
+                    {"HOROVOD_HIERARCHICAL_ALLREDUCE": "on"}):
+        rc, recs, tail = _run_json_lines(hier_cmd, env=arm_env)
+        arms.append({"env": arm_env, "rc": rc, "records": recs})
+    sections.append({"name": "hier_allgather_ab",
+                     "ok": all(a["rc"] == 0 for a in arms),
+                     "skipped": False, "arms": arms})
 
     efficiency = bw_summary.get("efficiency_vs_link")
     sections_ok = all(s.get("ok") or s.get("skipped")

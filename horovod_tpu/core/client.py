@@ -126,6 +126,13 @@ def load_library():
     lib.hvd_tcp_autotune_observe.argtypes = [ctypes.c_ulonglong,
                                              ctypes.c_double]
     lib.hvd_tcp_autotune_observe.restype = None
+    lib.hvd_tcp_kernel_tune_record.argtypes = [ctypes.c_int,
+                                               ctypes.c_double]
+    lib.hvd_tcp_kernel_tune_record.restype = None
+    lib.hvd_tcp_kernel_tune_best.argtypes = []
+    lib.hvd_tcp_kernel_tune_best.restype = ctypes.c_int
+    lib.hvd_tcp_kernel_tune_samples.argtypes = []
+    lib.hvd_tcp_kernel_tune_samples.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -437,6 +444,19 @@ class TcpCore:
         """Report a device-plane allreduce group's (bytes, time-to-
         completion) to rank 0's autotuner (no-op elsewhere)."""
         self._lib.hvd_tcp_autotune_observe(int(nbytes), float(secs))
+
+    def kernel_tune_record(self, choice: int, score: float):
+        """Report one kernel-parameter sample (flash block-shape sweep)
+        to the core's KernelTuner — the native twin of
+        utils.autotune.KernelBlockTuner."""
+        self._lib.hvd_tcp_kernel_tune_record(int(choice), float(score))
+
+    def kernel_tune_best(self) -> int:
+        """Argmax-by-mean choice index; -1 before any sample."""
+        return int(self._lib.hvd_tcp_kernel_tune_best())
+
+    def kernel_tune_samples(self) -> int:
+        return int(self._lib.hvd_tcp_kernel_tune_samples())
 
     def barrier(self, name=None, process_set_id=0):
         h = self._enqueue(name or "barrier.%f" % time.monotonic(),

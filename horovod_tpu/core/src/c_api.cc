@@ -118,6 +118,21 @@ void hvd_tcp_autotune_observe(unsigned long long bytes, double secs) {
   CoreState::Get().AutotuneObserve(static_cast<uint64_t>(bytes), secs);
 }
 
+// Kernel-parameter tuner (flash-attention block shapes): the Python
+// sweep reports per-choice scores; Best() is the argmax-by-mean
+// choice index, -1 before any sample.
+void hvd_tcp_kernel_tune_record(int choice, double score) {
+  CoreState::Get().kernel_tuner().Record(choice, score);
+}
+
+int hvd_tcp_kernel_tune_best() {
+  return CoreState::Get().kernel_tuner().Best();
+}
+
+int hvd_tcp_kernel_tune_samples() {
+  return CoreState::Get().kernel_tuner().Samples();
+}
+
 int hvd_tcp_poll(int handle) { return CoreState::Get().Poll(handle); }
 
 long long hvd_tcp_result_nbytes(int handle) {

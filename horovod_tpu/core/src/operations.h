@@ -90,6 +90,7 @@ class CoreState {
   ResponseCache& cache() { return cache_; }
   Timeline& timeline() { return timeline_; }
   ParameterManager& params() { return params_; }
+  KernelTuner& kernel_tuner() { return kernel_tuner_; }
 
  private:
   void BackgroundLoop();
@@ -109,6 +110,7 @@ class CoreState {
   StallInspector stall_;
   Timeline timeline_;
   ParameterManager params_;
+  KernelTuner kernel_tuner_;
   std::unique_ptr<ThreadPool> pool_;  // created in Initialize
   bool hierarchical_ = false;
   bool hierarchical_allgather_ = false;

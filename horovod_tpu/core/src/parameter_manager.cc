@@ -90,6 +90,34 @@ int BayesianOptimization::BestSample() const {
   return best;
 }
 
+void KernelTuner::Record(int choice, double score) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& e = agg_[choice];
+  e.first += score;
+  e.second += 1;
+}
+
+int KernelTuner::Best() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  int best = -1;
+  double best_mean = -1e300;
+  for (const auto& kv : agg_) {
+    double m = kv.second.first / kv.second.second;
+    if (m > best_mean) {
+      best_mean = m;
+      best = kv.first;
+    }
+  }
+  return best;
+}
+
+int KernelTuner::Samples() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  int n = 0;
+  for (const auto& kv : agg_) n += kv.second.second;
+  return n;
+}
+
 void ParameterManager::Configure(uint64_t fusion_threshold,
                                  double cycle_time_ms, bool enabled,
                                  const std::string& log_path,

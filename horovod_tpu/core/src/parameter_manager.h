@@ -12,8 +12,10 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <map>
 #include <mutex>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "gaussian_process.h"
@@ -34,6 +36,24 @@ class BayesianOptimization {
   std::vector<int> sampled_idx_;
   std::vector<double> scores_;
   GaussianProcess gp_;
+};
+
+// Categorical argmax-by-mean tuner for kernel launch parameters
+// (flash-attention block shapes): the Python sweep measures TFLOP/s per
+// (block_q, block_k) choice and reports (choice, score) samples here;
+// Best() is the choice with the highest mean score.  The discrete
+// choice set is tiny, so no GP is warranted — this is the native twin
+// of utils/autotune.py KernelBlockTuner, kept on the core so the TCP
+// world has a rank-0 aggregation point across runs.
+class KernelTuner {
+ public:
+  void Record(int choice, double score);
+  int Best() const;       // -1 when no samples recorded
+  int Samples() const;
+
+ private:
+  mutable std::mutex mu_;
+  std::map<int, std::pair<double, int>> agg_;  // choice -> (sum, n)
 };
 
 class ParameterManager {

@@ -77,6 +77,26 @@ def _is_device_array(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def _chunked_segments(p, n_items, item_start, item_valid, bc, k):
+    """Segment list staging k j-major local chunks of ``n_items``
+    padded items: local chunk j carries, for every item m, elements
+    [j*bc, (j+1)*bc) of item m's (bc*k)-padded span.  Items are slices
+    of ``p`` at ``item_start[m]`` with ``item_valid[m]`` live elements;
+    the remainder pads with zeros.  Shared by the hierarchical alltoall
+    (items = destination blocks) and reducescatter (items = member
+    segments) staging paths."""
+    segs = []
+    for j in range(k):
+        for m in range(n_items):
+            lo = j * bc
+            take = min(max(int(item_valid[m]) - lo, 0), bc)
+            if take:
+                segs.append((p, int(item_start[m]) + lo, take))
+            if take < bc:
+                segs.append((None, 0, bc - take))
+    return segs
+
+
 def adasum_combine(v, axis_name: str, size: int):
     """Device-resident Adasum over a mesh axis (per-shard code).
 
@@ -293,6 +313,36 @@ class GlobalMeshCollectives:
         """This process's row of a P('proc') program output."""
         return garr.addressable_shards[0].data[0]
 
+    def _hier_eligible(self, nbytes: int) -> bool:
+        """Route this payload over the proc x local mesh?  One shared
+        gate for all five eager ops (the reference's NCCL ops drive
+        every local accelerator's links for every collective, SURVEY
+        §2.2): more than one local chip, and either mode 'on' or the
+        payload at/above the hierarchical threshold."""
+        return (self.local_size > 1
+                and (self._hier_mode == "on"
+                     or int(nbytes) >= self._hier_threshold))
+
+    def _stage_hier(self, segments, total: int, chunk: int, np_dtype):
+        """Stage ``segments`` as this process's (1, k, chunk) slab of a
+        [size, k, chunk] array over the proc x local mesh: the packed
+        flat [k*chunk] buffer splits j-major, chunk j committed to
+        local device j (one device-to-device put per chip; numpy
+        payloads cross the host once inside ``_pack_flat``)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        k = self.local_size
+        flat = self._pack_flat(segments, total, chunk * k, np_dtype)
+        rows = [jax.device_put(
+            jax.lax.slice_in_dim(flat, j * chunk, (j + 1) * chunk
+                                 ).reshape(1, 1, chunk), dev)
+                for j, dev in enumerate(self.local_devices)]
+        return jax.make_array_from_single_device_arrays(
+            (self.size, k, chunk),
+            NamedSharding(self.mesh2, P("proc", "local")), rows)
+
     def _compiled(self, key, build, example_args=None, notify=None):
         """``notify`` is the per-dispatch cold-compile callback,
         threaded through the call chain from the engine's dispatch (it
@@ -406,10 +456,8 @@ class GlobalMeshCollectives:
                 payloads, lengths, dtype, red_op, prescale, postscale,
                 notify)
         if (len(lengths) == 1 and red_op != ADASUM
-                and self.local_size > 1
-                and (self._hier_mode == "on"
-                     or lengths[0] * np.dtype(dtype).itemsize
-                     >= self._hier_threshold)):
+                and self._hier_eligible(
+                    lengths[0] * np.dtype(dtype).itemsize)):
             # Multi-chip hierarchical path: every local chip moves 1/k
             # of the bytes cross-host instead of chip 0 moving all of
             # them.  Adasum is excluded — its combine is dot-product
@@ -458,42 +506,14 @@ class GlobalMeshCollectives:
         process's first local device, like the one-device plane).
         """
         import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         k = self.local_size
         chunk = -(-int(n) // k)
         padded = chunk * k
         np_dtype = np.dtype(dtype)
-        rows = []
-        if p is None:
-            for dev in self.local_devices:
-                with jax.default_device(dev):
-                    rows.append(jnp.zeros((1, 1, chunk), np_dtype))
-        elif _is_device_array(p):
-            flat = jnp.reshape(p, (int(n),))
-            if padded > n:
-                flat = jnp.concatenate(
-                    [flat, jnp.zeros((padded - int(n),), np_dtype)])
-            for j, dev in enumerate(self.local_devices):
-                rows.append(jax.device_put(
-                    jax.lax.slice_in_dim(
-                        flat, j * chunk, (j + 1) * chunk
-                    ).reshape(1, 1, chunk), dev))
-        else:
-            self.host_stages += 1
-            flat = np.ascontiguousarray(np.asarray(p)).reshape(int(n))  # graftlint: disable=host-bounce issue=ISSUE-1 -- documented numpy staging point for host-typed payloads, counted by host_stages
-            if padded > n:
-                flat = np.concatenate(  # graftlint: disable=host-bounce issue=ISSUE-1 -- pads the already-host-staged payload before device_put
-                    [flat, np.zeros((padded - int(n),), np_dtype)])  # graftlint: disable=host-bounce issue=ISSUE-1 -- zero-pad of the host-staged payload
-            for j, dev in enumerate(self.local_devices):
-                rows.append(jax.device_put(
-                    flat[j * chunk:(j + 1) * chunk].reshape(1, 1, chunk),
-                    dev))
-        garr = jax.make_array_from_single_device_arrays(
-            (self.size, k, chunk),
-            NamedSharding(self.mesh2, P("proc", "local")), rows)
+        garr = self._stage_hier([(p, 0, int(n))], int(n), chunk,
+                                np_dtype)
 
         key = ("hier_allreduce", int(chunk), str(np_dtype), red_op,
                float(prescale), float(postscale), k)
@@ -570,23 +590,63 @@ class GlobalMeshCollectives:
             local = (local.astype(jnp.uint8) if _is_device_array(local)
                      else np.asarray(local).astype(np.uint8))  # graftlint: disable=host-bounce issue=ISSUE-1 -- bool wire-cast; np branch reached only for host-typed inputs
         bucket = _size_class(n, wire.itemsize)
-        key = ("broadcast", str(wire), int(bucket), int(root_idx))
+        if self._hier_eligible(n * wire.itemsize):
+            out = self._hier_broadcast(local, n, bucket, wire, root_idx,
+                                       notify)
+        else:
+            key = ("broadcast", str(wire), int(bucket), int(root_idx))
+
+            def build():
+                def fn(x):
+                    idx = jax.lax.axis_index("proc")
+                    v = jnp.where(idx == root_idx, x[0],
+                                  jnp.zeros_like(x[0]))
+                    return jax.lax.psum(v, "proc")
+                from jax.sharding import PartitionSpec as P
+                return self._collective_jit(fn, 1, P())
+
+            staged = self._stage_flat_padded([(local, 0, n)], n, bucket,
+                                             wire)
+            out = self._replicated(
+                self._compiled(key, build, (staged,), notify)(staged))
+        out = (out[:n].reshape(shape) if out.shape[0] > n
+               else out.reshape(shape))
+        return out.astype(jnp.bool_) if is_bool else out
+
+    def _hier_broadcast(self, p, n: int, bucket: int, wire, root_idx,
+                        notify=None):  # graftlint: hot-path
+        """Broadcast over the proc x local mesh: the root's payload
+        scatters into k chunks across its local chips (staging), each
+        chunk rides a masked cross-host psum over that chip's own
+        ICI/DCN links (1/k of the bytes per chip), and a local
+        ``all_gather`` reassembles the full tensor on every chip —
+        the ``_hier_allreduce`` treatment for the one-sender case
+        (``broadcast_parameters`` sweeps are burst of exactly these).
+        Non-root members stage zeros (nothing of theirs is sent), and
+        the in-program root mask stays as defense in depth."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        k = self.local_size
+        chunk = -(-int(bucket) // k)
+        key = ("hier_broadcast", str(wire), int(chunk), int(root_idx), k)
 
         def build():
             def fn(x):
                 idx = jax.lax.axis_index("proc")
-                v = jnp.where(idx == root_idx, x[0],
-                              jnp.zeros_like(x[0]))
-                return jax.lax.psum(v, "proc")
-            from jax.sharding import PartitionSpec as P
-            return self._collective_jit(fn, 1, P())
+                v = jnp.where(idx == root_idx, x[0, 0],
+                              jnp.zeros_like(x[0, 0]))
+                r = jax.lax.psum(v, "proc")
+                return jax.lax.all_gather(r, "local", tiled=True)
+            return self._collective_jit(fn, 1, P(), mesh=self.mesh2,
+                                        in_spec=P("proc", "local"))
 
-        staged = self._stage_flat_padded([(local, 0, n)], n, bucket,
-                                         wire)
-        out = self._replicated(
-            self._compiled(key, build, (staged,), notify)(staged))
-        out = out[:n].reshape(shape) if bucket > n else out.reshape(shape)
-        return out.astype(jnp.bool_) if is_bool else out
+        segments = ([(p, 0, int(n))] if self.my_idx == root_idx else [])
+        garr = self._stage_hier(
+            segments, int(n) if segments else 0, chunk, wire)
+        return self._replicated(
+            self._compiled(key, build, (garr,), notify)(garr))
 
     def allgather(self, local, rows_per_member: Sequence[int],
                   notify=None):  # graftlint: hot-path
@@ -611,24 +671,60 @@ class GlobalMeshCollectives:
             with jax.default_device(self.device):
                 return jnp.zeros((0,) + trailing, dtype)
         bucket = _size_class(max(lens), dtype.itemsize)
-        key = ("allgather", str(dtype), int(bucket))
         size = self.size
-
-        def build():
-            def fn(x):
-                return jax.lax.all_gather(x[0], "proc")  # [size, bucket]
-            from jax.sharding import PartitionSpec as P
-            return self._collective_jit(fn, 1, P())
-
         my_len = lens[self.my_idx]
-        staged = self._stage_flat_padded([(local, 0, my_len)], my_len,
-                                         bucket, dtype)
-        g = self._replicated(
-            self._compiled(key, build, (staged,), notify)(staged))
+        if self._hier_eligible(bucket * dtype.itemsize):
+            g = self._hier_allgather(local, my_len, bucket, dtype,
+                                     notify)
+        else:
+            key = ("allgather", str(dtype), int(bucket))
+
+            def build():
+                def fn(x):
+                    return jax.lax.all_gather(x[0], "proc")  # [size, bucket]
+                from jax.sharding import PartitionSpec as P
+                return self._collective_jit(fn, 1, P())
+
+            staged = self._stage_flat_padded([(local, 0, my_len)],
+                                             my_len, bucket, dtype)
+            g = self._replicated(
+                self._compiled(key, build, (staged,), notify)(staged))
         parts = [g[m, :lens[m]].reshape((rows[m],) + trailing)
                  for m in range(size) if rows[m]]
         return (jnp.concatenate(parts, axis=0) if len(parts) > 1
                 else parts[0])
+
+    def _hier_allgather(self, p, my_len: int, bucket: int, np_dtype,
+                        notify=None):  # graftlint: hot-path
+        """Allgather over the proc x local mesh: each member's padded
+        bucket splits into k chunks across its local chips; chunk j
+        all_gathers over the ``proc`` axis from local device j (every
+        chip moves (size-1)/k buckets cross-host instead of chip 0
+        moving them all), and a local ``all_gather`` reassembles the
+        member-major [size, bucket] result over intra-host ICI.
+        Returns the gathered [size, k*ceil(bucket/k)] device array
+        (k*chunk >= bucket; callers slice valid rows)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        k = self.local_size
+        chunk = -(-int(bucket) // k)
+        size = self.size
+        key = ("hier_allgather", str(np_dtype), int(chunk), k)
+
+        def build():
+            def fn(x):
+                g = jax.lax.all_gather(x[0, 0], "proc")  # [size, chunk]
+                gg = jax.lax.all_gather(g, "local")      # [k, size, chunk]
+                return jnp.swapaxes(gg, 0, 1).reshape(size, k * chunk)
+            return self._collective_jit(fn, 1, P(), mesh=self.mesh2,
+                                        in_spec=P("proc", "local"))
+
+        garr = self._stage_hier([(p, 0, int(my_len))], int(my_len),
+                                chunk, np_dtype)
+        return self._replicated(
+            self._compiled(key, build, (garr,), notify)(garr))
 
     def alltoall(self, local, splits_matrix: np.ndarray,
                  notify=None):  # graftlint: hot-path
@@ -658,32 +754,39 @@ class GlobalMeshCollectives:
         # splits matrices (MoE routing shifts every step) reuse one
         # program per size class instead of compiling per matrix.
         block = _size_class(c * telems, dtype.itemsize)
-        key = ("alltoall", str(dtype), int(block))
         my_idx = self.my_idx
         offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)  # graftlint: disable=host-bounce issue=ISSUE-1 -- offsets over the negotiated splits row, never payload bytes
 
-        def build():
-            def fn(x):
-                y = x[0].reshape(size, block)
-                w = jax.lax.all_to_all(y, "proc", split_axis=0,
-                                       concat_axis=0)  # [size, block]
-                return w.reshape(1, size * block)
-            from jax.sharding import PartitionSpec as P
-            return self._collective_jit(fn, 1, P("proc"))
+        if self._hier_eligible(size * block * dtype.itemsize):
+            w, stride = self._hier_alltoall(local, sm, offs, telems,
+                                            block, dtype, notify)
+        else:
+            stride = block
+            key = ("alltoall", str(dtype), int(block))
 
-        # Segment layout: dest j's rows (slice from my payload), padded
-        # to the uniform block.
-        segments = []
-        for j in range(size):
-            seg_elems = int(sm[my_idx, j]) * telems
-            segments.append((local, int(offs[j]) * telems, seg_elems))
-            if seg_elems < block:
-                segments.append((None, 0, block - seg_elems))
-        staged = self._stage_flat_padded(segments, size * block,
-                                         size * block, dtype)
-        w = self._my_row(
-            self._compiled(key, build, (staged,), notify)(staged))
-        parts = [w[j * block:j * block + recv_splits[j] * telems]
+            def build():
+                def fn(x):
+                    y = x[0].reshape(size, block)
+                    w = jax.lax.all_to_all(y, "proc", split_axis=0,
+                                           concat_axis=0)  # [size, block]
+                    return w.reshape(1, size * block)
+                from jax.sharding import PartitionSpec as P
+                return self._collective_jit(fn, 1, P("proc"))
+
+            # Segment layout: dest j's rows (slice from my payload),
+            # padded to the uniform block.
+            segments = []
+            for j in range(size):
+                seg_elems = int(sm[my_idx, j]) * telems
+                segments.append((local, int(offs[j]) * telems,
+                                 seg_elems))
+                if seg_elems < block:
+                    segments.append((None, 0, block - seg_elems))
+            staged = self._stage_flat_padded(segments, size * block,
+                                             size * block, dtype)
+            w = self._my_row(
+                self._compiled(key, build, (staged,), notify)(staged))
+        parts = [w[j * stride:j * stride + recv_splits[j] * telems]
                  .reshape((recv_splits[j],) + trailing)
                  for j in range(size) if recv_splits[j]]
         if not parts:
@@ -692,6 +795,47 @@ class GlobalMeshCollectives:
         out = (jnp.concatenate(parts, axis=0) if len(parts) > 1
                else parts[0])
         return out, recv_splits
+
+    def _hier_alltoall(self, p, sm, offs, telems: int, block: int,
+                       np_dtype, notify=None):  # graftlint: hot-path
+        """Alltoall over the proc x local mesh: every destination block
+        splits into k chunks across the local chips; local device j
+        runs the cross-host ``all_to_all`` for chunk j of every block
+        (each chip exchanges 1/k of the bytes over its own links), and
+        a local ``all_gather`` reassembles the received blocks.
+        Returns (my received flat [size * k*ceil(block/k)] row, the
+        per-source stride k*ceil(block/k))."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        k = self.local_size
+        bc = -(-int(block) // k)    # block chunk per local chip
+        blockk = bc * k
+        size = self.size
+        my_idx = self.my_idx
+        key = ("hier_alltoall", str(np_dtype), int(bc), k)
+
+        def build():
+            def fn(x):
+                y = x[0, 0].reshape(size, bc)
+                w = jax.lax.all_to_all(y, "proc", split_axis=0,
+                                       concat_axis=0)   # [size, bc]
+                ww = jax.lax.all_gather(w, "local")     # [k, size, bc]
+                return jnp.swapaxes(ww, 0, 1).reshape(
+                    1, size * blockk)
+            return self._collective_jit(fn, 1, P("proc"),
+                                        mesh=self.mesh2,
+                                        in_spec=P("proc", "local"))
+
+        segments = _chunked_segments(
+            p, size, [int(offs[m]) * telems for m in range(size)],
+            [int(sm[my_idx, m]) * telems for m in range(size)], bc, k)
+        garr = self._stage_hier(segments, size * blockk, size * bc,
+                                np_dtype)
+        w = self._my_row(
+            self._compiled(key, build, (garr,), notify)(garr))
+        return w, blockk
 
     def reducescatter(self, local, red_op: str = SUM,
                       notify=None):  # graftlint: hot-path
@@ -716,8 +860,17 @@ class GlobalMeshCollectives:
         # by (dtype, segment, op) — shape-varying bursts reuse one
         # program per size class (the packed-fusion-bucket treatment).
         seg = _size_class(max(c * telems, 1), dtype.itemsize)
-        key = ("reducescatter", str(dtype), int(seg), red_op)
         my_idx = self.my_idx
+        if (red_op in (SUM, AVERAGE, MIN, MAX, PRODUCT)
+                and self._hier_eligible(size * seg * dtype.itemsize)):
+            # Adasum (and any other whole-vector combine) stays on the
+            # one-device plane: per-chunk combines would change the
+            # math — the ``_hier_allreduce`` exclusion.
+            out = self._hier_reducescatter(local, rows, offs, telems,
+                                           seg, dtype, red_op, notify)
+            my_n = rows[my_idx] * telems
+            return out[:my_n].reshape((rows[my_idx],) + trailing)
+        key = ("reducescatter", str(dtype), int(seg), red_op)
 
         def build():
             def fn(x):
@@ -756,6 +909,51 @@ class GlobalMeshCollectives:
             self._compiled(key, build, (staged,), notify)(staged))
         my_n = rows[my_idx] * telems
         return out[:my_n].reshape((rows[my_idx],) + trailing)
+
+    def _hier_reducescatter(self, p, rows, offs, telems: int, seg: int,
+                            np_dtype, red_op,
+                            notify=None):  # graftlint: hot-path
+        """Reducescatter over the proc x local mesh: every member
+        segment splits into k chunks across the local chips; local
+        device j reduces+scatters chunk j of every segment over the
+        ``proc`` axis (``psum_scatter`` for Sum/Average, the
+        bytes-proportional ``alltoall_chunk_reduce`` for
+        Min/Max/Product — each chip moving 1/k of the bytes), and a
+        local ``all_gather`` reassembles this member's full reduced
+        segment.  Returns the flat padded [k*ceil(seg/k)] row."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        k = self.local_size
+        sc = -(-int(seg) // k)      # segment chunk per local chip
+        size = self.size
+        key = ("hier_reducescatter", str(np_dtype), int(sc), red_op, k)
+
+        def build():
+            def fn(x):
+                y = x[0, 0]          # [size * sc]
+                if red_op in (SUM, AVERAGE):
+                    w = jax.lax.psum_scatter(
+                        y, "proc", scatter_dimension=0, tiled=True)
+                    if red_op == AVERAGE:
+                        w = (w / size).astype(w.dtype) if \
+                            jnp.issubdtype(w.dtype, jnp.floating) \
+                            else w // size
+                else:
+                    w = alltoall_chunk_reduce(y, "proc", size, red_op)
+                return jax.lax.all_gather(w, "local", tiled=True)[None]
+            return self._collective_jit(fn, 1, P("proc"),
+                                        mesh=self.mesh2,
+                                        in_spec=P("proc", "local"))
+
+        segments = _chunked_segments(
+            p, size, [int(offs[m]) * telems for m in range(size)],
+            [int(rows[m]) * telems for m in range(size)], sc, k)
+        garr = self._stage_hier(segments, size * sc * k, size * sc,
+                                np_dtype)
+        return self._my_row(
+            self._compiled(key, build, (garr,), notify)(garr))
 
 
 class MultihostEngine:

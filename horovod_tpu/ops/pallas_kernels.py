@@ -26,6 +26,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from ..common import jax_compat  # noqa: F401 - installs jax.typeof shim
@@ -198,15 +199,31 @@ def _chunked_attention_bwd(q, k, v, g, causal: bool, block_q: int):
     return to_out(dq, q), to_out(dk, k), to_out(dv, v)
 
 
+# (seq, d_pad) -> (block_q, block_k) pinned by autotune_flash_blocks:
+# the measured winner of the on-device block sweep.  Env overrides
+# still win (an explicit A/B must never be silently retuned); the
+# default chains below are only the cold fallback.
+_TUNED_BLOCKS: dict = {}
+
+_BLOCK_Q_DEFAULTS = (512, 256, 128, 64)
+_BLOCK_K_DEFAULTS = (1024, 512, 256, 128, 64)
+
+
+def _d_pad(d: int) -> int:
+    return max(128, ((d + 127) // 128) * 128)
+
+
 def _plan(s: int, d: int):
     """Block plan shared by fwd and bwd.  Large tiles amortize
     per-grid-step overhead; MXU tiles are 128-aligned so any divisor
     ≥64 works.  The head dim is lane-padded to 128 (zero columns add 0
-    to every dot product).  HVD_TPU_FLASH_BLOCK_Q/K override the
-    defaults for A/B tuning (must divide the sequence length)."""
+    to every dot product).  Precedence: HVD_TPU_FLASH_BLOCK_Q/K env
+    overrides (must divide the sequence length) > blocks pinned by
+    ``autotune_flash_blocks`` (the measured sweep) > the default
+    chains."""
     import os
 
-    def _env_block(name, dflt_chain):
+    def _env_block(name, tuned, dflt_chain):
         v = os.environ.get(name)
         if v:
             # Fail loudly, like HVD_TPU_FLASH_BWD below: a silently
@@ -221,12 +238,16 @@ def _plan(s: int, d: int):
                     "aligned (multiple of 16), and divide the "
                     "sequence length %d" % (name, b, s))
             return b
+        if tuned is not None:
+            return tuned
         return next((b for b in dflt_chain if s % b == 0), None)
 
-    block_q = _env_block("HVD_TPU_FLASH_BLOCK_Q", (512, 256, 128, 64))
+    d_pad = _d_pad(d)
+    tuned = _TUNED_BLOCKS.get((s, d_pad))
+    block_q = _env_block("HVD_TPU_FLASH_BLOCK_Q",
+                         tuned[0] if tuned else None, _BLOCK_Q_DEFAULTS)
     block_k = _env_block("HVD_TPU_FLASH_BLOCK_K",
-                         (1024, 512, 256, 128, 64))
-    d_pad = max(128, ((d + 127) // 128) * 128)
+                         tuned[1] if tuned else None, _BLOCK_K_DEFAULTS)
     # The FULL attention scale folds into one pre-multiply of q (the
     # kernels do no scaling at all): one (BH,S,D) pass replaces a
     # (BQ,BK) pass per grid block (~16x more elements at seq 2048,
@@ -421,6 +442,107 @@ def _flash_attention_bwd_flat(q, k, v, g, lse, delta, *, causal: bool,
     return dq, dk, dv
 
 
+def _flash_bwd_onepass_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref,
+                              delta_ref, dqp_ref, dk_ref, dv_ref,
+                              dk_scr, dv_scr, *, block_q: int,
+                              block_k: int, causal: bool):
+    # grid = (bh, nk, nq): ONE kernel for dq/dk/dv.  Q/G stream along
+    # the inner axis while this k block's dk/dv accumulate in VMEM
+    # scratch (as in the two-pass dkv kernel); the dq contribution of
+    # each (k block, q block) tile is emitted as an f32 PARTIAL block
+    # (indexed by the k-block axis) and reduced outside the kernel.
+    # Trade measured on hardware, not assumed: Q/K/V/G are each read
+    # from HBM once per tile pair instead of twice (the two-pass cost),
+    # against nk x extra dq-partial HBM writes + one cheap XLA sum.
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    block_live = jnp.logical_or(
+        jnp.logical_not(causal),
+        j * block_q + block_q - 1 >= t * block_k)
+
+    @pl.when(block_live)
+    def _update():
+        # q pre-scaled by 1/sqrt(d): s needs no per-block multiply.
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BQ, BK)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = t * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(cols <= rows, p, 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(g_ref.dtype), g_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BK, D)
+        dp = jax.lax.dot_general(
+            g_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BQ, BK)
+        ds = p * (dp - delta_ref[0])
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BK, D)
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (BQ, D)
+
+    @pl.when(jnp.logical_not(block_live))
+    def _dead():
+        # Causal-dead tiles still own an output block in the partial
+        # array: write zeros or the sum reads uninitialized memory.
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    @pl.when(j == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_onepass_flat(q, k, v, g, lse, delta, *,
+                                      causal: bool, block_q: int,
+                                      block_k: int, interpret: bool):
+    """Flat (BH, S, D) backward via the single one-pass kernel above;
+    returns (dq_f32, dk, dv) with dq still in the fwd's q scaling (the
+    nk partial blocks are summed here, one cheap XLA reduce)."""
+    from jax.experimental.pallas import tpu as pltpu
+    bh, seq, d = q.shape
+    nk = seq // block_k
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, t, j: (i, j, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda i, t, j: (i, j, 0))
+    dqp, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_onepass_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(bh, nk, seq // block_q),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda i, t, j: (i, t, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, t, j: (i, t, 0)),
+        ],
+        out_shape=[
+            _sds((bh, nk, seq, d), jnp.float32, q),
+            _sds((bh, seq, d), k.dtype, k),
+            _sds((bh, seq, d), v.dtype, v),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return jnp.sum(dqp, axis=1), dk, dv
+
+
 def _flash_bwd_chunked(causal, res, g):
     q, k, v = res
     b, s, h, _ = q.shape
@@ -455,10 +577,10 @@ def _flash_bwd(causal, res, g):
     # steps.  Unknown values fail loudly so a typo can't silently
     # invalidate an A/B comparison.
     choice = os.environ.get("HVD_TPU_FLASH_BWD", "pallas")
-    if choice not in ("pallas", "chunked"):
+    if choice not in ("pallas", "pallas_onepass", "chunked"):
         raise ValueError(
-            "HVD_TPU_FLASH_BWD must be 'pallas' or 'chunked', got %r"
-            % choice)
+            "HVD_TPU_FLASH_BWD must be 'pallas', 'pallas_onepass' or "
+            "'chunked', got %r" % choice)
     if choice == "chunked":
         # A/B escape hatch (docs/benchmarks.md records the comparison).
         return _flash_bwd_chunked(causal, (q, k, v), g)
@@ -470,7 +592,10 @@ def _flash_bwd(causal, res, g):
     delta = jnp.sum(jnp.swapaxes(g, 1, 2).astype(jnp.float32)
                     * jnp.swapaxes(o, 1, 2).astype(jnp.float32),
                     axis=-1).reshape(b * h, s, 1)
-    dq, dk, dv = _flash_attention_bwd_flat(
+    bwd_flat = (_flash_attention_bwd_onepass_flat
+                if choice == "pallas_onepass"
+                else _flash_attention_bwd_flat)
+    dq, dk, dv = bwd_flat(
         _to_flat(q * pre_scale, d_pad), _to_flat(k, d_pad),
         _to_flat(v, d_pad), _to_flat(g, d_pad), lse, delta,
         causal=causal, block_q=block_q, block_k=block_k,
@@ -499,6 +624,180 @@ def flash_attention(q, k, v, causal: bool = True):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     return _flash_attention(q, k, v, causal)
+
+
+# ---------------------------------------------------------------------------
+# flash block autotune (the kernel-parameter leg of the autotune plane)
+# ---------------------------------------------------------------------------
+
+def flash_plan_info(s: int, d: int) -> dict:
+    """Attribution record for the benchmark JSON: which blocks the plan
+    would pick for (seq, head_dim) and WHY (env override, autotuned
+    pin, or default chain), plus the active backward variant.  Pure
+    metadata — never traces or compiles anything."""
+    import os
+    block_q, block_k, d_pad, _ = _plan(s, d)
+    if os.environ.get("HVD_TPU_FLASH_BLOCK_Q") or \
+            os.environ.get("HVD_TPU_FLASH_BLOCK_K"):
+        source = "env"
+    elif (s, d_pad) in _TUNED_BLOCKS:
+        source = "autotuned"
+    elif block_q is None or block_k is None:
+        source = "fallback_xla"
+    else:
+        source = "default"
+    return {"block_q": block_q, "block_k": block_k, "d_pad": d_pad,
+            "source": source,
+            "bwd": os.environ.get("HVD_TPU_FLASH_BWD", "pallas")}
+
+
+def flash_block_candidates(seq: int, d: int,
+                           vmem_budget_bytes: int = 12 << 20):
+    """(block_q, block_k) sweep grid for one (seq, head_dim) shape:
+    every sublane-aligned pair dividing the sequence whose resident
+    f32 working set (scores + dq/dk/dv accumulators + double-buffered
+    in/out blocks) fits the VMEM budget (~16 MB/core minus headroom)."""
+    d_pad = _d_pad(d)
+    out = []
+    for bq in (64, 128, 256, 512, 1024):
+        if seq % bq:
+            continue
+        for bk in (64, 128, 256, 512, 1024, 2048):
+            if seq % bk:
+                continue
+            est = (4 * (2 * bq * bk + bq * d_pad + 2 * bk * d_pad)
+                   + 2 * 2 * (bq + bk) * d_pad)
+            if est <= vmem_budget_bytes:
+                out.append((bq, bk))
+    return out
+
+
+def _time_device(fn, args, iters: int) -> float:
+    """Per-call seconds via differential timing (2N − N dispatch loops
+    around one scalar-fetch barrier — the bench.py discipline; on the
+    tunnel runtime block_until_ready alone is not a reliable
+    completion barrier)."""
+    import time
+
+    def first_leaf(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        return leaves[0]
+
+    fetch = jax.jit(lambda v: v.reshape(-1)[0].astype(jnp.float32))
+
+    def run(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        float(np.asarray(fetch(first_leaf(out))))
+        return time.perf_counter() - t0
+
+    run(max(1, iters // 2))  # warm (compile + dispatch path)
+    t1, t2 = run(iters), run(2 * iters)
+    return max(t2 - t1, 1e-9) / iters
+
+
+def autotune_flash_blocks(seq: int, d: int, *, batch_heads: int = 8,
+                          dtype=None, causal: bool = True,
+                          iters: int = 4, candidates=None,
+                          include_bwd: bool = True,
+                          allreduce_scores=None, report_core=True,
+                          pin: bool = True):
+    """Measure fwd(+bwd) TFLOP/s for each (block_q, block_k) candidate
+    on the local device and PIN the winner into the plan registry, so
+    the blocks the kernels run with are tuned, not hardcoded (the
+    kernel-parameter leg of the autotune plane; fusion/cycle stay with
+    the GP tuner).
+
+    SPMD safety: every rank must compile the SAME kernel.  Pass
+    ``allreduce_scores`` (e.g. ``lambda v: hvd.allreduce(v, op=Average)``)
+    to average the per-candidate scores across ranks before the argmax
+    — a deterministic reduction of identical-length vectors, so every
+    rank pins the same pair.  Scores are also reported to the native
+    core's KernelTuner (``hvd_tcp_kernel_tune_record``) when the TCP
+    control plane is up, for cross-run observability.
+
+    Returns the attribution dict: candidates, per-candidate TFLOP/s,
+    the winner, and whether an env override suppressed pinning.
+    """
+    import os
+
+    from ..utils.autotune import KernelBlockTuner
+
+    dtype = dtype or jnp.bfloat16
+    d_pad = _d_pad(d)
+    cands = list(candidates or flash_block_candidates(seq, d))
+    if not cands:
+        return {"candidates": [], "best": None, "pinned": False}
+    interp = not _on_tpu()
+    bh = int(batch_heads)
+    rng = np.random.RandomState(0)
+    # Random payloads: the tunnel runtime dedups value-identical
+    # executions, which would time cache hits instead of kernels.
+    q = jnp.asarray(rng.randn(bh, seq, d_pad), dtype)
+    k = jnp.asarray(rng.randn(bh, seq, d_pad), dtype)
+    v = jnp.asarray(rng.randn(bh, seq, d_pad), dtype)
+    g = jnp.asarray(rng.randn(bh, seq, d_pad), dtype)
+    # Causal attention touches half the tiles; 2 matmuls fwd, 5 bwd.
+    tile_frac = 0.5 if causal else 1.0
+    fwd_flops = 4.0 * bh * seq * seq * d_pad * tile_frac
+    bwd_flops = 2.5 * fwd_flops
+
+    tuner = KernelBlockTuner(cands)
+    records = {}
+    for idx, (bq, bk) in enumerate(cands):
+        fwd = jax.jit(functools.partial(
+            _flash_attention_fwd_flat, causal=causal, block_q=bq,
+            block_k=bk, interpret=interp))
+        t_fwd = _time_device(fwd, (q, k, v), iters)
+        total_t, total_f = t_fwd, fwd_flops
+        t_bwd = None
+        if include_bwd:
+            out, lse = fwd(q, k, v)
+            delta = jnp.sum(g.astype(jnp.float32)
+                            * out.astype(jnp.float32),
+                            axis=-1, keepdims=True)
+            bwd = jax.jit(functools.partial(
+                _flash_attention_bwd_flat, causal=causal, block_q=bq,
+                block_k=bk, interpret=interp))
+            t_bwd = _time_device(bwd, (q, k, v, g, lse, delta), iters)
+            total_t += t_bwd
+            total_f += bwd_flops
+        score = total_f / total_t
+        tuner.record(idx, score)
+        records[(bq, bk)] = {
+            "fwd_tflops": fwd_flops / t_fwd / 1e12,
+            "bwd_tflops": (bwd_flops / t_bwd / 1e12
+                           if t_bwd else None),
+            "score_tflops": score / 1e12,
+        }
+
+    scores = tuner.scores_vector()
+    if allreduce_scores is not None:
+        # Cross-rank mean: identical argmax input on every rank.
+        scores = np.asarray(allreduce_scores(
+            np.asarray(scores, np.float64)))
+    if report_core:
+        try:
+            from ..common import basics
+            core = basics._get_tcp_core()
+            if core is not None:
+                for idx in range(len(cands)):
+                    core.kernel_tune_record(idx, float(scores[idx]))
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+    best = cands[int(np.argmax(scores))]
+    pinned = False
+    if pin and not (os.environ.get("HVD_TPU_FLASH_BLOCK_Q")
+                    or os.environ.get("HVD_TPU_FLASH_BLOCK_K")):
+        # Env overrides win over the tuner (explicit A/Bs must stay
+        # what the operator asked for).
+        _TUNED_BLOCKS[(seq, d_pad)] = best
+        pinned = True
+    return {"candidates": cands, "samples": records, "best": best,
+            "pinned": pinned,
+            "scores_tflops": [float(x) / 1e12 for x in scores]}
 
 
 # ---------------------------------------------------------------------------

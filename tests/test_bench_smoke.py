@@ -31,3 +31,68 @@ def test_bench_emits_one_valid_json_line():
         assert key in d, key
     assert d["metric"] == "resnet50_images_per_sec_per_chip"
     assert d["value"] > 0 and d["step_ms"] > 0
+    # r9: per-lever attribution block — flash block plan + bwd variant
+    # + hier-op mode, so a BENCH delta is attributable to one lever.
+    lev = d["levers"]
+    flash = lev["flash"]
+    assert flash["source"] in ("env", "autotuned", "default",
+                               "fallback_xla")
+    assert flash["bwd"] in ("pallas", "pallas_onepass", "chunked")
+    assert "block_q" in flash and "block_k" in flash
+    assert lev["hier"]["mode"] in ("auto", "on", "off")
+    assert set(lev["hier"]["ops"]) == {
+        "allreduce", "allgather", "alltoall", "reducescatter",
+        "broadcast"}
+
+
+def test_allreduce_bw_amortization_math():
+    # The small-message batching: a fake 2 us/op timer must be batched
+    # up until the differential window clears the tunnel resolution,
+    # and the recovered per-op time must stay exact.
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    from allreduce_bw import bus_bytes, measure_per_op
+
+    per_op_true = 2e-6
+
+    def fake_timed(total_ops):
+        return 1e-4 + per_op_true * total_ops  # fixed dispatch + ops
+
+    per_op, opw, resolvable = measure_per_op(fake_timed, 10)
+    assert resolvable
+    assert opw > 10, "small ops were not amortized"
+    assert abs(per_op - per_op_true) / per_op_true < 0.01
+    # a big op needs no batching
+    per_op2, opw2, r2 = measure_per_op(lambda k: 1e-3 * k, 10)
+    assert r2 and opw2 == 10 and abs(per_op2 - 1e-3) < 1e-5
+    # NCCL bus-bytes conventions
+    assert bus_bytes("allreduce", 4, 100) == 2 * 3 / 4 * 100
+    assert bus_bytes("allgather", 4, 100) == 3 * 100
+    assert bus_bytes("reducescatter", 4, 100) == 3 / 4 * 100
+    assert bus_bytes("alltoall", 4, 100) == 3 / 4 * 100
+    assert bus_bytes("broadcast", 4, 100) == 3 / 4 * 100
+
+
+def test_flash_roofline_smoke_schema():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "flash_roofline.py"),
+         "--cpu-smoke"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    by_metric = {}
+    for r in recs:
+        by_metric.setdefault(r["metric"], []).append(r)
+    assert by_metric["flash_block_sweep"], recs
+    variants = {r["variant"] for r in by_metric["flash_bwd_variant"]
+                if "error" not in r}
+    assert variants == {"pallas", "pallas_onepass", "chunked"}
+    summary = by_metric["flash_roofline"][0]
+    for key in ("matmul_roofline_tflops", "best_block_q",
+                "best_block_k", "best_bwd_variant",
+                "best_fwd_frac_of_roofline"):
+        assert key in summary, key

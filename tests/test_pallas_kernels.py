@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from horovod_tpu.ops import pallas_kernels as pk
 from horovod_tpu.ops.pallas_kernels import (flash_attention,
                                             fused_scale_sum,
                                             _reference_attention)
@@ -84,6 +85,143 @@ def test_flash_attention_grad_multiblock_grid(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-4,
                                    err_msg="d%s mismatch" % lbl)
+
+
+# The r9 kernel grid: both Pallas backward structures (two-pass dq/dkv
+# and the fused one-pass with dq partials) across block shapes, causal
+# on/off, and a lane-padded vs exact head dim — the interpret-mode
+# numerics net under any kernel restructure.  Block shapes are driven
+# through the HVD_TPU_FLASH_BLOCK_Q/K hooks, exactly how an A/B or the
+# autotune sweep drives them.
+@pytest.mark.parametrize(
+    "variant,block_q,block_k,causal",
+    # full causal coverage over the block pairs; non-causal once per
+    # variant (the masking branch is the only causal-sensitive code,
+    # and interpret-mode grads are the expensive part of tier-1)
+    [(v, bq, bk, True) for v in ("pallas", "pallas_onepass")
+     for bq, bk in ((64, 128), (128, 64), (128, 128))]
+    + [(v, 128, 128, False) for v in ("pallas", "pallas_onepass")])
+def test_flash_bwd_grid(monkeypatch, variant, block_q, block_k, causal):
+    monkeypatch.setenv("HVD_TPU_FLASH_BWD", variant)
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", str(block_q))
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", str(block_k))
+    q, k, v = _qkv(b=1, s=128, h=1, d=32, seed=11)
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=causal) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(_reference_attention(q_, k_, v_, causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, lbl in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg="d%s mismatch" % lbl)
+
+
+@pytest.mark.parametrize("variant", ["pallas", "pallas_onepass"])
+def test_flash_bwd_grid_exact_lane_dim(monkeypatch, variant):
+    # d=128: no lane padding (d_pad == d) — the zero-column path of the
+    # d=32 grid above must not be the only covered layout.
+    monkeypatch.setenv("HVD_TPU_FLASH_BWD", variant)
+    q, k, v = _qkv(b=1, s=128, h=1, d=128, seed=12)
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(_reference_attention(q_, k_, v_, True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, lbl in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg="d%s mismatch" % lbl)
+
+
+def test_flash_bwd_onepass_multiblock_grid(monkeypatch):
+    # s=192 -> 3x3 grid of 64-blocks: the one-pass kernel's scratch
+    # accumulation, dead-tile zero write, and partial-dq reduce across
+    # a grid where causal skipping actually fires.
+    monkeypatch.setenv("HVD_TPU_FLASH_BWD", "pallas_onepass")
+    q, k, v = _qkv(b=1, s=192, h=2, d=32, seed=13)
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(_reference_attention(q_, k_, v_, True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, lbl in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg="d%s mismatch" % lbl)
+
+
+def test_flash_bwd_unknown_variant_fails_loudly(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FLASH_BWD", "onepass")  # typo'd value
+    q, k, v = _qkv(b=1, s=128, h=1, d=32, seed=14)
+    with pytest.raises(ValueError, match="HVD_TPU_FLASH_BWD"):
+        jax.grad(lambda q_: jnp.sum(
+            flash_attention(q_, k, v, causal=True) ** 2))(q)
+
+
+def test_autotune_flash_blocks_pins_plan(monkeypatch):
+    # The sweep measures each candidate and PINS the winner into the
+    # plan registry: _plan must consult it, and env overrides must win
+    # over (and suppress) the pin.
+    monkeypatch.delenv("HVD_TPU_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("HVD_TPU_FLASH_BLOCK_K", raising=False)
+    try:
+        info = pk.autotune_flash_blocks(
+            128, 32, batch_heads=1, iters=1, include_bwd=False,
+            candidates=[(64, 64), (128, 128)], report_core=False)
+        assert info["pinned"], info
+        assert info["best"] in info["candidates"]
+        assert pk._TUNED_BLOCKS[(128, 128)] == info["best"]
+        plan = pk.flash_plan_info(128, 32)
+        assert plan["source"] == "autotuned"
+        assert (plan["block_q"], plan["block_k"]) == info["best"]
+        # tuned blocks still produce oracle-exact attention
+        q, k, v = _qkv(b=1, s=128, h=1, d=32, seed=15)
+        np.testing.assert_allclose(
+            np.asarray(flash_attention(q, k, v, causal=True)),
+            np.asarray(_reference_attention(q, k, v, True)),
+            atol=2e-5, rtol=2e-5)
+        # an explicit env A/B wins over the tuner and suppresses pinning
+        monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "64")
+        assert pk.flash_plan_info(128, 32)["source"] == "env"
+        info2 = pk.autotune_flash_blocks(
+            128, 32, batch_heads=1, iters=1, include_bwd=False,
+            candidates=[(64, 64)], report_core=False)
+        assert not info2["pinned"]
+    finally:
+        pk._TUNED_BLOCKS.clear()
+
+
+def test_kernel_tuner_native_mirror():
+    # The C++ KernelTuner (core/src/parameter_manager.cc) must agree
+    # with the Python KernelBlockTuner on argmax-by-mean.
+    pytest.importorskip("ctypes")
+    from horovod_tpu.core.client import (core_library_available,
+                                         load_library)
+    if not core_library_available():
+        pytest.skip("native core not buildable here")
+    lib = load_library()
+    base = lib.hvd_tcp_kernel_tune_samples()
+    # Huge scores so this test's choices dominate any samples another
+    # in-process test may have recorded into the singleton tuner.
+    lib.hvd_tcp_kernel_tune_record(7, 1.0e18)
+    lib.hvd_tcp_kernel_tune_record(9, 3.0e18)
+    lib.hvd_tcp_kernel_tune_record(9, 5.0e18)
+    lib.hvd_tcp_kernel_tune_record(7, 10.0e18)  # mean 5.5e18 beats 4e18
+    assert lib.hvd_tcp_kernel_tune_best() == 7
+    assert lib.hvd_tcp_kernel_tune_samples() == base + 4
 
 
 def test_flash_attention_grad_chunked_escape_hatch(monkeypatch):

@@ -33,7 +33,8 @@ def test_podcheck_smoke_artifact_schema(tmp_path):
     assert art["smoke"] is True
     by_name = {s["name"]: s for s in art["sections"]}
     assert set(by_name) == {"allreduce_bw", "scaling_efficiency",
-                            "bench", "autotune_ab"}
+                            "bench", "autotune_ab",
+                            "hier_allgather_ab"}
     # The bandwidth section must have run and carried the summary line
     # the headline is computed from.
     bw = by_name["allreduce_bw"]
@@ -44,3 +45,11 @@ def test_podcheck_smoke_artifact_schema(tmp_path):
     # bench needs the real chip; smoke marks it skipped, not failed.
     assert by_name["bench"]["skipped"] is True
     assert by_name["autotune_ab"]["skipped"] is True  # --skip-autotune
+    # The non-allreduce pod A/B (hier legs off vs on) must have run
+    # both arms and produced the eager allgather records.
+    hier = by_name["hier_allgather_ab"]
+    assert hier["ok"], hier
+    assert len(hier["arms"]) == 2
+    for arm in hier["arms"]:
+        assert any(r.get("metric") == "allgather_bus_bandwidth_peak"
+                   for r in arm["records"]), arm

@@ -198,19 +198,115 @@ def main():
     for i, h in enumerate(bhs2):
         np.testing.assert_allclose(np.asarray(h.wait(60)),
                                    np.full((16384,), tot * (i + 1)))
+    # Multi-chip legs for the OTHER four eager ops (r9): payloads at or
+    # above the threshold shard across every local chip on all five
+    # collectives.  Position-dependent payloads so a chunk delivered to
+    # the wrong slot fails numerically, not just structurally.
+    # TEST_HIER_OPS=0 skips these sections (the 3-proc world runs them
+    # at ~3x the compile+gloo cost for no extra coverage — the 2-proc
+    # x 4-local world already spans multi-proc x multi-local).
+    hier_ops = os.environ.get("TEST_HIER_OPS", "1") == "1"
+    if hier_ops:
+        bc_n = 32768  # 128 KiB f32 >= the 64 KiB default threshold
+        src = np.arange(bc_n, dtype=np.float32)
+        hb = hvd.broadcast(jnp.asarray(src) if r == 1
+                           else jnp.zeros((bc_n,), jnp.float32),
+                           root_rank=1, name="hier_bc")
+        assert isinstance(hb, jax.Array), type(hb)
+        np.testing.assert_allclose(np.asarray(hb), src)
+
+        ag_rows = 8192 + r  # ragged: rank r contributes 8192+r rows of 4
+        mine = (np.arange(ag_rows * 4, dtype=np.float32).reshape(ag_rows, 4)
+                + r * 1e6)
+        hg = hvd.allgather(jnp.asarray(mine), name="hier_ag")
+        assert isinstance(hg, jax.Array), type(hg)
+        np.testing.assert_allclose(
+            np.asarray(hg),
+            np.concatenate([np.arange((8192 + j) * 4, dtype=np.float32)
+                            .reshape(8192 + j, 4) + j * 1e6
+                            for j in range(n)]))
+
+        a2a_rows = 4096  # per-dest block 64 KiB
+        payload = np.repeat(np.arange(n, dtype=np.float32),
+                            a2a_rows)[:, None] + 100.0 * r
+        ha, hrecv = hvd.alltoall(
+            jnp.asarray(np.tile(payload, (1, 4))),
+            splits=[a2a_rows] * n, name="hier_a2a")
+        assert isinstance(ha, jax.Array), type(ha)
+        assert list(hrecv) == [a2a_rows] * n, hrecv
+        np.testing.assert_allclose(  # from source m: rows valued r + 100*m
+            np.asarray(ha)[:, 0],
+            np.repeat(100.0 * np.arange(n, dtype=np.float32) + r, a2a_rows))
+
+        rs_d0 = n * 4096
+        base = np.tile(np.arange(rs_d0, dtype=np.float32)[:, None], (1, 4))
+        hr = hvd.reducescatter(jnp.asarray(base * (r + 1)), op=hvd.Sum,
+                               name="hier_rs")
+        assert isinstance(hr, jax.Array), type(hr)
+        np.testing.assert_allclose(
+            np.asarray(hr),
+            base[r * 4096:(r + 1) * 4096] * sum(j + 1 for j in range(n)))
+
     if n_local > 1:
         assert mc.local_size == n_local, mc.local_size
-        hier = {k: v for k, v in mc.hlo.items()
-                if k[0] == "hier_allreduce"} \
-            if os.environ.get("HVD_TPU_DUMP_HLO") else None
-        if hier is not None:
-            assert hier, "large allreduce did not ride the hier plane"
-            htxt = "\n".join(hier.values())
-            assert "all_gather" in htxt, "no local all_gather leg"
-            assert "all_reduce" in htxt, "no cross-host reduce leg"
-            assert ("num_partitions = %d" % (n * n_local)) in htxt, (
-                "hier program does not span all %d devices"
-                % (n * n_local))
+        if os.environ.get("HVD_TPU_DUMP_HLO"):
+            # Every hier program must SPAN all n*n_local partitions
+            # with a real cross-host leg plus the local all_gather
+            # reassembly leg.
+            fams = [("hier_allreduce", "all_reduce")]
+            if hier_ops:
+                fams += [("hier_broadcast", "all_reduce"),
+                         ("hier_allgather", "all_gather"),
+                         ("hier_alltoall", "all_to_all"),
+                         ("hier_reducescatter", "reduce_scatter")]
+            for fam, leg in fams:
+                txts = [v for kk, v in mc.hlo.items() if kk[0] == fam]
+                assert txts, ("large %s did not ride the hier plane"
+                              % fam)
+                htxt = "\n".join(txts)
+                assert "all_gather" in htxt, (
+                    "%s: no local all_gather leg" % fam)
+                assert leg in htxt, (
+                    "%s: no cross-host %s leg" % (fam, leg))
+                assert ("num_partitions = %d" % (n * n_local)) in htxt, (
+                    "%s program does not span all %d devices"
+                    % (fam, n * n_local))
+    # Hier cache flatness (r9): a burst of varying shapes in ONE size
+    # class per op must reuse ONE hier executable per op family — the
+    # packed-bucket recompile-cliff treatment holds on the multi-chip
+    # plane too.  (On single-local-chip worlds the hier families stay
+    # empty and the assertion is vacuous.)
+    def _op_keys(op):
+        return sum(1 for kk in mc._fns.keys() if kk[0] == op)
+    if hier_ops:
+        hier_before = {op: _op_keys(op) for op in (
+            "hier_allgather", "hier_alltoall", "hier_reducescatter",
+            "hier_broadcast")}
+        for i in range(3):
+            rows_i = 8193 + 7 * i + r
+            g = hvd.allgather(jnp.full((rows_i, 4), 1.0 + r, jnp.float32),
+                              name="hag.%d" % i)
+            assert np.asarray(g).shape == (
+                sum(8193 + 7 * i + j for j in range(n)), 4)
+            spl = [4097 + i] * n
+            a2, rcv = hvd.alltoall(
+                jnp.ones((sum(spl), 4), jnp.float32), splits=spl,
+                name="ha2a.%d" % i)
+            assert list(rcv) == [4097 + i] * n, rcv
+            rs = hvd.reducescatter(
+                jnp.ones((n * (4097 + i), 4), jnp.float32), op=hvd.Sum,
+                name="hrs.%d" % i)
+            np.testing.assert_allclose(np.asarray(rs), float(n))
+            bc = hvd.broadcast(
+                jnp.full((16385 + 3 * i,), float(r), jnp.float32),
+                root_rank=0, name="hbc.%d" % i)
+            np.testing.assert_allclose(np.asarray(bc), 0.0)
+        for op, before_ct in hier_before.items():
+            added = _op_keys(op) - before_ct
+            assert added <= 1, (
+                "hier %s burst grew the executable cache by %d keys "
+                "(recompile cliff on the multi-chip plane)" % (op, added))
+
     assert mc.host_stages == before, (
         "device payloads transited the host: %d stagings"
         % (mc.host_stages - before))
@@ -256,8 +352,6 @@ def main():
     # alltoall / reducescatter / broadcast.  Shapes below all land in
     # the same power-of-two bucket, so the cache may grow by at most
     # one key per op family.
-    def _op_keys(op):
-        return sum(1 for kk in mc._fns.keys() if kk[0] == op)
     cache_before = {op: _op_keys(op) for op in (
         "allgather", "alltoall", "reducescatter", "broadcast")}
     for i in range(5):
