@@ -118,6 +118,20 @@ SITES: Dict[str, str] = {
         "elastic driver, _spawn_workers: one worker-spawn attempt for "
         "one slot (drop = the carrier declines the spawn, exercising "
         "the exponential respawn backoff)",
+    "worker.preempt.sigterm":
+        "elastic state, State.check_drain: the preemption-notice seam "
+        "(drop = a synthetic SIGTERM/preemption notice arrives at this "
+        "worker right now, entering the drain protocol exactly as a "
+        "real cloud preemption would)",
+    "driver.drain.ack":
+        "elastic driver, _handle drain message: the drain-ack seam "
+        "(drop = the driver loses the worker's drain notice; the "
+        "distinguished drain exit code is then the only planned-"
+        "removal signal)",
+    "elastic.state.spill":
+        "elastic spill, write: one durable commit spill for one rank "
+        "(drop = the write is torn mid-blob, leaving a truncated file "
+        "the CRC-checked restore must detect and skip)",
 }
 
 ACTIONS = ("delay", "drop", "die", "wedge")
@@ -133,6 +147,9 @@ DROP_SITES = frozenset({
     "runner.rpc.request",
     "elastic.discovery.run",
     "driver.spawn.attempt",
+    "worker.preempt.sigterm",
+    "driver.drain.ack",
+    "elastic.state.spill",
 })
 
 _COND_ENV = {

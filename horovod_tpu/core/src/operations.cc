@@ -52,9 +52,11 @@ Status CoreState::Initialize(int rank, int size,
                               "HOROVOD_CACHE_CAPACITY", 1024);
   cache_ = ResponseCache(static_cast<size_t>(cache_cap));
   double stall_warn = EnvDouble("HVD_TPU_STALL_CHECK_TIME_SECONDS",
-                                "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0);
+                                "HOROVOD_STALL_CHECK_TIME_SECONDS",
+                                StallInspector::kDefaultWarningSecs);
   double stall_kill = EnvDouble("HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS",
-                                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0);
+                                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+                                StallInspector::kDefaultShutdownSecs);
   bool stall_off = EnvBool("HVD_TPU_STALL_CHECK_DISABLE",
                            "HOROVOD_STALL_CHECK_DISABLE", false);
   stall_.Configure(stall_warn, stall_kill, !stall_off);

@@ -4,6 +4,12 @@
 
 namespace hvdtpu {
 
+// Out-of-line definitions (redundant under C++17's inline constexpr
+// statics, required for ODR-use under older standards).  The values
+// live in stall_inspector.h next to their Python mirrors.
+constexpr double StallInspector::kDefaultWarningSecs;
+constexpr double StallInspector::kDefaultShutdownSecs;
+
 void StallInspector::RecordRankReady(const std::string& tensor, int rank,
                                      int world) {
   if (!enabled_) return;

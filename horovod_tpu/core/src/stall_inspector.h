@@ -15,6 +15,16 @@ namespace hvdtpu {
 
 class StallInspector {
  public:
+  // Default thresholds, mirrored by the Python inspector
+  // (utils/stall_inspector.py) and the Config snapshot
+  // (common/config.py DEFAULT_STALL_*): warn after 60 s, never abort
+  // (0) unless HOROVOD_STALL_SHUTDOWN_TIME_SECONDS opts in.  A
+  // crossed shutdown threshold surfaces as a StallError in Python and
+  // enters the elastic drain path (committed-then-abort), so the two
+  // planes MUST agree on when that happens.
+  static constexpr double kDefaultWarningSecs = 60.0;
+  static constexpr double kDefaultShutdownSecs = 0.0;
+
   void Configure(double warning_secs, double shutdown_secs, bool enabled) {
     warning_secs_ = warning_secs;
     shutdown_secs_ = shutdown_secs;
@@ -35,8 +45,8 @@ class StallInspector {
     std::vector<bool> ready;
     std::chrono::steady_clock::time_point last_warn{};
   };
-  double warning_secs_ = 60.0;
-  double shutdown_secs_ = 0.0;
+  double warning_secs_ = kDefaultWarningSecs;
+  double shutdown_secs_ = kDefaultShutdownSecs;
   bool enabled_ = true;
   std::unordered_map<std::string, PendingInfo> pending_;
 };

@@ -11,13 +11,15 @@ from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
 from .driver import ElasticDriver, elastic_run
 from .registration import WorkerStateRegistry
 from .sampler import ElasticSampler
-from .state import JaxState, ObjectState, State, run
-from .worker import (HostsUpdatedInterrupt, WorkerNotificationManager,
+from .state import JaxState, ObjectState, State, StateSyncError, run
+from .worker import (DRAIN_EXIT_CODE, HostsUpdatedInterrupt,
+                     WorkerDrained, WorkerNotificationManager,
                      WorkerStopped, notification_manager)
 
 __all__ = [
     "run", "State", "ObjectState", "JaxState", "ElasticSampler",
-    "HostsUpdatedInterrupt", "WorkerStopped", "ElasticDriver",
+    "StateSyncError", "HostsUpdatedInterrupt", "WorkerDrained",
+    "WorkerStopped", "DRAIN_EXIT_CODE", "ElasticDriver",
     "elastic_run", "HostDiscovery", "HostDiscoveryScript", "FixedHosts",
     "HostManager", "HostUpdateResult", "WorkerStateRegistry",
     "WorkerNotificationManager", "notification_manager",

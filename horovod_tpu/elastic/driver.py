@@ -29,6 +29,7 @@ from ..runner.services import MessageServer, send_message
 from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
                         HostManager, HostUpdateResult)
 from .registration import WorkerStateRegistry
+from .worker import DRAIN_EXIT_CODE
 
 LOG = logging.getLogger("horovod_tpu.elastic.driver")
 
@@ -104,9 +105,12 @@ class ElasticDriver:
         self._procs: Dict[Slot, safe_shell_exec.ManagedProcess] = {}  # graftlint: guarded-by=_lock
         self._worker_addrs: Dict[Slot, Tuple[str, int]] = {}  # graftlint: guarded-by=_lock
         # slots told/forced to stop; slots whose proc exited 0;
-        # per-slot spawn retry throttle; spawn RPCs in flight off-lock.
+        # slots that announced a drain (planned removal — preemption,
+        # stall abort); per-slot spawn retry throttle; spawn RPCs in
+        # flight off-lock.
         self._stopped: set = set()  # graftlint: guarded-by=_lock
         self._succeeded: set = set()  # graftlint: guarded-by=_lock
+        self._draining: set = set()  # graftlint: guarded-by=_lock
         self._spawn_attempts: Dict[Slot, float] = {}  # graftlint: guarded-by=_lock
         self._spawn_backoff: Dict[Slot, float] = {}  # graftlint: guarded-by=_lock
         self._pending_spawns: set = set()  # graftlint: guarded-by=_lock
@@ -134,6 +138,12 @@ class ElasticDriver:
             return self._handle_rendezvous(
                 (req["host"], int(req["slot"])),
                 int(req.get("min_epoch", 0)))
+        if kind == "drain":
+            return self._handle_drain(
+                (req["host"], int(req["slot"])),
+                req.get("reason", "?"), int(req.get("commit_id", 0)))
+        if kind == "replicate":
+            return self._handle_replicate(req)
         if kind == "ping":
             return {"ok": True, "epoch": self._epoch}
         if self._extra_handler is not None:
@@ -167,6 +177,62 @@ class ElasticDriver:
             if self._published and slot in self._assignments:
                 return dict(self._assignments[slot], status="go")
             return {"status": "wait"}
+
+    def _handle_drain(self, slot: Slot, reason: str,
+                      commit_id: int) -> Dict:
+        """A worker announced a PLANNED exit (preemption SIGTERM, stall
+        abort): mark the slot draining so its exit is never treated as
+        a failure — no blacklist, no failure count, no respawn-backoff
+        penalty.  The distinguished drain exit code is the fallback
+        signal when this notice (or its ack) is lost."""
+        if faultline.site("driver.drain.ack"):
+            LOG.warning("drain notice from %s:%d dropped (faultline "
+                        "driver.drain.ack)", slot[0], slot[1])
+            return {"error": "drain ack dropped (faultline "
+                             "driver.drain.ack)"}
+        with self._lock:
+            self._draining.add(slot)
+        LOG.warning("worker %s:%d draining (%s) at commit %d: planned "
+                    "removal", slot[0], slot[1], reason, commit_id)
+        return {"ok": True}
+
+    def _handle_replicate(self, req: Dict) -> Dict:
+        """Fan one worker's durable-commit blob out to its buddy ranks
+        (the next k slots in target order): the driver owns the
+        slot→address table, workers don't know their peers.  Runs on
+        the message-server thread pool; sends are bounded and best-
+        effort — replication must never wedge the control plane."""
+        source = (req["host"], int(req["slot"]))
+        want = max(0, int(req.get("replicas", 1)))
+        with self._lock:
+            target = list(self._target)
+            addrs = dict(self._worker_addrs)
+        if source not in target or want == 0:
+            return {"ok": True, "delivered": 0}
+        ring = target[target.index(source) + 1:] + \
+            target[:target.index(source)]
+        ring = [s for s in ring if s != source]
+        # Host-distinct buddies first: a replica on the source's own
+        # host dies with it in the host-loss scenario replication
+        # exists for; same-host slots are only a last resort.
+        buddies = ([s for s in ring if s[0] != source[0]]
+                   + [s for s in ring if s[0] == source[0]])[:want]
+        delivered = 0
+        payload = {"kind": "replica", "commit_id": req.get("commit_id"),
+                   "source_rank": req.get("source_rank"),
+                   "blob": req.get("blob")}
+        for buddy in buddies:
+            addr = addrs.get(buddy)
+            if addr is None:
+                continue
+            try:
+                send_message(addr, self._secret, payload, timeout=5.0,
+                             retries=0)
+                delivered += 1
+            except Exception:  # noqa: BLE001 — buddy may be mid-respawn
+                LOG.debug("replica forward to %s:%d failed",
+                          buddy[0], buddy[1], exc_info=True)
+        return {"ok": True, "delivered": delivered}
 
     def _publish_epoch(self):  # graftlint: requires-lock=_lock
         """All target slots checked in: assign ranks and open the world
@@ -290,13 +356,12 @@ class ElasticDriver:
             except Exception:  # noqa: BLE001 — worker may be dead
                 pass
         # Terminate stopped procs off-lock too (AgentProc.terminate is
-        # a network RPC).
+        # a network RPC); one shared grace window, not one per proc.
         with self._lock:
             to_stop = [mp for slot, mp in self._procs.items()
                        if slot in self._stopped]
-        for mp in to_stop:
-            if mp.poll() is None:
-                mp.terminate()
+        safe_shell_exec.terminate_all(
+            [mp for mp in to_stop if mp.poll() is None])
 
     def _worker_env(self, slot: Slot) -> Dict[str, str]:
         host, idx = slot
@@ -377,6 +442,10 @@ class ElasticDriver:
                 if not stale:
                     self._procs[slot] = mp
                     self._succeeded.discard(slot)
+                    # A fresh process is not draining, whatever its
+                    # predecessor announced (a late drain notice must
+                    # not relabel this incarnation's future failures).
+                    self._draining.discard(slot)
                     # A successful spawn resets the slot's respawn
                     # backoff to the base interval.
                     self._spawn_backoff.pop(slot, None)
@@ -450,6 +519,7 @@ class ElasticDriver:
     def _check_procs(self) -> bool:
         """Reap exited workers; returns True when the run is finished."""
         failed_hosts = []
+        drained_slots = []
         # Poll OUTSIDE the lock: platform proc proxies (Spark agents)
         # may do blocking RPCs, and the message handler needs the lock.
         with self._lock:
@@ -462,9 +532,30 @@ class ElasticDriver:
                 del self._procs[slot]
                 if slot in self._stopped:
                     continue
-                if rc == 0:
+                drained = (slot in self._draining
+                           or rc == DRAIN_EXIT_CODE)
+                if drained:
+                    # Planned removal (preemption drain, stall abort):
+                    # extend the r8 clean-exit rule — no blacklist, no
+                    # failure count, respawn backoff reset to base.
+                    # The rc fallback covers a drain notice (or its
+                    # ack) lost in flight.  NOT a success either: the
+                    # slot's work is unfinished and it respawns if its
+                    # host stays discovered.
+                    self._draining.discard(slot)
+                    self._spawn_backoff.pop(slot, None)
+                    self._registry.record_success(slot[0])
+                    drained_slots.append(slot)
+                    LOG.warning("worker %s:%d drained (rc=%d): planned "
+                                "removal, host not blacklisted",
+                                slot[0], slot[1], rc)
+                elif rc == 0:
                     self._succeeded.add(slot)
                     self._registry.record_success(slot[0])
+                    # A clean exit resets the slot's respawn throttle
+                    # too: the next spawn on this slot (a later epoch)
+                    # starts from the base interval.
+                    self._spawn_backoff.pop(slot, None)
                 else:
                     LOG.warning("worker %s:%d failed (rc=%d)",
                                 slot[0], slot[1], rc)
@@ -509,6 +600,12 @@ class ElasticDriver:
         if failed_hosts:
             self._hosts.blacklist_refresh()
             self._recompute_world("worker failure")
+        elif drained_slots:
+            # A drained slot changes the live world without a failure:
+            # bump the epoch proactively so survivors re-rendezvous at
+            # their next commit (HostsUpdatedInterrupt, no rollback)
+            # instead of discovering the hole via a failed collective.
+            self._recompute_world("worker drained")
         with self._lock:
             if (self._below_min_since is not None
                     and time.monotonic() - self._below_min_since
@@ -548,8 +645,10 @@ class ElasticDriver:
             self._shutdown.set()
             with self._lock:
                 procs = list(self._procs.values())
-            for mp in procs:
-                mp.terminate()
+            # One shared grace window for the whole world: serial
+            # per-proc terminates would multiply the drain grace by
+            # the straggler count.
+            safe_shell_exec.terminate_all(procs)
             self._server.stop()
             self._kv.stop()
 

@@ -1,0 +1,223 @@
+"""Durable commit spills: CRC-checked blobs under a spill directory.
+
+The in-memory ``state.commit()`` snapshot survives a *worker* failure
+but not a *host* (or whole-job) one — and Cloud TPU preemption
+routinely takes every host at once.  When ``HOROVOD_STATE_SPILL_DIR``
+is set, each commit additionally spills the pickled state blob to
+disk, and a restarted world restores from the newest **valid** blob
+during ``state.sync()``'s root election (elastic/state.py).
+
+Format (one file per commit per writer)::
+
+    MAGIC(10) | commit_id u64 | payload_len u64 | crc32 u32 | payload
+
+* **Atomic**: the blob is written to a same-directory temp file and
+  ``os.replace``d into place, so a reader never observes a half-
+  written *named* spill — and a crash mid-write leaves only a temp
+  file the pruner sweeps.
+* **CRC-checked**: a torn or bit-flipped blob (injectable via the
+  ``elastic.state.spill`` fault site) fails decode loudly and restore
+  falls back to the next-newest blob instead of unpickling garbage.
+* **Keep-last-K**: each writer prunes its own files down to
+  ``HOROVOD_STATE_KEEP`` after every spill, so the directory holds a
+  bounded history (the fallback chain for corrupt-newest).
+
+Filenames are ``state-<commit_id>-<tag>.spill`` with a zero-padded,
+lexically-sortable commit id.  Restore scans **every** writer's files:
+states are identical across ranks at a given commit id by
+construction (sync broadcasts one elected root to all), so the newest
+valid blob in the directory is the right restore point no matter who
+wrote it — which is exactly what multi-host loss needs when the
+directory is shared storage.
+"""
+
+from __future__ import annotations
+
+import binascii
+import logging
+import os
+import struct
+import tempfile
+import time
+from typing import List, Optional, Tuple
+
+from ..common import faultline
+from ..common.envutil import env_int
+
+LOG = logging.getLogger("horovod_tpu.elastic.spill")
+
+MAGIC = b"HVDSPILL1\n"
+_HEADER = struct.Struct("!QQI")  # commit_id, payload_len, crc32
+_SUFFIX = ".spill"
+
+
+class SpillCorrupt(ValueError):
+    """A spill blob failed validation (torn write, bad CRC, bad magic)."""
+
+
+def spill_dir() -> Optional[str]:
+    """The durable-commit directory (``HOROVOD_STATE_SPILL_DIR``);
+    None disables spilling entirely."""
+    return os.environ.get("HOROVOD_STATE_SPILL_DIR") or None
+
+
+def keep_last() -> int:
+    """Blobs each writer keeps (``HOROVOD_STATE_KEEP``, default 3,
+    floor 1): the fallback chain when the newest blob is corrupt."""
+    return env_int("HOROVOD_STATE_KEEP", 3, minimum=1)
+
+
+def replica_count() -> int:
+    """Buddy ranks each commit is mirrored to
+    (``HOROVOD_STATE_REPLICAS``, default 0 = no mirroring)."""
+    return env_int("HOROVOD_STATE_REPLICAS", 0, minimum=0)
+
+
+def encode(commit_id: int, payload: bytes) -> bytes:
+    return (MAGIC
+            + _HEADER.pack(commit_id, len(payload),
+                           binascii.crc32(payload) & 0xFFFFFFFF)
+            + payload)
+
+
+def decode(blob: bytes) -> Tuple[int, bytes]:
+    """(commit_id, payload) or :class:`SpillCorrupt` — every field is
+    validated before the payload is trusted."""
+    head_len = len(MAGIC) + _HEADER.size
+    if len(blob) < head_len or not blob.startswith(MAGIC):
+        raise SpillCorrupt("bad magic or truncated header "
+                           "(%d bytes)" % len(blob))
+    commit_id, payload_len, crc = _HEADER.unpack(
+        blob[len(MAGIC):head_len])
+    payload = blob[head_len:]
+    if len(payload) != payload_len:
+        raise SpillCorrupt(
+            "torn payload: header promises %d bytes, file holds %d"
+            % (payload_len, len(payload)))
+    if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+        raise SpillCorrupt("payload CRC mismatch")
+    return commit_id, payload
+
+
+def _filename(commit_id: int, tag: str) -> str:
+    return "state-%020d-%s%s" % (commit_id, tag, _SUFFIX)
+
+
+def write(commit_id: int, payload: bytes, tag: str) -> Optional[str]:
+    """Spill one commit blob atomically; returns the path, or None when
+    spilling is disabled.  Never raises into the commit path — a full
+    disk must degrade durability, not kill training mid-step."""
+    d = spill_dir()
+    if d is None:
+        return None
+    blob = encode(commit_id, payload)
+    if faultline.site("elastic.state.spill"):
+        # Injected torn write: the file lands truncated mid-payload,
+        # past the header — exactly the shape a host losing power
+        # mid-commit leaves behind.  os.replace still runs, so only
+        # the CRC/length check can catch it.
+        blob = blob[:len(MAGIC) + _HEADER.size + max(1, len(payload) // 2)]
+        LOG.warning("spill for commit %d torn (faultline "
+                    "elastic.state.spill)", commit_id)
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=".tmp-spill-", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(d, _filename(commit_id, tag)))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _prune(d, tag)
+        return os.path.join(d, _filename(commit_id, tag))
+    except OSError as exc:
+        LOG.warning("state spill for commit %d failed (%s); continuing "
+                    "without durability for this commit", commit_id, exc)
+        return None
+
+
+# Orphaned temp files older than this are swept by the pruner: far
+# beyond any live write's lifetime, so a crash mid-write (the power
+# loss the atomic rename protects against) cannot leak disk forever,
+# while a concurrent writer's in-flight temp is never touched.
+_TMP_SWEEP_AGE_S = 300.0
+
+
+def _prune(d: str, tag: str):
+    """Keep the newest ``keep_last()`` blobs with this writer's tag
+    (only own files: pruning a peer's history would race its writes),
+    and sweep crash-orphaned temp files past the age guard."""
+    mine = sorted(n for n in os.listdir(d)
+                  if n.endswith("-%s%s" % (tag, _SUFFIX))
+                  and n.startswith("state-"))
+    for name in mine[:-keep_last()]:
+        try:
+            os.unlink(os.path.join(d, name))
+        except OSError:
+            pass
+    now = time.time()
+    for name in os.listdir(d):
+        if not name.startswith(".tmp-spill-"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            if now - os.path.getmtime(path) > _TMP_SWEEP_AGE_S:
+                os.unlink(path)
+        except OSError:
+            pass
+
+
+def scan(d: Optional[str] = None) -> List[Tuple[int, str]]:
+    """(commit_id, path) for every named spill file, newest first.
+    Commit ids come from the filename here; :func:`load_newest`
+    re-validates them against the header at read time."""
+    d = d if d is not None else spill_dir()
+    if d is None or not os.path.isdir(d):
+        return []
+    out = []
+    for name in os.listdir(d):
+        if not name.startswith("state-") or not name.endswith(_SUFFIX):
+            continue
+        parts = name[len("state-"):-len(_SUFFIX)].split("-", 1)
+        try:
+            out.append((int(parts[0]), os.path.join(d, name)))
+        except ValueError:
+            continue
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
+
+
+def have_evidence(d: Optional[str] = None) -> bool:
+    """True when the spill directory holds ANY spill file, valid or
+    not: committed state existed, so a restore that finds no valid
+    blob must fail loudly rather than silently restart from zeros."""
+    return bool(scan(d))
+
+
+def load_newest(min_commit_id: int = 0,
+                d: Optional[str] = None) -> Optional[Tuple[int, bytes]]:
+    """The newest valid blob strictly newer than ``min_commit_id``,
+    as (commit_id, payload); corrupt blobs are warned about and
+    skipped (the keep-last-K chain is the fallback)."""
+    for commit_id, path in scan(d):
+        if commit_id <= min_commit_id:
+            return None
+        try:
+            with open(path, "rb") as f:
+                file_commit_id, payload = decode(f.read())
+            if file_commit_id != commit_id:
+                raise SpillCorrupt(
+                    "filename claims commit %d, header %d"
+                    % (commit_id, file_commit_id))
+            return file_commit_id, payload
+        except (OSError, SpillCorrupt) as exc:
+            LOG.warning("skipping corrupt spill %s (%s); falling back "
+                        "to the previous blob", path, exc)
+            continue
+    return None

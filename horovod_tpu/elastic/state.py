@@ -6,12 +6,24 @@ Reference parity: ``horovod/common/elastic.py`` (``State``,
 
 * ``commit()``  — snapshot state in host memory AND check for pending
   host updates (cheap in-memory checkpoint; called every N batches).
+  With ``HOROVOD_STATE_SPILL_DIR`` / ``HOROVOD_STATE_REPLICAS`` set
+  the snapshot is additionally spilled to disk and/or mirrored to
+  buddy ranks (elastic/spill.py), so full-job restart and multi-host
+  loss restore from the newest valid blob.
 * ``restore()`` — roll back to the last commit (after a failure).
-* ``sync()``    — broadcast state from rank 0 to the (possibly new)
-  world after a re-rendezvous.
+* ``sync()``    — broadcast state to the (possibly new) world after a
+  re-rendezvous, from a **survivor-elected root**: every rank
+  allgathers a small commit-metadata record, the max-progress rank
+  wins deterministically on all ranks, and a blank joiner can never
+  overwrite survivors' progress (the reference broadcasts from rank 0
+  and assumes survivors keep low ranks; our driver makes no such
+  guarantee).
 * user code runs inside ``hvd.elastic.run(train)(state)`` which retries
   on ``HorovodInternalError`` (restore) and ``HostsUpdatedInterrupt``
-  (no rollback), re-rendezvousing in between.
+  (no rollback), re-rendezvousing in between; a SIGTERM/preemption
+  notice (or a stall crossing the shutdown threshold) leaves through
+  the drain protocol instead — commit, notify the driver, exit with
+  the distinguished drain code.
 """
 
 from __future__ import annotations
@@ -20,6 +32,7 @@ import copy
 import functools
 import logging
 import os
+import pickle
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -27,11 +40,21 @@ import numpy as np
 
 from ..common import basics, faultline
 from ..ops.engine import HorovodInternalError
-from .worker import (HostsUpdatedInterrupt, WorkerStopped,
+from ..utils.stall_inspector import StallError
+from . import spill
+from .worker import (HostsUpdatedInterrupt, WorkerDrained, WorkerStopped,
                      arm_last_resort_exit, elastic_timeout,
-                     install_assignment, notification_manager)
+                     install_assignment, install_preemption_handler,
+                     notification_manager, preempt_grace_secs)
 
 LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+class StateSyncError(RuntimeError):
+    """``sync()`` refused to proceed: the elected root holds no
+    committed state while durable evidence says state existed, or the
+    broadcast would regress this rank's progress.  Loud by design —
+    the alternative is silently training from reinitialized zeros."""
 
 
 class State:
@@ -39,6 +62,11 @@ class State:
 
     def __init__(self, **kwargs):
         self._reset_callbacks: List[Callable[[], None]] = []
+        # Monotonic commit counter: 0 = never committed.  Drives the
+        # sync()-time root election (max progress wins) and names the
+        # durable spill blobs; a synced rank adopts the root's id.
+        self._commit_id = 0
+        self._sync_root: Optional[int] = None
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -51,8 +79,34 @@ class State:
 
     def commit(self):
         faultline.site("elastic.state.commit")
+        self._commit_id += 1
         self.save()
+        self._persist()
+        self.check_drain()
         self.check_host_updates()
+
+    def check_drain(self):
+        """Leave via the drain protocol when a preemption notice
+        arrived: the step just finished and the state is committed (and
+        persisted), so this is the one safe exit point.  Checked before
+        host updates — a preempted worker re-rendezvousing would waste
+        its whole grace window."""
+        nm = notification_manager()
+        if faultline.site("worker.preempt.sigterm"):
+            nm.request_drain(
+                "injected preemption (faultline worker.preempt.sigterm)")
+        if nm.drain_requested():
+            # WARNING on purpose: preemption is the operator-visible
+            # event the drain e2e tests (and humans) key on.
+            LOG.warning("draining at commit %d: in-flight step "
+                        "finished and committed; notifying the driver "
+                        "and exiting", self._commit_id)
+            nm.send_drain_notice(commit_id=self._commit_id)
+            # Commit + notice are safe: shrink the force-exit window to
+            # a teardown allowance, so a shutdown wedged on the broken
+            # collective cannot eat the rest of the preemption grace.
+            nm.arm_drain_exit(min(5.0, preempt_grace_secs()))
+            raise WorkerDrained()
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt if the driver notified us of a
@@ -71,6 +125,10 @@ class State:
 
     def sync(self):
         raise NotImplementedError
+
+    def _persist(self):
+        """Durable-commit hook (spill + buddy replication); base state
+        has no serializable payload."""
 
 
 class ObjectState(State):
@@ -93,14 +151,122 @@ class ObjectState(State):
         for k, v in copy.deepcopy(self._saved).items():
             setattr(self, k, v)
 
+    # -- durability (spill + buddy replication) ----------------------------
+
+    def _spill_payload(self) -> Dict[str, Any]:
+        return {"attrs": self._saved}
+
+    def _load_payload(self, payload: Dict[str, Any]):
+        self._saved = payload.get("attrs", {})
+        self.restore()
+
+    def _persist(self):
+        if spill.spill_dir() is None and spill.replica_count() <= 0:
+            return
+        payload = pickle.dumps(self._spill_payload())
+        tag = "r%d" % (basics.rank() if basics.is_initialized() else 0)
+        spill.write(self._commit_id, payload, tag)
+        replicas = spill.replica_count()
+        if replicas > 0:
+            notification_manager().mirror_commit(
+                spill.encode(self._commit_id, payload),
+                self._commit_id, replicas)
+
+    def _durable_evidence(self) -> bool:
+        return (spill.have_evidence()
+                or notification_manager().replica_blob() is not None)
+
+    def _adopt_durable_state(self) -> bool:
+        """Load the newest valid durable blob (local spill or a buddy
+        replica) when it is strictly newer than memory — the full-job
+        restart and multi-host loss recovery path.  Mid-job syncs are
+        no-ops here: memory is always at least as new as the disk."""
+        best: Optional[tuple] = None  # (commit_id, payload, source)
+        loaded = spill.load_newest(min_commit_id=self._commit_id)
+        if loaded is not None:
+            best = (loaded[0], loaded[1], "spill")
+        rep = notification_manager().replica_blob()
+        if rep is not None and rep.get("blob"):
+            try:
+                rid, rpayload = spill.decode(rep["blob"])
+                if rid > self._commit_id and (best is None
+                                              or rid > best[0]):
+                    best = (rid, rpayload,
+                            "replica of rank %s" % rep.get("source_rank"))
+            except spill.SpillCorrupt as exc:
+                LOG.warning("buddy replica blob is corrupt (%s); "
+                            "ignoring it", exc)
+        if best is None:
+            return False
+        self._load_payload(pickle.loads(best[1]))
+        self._commit_id = best[0]
+        self.save()
+        LOG.info("restored durable state at commit %d from %s",
+                 self._commit_id, best[2])
+        return True
+
+    # -- sync with survivor-elected root -----------------------------------
+
+    def _elect_sync_root(self) -> int:
+        """Allgather commit metadata, elect the max-progress rank as
+        root — identically on every rank — and refuse the blank-root
+        hazard loudly (a freshly-joined rank must never broadcast its
+        reinitialized state over survivors' progress)."""
+        from ..jax.functions import elect_state_root
+        record = {"rank": basics.rank(),
+                  "commit_id": self._commit_id,
+                  "evidence": self._durable_evidence()}
+        root, records = elect_state_root(record)
+        root_commit = int(root.get("commit_id", 0))
+        if any(int(r.get("commit_id", 0)) > root_commit
+               for r in records):
+            raise StateSyncError(
+                "state-root election violated its own invariant: "
+                "elected rank %r at commit %d but a rank reports more "
+                "progress (records: %r)" % (root.get("rank"),
+                                            root_commit, records))
+        if root_commit == 0 and any(r.get("evidence") for r in records):
+            raise StateSyncError(
+                "no rank holds committed state but durable commit "
+                "evidence exists (spill/replica blobs); refusing to "
+                "silently restart from reinitialized state — "
+                "inspect HOROVOD_STATE_SPILL_DIR")
+        if root_commit > 0:
+            LOG.info("elastic sync: elected rank %d as state root "
+                     "(commit id %d)", int(root["rank"]), root_commit)
+        return int(root["rank"])
+
     def sync(self):
+        self._sync_root = None
+        adopted = self._adopt_durable_state()
+        if (not adopted and self._commit_id == 0
+                and self._durable_evidence()):
+            raise StateSyncError(
+                "durable commit evidence exists but no valid blob "
+                "could be restored (all torn/corrupt?); refusing to "
+                "train from reinitialized state — inspect "
+                "HOROVOD_STATE_SPILL_DIR")
         if not basics.is_initialized() or basics.size() <= 1:
             return
         from ..jax.functions import broadcast_object
-        synced = broadcast_object(self._public_attrs(), root_rank=0,
-                                  name="elastic.ObjectState")
-        for k, v in synced.items():
+        root = self._elect_sync_root()
+        self._sync_root = root
+        synced = broadcast_object(
+            {"attrs": self._public_attrs(), "commit_id": self._commit_id},
+            root_rank=root, name="elastic.ObjectState")
+        synced_commit = int(synced.get("commit_id", 0))
+        # Blank/stale-root guard, independent of how the root was
+        # chosen: a sync may fast-forward this rank or hold it still,
+        # never rewind it.
+        if synced_commit < self._commit_id:
+            raise StateSyncError(
+                "sync from root rank %d would regress this rank from "
+                "commit %d to %d; refusing to overwrite progress with "
+                "a blank or stale root" % (root, self._commit_id,
+                                           synced_commit))
+        for k, v in synced.get("attrs", {}).items():
             setattr(self, k, v)
+        self._commit_id = synced_commit
         self.save()
 
 
@@ -152,14 +318,26 @@ class JaxState(ObjectState):
         for k, tree in self._saved_trees.items():
             setattr(self, k, self._jax.tree.map(np.copy, tree))
 
+    def _spill_payload(self) -> Dict[str, Any]:
+        payload = super()._spill_payload()
+        payload["trees"] = self._saved_trees
+        return payload
+
+    def _load_payload(self, payload: Dict[str, Any]):
+        self._saved_trees = payload.get("trees", {})
+        super()._load_payload(payload)
+
     def sync(self):
         super().sync()
         if not basics.is_initialized() or basics.size() <= 1:
             return
+        # Same elected root as the attribute broadcast: pytrees from
+        # anyone else could mix two ranks' training states.
+        root = self._sync_root if self._sync_root is not None else 0
         from ..jax.functions import broadcast_parameters
         for k in self._tree_attrs:
             setattr(self, k, broadcast_parameters(getattr(self, k),
-                                                  root_rank=0))
+                                                  root_rank=root))
         self.save()
 
 
@@ -181,6 +359,38 @@ def _reset_and_reinit(min_epoch=None, timeout=None):
     basics.init()
 
 
+def _is_stall_abort(exc: BaseException) -> bool:
+    """Did this collective failure come from the stall-shutdown
+    threshold?  The in-process engine chains the StallError as the
+    cause; the native core surfaces its Aborted status as message text
+    ('stall shutdown threshold exceeded', operations.cc) — both planes
+    must take the drain exit, not the blacklist-churning crash."""
+    return (isinstance(exc.__cause__, StallError)
+            or "stall shutdown threshold" in str(exc).lower())
+
+
+def _stall_abort(state: State, exc: BaseException):
+    """A collective crossed ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``:
+    the engine already error-completed the outstanding handles, so the
+    in-memory state is exactly the last commit.  Leave through the
+    drain path — committed-then-abort — instead of a hard crash: a
+    stall usually means a PEER died, and blacklist-churning THIS
+    (healthy) host for it would punish the wrong machine.  Raises
+    :class:`WorkerDrained`."""
+    nm = notification_manager()
+    LOG.error("stall crossed the shutdown threshold (%s); aborting at "
+              "the last commit via the drain protocol", exc)
+    nm.request_drain(
+        "stall shutdown threshold (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)")
+    try:
+        state.restore()
+    except Exception:  # noqa: BLE001 — exiting anyway, keep it loud-free
+        LOG.debug("restore before stall abort failed", exc_info=True)
+    nm.send_drain_notice(commit_id=getattr(state, "_commit_id", 0))
+    nm.arm_drain_exit(min(5.0, preempt_grace_secs()))
+    raise WorkerDrained() from exc
+
+
 def run(func):
     """Elastic retry decorator: ``hvd.elastic.run(train)(state, ...)``
     (reference ``run_fn`` in horovod/common/elastic.py)."""
@@ -189,6 +399,10 @@ def run(func):
     def wrapper(state: State, *args, **kwargs):
         nm = notification_manager()
         nm.init()
+        # SIGTERM (cloud preemption, planned shutdown) enters the
+        # drain protocol: finish the step, commit, notify, exit
+        # distinguished — instead of dying mid-step as a "crash".
+        install_preemption_handler()
         if not basics.is_initialized():
             _reset_and_reinit()
         skip_sync = False
@@ -201,7 +415,11 @@ def run(func):
                 if not skip_sync:
                     state.sync()
                 return func(state, *args, **kwargs)
+            except StallError as exc:
+                _stall_abort(state, exc)
             except HorovodInternalError as exc:
+                if _is_stall_abort(exc):
+                    _stall_abort(state, exc)
                 LOG.warning("collective failed (%s); restoring last "
                             "commit and re-rendezvousing", exc)
                 state.restore()

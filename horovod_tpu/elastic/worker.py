@@ -17,15 +17,35 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import threading
 import time
 from typing import Any, Dict, Optional
 
 from ..common import faultline
+from ..common.envutil import env_float
 from ..runner import services
 from ..runner.http_client import is_transient, jittered
 
 LOG = logging.getLogger("horovod_tpu.elastic")
+
+# Distinguished exit code for a worker that left via the drain
+# protocol (preemption SIGTERM, stall abort): the driver treats this
+# rc as a PLANNED removal — no blacklist, no failure count, no
+# respawn-backoff penalty — even when the drain notice itself was
+# lost.  Distinct from 0 (success: the slot is done and not
+# respawned) and from the last-resort exit (70).
+DRAIN_EXIT_CODE = 85
+
+
+def preempt_grace_secs() -> float:
+    """Seconds a preempted worker has to finish the in-flight step,
+    commit, send its drain notice and exit (HOROVOD_PREEMPT_GRACE_SECS,
+    default 30 — inside Cloud TPU's shortest preemption warning).  The
+    same window bounds the driver's SIGTERM→SIGKILL escalation in
+    runner/safe_shell_exec.py, so a drain-capable worker is never
+    killed mid-commit by its own driver."""
+    return env_float("HOROVOD_PREEMPT_GRACE_SECS", 30.0, minimum=0.0)
 
 
 def elastic_timeout() -> float:
@@ -88,6 +108,17 @@ class WorkerStopped(SystemExit):
         super().__init__(0)
 
 
+class WorkerDrained(SystemExit):
+    """This worker is leaving via the drain protocol: the in-flight
+    step finished, the state is committed (and spilled when durability
+    is on), the drain notice went to the driver.  Exits with the
+    distinguished :data:`DRAIN_EXIT_CODE` so the driver treats the
+    removal as planned even if the notice was lost."""
+
+    def __init__(self):
+        super().__init__(DRAIN_EXIT_CODE)
+
+
 def _driver_addr() -> Optional[tuple]:
     addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
     if not addr:
@@ -106,6 +137,24 @@ class WorkerNotificationManager:
         self._update_result: Optional[int] = None
         self.host = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
         self.slot = int(os.environ.get("HOROVOD_ELASTIC_SLOT", "0"))
+        # Drain protocol state: reason set once (SIGTERM handler, stall
+        # abort, injected preemption), notice sent at most once, and a
+        # last-resort timer guarantees the process exits with the drain
+        # code inside the preemption grace window even when the
+        # in-flight step never reaches another commit.  RLock, NOT
+        # Lock: the SIGTERM handler runs ON the main thread between
+        # bytecodes, so it can interrupt a main-thread critical section
+        # of this very lock (replica_blob() during sync, the notice
+        # send) — a non-reentrant lock would deadlock the worker right
+        # through its grace window.
+        self._drain_reason: Optional[str] = None
+        self._drain_timer: Optional[threading.Timer] = None
+        self._drain_notice_sent = False
+        self._drain_lock = threading.RLock()
+        # Buddy-replica blob (newest wins): peers mirror their durable
+        # commits here via the driver so a survivor can hand back a
+        # dead rank's progress at the next root election.
+        self._replica: Optional[Dict[str, Any]] = None
 
     @property
     def active(self) -> bool:
@@ -133,6 +182,19 @@ class WorkerNotificationManager:
                 self._pending_epoch = payload.get("epoch")
                 self._update_result = payload.get("update_result")
             return {"ok": True}
+        if req.get("kind") == "replica":
+            # A peer's durable commit, forwarded by the driver: keep
+            # the newest (CRC-validated at adoption time, not here —
+            # the blob is opaque bytes on this side).
+            with self._drain_lock:
+                cur = self._replica
+                if cur is None or int(req.get("commit_id", 0)) > \
+                        int(cur.get("commit_id", 0)):
+                    self._replica = {
+                        "commit_id": int(req.get("commit_id", 0)),
+                        "source_rank": req.get("source_rank"),
+                        "blob": req.get("blob")}
+            return {"ok": True}
         if req.get("kind") == "ping":
             return {"ok": True, "host": self.host, "slot": self.slot}
         return {"error": "unknown request"}
@@ -143,6 +205,122 @@ class WorkerNotificationManager:
     def consume_update(self) -> Optional[int]:
         ep, self._pending_epoch = self._pending_epoch, None
         return ep
+
+    # -- drain protocol ----------------------------------------------------
+
+    def replica_blob(self) -> Optional[Dict[str, Any]]:
+        """The newest buddy-replica record this worker holds, if any."""
+        with self._drain_lock:
+            return self._replica
+
+    def request_drain(self, reason: str):
+        """Enter the drain protocol: the next ``state.commit()`` (or
+        rendezvous poll) sends the drain notice and exits with the
+        distinguished code.  A daemon timer enforces the grace window
+        (``HOROVOD_PREEMPT_GRACE_SECS``): a worker whose in-flight
+        step wedges still exits as DRAINED, not as a respawn-churning
+        crash, before the platform's SIGKILL lands."""
+        with self._drain_lock:
+            if self._drain_reason is not None:
+                return
+            self._drain_reason = reason
+            grace = preempt_grace_secs()
+            if grace > 0:
+                t = threading.Timer(grace, self._drain_deadline_exit)
+                t.daemon = True
+                t.start()
+                self._drain_timer = t
+        LOG.warning("drain requested (%s): finishing the in-flight "
+                    "step, committing, and exiting within %.0fs",
+                    reason, preempt_grace_secs())
+
+    def drain_requested(self) -> bool:
+        return self._drain_reason is not None
+
+    def _drain_deadline_exit(self):
+        LOG.error("drain grace expired with the worker still alive; "
+                  "exiting with the drain code now so the platform's "
+                  "SIGKILL does not beat the notice")
+        try:
+            self.send_drain_notice(commit_id=-1, fast=True)
+        except Exception:  # noqa: BLE001 — dying anyway
+            pass
+        os._exit(DRAIN_EXIT_CODE)
+
+    def arm_drain_exit(self, delay: float):
+        """Re-arm the force-exit timer with a SHORT teardown allowance.
+        Called once the commit + drain notice are done: everything of
+        value is already safe, so the remaining grace belongs to
+        normal exception unwinding and user cleanup — NOT to a wedged
+        engine shutdown (observed: the tcp core's clean teardown can
+        block on a peer still parked in the broken collective, eating
+        the whole preemption window before the timer fired).  A grace
+        of 0 disables force-exits entirely, matching request_drain."""
+        if preempt_grace_secs() <= 0:
+            return
+        with self._drain_lock:
+            if self._drain_timer is not None:
+                self._drain_timer.cancel()
+            t = threading.Timer(max(0.5, delay), self._drain_deadline_exit)
+            t.daemon = True
+            t.start()
+            self._drain_timer = t
+
+    def send_drain_notice(self, commit_id: int = 0, fast: bool = False):
+        """Tell the driver this slot's exit is PLANNED (idempotent;
+        best-effort: the distinguished exit code is the fallback signal
+        when the notice or its ack is lost).  ``fast`` is the
+        last-resort-timer variant: one short attempt only — the
+        SIGKILL is imminent and os._exit must not wait out an RPC
+        retry loop against a driver that may itself be preempted."""
+        with self._drain_lock:
+            if self._drain_notice_sent:
+                return
+            self._drain_notice_sent = True
+            reason = self._drain_reason or "drain"
+        if not self.active:
+            return
+        secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+        try:
+            resp = services.send_message(
+                _driver_addr(), secret,
+                {"kind": "drain", "host": self.host, "slot": self.slot,
+                 "commit_id": commit_id, "reason": reason},
+                timeout=1.5 if fast else 5.0,
+                retries=0 if fast else 2,
+                deadline=2.0 if fast else max(5.0, preempt_grace_secs()))
+            if not resp.get("ok"):
+                LOG.warning("driver did not ack the drain notice (%r); "
+                            "relying on the drain exit code", resp)
+            else:
+                LOG.info("drain notice acked by driver (commit id %d)",
+                         commit_id)
+        except Exception as exc:  # noqa: BLE001 — exit code is fallback
+            LOG.warning("drain notice failed (%s); relying on the "
+                        "drain exit code", exc)
+
+    def mirror_commit(self, blob: bytes, commit_id: int, replicas: int):
+        """Mirror one durable commit blob to ``replicas`` buddy ranks
+        via the driver (it owns the slot→address table).  Best-effort:
+        replication strengthens durability, it must never stall or
+        kill the training loop."""
+        if not self.active or replicas <= 0:
+            return
+        secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+        try:
+            resp = services.send_message(
+                _driver_addr(), secret,
+                {"kind": "replicate", "host": self.host,
+                 "slot": self.slot, "commit_id": commit_id,
+                 "source_rank": os.environ.get("HOROVOD_RANK"),
+                 "replicas": replicas, "blob": blob},
+                timeout=10.0, retries=1, deadline=15.0)
+            if not resp.get("ok"):
+                LOG.warning("commit %d replication rejected: %r",
+                            commit_id, resp)
+        except Exception as exc:  # noqa: BLE001 — durability best-effort
+            LOG.warning("commit %d replication failed (%s); continuing",
+                        commit_id, exc)
 
     def rendezvous(self, timeout: Optional[float] = None,
                    min_epoch: Optional[int] = None) -> Dict[str, Any]:
@@ -158,6 +336,14 @@ class WorkerNotificationManager:
         secret = os.environ.get("HOROVOD_SECRET_KEY", "")
         deadline = time.monotonic() + (timeout or elastic_timeout())
         while True:
+            # A drain request must interrupt a PARKED worker too: one
+            # waiting out a "wait" status would otherwise sit past the
+            # whole grace window without ever reaching a commit, and
+            # only the last-resort timer would end it.
+            if self.drain_requested():
+                self.send_drain_notice()
+                self.arm_drain_exit(min(5.0, preempt_grace_secs()))
+                raise WorkerDrained()
             if faultline.site("elastic.rendezvous.poll"):
                 # Injected dropped poll: the deadline still applies.
                 if time.monotonic() > deadline:
@@ -245,6 +431,27 @@ def notification_manager() -> WorkerNotificationManager:
     if _manager is None:
         _manager = WorkerNotificationManager()
     return _manager
+
+
+def _on_sigterm(signum, frame):  # noqa: ARG001 — signal API
+    notification_manager().request_drain(
+        "SIGTERM (preemption / planned shutdown notice)")
+
+
+def install_preemption_handler() -> bool:
+    """Route SIGTERM into the drain protocol (Cloud TPU preemption,
+    ``kubectl delete pod``, and the driver's own escalating terminate
+    all lead with SIGTERM).  Python only allows this from the main
+    thread; elsewhere — or with the grace window disabled — the
+    default handler (immediate death) is kept and we return False."""
+    if preempt_grace_secs() <= 0:
+        return False
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        return True
+    except ValueError:  # not the main thread
+        LOG.debug("preemption handler not installed (non-main thread)")
+        return False
 
 
 def install_assignment(info: Dict[str, Any]):

@@ -86,3 +86,21 @@ def allgather_object(obj: Any, name: Optional[str] = None,
         return [obj] * basics.size()
     core = basics._get_tcp_core()
     return core.allgather_object(obj, name=name)
+
+
+def elect_state_root(record: dict, name: Optional[str] = None):
+    """Allgather one small commit-metadata record per rank and elect
+    the max-progress rank as the state-sync root, identically on every
+    rank: max ``commit_id`` wins, ties go to the LOWEST rank (so a
+    fresh world with no commits anywhere degenerates to the
+    reference's rank-0 broadcast).  Used by ``elastic.state`` — our
+    driver does not guarantee survivors keep low ranks after a
+    reshuffle, so the root must be elected, not assumed.
+
+    Returns ``(root_record, all_records)``; the election key is order-
+    independent, so any transport ordering of the gathered records
+    yields the same winner everywhere."""
+    records = allgather_object(record, name=name or "elastic.sync.election")
+    root = max(records, key=lambda r: (int(r.get("commit_id", 0)),
+                                       -int(r.get("rank", 0))))
+    return root, records

@@ -220,8 +220,7 @@ def gloo_run(args, hosts: List[util.HostInfo],
                                 "[launcher] worker rank %d exited with "
                                 "code %d\n" % (rank_i, code))
                         rc = code
-                        for other in remaining:
-                            other.terminate()
+                        safe_shell_exec.terminate_all(remaining)
                         remaining = []
                         break
             time.sleep(0.05)
@@ -232,8 +231,7 @@ def gloo_run(args, hosts: List[util.HostInfo],
                 mp.terminate()
         return rc
     finally:
-        for mp in procs:
-            mp.terminate()
+        safe_shell_exec.terminate_all(procs)
         server.stop()
 
 

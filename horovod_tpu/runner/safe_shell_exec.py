@@ -20,6 +20,20 @@ from typing import Callable, Dict, List, Optional
 GRACEFUL_TERMINATION_TIME_S = 5.0
 
 
+def _termination_grace() -> float:
+    """SIGTERM→SIGKILL escalation window.  When the launcher exports
+    ``HOROVOD_PREEMPT_GRACE_SECS`` (the drain protocol's grace), the
+    driver's own terminate honors the same window — a drain-capable
+    worker told to stop gets to finish its step, commit, and send its
+    drain notice before the SIGKILL lands.  Unset keeps the historical
+    5 s (non-elastic children have no drain work to protect)."""
+    if os.environ.get("HOROVOD_PREEMPT_GRACE_SECS") is None:
+        return GRACEFUL_TERMINATION_TIME_S
+    from ..common.envutil import env_float
+    return env_float("HOROVOD_PREEMPT_GRACE_SECS",
+                     GRACEFUL_TERMINATION_TIME_S, minimum=0.0)
+
+
 def _stream(pipe, sink: Callable[[str], None]):
     try:
         for line in iter(pipe.readline, b""):
@@ -59,16 +73,20 @@ class ManagedProcess:
             t.join(timeout=2.0)
         return rc
 
-    def terminate(self):
-        """SIGTERM the process group; SIGKILL stragglers after a grace
-        period (reference teardown behavior)."""
+    def terminate(self, grace: Optional[float] = None):
+        """SIGTERM the process group, wait out the grace window (the
+        drain protocol's ``HOROVOD_PREEMPT_GRACE_SECS`` when exported,
+        else 5 s), then SIGKILL stragglers — never an immediate kill:
+        a preemption-aware child uses the window to commit and drain."""
         if self.proc.poll() is not None:
             return
+        if grace is None:
+            grace = _termination_grace()
         try:
             os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             return
-        deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+        deadline = time.monotonic() + grace
         while time.monotonic() < deadline:
             if self.proc.poll() is not None:
                 return
@@ -85,6 +103,54 @@ class ManagedProcess:
             if self.proc.poll() is not None:
                 return
             time.sleep(0.05)
+
+
+def terminate_all(procs, grace: Optional[float] = None):
+    """Terminate many managed processes under ONE shared grace window:
+    SIGTERM every process group first, wait out a single deadline,
+    then SIGKILL the stragglers.  The serial ``for mp: mp.terminate()``
+    shape multiplies the grace by the straggler count — with the drain
+    window exported (``HOROVOD_PREEMPT_GRACE_SECS``, default 30 s)
+    that turns an 8-worker teardown into minutes.
+
+    Non-ManagedProcess entries (platform proc proxies: Spark agents,
+    Ray actors) keep their own ``terminate()`` semantics — their
+    teardown is an RPC, not a signal."""
+    for mp in procs:
+        if not isinstance(mp, ManagedProcess):
+            try:
+                mp.terminate()
+            except Exception:  # noqa: BLE001 — proxy may already be gone
+                pass
+    procs = [mp for mp in procs
+             if isinstance(mp, ManagedProcess) and mp.proc.poll() is None]
+    if not procs:
+        return
+    if grace is None:
+        grace = _termination_grace()
+    for mp in procs:
+        try:
+            os.killpg(os.getpgid(mp.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if all(mp.proc.poll() is not None for mp in procs):
+            return
+        time.sleep(0.05)
+    for mp in procs:
+        if mp.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(mp.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    # Confirm the deaths (SIGKILL delivery is asynchronous) so a
+    # caller exiting right after cannot orphan unreaped children.
+    deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+    while time.monotonic() < deadline:
+        if all(mp.proc.poll() is not None for mp in procs):
+            return
+        time.sleep(0.05)
 
 
 def execute(command: List[str], env: Optional[Dict[str, str]] = None,

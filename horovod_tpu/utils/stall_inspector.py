@@ -5,7 +5,13 @@ collective has been submitted but not completed for longer than the warning
 threshold (``HOROVOD_STALL_CHECK_TIME_SECONDS``, default 60 s), log which
 tensors are stuck — in multi-process mode, also which ranks are missing
 them.  Optionally aborts after a shutdown threshold
-(``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``).
+(``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``, default 0 = warn only;
+mirrored by ``core/src/stall_inspector.h`` ``kDefaultShutdownSecs`` —
+the two planes must agree on when a stall turns fatal).  In an elastic
+world the resulting :class:`StallError` does not hard-kill the worker:
+``hvd.elastic.run`` routes it through the drain protocol
+(committed-then-abort, distinguished exit code, no blacklist churn for
+the healthy host that merely observed a peer's death).
 
 This is the most-loved debugging feature of the reference (it turns a hang
 into an actionable message like "ranks 1,3 have not submitted tensor X"),

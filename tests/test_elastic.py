@@ -305,6 +305,304 @@ def test_object_state_commit_restore():
     assert st.batch == 5 and st.lr == 0.1
 
 
+def test_commit_id_monotonic_and_restore_preserves_it():
+    st = ObjectState(batch=0)
+    assert st._commit_id == 0  # construction is not a commit
+    st.commit()
+    st.commit()
+    assert st._commit_id == 2
+    st.batch = 99
+    st.restore()
+    # restore rolls the DATA back to commit 2; the id stays (the
+    # restored state IS commit 2, not a new one).
+    assert st._commit_id == 2 and st.batch == 0
+
+
+# -- durable spills (ISSUE 5 tentpole layer 3) -----------------------------
+
+def test_spill_roundtrip_keep_k_and_corrupt_fallback(tmp_path, monkeypatch):
+    from horovod_tpu.elastic import spill
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_STATE_KEEP", "3")
+    for cid in range(1, 6):
+        spill.write(cid, b"payload-%d" % cid, "r0")
+    names = sorted(os.listdir(str(tmp_path)))
+    assert len(names) == 3, names  # keep-last-K pruned commits 1 and 2
+    assert not [n for n in names if n.startswith(".tmp")]
+    assert spill.load_newest() == (5, b"payload-5")
+    # Torn tail on the newest: restore falls back to the previous blob.
+    newest = [p for c, p in spill.scan() if c == 5][0]
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[:-3])
+    assert spill.load_newest() == (4, b"payload-4")
+    # Bit flip inside the payload: the CRC catches it.
+    p4 = [p for c, p in spill.scan() if c == 4][0]
+    raw = bytearray(open(p4, "rb").read())
+    raw[-1] ^= 0xFF
+    with open(p4, "wb") as f:
+        f.write(bytes(raw))
+    assert spill.load_newest() == (3, b"payload-3")
+    # Nothing strictly newer than memory -> no adoption.
+    assert spill.load_newest(min_commit_id=3) is None
+    assert spill.have_evidence()
+
+
+def test_spill_fault_injection_torn_write(tmp_path, monkeypatch):
+    """elastic.state.spill drop = the write lands truncated mid-payload
+    (a host losing power mid-commit); restore must detect and skip it."""
+    from horovod_tpu.common import faultline
+    from horovod_tpu.elastic import spill
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    spill.write(1, b"A" * 64, "r0")
+    monkeypatch.setenv("HVD_TPU_FAULT", "elastic.state.spill:drop@times=1")
+    faultline.reset()
+    try:
+        spill.write(2, b"B" * 64, "r0")
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        faultline.reset()
+    assert len(spill.scan()) == 2  # the torn file exists on disk ...
+    assert spill.load_newest() == (1, b"A" * 64)  # ... and is skipped
+
+
+def test_spill_prune_sweeps_stale_tmp_files(tmp_path, monkeypatch):
+    # A crash between mkstemp and os.replace leaves a temp file; the
+    # pruner sweeps it once it is safely past any live write's
+    # lifetime, and never touches a fresh (possibly in-flight) one.
+    from horovod_tpu.elastic import spill
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    stale = tmp_path / ".tmp-spill-dead"
+    stale.write_bytes(b"x")
+    old = time.time() - 600
+    os.utime(str(stale), (old, old))
+    fresh = tmp_path / ".tmp-spill-live"
+    fresh.write_bytes(b"y")
+    spill.write(1, b"payload", "r0")
+    assert not stale.exists()
+    assert fresh.exists()
+
+
+def test_replica_buddies_prefer_other_hosts(monkeypatch):
+    # A replica on the source's own host dies with it; host-distinct
+    # slots must be picked first.
+    from horovod_tpu.elastic import driver as driver_mod
+    sent = []
+    monkeypatch.setattr(
+        driver_mod, "send_message",
+        lambda addr, secret, payload, **kw: sent.append(addr))
+    d = _make_driver(FixedHosts({"a": 2, "b": 1}))
+    try:
+        d._target = [("a", 0), ("a", 1), ("b", 0)]
+        d._worker_addrs = {("a", 0): ("a", 1), ("a", 1): ("a", 2),
+                           ("b", 0): ("b", 3)}
+        resp = d._handle({"kind": "replicate", "host": "a", "slot": 0,
+                          "commit_id": 5, "replicas": 1, "blob": b"x"})
+        assert resp["delivered"] == 1
+        assert sent == [("b", 3)]  # not the same-host slot ("a", 2)
+    finally:
+        _close_driver(d)
+
+
+def test_sync_restores_from_spill_uninitialized_world(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    st = ObjectState(batch=0, total=0.0)
+    st.batch, st.total = 4, 8.0
+    st.commit()
+    # A fresh incarnation (full-job restart) adopts the newest blob.
+    st2 = ObjectState(batch=0, total=0.0)
+    st2.sync()
+    assert st2.batch == 4 and st2.total == 8.0
+    assert st2._commit_id == 1
+
+
+def test_sync_no_valid_blob_fails_loudly(tmp_path, monkeypatch):
+    from horovod_tpu.elastic.state import StateSyncError
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    corrupt = tmp_path / "state-00000000000000000003-r0.spill"
+    corrupt.write_bytes(b"garbage that is definitely not a spill blob")
+    st = ObjectState(batch=0)
+    with pytest.raises(StateSyncError):
+        st.sync()
+    # An EMPTY spill dir is a genuine fresh start, never an error.
+    corrupt.unlink()
+    st.sync()
+    assert st.batch == 0
+
+
+def test_jax_state_spill_roundtrip(tmp_path, monkeypatch):
+    from horovod_tpu.elastic.state import JaxState
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    st = JaxState(params=params, epoch=0)
+    st.params = {"w": st.params["w"] + 2.5}
+    st.epoch = 3
+    st.commit()
+    st2 = JaxState(params={"w": np.zeros((2, 3), np.float32)}, epoch=0)
+    st2.sync()
+    assert st2.epoch == 3 and st2._commit_id == 1
+    np.testing.assert_array_equal(
+        np.asarray(st2.params["w"]),
+        np.arange(6, dtype=np.float32).reshape(2, 3) + 2.5)
+
+
+# -- survivor-elected state root (ISSUE 5 tentpole layer 2) ----------------
+
+def test_elect_state_root_prefers_progress_then_low_rank(monkeypatch):
+    from horovod_tpu.jax import functions
+    recs = [{"rank": 0, "commit_id": 0, "evidence": False},
+            {"rank": 1, "commit_id": 7, "evidence": False},
+            {"rank": 2, "commit_id": 7, "evidence": False}]
+    monkeypatch.setattr(functions, "allgather_object",
+                        lambda obj, name=None: recs)
+    root, records = functions.elect_state_root(recs[0])
+    assert root["rank"] == 1  # max progress, ties to the LOWEST rank
+    assert records is recs
+    # All blank (fresh world): degenerates to the reference's rank 0.
+    recs0 = [{"rank": r, "commit_id": 0} for r in (2, 0, 1)]
+    monkeypatch.setattr(functions, "allgather_object",
+                        lambda obj, name=None: recs0)
+    root, _ = functions.elect_state_root(recs0[0])
+    assert root["rank"] == 0
+
+
+# -- drain protocol bookkeeping (ISSUE 5 tentpole layer 1) -----------------
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+        self.terminated = False
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self.terminated = True
+
+
+def test_drained_worker_is_planned_removal_not_failure():
+    """Satellite: a drained (or clean-exit-0) worker resets the slot's
+    respawn backoff and never contributes to
+    HOROVOD_HOST_FAILURE_THRESHOLD — no blacklist, no respawn churn."""
+    from horovod_tpu.elastic.worker import DRAIN_EXIT_CODE
+    driver = _make_driver(FixedHosts({"h": 1}), failure_threshold=1)
+    driver._make_worker_proc = lambda slot, env: None
+    slot = ("h", 0)
+    recomputes = []
+    driver._recompute_world = recomputes.append
+    try:
+        driver._target = [slot]
+        driver._published = True
+        # (1) rc fallback: the drain notice was lost, the distinguished
+        # exit code alone marks the removal as planned.
+        driver._spawn_backoff[slot] = 8.0
+        driver._procs[slot] = _FakeProc(DRAIN_EXIT_CODE)
+        driver._spawn_attempts[slot] = time.monotonic()
+        assert driver._check_procs() is False
+        assert driver._registry.blacklisted_hosts() == []
+        assert driver._registry._failures == {}
+        assert slot not in driver._spawn_backoff  # backoff reset
+        assert slot not in driver._succeeded      # but not "done" either
+        assert recomputes == ["worker drained"]
+        # (2) notice path: after a drain message ANY rc is planned
+        # (SIGKILL beat the clean exit).
+        resp = driver._handle({"kind": "drain", "host": "h", "slot": 0,
+                               "commit_id": 3, "reason": "preemption"})
+        assert resp.get("ok"), resp
+        driver._procs[slot] = _FakeProc(137)
+        driver._spawn_attempts[slot] = time.monotonic()
+        assert driver._check_procs() is False
+        assert driver._registry.blacklisted_hosts() == []
+        assert driver._registry._failures == {}
+        assert recomputes == ["worker drained", "worker drained"]
+        assert slot not in driver._draining  # consumed by the reap
+        # (3) clean exit 0 resets the backoff too and counts as done.
+        driver._spawn_backoff[slot] = 8.0
+        driver._procs[slot] = _FakeProc(0)
+        driver._spawn_attempts[slot] = time.monotonic()
+        assert driver._check_procs() is True  # all target slots done
+        assert slot not in driver._spawn_backoff
+        # (4) an actual failure still counts toward the threshold.
+        driver._succeeded.discard(slot)
+        driver._procs[slot] = _FakeProc(17)
+        driver._spawn_attempts[slot] = time.monotonic()
+        driver._check_procs()
+        assert driver._registry.blacklisted_hosts() == ["h"]
+    finally:
+        _close_driver(driver)
+
+
+def test_drain_ack_drop_falls_back_to_exit_code(monkeypatch):
+    """driver.drain.ack drop: the notice is lost at the driver; the
+    slot is NOT marked draining, but the drain exit code still lands
+    the worker in the planned-removal path."""
+    from horovod_tpu.common import faultline
+    from horovod_tpu.elastic.worker import DRAIN_EXIT_CODE
+    monkeypatch.setenv("HVD_TPU_FAULT", "driver.drain.ack:drop")
+    faultline.reset()
+    driver = _make_driver(FixedHosts({"h": 1}))
+    driver._make_worker_proc = lambda slot, env: None
+    driver._recompute_world = lambda reason: None
+    slot = ("h", 0)
+    try:
+        driver._target = [slot]
+        resp = driver._handle({"kind": "drain", "host": "h", "slot": 0,
+                               "commit_id": 3, "reason": "preemption"})
+        assert "error" in resp
+        assert slot not in driver._draining
+        driver._procs[slot] = _FakeProc(DRAIN_EXIT_CODE)
+        driver._spawn_attempts[slot] = time.monotonic()
+        driver._check_procs()
+        assert driver._registry.blacklisted_hosts() == []
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        faultline.reset()
+        _close_driver(driver)
+
+
+def test_stall_error_aborts_via_drain_path(monkeypatch):
+    """Satellite: a StallError (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+    crossed) leaves through the drain protocol — committed-then-abort
+    with the distinguished exit code — not a hard crash that would
+    blacklist the healthy host that merely watched a peer die."""
+    import horovod_tpu.elastic.worker as worker_mod
+    from horovod_tpu.elastic import state as state_mod
+    from horovod_tpu.elastic.worker import WorkerDrained
+    from horovod_tpu.ops.engine import HorovodInternalError
+    from horovod_tpu.utils.stall_inspector import StallError
+    monkeypatch.setattr(worker_mod, "_manager", None)  # fresh singleton
+    monkeypatch.setenv("HOROVOD_PREEMPT_GRACE_SECS", "0")  # no timer
+    st = ObjectState(batch=2)
+    st.commit()
+    st.batch = 9  # half-applied step the abort must roll back
+    # The engine wraps handle errors in HorovodInternalError with the
+    # original as __cause__ (CollectiveHandle.wait raises `from`).
+    cause = StallError("tensor 'b3' stalled beyond the threshold")
+    exc = HorovodInternalError(str(cause))
+    exc.__cause__ = cause
+    with pytest.raises(WorkerDrained) as ei:
+        state_mod._stall_abort(st, exc)
+    assert ei.value.code == worker_mod.DRAIN_EXIT_CODE
+    assert worker_mod.notification_manager().drain_requested()
+    assert st.batch == 2  # restored to the last commit before aborting
+
+
+def test_stall_abort_detection_covers_both_planes():
+    # In-process engine: StallError chained as __cause__.  Native
+    # core: Aborted status text only (operations.cc).  Anything else
+    # stays on the restore-and-rejoin path.
+    from horovod_tpu.elastic.state import _is_stall_abort
+    from horovod_tpu.ops.engine import HorovodInternalError
+    from horovod_tpu.utils.stall_inspector import StallError
+    chained = HorovodInternalError("collective 'b3' failed")
+    chained.__cause__ = StallError("stalled")
+    assert _is_stall_abort(chained)
+    assert _is_stall_abort(
+        HorovodInternalError("stall shutdown threshold exceeded"))
+    assert not _is_stall_abort(HorovodInternalError("peer closed"))
+
+
 class _FakeMetadata:
     """GCE-style metadata server: worker-network-endpoints +
     unhealthy-workers, both mutable by the test."""
@@ -865,6 +1163,155 @@ train(state)
             proc.stdout + proc.stderr
     assert "dropped (faultline driver.spawn.attempt)" in proc.stderr, \
         proc.stderr
+
+
+DRAIN_WORKER = """
+import hashlib, os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(batch=0, params=np.zeros(8, np.float32))
+
+@elastic.run
+def train(state):
+    print("SYNCED rank=%d batch=%d commit=%d root=%s"
+          % (hvd.rank(), state.batch, state._commit_id,
+             state._sync_root), flush=True)
+    while state.batch < 8:
+        out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.params = state.params + np.asarray(out)
+        state.batch += 1
+        state.commit()
+    digest = hashlib.md5(np.asarray(state.params,
+                                    np.float32).tobytes()).hexdigest()
+    print("DONE rank=%d size=%d batch=%d params=%s"
+          % (hvd.rank(), hvd.size(), state.batch, digest), flush=True)
+
+train(state)
+"""
+
+
+def test_elastic_preemption_drain_survivor_elected_root(tmp_path):
+    """ISSUE 5 acceptance: injected preemption (worker.preempt.sigterm)
+    on the rank-0 host mid-epoch → the worker finishes the in-flight
+    step, commits, sends an acked drain notice, and exits with the
+    drain code; the driver treats it as a PLANNED removal (no
+    blacklist, no failure count); the respawned blank worker must NOT
+    win the root election — the survivor (max commit id) does, and the
+    restored params are bitwise-identical on all ranks."""
+    script = tmp_path / "train.py"
+    script.write_text(DRAIN_WORKER)
+    env = _env()
+    # Fires on the 3rd commit of the epoch-1 worker on 127.0.0.1 (the
+    # rank-0 host): mid-epoch, after real progress exists.  The
+    # respawned worker runs in epoch >= 2, so the injection never
+    # re-fires and the world proves recovery.
+    env["HVD_TPU_FAULT"] = \
+        "worker.preempt.sigterm:drop@host=127.0.0.1@epoch=1@after=2@times=1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "1",
+         "--max-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Drain sequence: worker announced it, driver acked and treated
+    # the exit as planned ...
+    assert "draining at commit 3" in proc.stderr, proc.stderr
+    assert "planned removal" in proc.stderr, proc.stderr
+    # ... with NO blacklist entry (the whole point: preemption is not
+    # a host failure).
+    assert "blacklisting host" not in proc.stderr, proc.stderr
+    # The respawned blank worker (rank 0 again: first host in target
+    # order) adopted the SURVIVOR's progress via the elected root —
+    # commit id 3, root rank 1, not a zero-filled restart.
+    assert "SYNCED rank=0 batch=3 commit=3 root=1" in proc.stdout, \
+        proc.stdout + proc.stderr
+    # Both ranks finished the epoch with bitwise-identical params.
+    digests = {line.split("params=")[1].strip()
+               for line in proc.stdout.splitlines()
+               if "DONE rank=" in line and "batch=8" in line}
+    done = [line for line in proc.stdout.splitlines()
+            if "DONE rank=" in line]
+    assert len(done) == 2 and len(digests) == 1, \
+        proc.stdout + proc.stderr
+
+
+SPILL_WORKER = """
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(batch=0, total=0.0)
+
+@elastic.run
+def train(state):
+    print("ENTER rank=%d batch=%d commit=%d"
+          % (hvd.rank(), state.batch, state._commit_id), flush=True)
+    while state.batch < 6:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.total += float(np.asarray(out)[0])
+        state.batch += 1
+        state.commit()
+    print("DONE rank=%d size=%d batch=%d total=%.1f"
+          % (hvd.rank(), hvd.size(), state.batch, state.total),
+          flush=True)
+
+train(state)
+"""
+
+
+def test_elastic_full_restart_restores_from_spill(tmp_path):
+    """ISSUE 5 acceptance: EVERY worker dies at once (whole-job
+    preemption) with durable spills on; a fresh run over the same
+    spill dir restores from the newest VALID blob — the newest blob
+    itself was torn by injection (elastic.state.spill), so restore
+    falls back to the previous commit.  Run 1: commits 1-5 spill (#5
+    torn), all workers die at commit 6.  Run 2: resumes at commit 4."""
+    spill_dir = tmp_path / "spills"
+    script = tmp_path / "train.py"
+    script.write_text(SPILL_WORKER)
+    env = _env()
+    env["HOROVOD_STATE_SPILL_DIR"] = str(spill_dir)
+    env1 = dict(env)
+    env1["HVD_TPU_FAULT"] = ("elastic.state.spill:drop@after=4@times=1,"
+                             "elastic.state.commit:die:21@after=5")
+    env1["HOROVOD_ELASTIC_EXIT_GRACE"] = "5"
+    proc1 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         "--elastic-timeout", "6",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env1, cwd=REPO)
+    # Multi-host loss: the whole run fails (both hosts die at commit 6).
+    assert proc1.returncode != 0, proc1.stdout + proc1.stderr
+    from horovod_tpu.elastic import spill
+    on_disk = spill.scan(str(spill_dir))
+    assert on_disk and max(c for c, _ in on_disk) == 5, on_disk
+    # Run 2: fresh job, same spill dir, no faults.  Commit 5's blob is
+    # torn on disk -> restore falls back to commit 4 and finishes.
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env, cwd=REPO)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "skipping corrupt spill" in proc2.stderr, proc2.stderr
+    for r in range(2):
+        assert "ENTER rank=%d batch=4 commit=4" % r in proc2.stdout, \
+            proc2.stdout + proc2.stderr
+        # total: 4 restored batches x 2.0 + 2 fresh batches x 2.0
+        assert "DONE rank=%d size=2 batch=6 total=12.0" % r \
+            in proc2.stdout, proc2.stdout + proc2.stderr
 
 
 def test_elastic_unformable_world_worker_deadline(tmp_path):

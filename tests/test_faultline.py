@@ -76,6 +76,20 @@ def test_drop_returns_true(monkeypatch):
     assert faultline.site(DROP_SITE) is True
 
 
+def test_preemption_and_durability_sites_parse():
+    # ISSUE 5 sites: all three are drop-capable (synthetic preemption
+    # arrival / lost drain ack / torn spill write) and compose with
+    # the targeting + counting keys the drain e2e tests arm.
+    specs = faultline.parse(
+        "worker.preempt.sigterm:drop@host=h@epoch=1@after=2@times=1,"
+        "driver.drain.ack:drop,elastic.state.spill:drop@times=1")
+    pre = specs["worker.preempt.sigterm"]
+    assert pre.action == "drop" and pre.after == 2 and pre.times == 1
+    assert pre.conds == (("host", "h"), ("epoch", "1"))
+    assert specs["driver.drain.ack"].action == "drop"
+    assert specs["elastic.state.spill"].times == 1
+
+
 def test_delay_sleeps(monkeypatch):
     monkeypatch.setenv("HVD_TPU_FAULT", "%s:delay:0.2" % SITE)
     t0 = time.monotonic()
