@@ -181,6 +181,15 @@ def lever_attribution(jax, jnp, on_accel, peak):
                     sample["bwd_tflops"] * 1e12 / peak, 4)
     except Exception as exc:  # noqa: BLE001 - attribution is optional
         print("lever attribution degraded: %s" % exc, file=sys.stderr)
+    try:
+        # Live telemetry snapshot (the "autotune from live telemetry"
+        # seam, ROADMAP item 1): engine cycle/fusion/cache series as
+        # the benched process actually ran them.  Additive levers key —
+        # the headline JSON schema is unchanged.
+        from horovod_tpu.common import metrics as _metrics
+        lev["metrics"] = _metrics.metrics_snapshot()
+    except Exception as exc:  # noqa: BLE001 - attribution is optional
+        print("metrics snapshot degraded: %s" % exc, file=sys.stderr)
     return lev
 
 

@@ -273,6 +273,19 @@ class LintConfig:
     faultline_module: str = "horovod_tpu/common/faultline.py"
     faultline_roots: Sequence[str] = ("horovod_tpu",)
     faultline_cc_roots: Sequence[str] = ("horovod_tpu/core/src",)
+    # metric-names rule: the canonical series registry and the trees
+    # whose metrics.counter/gauge/histogram call sites it validates.
+    metrics_module: str = "horovod_tpu/common/metrics.py"
+    metrics_roots: Sequence[str] = ("horovod_tpu",)
+    # env-drift rule: bootstrap modules whose direct env reads (envutil
+    # helpers / os.environ.get) must be documented like config.py's —
+    # the metrics/spill/rpc knobs are consumed before hvd.init().
+    bootstrap_env_files: Sequence[str] = (
+        "horovod_tpu/common/metrics.py",
+        "horovod_tpu/utils/timeline.py",
+        "horovod_tpu/elastic/spill.py",
+        "horovod_tpu/runner/http_client.py",
+    )
 
     def resolve(self, rel: str) -> str:
         return os.path.join(self.repo_root, rel)
@@ -327,6 +340,10 @@ def run_paths(paths: Sequence[str],
     if in_scope(cfg.faultline_module) \
             or any(in_scope(r) for r in cfg.faultline_roots):
         findings += faultline_sites.check(cfg)
+    from .rules import metric_names
+    if in_scope(cfg.metrics_module) \
+            or any(in_scope(r) for r in cfg.metrics_roots):
+        findings += metric_names.check(cfg)
     for src, errs in _CACHE.values():
         findings += errs
         if src is not None:

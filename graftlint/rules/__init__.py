@@ -5,11 +5,12 @@ pairs — ``python -m graftlint --list-rules`` renders them — plus its
 entry point (``check_files`` / ``check_roots`` / ``check``).
 """
 
-from . import env_drift, faultline_sites, host_bounce, ownership  # noqa: F401
+from . import (env_drift, faultline_sites, host_bounce,  # noqa: F401
+               metric_names, ownership)
 
 ALL_CHECKS = (
     ownership.CHECKS + env_drift.CHECKS + host_bounce.CHECKS
-    + faultline_sites.CHECKS + (
+    + faultline_sites.CHECKS + metric_names.CHECKS + (
         ("bad-suppression",
          "suppression missing disable=/issue= citation or reason"),
         ("unused-suppression",

@@ -43,6 +43,10 @@ CHECKS = (
 )
 
 _ENV_HELPERS = {"_env", "_env_int", "_env_float", "_env_bool", "opt_int"}
+# Bootstrap modules (LintConfig.bootstrap_env_files) read knobs through
+# the shared envutil helpers before hvd.init(); those reads carry FULL
+# key names and must be documented exactly like config.py's.
+_BOOTSTRAP_HELPERS = {"env_int", "env_float", "env_bool", "env_str"}
 _PREFIXES = ("HOROVOD_", "HVD_TPU_")
 
 
@@ -84,6 +88,32 @@ def config_keys(path: str) -> List[Tuple[str, int]]:
             key = _const_str(node.args[0])
             if key is not None:
                 out.append((key, node.lineno))
+    return out
+
+
+def bootstrap_keys(path: str) -> List[Tuple[str, int]]:
+    """(full-key, line) for every envutil helper read and direct
+    ``os.environ`` get of a ``HOROVOD_*``/``HVD_TPU_*`` key in one
+    bootstrap module."""
+    src, _ = get_source(path)
+    if src is None:
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        key = None
+        if isinstance(node, ast.Call) and node.args:
+            func = node.func
+            helper = (isinstance(func, ast.Name)
+                      and func.id in _BOOTSTRAP_HELPERS) or \
+                     (isinstance(func, ast.Attribute)
+                      and func.attr in _BOOTSTRAP_HELPERS)
+            environ_get = (isinstance(func, ast.Attribute)
+                           and func.attr in ("get", "setdefault")
+                           and _is_environ(func.value))
+            if helper or environ_get:
+                key = _const_str(node.args[0])
+        if key is not None and key.startswith(_PREFIXES):
+            out.append((key, node.lineno))
     return out
 
 
@@ -172,6 +202,31 @@ def check(cfg: LintConfig) -> List[Finding]:
                     "HOROVOD_%s (alias HVD_TPU_%s) is read here but "
                     "documented nowhere in %s" % (
                         key, key, list(cfg.doc_files))))
+
+    # Bootstrap modules read FULL key names (HOROVOD_METRICS_DIR, the
+    # spill/RPC knobs) before hvd.init(); a knob born undocumented in
+    # one of them is exactly the drift this rule exists for.
+    for rel in getattr(cfg, "bootstrap_env_files", ()):
+        path = cfg.resolve(rel)
+        if not os.path.isfile(path):
+            continue  # fixture configs legitimately aim elsewhere
+        fsrc, _ = get_source(path)
+        if fsrc is None:
+            continue
+        fsrc.checked.add("env-undocumented")
+        seen_boot: set = set()
+        for key, line in bootstrap_keys(path):
+            if key in seen_boot:
+                continue
+            seen_boot.add(key)
+            if re.search(r"\b%s\b" % re.escape(key), docs):
+                continue
+            if fsrc.suppressed(line, "env-undocumented"):
+                continue
+            findings.append(Finding(
+                path, line, "env-undocumented",
+                "%s is read here but documented nowhere in %s"
+                % (key, list(cfg.doc_files))))
 
     by_key: Dict[str, List[Tuple[str, str, int]]] = {}
     for key, default, path, line in direct_reads(

@@ -1,0 +1,187 @@
+"""Metric series-name registry checker.
+
+``common/metrics.py`` holds the ONE canonical table of metric series
+(``NAMES``: name -> (kind, help)); series are touched as
+``metrics.counter("name", ...)`` / ``metrics.gauge`` /
+``metrics.histogram`` across the tree (and as bare ``counter(...)``
+calls inside the metrics module itself).  A typo'd name silently forks
+a series — the aggregation, the docs table and every dashboard keyed
+on the real name miss it — so four drifts are mechanically findings:
+
+* **`metric-unregistered`** — a call site naming a series absent from
+  ``NAMES`` (the registry also raises at runtime, but only when the
+  seam is reached), or passing a non-literal name (a dynamic series
+  name cannot be audited and is forbidden by construction).
+* **`metric-kind-mismatch`** — a call using a name as a different kind
+  than its declaration (``counter("x")`` where ``NAMES`` says gauge).
+* **`metric-duplicate-decl`** — one name keyed twice in the ``NAMES``
+  literal (Python silently keeps the last value; the table must
+  declare each series exactly once).
+* **`metric-orphan`** — a declared series no call site ever touches:
+  dead registry weight documenting telemetry the tree cannot emit.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, LintConfig, get_source, iter_py_files
+
+CHECKS = (
+    ("metric-unregistered",
+     "metric name used but absent from metrics.NAMES (or non-literal)"),
+    ("metric-kind-mismatch",
+     "metric used as a different kind than its NAMES declaration"),
+    ("metric-duplicate-decl",
+     "metric name declared more than once in the NAMES table"),
+    ("metric-orphan",
+     "metric declared in NAMES but used at no call site"),
+)
+
+_KIND_FUNCS = ("counter", "gauge", "histogram")
+
+
+def _names_literal(tree) -> List[ast.Dict]:
+    out = []
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if any(isinstance(t, ast.Name) and t.id == "NAMES"
+               for t in targets) and isinstance(node.value, ast.Dict):
+            out.append(node.value)
+    return out
+
+
+def registry_names(path: str) -> Tuple[Dict[str, Tuple[str, int]],
+                                       List[Finding]]:
+    """name -> (kind, line) from the NAMES literal, plus duplicate-key
+    findings (dict literals silently last-win on duplicates)."""
+    names: Dict[str, Tuple[str, int]] = {}
+    findings: List[Finding] = []
+    src, _ = get_source(path)
+    if src is None:
+        return names, findings
+    src.checked.add("metric-duplicate-decl")
+    for d in _names_literal(src.tree):
+        for key, value in zip(d.keys, d.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)):
+                continue
+            name = key.value
+            kind = ""
+            if isinstance(value, ast.Tuple) and value.elts and \
+                    isinstance(value.elts[0], ast.Constant):
+                kind = str(value.elts[0].value)
+            if name in names:
+                if not src.suppressed(key.lineno,
+                                      "metric-duplicate-decl"):
+                    findings.append(Finding(
+                        path, key.lineno, "metric-duplicate-decl",
+                        "metric %r already declared at line %d; one "
+                        "declaration per series" % (name,
+                                                    names[name][1])))
+                continue
+            names[name] = (kind, key.lineno)
+    return names, findings
+
+
+def _plants(path: str, is_registry_module: bool):
+    """(kind, name-or-None, line) for every metric call site in one
+    file: ``metrics.counter/gauge/histogram(...)`` anywhere, plus bare
+    ``counter/gauge/histogram(...)`` inside the registry module itself
+    (its own internal mirrors, e.g. events_total)."""
+    src, _ = get_source(path)
+    if src is None:
+        return [], None
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        kind = None
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _KIND_FUNCS and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id in ("metrics", "_metrics"):
+            kind = func.attr
+        elif is_registry_module and isinstance(func, ast.Name) and \
+                func.id in _KIND_FUNCS:
+            kind = func.id
+        elif is_registry_module and isinstance(func, ast.Attribute) \
+                and func.attr in _KIND_FUNCS and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self":
+            # Registry methods calling each other (the cardinality
+            # guard's self._get is handled by the _get name check
+            # below; self.counter is the public path).
+            kind = func.attr
+        if kind is None:
+            continue
+        name = None
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        out.append((kind, name, node.lineno))
+    return out, src
+
+
+def check(cfg: LintConfig) -> List[Finding]:
+    registry_path = cfg.resolve(cfg.metrics_module)
+    if not os.path.isfile(registry_path):
+        return []  # fixture configs legitimately aim elsewhere
+    names, findings = registry_names(registry_path)
+    used: Set[str] = set()
+    for root in cfg.metrics_roots:
+        for path in iter_py_files(cfg.resolve(root)):
+            is_registry = path == registry_path
+            plants, src = _plants(path, is_registry)
+            if src is None:
+                continue
+            src.checked.update(("metric-unregistered",
+                                "metric-kind-mismatch"))
+            for kind, name, line in plants:
+                if name is None:
+                    if not src.suppressed(line, "metric-unregistered"):
+                        findings.append(Finding(
+                            path, line, "metric-unregistered",
+                            "metric name is not a string literal; "
+                            "dynamic series names cannot be audited "
+                            "against metrics.NAMES"))
+                    continue
+                decl = names.get(name)
+                if decl is None:
+                    if not src.suppressed(line, "metric-unregistered"):
+                        findings.append(Finding(
+                            path, line, "metric-unregistered",
+                            "metric %r is not declared in "
+                            "metrics.NAMES" % name))
+                    continue
+                used.add(name)
+                if decl[0] != kind and not src.suppressed(
+                        line, "metric-kind-mismatch"):
+                    findings.append(Finding(
+                        path, line, "metric-kind-mismatch",
+                        "metric %r is declared as a %s but used as a "
+                        "%s here" % (name, decl[0], kind)))
+    reg_src, _ = get_source(registry_path)
+    if reg_src is not None:
+        reg_src.checked.add("metric-orphan")
+    for name, (_kind, line) in sorted(names.items()):
+        if name in used:
+            continue
+        if reg_src is not None and reg_src.suppressed(
+                line, "metric-orphan"):
+            continue
+        findings.append(Finding(
+            registry_path, line, "metric-orphan",
+            "metric %r is declared in NAMES but no call site touches "
+            "it; delete the declaration or instrument the seam"
+            % name))
+    return findings

@@ -46,6 +46,9 @@ for _mod, _names in (
       "grouped_reducescatter_async", "barrier", "join", "synchronize",
       "poll")),
     (".ops.engine", ("CollectiveHandle", "HorovodInternalError")),
+    # Metrics plane: the live in-process snapshot (works without init —
+    # the registry is process-local and always on).
+    (".common.metrics", ("metrics_snapshot",)),
 ):
     for _n in _names:
         _EXPORTS[_n] = (_mod, _n)

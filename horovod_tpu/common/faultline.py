@@ -61,6 +61,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from . import metrics
+
 LOG = logging.getLogger("horovod_tpu.faultline")
 
 # The canonical site table: every injection point in the tree (Python
@@ -320,6 +322,14 @@ def site(name: str) -> bool:
             return False
     LOG.warning("faultline: site %s firing action=%s arg=%s",
                 name, spec.action, spec.arg)
+    # Counter + journal BEFORE the action executes: a ``die`` fire must
+    # still be visible to the observability plane (the journal line is
+    # written ahead of the os._exit), so injection certification can
+    # assert the fire itself, not just its downstream symptom.
+    metrics.counter("fault_injections_total", site=name,
+                    action=spec.action).inc()
+    metrics.event("fault_fire", site=name, action=spec.action,
+                  arg=spec.arg)
     if spec.action == "delay":
         time.sleep(spec.arg)
         return False

@@ -1,0 +1,541 @@
+"""Unified metrics & structured-events plane: the process-local registry.
+
+Rounds 6-10 built lint, fault-injection, self-healing and preemption
+planes, but the only quantitative windows into a running world were the
+Chrome-trace timeline and ad-hoc log lines.  This module is the
+always-on substrate those planes (and the GP autotuner, and a fleet
+operator's Prometheus) can actually consume:
+
+* **Registry** — dependency-free, thread-safe, process-local counters,
+  gauges and log2-bucket histograms, optionally labeled.  Every series
+  name is declared exactly once in :data:`NAMES` (the one canonical
+  table, enforced at runtime here and statically by the graftlint
+  ``metric-*`` rules) so a typo can never fork a series.
+* **Exposition** — ``render_prometheus()`` emits Prometheus text
+  (served unauthenticated at ``GET /metrics`` on the rendezvous KV
+  server: it is read-only operational telemetry, carries no payload
+  data, and scrapers cannot compute the launcher HMAC);
+  ``snapshot()`` returns the same model as a plain dict
+  (``hvd.metrics_snapshot()``); ``render_merged()`` fuses the driver's
+  and every worker's snapshots into one scrape with a ``rank`` label
+  per source — the elastic driver's ``/metrics`` is fleet-wide.
+* **Event journal** — ``event(kind, ...)`` appends one JSON line per
+  structured event (drain, election, stall, fault fire, spill
+  corruption) to ``HOROVOD_METRICS_DIR``: atomic ``O_APPEND`` writes,
+  rank-stamped, per-process monotonic ``seq``, mirrored into the
+  ``events_total`` counter.  Unset dir = counters only, no IO.
+
+Label cardinality is bounded per family by
+``HOROVOD_METRICS_MAX_SERIES`` (default 256): past the cap new label
+combinations collapse into one ``overflow="true"`` series and bump
+``metrics_dropped_series_total`` — a runaway label (a tensor name, a
+group id) degrades resolution, never memory.  Group-id correlation
+therefore rides the *timeline* (``args.group`` on EXEC events) and the
+*journal*, while metric labels stay low-cardinality (op, size class,
+path, site).
+
+Nothing here may raise into an instrumented seam: journal IO failures
+degrade to a warning, and the registry's own strictness (unknown or
+kind-mismatched names raise) is aimed at authors, caught at first use
+in any test that touches the seam.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .envutil import env_int
+
+LOG = logging.getLogger("horovod_tpu.metrics")
+
+# The canonical series table: every metric name in the tree, declared
+# once with its kind and help string.  The graftlint ``metric-*`` rules
+# cross-check every ``metrics.counter/gauge/histogram`` call site
+# against this table (unregistered, kind-mismatched, duplicate and
+# orphaned names are findings); docs/observability.md carries the same
+# table for operators.
+NAMES: Dict[str, Tuple[str, str]] = {
+    # -- engine plane (the in-process CollectiveEngine and the
+    #    multihost executor both report these; one process only ever
+    #    runs one of them) --
+    "engine_cycles_total": (
+        "counter", "execution cycles (negotiated groups in multihost "
+                   "mode) that dispatched at least one collective"),
+    "engine_cycle_seconds": (
+        "histogram", "wall time of one execution cycle"),
+    "engine_queue_depth": (
+        "gauge", "entries drained at the start of the latest cycle "
+                 "(multihost: payloads parked awaiting negotiation)"),
+    "engine_bytes_submitted_total": (
+        "counter", "payload bytes enqueued into the engine"),
+    "engine_bytes_fused_total": (
+        "counter", "payload bytes that rode a multi-tensor fused "
+                   "execution (vs dispatched alone)"),
+    "engine_tensors_fused_total": (
+        "counter", "tensors that rode multi-tensor fused executions"),
+    "exec_cache_hits": (
+        "gauge", "compiled-executable cache hits since process start"),
+    "exec_cache_misses": (
+        "gauge", "compiled-executable cache misses (compiles) since "
+                 "process start"),
+    "engine_last_group_id": (
+        "gauge", "monotonic id of the newest dispatched collective "
+                 "group; the same id tags the group's timeline EXEC "
+                 "events (args.group) for cross-plane correlation"),
+    # -- multihost payload plane --
+    "mh_collective_seconds": (
+        "histogram", "dispatch-to-completion latency of one negotiated "
+                     "group, labeled op + pow2 size_class bytes"),
+    "mh_bus_bytes_total": (
+        "counter", "payload bytes submitted to the cross-host "
+                   "collective, labeled op + path (hier|flat)"),
+    "mh_collective_path_total": (
+        "counter", "collective executions by op + path (hier|flat)"),
+    # -- runner control plane (r8 retry/backoff layer) --
+    "rpc_attempts_total": (
+        "counter", "control-plane RPC attempts (including retries)"),
+    "rpc_transient_failures_total": (
+        "counter", "transient RPC failures absorbed by retry/backoff"),
+    "rpc_giveups_total": (
+        "counter", "retried RPCs that exhausted their retry budget or "
+                   "deadline and escalated"),
+    # -- elastic plane: driver side --
+    "elastic_epoch": (
+        "gauge", "current published world epoch (driver)"),
+    "elastic_spawn_total": (
+        "counter", "worker processes spawned (driver)"),
+    "elastic_drain_total": (
+        "counter", "workers that left via the drain protocol (planned "
+                   "removal: preemption, stall abort)"),
+    "elastic_worker_failures_total": (
+        "counter", "worker processes reaped with a failure exit"),
+    "elastic_blacklist_total": (
+        "counter", "hosts blacklisted after crossing the failure "
+                   "threshold"),
+    # -- elastic plane: worker side --
+    "elastic_elections_total": (
+        "counter", "state-root elections this worker participated in"),
+    "spill_commits_total": (
+        "counter", "durable commit blobs spilled to "
+                   "HOROVOD_STATE_SPILL_DIR"),
+    "spill_commit_seconds": (
+        "histogram", "wall time of one durable commit spill "
+                     "(encode + write + fsync + rename + prune)"),
+    "spill_crc_failures_total": (
+        "counter", "spill/replica blobs rejected by CRC/length "
+                   "validation (torn writes, bit flips)"),
+    # -- cross-cutting --
+    "stall_detected_total": (
+        "counter", "stall-inspector warnings (a collective outlived "
+                   "the warning threshold)"),
+    "fault_injections_total": (
+        "counter", "faultline site fires, labeled site + action "
+                   "(injection certification reads this)"),
+    "events_total": (
+        "counter", "structured journal events emitted, labeled kind "
+                   "(bumped even when no journal dir is set)"),
+    "metrics_dropped_series_total": (
+        "counter", "label combinations collapsed into the overflow "
+                   "series by the cardinality guard"),
+}
+
+_KINDS = ("counter", "gauge", "histogram")
+
+# Histogram buckets are powers of two over this exponent range:
+# 2^-20 s (~1 us) .. 2^6 s (64 s) covers RPC round-trips through the
+# slowest cold-compile dispatch; observations outside clamp to the
+# edge buckets (+Inf catches the rest at render time).
+_HIST_EXP_MIN = -20
+_HIST_EXP_MAX = 6
+
+_OVERFLOW_LABELS = (("overflow", "true"),)
+
+
+def max_series() -> int:
+    """Per-family label-cardinality cap (``HOROVOD_METRICS_MAX_SERIES``,
+    default 256, floor 1).  Sized for the largest legitimate family:
+    the multihost (op, size_class) space is 5 ops x ~40 pow2 classes =
+    ~200 series; anything past the cap is a runaway label."""
+    return env_int("HOROVOD_METRICS_MAX_SERIES", 256, minimum=1)
+
+
+class _Series:
+    __slots__ = ("labels", "value", "buckets", "sum", "count")
+
+    def __init__(self, labels: Tuple[Tuple[str, str], ...]):
+        self.labels = labels
+        self.value = 0.0
+        self.buckets: Dict[int, int] = {}
+        self.sum = 0.0
+        self.count = 0
+
+
+class _Handle:
+    """One (family, label-set) series; mutation goes through the
+    registry lock so concurrent increments never lose updates."""
+
+    __slots__ = ("_registry", "_series", "_kind")
+
+    def __init__(self, registry: "Registry", series: _Series, kind: str):
+        self._registry = registry
+        self._series = series
+        self._kind = kind
+
+    def inc(self, n: float = 1.0):
+        if self._kind != "counter":
+            raise ValueError("inc() on a %s" % self._kind)
+        with self._registry._lock:
+            self._series.value += n
+
+    def set(self, v: float):
+        if self._kind != "gauge":
+            raise ValueError("set() on a %s" % self._kind)
+        with self._registry._lock:
+            self._series.value = float(v)
+
+    def observe(self, v: float):
+        if self._kind != "histogram":
+            raise ValueError("observe() on a %s" % self._kind)
+        v = float(v)
+        e: Optional[int] = _HIST_EXP_MIN
+        if v > 2.0 ** _HIST_EXP_MAX:
+            e = None  # beyond the top finite bucket: +Inf only
+        else:
+            while e < _HIST_EXP_MAX and v > 2.0 ** e:
+                e += 1
+        with self._registry._lock:
+            s = self._series
+            if e is not None:
+                s.buckets[e] = s.buckets.get(e, 0) + 1
+            s.sum += v
+            s.count += 1
+
+    @property
+    def value(self) -> float:
+        with self._registry._lock:
+            return self._series.value
+
+
+class _Family:
+    __slots__ = ("name", "kind", "help", "series", "overflow_warned")
+
+    def __init__(self, name: str, kind: str, help_text: str):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.series: Dict[Tuple[Tuple[str, str], ...], _Series] = {}
+        self.overflow_warned = False
+
+
+class Registry:
+    """Thread-safe process-local metric registry over :data:`NAMES`."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get(self, kind: str, name: str,
+             labels: Dict[str, Any]) -> _Handle:
+        decl = NAMES.get(name)
+        if decl is None:
+            raise KeyError(
+                "metric %r is not declared in metrics.NAMES; register "
+                "it (kind + help) before instrumenting — the graftlint "
+                "metric-unregistered rule enforces this statically"
+                % name)
+        if decl[0] != kind:
+            raise ValueError(
+                "metric %r is declared as a %s but used as a %s"
+                % (name, decl[0], kind))
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, decl[1])
+                self._families[name] = fam
+            series = fam.series.get(key)
+            if series is None:
+                if key != _OVERFLOW_LABELS and \
+                        len(fam.series) >= max_series():
+                    # Cardinality guard: collapse into one overflow
+                    # series instead of growing without bound.
+                    if not fam.overflow_warned:
+                        fam.overflow_warned = True
+                        LOG.warning(
+                            "metric %r reached %d label combinations; "
+                            "new ones collapse into overflow=\"true\" "
+                            "(raise HOROVOD_METRICS_MAX_SERIES if this "
+                            "cardinality is intended)",
+                            name, max_series())
+                    self.counter("metrics_dropped_series_total").inc()
+                    key = _OVERFLOW_LABELS
+                    series = fam.series.get(key)
+                if series is None:
+                    series = _Series(key)
+                    fam.series[key] = series
+            return _Handle(self, series, kind)
+
+    def counter(self, name: str, **labels) -> _Handle:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> _Handle:
+        return self._get("gauge", name, labels)
+
+    def histogram(self, name: str, **labels) -> _Handle:
+        return self._get("histogram", name, labels)
+
+    # -- exposition --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The full model as plain dicts (pickle/json-safe):
+        ``{name: {kind, help, series: [{labels, value} |
+        {labels, buckets, sum, count}]}}``."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, fam in self._families.items():
+                rows = []
+                for series in fam.series.values():
+                    row: Dict[str, Any] = {
+                        "labels": dict(series.labels)}
+                    if fam.kind == "histogram":
+                        row["buckets"] = {
+                            str(e): n
+                            for e, n in sorted(series.buckets.items())}
+                        row["sum"] = series.sum
+                        row["count"] = series.count
+                    else:
+                        row["value"] = series.value
+                    rows.append(row)
+                out[name] = {"kind": fam.kind, "help": fam.help,
+                             "series": rows}
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._families.clear()
+
+
+_registry = Registry()
+
+
+def counter(name: str, **labels) -> _Handle:
+    return _registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> _Handle:
+    return _registry.gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> _Handle:
+    return _registry.histogram(name, **labels)
+
+
+def snapshot() -> Dict[str, Any]:
+    return _registry.snapshot()
+
+
+def metrics_snapshot() -> Dict[str, Any]:
+    """The in-process metrics model as a dict (``hvd.metrics_snapshot``).
+    Works before/without ``hvd.init()`` — the registry is process-local
+    and always on."""
+    return snapshot()
+
+
+# -- Prometheus text rendering --------------------------------------------
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _label_text(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join('%s="%s"' % (k, _escape(v))
+                     for k, v in sorted(labels.items()))
+    return "{%s}" % inner
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _render_family(lines: List[str], name: str, fam: Dict[str, Any],
+                   extra: Optional[Dict[str, str]] = None):
+    for row in fam["series"]:
+        labels = dict(row.get("labels") or {})
+        if extra:
+            labels.update(extra)
+        if fam["kind"] == "histogram":
+            cum = 0
+            for e, n in sorted((int(k), v) for k, v in
+                               (row.get("buckets") or {}).items()):
+                cum += n
+                le = dict(labels, le=_fmt(2.0 ** e))
+                lines.append("%s_bucket%s %d"
+                             % (name, _label_text(le), cum))
+            inf = dict(labels, le="+Inf")
+            lines.append("%s_bucket%s %d"
+                         % (name, _label_text(inf), row.get("count", 0)))
+            lines.append("%s_sum%s %s"
+                         % (name, _label_text(labels),
+                            _fmt(row.get("sum", 0.0))))
+            lines.append("%s_count%s %d"
+                         % (name, _label_text(labels),
+                            row.get("count", 0)))
+        else:
+            lines.append("%s%s %s" % (name, _label_text(labels),
+                                      _fmt(row.get("value", 0.0))))
+
+
+def render_merged(models: List[Tuple[str, Dict[str, Any]]]) -> str:
+    """One Prometheus-text scrape from several per-process snapshot
+    models; each model's series gain a ``rank=<label>`` so the merged
+    exposition stays unique per series (HELP/TYPE emitted once per
+    family, as the format requires)."""
+    lines: List[str] = []
+    names: List[str] = []
+    for _, model in models:
+        for name in model:
+            if name not in names:
+                names.append(name)
+    for name in sorted(names):
+        first = next(m[name] for _, m in models if name in m)
+        lines.append("# HELP %s %s" % (name, _escape(first["help"])))
+        lines.append("# TYPE %s %s" % (name, first["kind"]))
+        for rank_label, model in models:
+            fam = model.get(name)
+            if fam is None or fam["kind"] != first["kind"]:
+                continue
+            _render_family(lines, name, fam, {"rank": str(rank_label)})
+    return "\n".join(lines) + "\n"
+
+
+def render_prometheus() -> str:
+    """This process's registry as Prometheus exposition text."""
+    lines: List[str] = []
+    model = snapshot()
+    for name in sorted(model):
+        fam = model[name]
+        lines.append("# HELP %s %s" % (name, _escape(fam["help"])))
+        lines.append("# TYPE %s %s" % (name, fam["kind"]))
+        _render_family(lines, name, fam)
+    return "\n".join(lines) + "\n"
+
+
+# -- structured-event journal ----------------------------------------------
+
+# RLock, not Lock: event() runs inside the SIGTERM drain handler
+# (worker.request_drain), which executes on the main thread and may
+# interrupt a frame already holding this lock — the exact
+# self-deadlock r10 hardened the drain state against.  Re-entrant
+# journal writes are safe: each record is one atomic O_APPEND write.
+_journal_lock = threading.RLock()
+_journal_seq = 0
+_journal_fds: Dict[str, int] = {}
+_journal_tag: Optional[str] = None
+_journal_warned = False
+
+
+def journal_dir() -> Optional[str]:
+    """The JSONL event-journal directory (``HOROVOD_METRICS_DIR``);
+    None disables journaling (counters still count)."""
+    return os.environ.get("HOROVOD_METRICS_DIR") or None
+
+
+def set_journal_tag(tag: str):
+    """Override the writer tag in the journal filename (the elastic
+    driver writes ``events-driver.jsonl``; workers default to their
+    rank)."""
+    global _journal_tag
+    _journal_tag = tag
+
+
+def _default_tag() -> str:
+    rank = os.environ.get("HOROVOD_RANK")
+    return "r%s" % rank if rank is not None else "pid%d" % os.getpid()
+
+
+def event(kind: str, **fields):
+    """Record one structured event: bumps ``events_total{kind=}`` and,
+    when ``HOROVOD_METRICS_DIR`` is set, appends one rank-stamped JSON
+    line (atomic ``O_APPEND`` write, per-process monotonic ``seq``) to
+    this process's journal file.  Never raises into the caller."""
+    global _journal_seq, _journal_warned
+    counter("events_total", kind=kind).inc()
+    d = journal_dir()
+    if d is None:
+        return
+    tag = _journal_tag or _default_tag()
+    rank = os.environ.get("HOROVOD_RANK")
+    try:
+        rank = int(rank) if rank is not None else None
+    except ValueError:
+        rank = None  # malformed env must degrade, never raise here
+    with _journal_lock:
+        _journal_seq += 1
+        record = {"ts": time.time(), "seq": _journal_seq,
+                  "rank": rank, "kind": kind}
+        for k, v in fields.items():
+            record[k] = v
+        try:
+            path = os.path.join(d, "events-%s.jsonl" % tag)
+            fd = _journal_fds.get(path)
+            if fd is None:
+                os.makedirs(d, exist_ok=True)
+                fd = os.open(path,
+                             os.O_CREAT | os.O_WRONLY | os.O_APPEND,
+                             0o644)
+                _journal_fds[path] = fd
+            line = json.dumps(record, default=str) + "\n"
+            os.write(fd, line.encode())
+        except OSError as exc:
+            if not _journal_warned:
+                _journal_warned = True
+                LOG.warning("event journal write failed (%s); further "
+                            "events count but are not journaled", exc)
+
+
+def iter_events(d: Optional[str] = None):
+    """Yield every journal record under ``d`` (default: the configured
+    journal dir) as dicts, across all writers, in (file, line) order —
+    the read half of the round trip, for tests and tooling."""
+    d = d if d is not None else journal_dir()
+    if d is None or not os.path.isdir(d):
+        return
+    for name in sorted(os.listdir(d)):
+        if not name.startswith("events-") or not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(d, name), "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    continue  # torn final line of a killed writer
+
+
+def reset():
+    """Drop every series, the journal fd cache and the seq counter
+    (tests)."""
+    global _journal_seq, _journal_tag, _journal_warned
+    _registry.reset()
+    with _journal_lock:
+        for fd in _journal_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        _journal_fds.clear()
+        _journal_seq = 0
+    _journal_tag = None
+    _journal_warned = False

@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..common import faultline
+from ..common import faultline, metrics
 from ..common.envutil import env_int
 from ..runner import safe_shell_exec, util
 from ..runner.http_server import RendezvousServer
@@ -89,6 +89,10 @@ class ElasticDriver:
         self._secret = util.make_secret()
         self._server = MessageServer(self._handle, self._secret)
         self._kv = RendezvousServer(secret=self._secret)
+        # Fleet-wide scrape: GET /metrics on the rendezvous server
+        # merges this driver's registry with every live worker's
+        # snapshot (one rank label per source).
+        self._kv.metrics_provider = self._metrics_text
 
         # World state below is shared between the run() reap loop
         # ("caller"), the discovery thread, and the message-server
@@ -192,6 +196,8 @@ class ElasticDriver:
                              "driver.drain.ack)"}
         with self._lock:
             self._draining.add(slot)
+        metrics.event("drain_notice", host=slot[0], slot=slot[1],
+                      reason=reason, commit_id=commit_id)
         LOG.warning("worker %s:%d draining (%s) at commit %d: planned "
                     "removal", slot[0], slot[1], reason, commit_id)
         return {"ok": True}
@@ -264,6 +270,10 @@ class ElasticDriver:
                 rank += 1
                 local_rank += 1
         self._published = True
+        metrics.gauge("elastic_epoch").set(self._epoch)
+        metrics.event("epoch_published", epoch=self._epoch,
+                      ranks=len(self._target),
+                      hosts=len(hosts_in_order))
         LOG.info("epoch %d published: %d ranks over %d hosts",
                  self._epoch, len(self._target), len(hosts_in_order))
 
@@ -449,6 +459,9 @@ class ElasticDriver:
                     # A successful spawn resets the slot's respawn
                     # backoff to the base interval.
                     self._spawn_backoff.pop(slot, None)
+            if not stale:
+                metrics.counter("elastic_spawn_total").inc()
+                metrics.event("spawn", host=host, slot=idx)
             if stale:
                 # The pending guard means no replacement proc can exist
                 # for this slot, so terminating the carrier (for agent
@@ -546,6 +559,9 @@ class ElasticDriver:
                     self._spawn_backoff.pop(slot, None)
                     self._registry.record_success(slot[0])
                     drained_slots.append(slot)
+                    metrics.counter("elastic_drain_total").inc()
+                    metrics.event("drained", host=slot[0], slot=slot[1],
+                                  rc=rc)
                     LOG.warning("worker %s:%d drained (rc=%d): planned "
                                 "removal, host not blacklisted",
                                 slot[0], slot[1], rc)
@@ -557,6 +573,9 @@ class ElasticDriver:
                     # starts from the base interval.
                     self._spawn_backoff.pop(slot, None)
                 else:
+                    metrics.counter("elastic_worker_failures_total").inc()
+                    metrics.event("worker_failed", host=slot[0],
+                                  slot=slot[1], rc=rc)
                     LOG.warning("worker %s:%d failed (rc=%d)",
                                 slot[0], slot[1], rc)
                     failed_hosts.append(slot[0])
@@ -593,6 +612,9 @@ class ElasticDriver:
         for host in set(failed_hosts):
             if self._registry.record_failure(host):
                 cooldown = self._registry.cooldown_for(host)
+                metrics.counter("elastic_blacklist_total").inc()
+                metrics.event("blacklist", host=host,
+                              cooldown_secs=cooldown)
                 LOG.warning(
                     "blacklisting host %s (%s)", host,
                     "cooldown %.1fs, then eligible to rejoin" % cooldown
@@ -618,7 +640,45 @@ class ElasticDriver:
 
     # -- entry -------------------------------------------------------------
 
+    def _metrics_text(self) -> str:
+        """Fleet-wide Prometheus scrape: this driver's registry merged
+        with every registered worker's snapshot (pulled over the
+        notification service; a dead or mid-respawn worker is skipped —
+        a scrape must never block on the control plane's health)."""
+        models = [("driver", metrics.snapshot())]
+        with self._lock:
+            addrs = list(self._worker_addrs.items())
+
+        def pull(slot, addr):
+            try:
+                return slot, send_message(addr, self._secret,
+                                          {"kind": "metrics"},
+                                          timeout=2.0, retries=0)
+            except Exception:  # noqa: BLE001 — worker may be gone
+                return slot, None
+
+        # Concurrent pulls: dead/mid-respawn workers each cost a full
+        # connect timeout, and a sequential loop would stack them —
+        # the scrape would exceed Prometheus' own timeout exactly
+        # during the failure event it exists to observe.
+        from concurrent.futures import ThreadPoolExecutor
+        if addrs:
+            with ThreadPoolExecutor(
+                    max_workers=min(len(addrs), 16)) as pool:
+                results = list(pool.map(lambda sa: pull(*sa), addrs))
+        else:
+            results = []
+        for slot, resp in results:
+            if not isinstance(resp, dict) or not resp.get("snapshot"):
+                continue
+            rank = resp.get("rank")
+            label = str(rank) if rank is not None \
+                else "%s:%d" % (slot[0], slot[1])
+            models.append((label, resp["snapshot"]))
+        return metrics.render_merged(models)
+
     def run(self) -> int:
+        metrics.set_journal_tag("driver")
         self._server.start()
         self._kv.start()
         deadline = time.monotonic() + self.start_timeout

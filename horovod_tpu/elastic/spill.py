@@ -41,7 +41,7 @@ import tempfile
 import time
 from typing import List, Optional, Tuple
 
-from ..common import faultline
+from ..common import faultline, metrics
 from ..common.envutil import env_int
 
 LOG = logging.getLogger("horovod_tpu.elastic.spill")
@@ -110,6 +110,7 @@ def write(commit_id: int, payload: bytes, tag: str) -> Optional[str]:
     d = spill_dir()
     if d is None:
         return None
+    t0 = time.monotonic()
     blob = encode(commit_id, payload)
     if faultline.site("elastic.state.spill"):
         # Injected torn write: the file lands truncated mid-payload,
@@ -135,6 +136,9 @@ def write(commit_id: int, payload: bytes, tag: str) -> Optional[str]:
                 pass
             raise
         _prune(d, tag)
+        metrics.counter("spill_commits_total").inc()
+        metrics.histogram("spill_commit_seconds").observe(
+            time.monotonic() - t0)
         return os.path.join(d, _filename(commit_id, tag))
     except OSError as exc:
         LOG.warning("state spill for commit %d failed (%s); continuing "
@@ -217,6 +221,8 @@ def load_newest(min_commit_id: int = 0,
                     % (commit_id, file_commit_id))
             return file_commit_id, payload
         except (OSError, SpillCorrupt) as exc:
+            metrics.counter("spill_crc_failures_total").inc()
+            metrics.event("spill_corrupt", path=path, error=str(exc))
             LOG.warning("skipping corrupt spill %s (%s); falling back "
                         "to the previous blob", path, exc)
             continue

@@ -38,7 +38,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..common import basics, faultline
+from ..common import basics, faultline, metrics
 from ..ops.engine import HorovodInternalError
 from ..utils.stall_inspector import StallError
 from . import spill
@@ -194,6 +194,11 @@ class ObjectState(State):
                     best = (rid, rpayload,
                             "replica of rank %s" % rep.get("source_rank"))
             except spill.SpillCorrupt as exc:
+                metrics.counter("spill_crc_failures_total").inc()
+                metrics.event("spill_corrupt",
+                              source="replica of rank %s"
+                                     % rep.get("source_rank"),
+                              error=str(exc))
                 LOG.warning("buddy replica blob is corrupt (%s); "
                             "ignoring it", exc)
         if best is None:
@@ -231,6 +236,10 @@ class ObjectState(State):
                 "evidence exists (spill/replica blobs); refusing to "
                 "silently restart from reinitialized state — "
                 "inspect HOROVOD_STATE_SPILL_DIR")
+        metrics.counter("elastic_elections_total").inc()
+        metrics.event("election", root_rank=int(root.get("rank", -1)),
+                      root_commit=root_commit,
+                      my_commit=self._commit_id)
         if root_commit > 0:
             LOG.info("elastic sync: elected rank %d as state root "
                      "(commit id %d)", int(root["rank"]), root_commit)

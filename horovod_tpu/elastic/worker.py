@@ -22,7 +22,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ..common import faultline
+from ..common import faultline, metrics
 from ..common.envutil import env_float
 from ..runner import services
 from ..runner.http_client import is_transient, jittered
@@ -197,6 +197,12 @@ class WorkerNotificationManager:
             return {"ok": True}
         if req.get("kind") == "ping":
             return {"ok": True, "host": self.host, "slot": self.slot}
+        if req.get("kind") == "metrics":
+            # Pull half of the fleet-wide scrape: the driver's
+            # /metrics provider collects every worker's snapshot and
+            # merges them with a rank label per source.
+            return {"ok": True, "rank": os.environ.get("HOROVOD_RANK"),
+                    "snapshot": metrics.snapshot()}
         return {"error": "unknown request"}
 
     def has_update(self) -> bool:
@@ -230,6 +236,8 @@ class WorkerNotificationManager:
                 t.daemon = True
                 t.start()
                 self._drain_timer = t
+        metrics.event("drain_request", reason=reason,
+                      grace_secs=preempt_grace_secs())
         LOG.warning("drain requested (%s): finishing the in-flight "
                     "step, committing, and exiting within %.0fs",
                     reason, preempt_grace_secs())

@@ -15,6 +15,7 @@ from ..common.basics import (init, shutdown, is_initialized, rank, size,
                              mpi_built, nccl_built, ccl_built, ddl_built,
                              cuda_built, rocm_built, mpi_enabled,
                              mpi_threads_supported)
+from ..common.metrics import metrics_snapshot
 from ..common.process_sets import (ProcessSet, global_process_set,
                                    add_process_set, remove_process_set,
                                    process_set_by_id, process_set_ids)

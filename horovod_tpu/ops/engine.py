@@ -30,7 +30,7 @@ import jax.profiler
 import jax.numpy as jnp
 import numpy as np
 
-from ..common import faultline
+from ..common import faultline, metrics
 from ..common.config import Config
 from ..utils.stall_inspector import StallInspector
 from ..utils.timeline import Timeline
@@ -140,6 +140,25 @@ class CollectiveEngine:
         # shutdown() can't race the cycle thread's wait predicate.
         self._shutdown = False  # graftlint: guarded-by=_lock
         self._cycle_count = 0  # graftlint: owned-by=hvd-tpu-cycle
+        # Monotonic collective-group id: every dispatched execution
+        # (fused chunk or single op) gets one; the same id tags the
+        # group's timeline EXEC events (args.group) and the
+        # engine_last_group_id gauge, correlating trace and metrics.
+        self._group_seq = 0  # graftlint: owned-by=hvd-tpu-cycle
+        # Fixed unlabeled series resolved ONCE: the enqueue/cycle hot
+        # paths must pay only the .inc()/.set() lock round trip, not a
+        # per-call name lookup + label-tuple build.
+        self._m_cycles = metrics.counter("engine_cycles_total")
+        self._m_cycle_seconds = metrics.histogram("engine_cycle_seconds")
+        self._m_queue_depth = metrics.gauge("engine_queue_depth")
+        self._m_bytes_submitted = metrics.counter(
+            "engine_bytes_submitted_total")
+        self._m_bytes_fused = metrics.counter("engine_bytes_fused_total")
+        self._m_tensors_fused = metrics.counter(
+            "engine_tensors_fused_total")
+        self._m_cache_hits = metrics.gauge("exec_cache_hits")
+        self._m_cache_misses = metrics.gauge("exec_cache_misses")
+        self._m_last_group = metrics.gauge("engine_last_group_id")
         self.stall_inspector = StallInspector(
             warning_secs=config.stall_warning_secs,
             shutdown_secs=config.stall_shutdown_secs,
@@ -235,6 +254,7 @@ class CollectiveEngine:
                    joined_idx=joined_idx)
         self.timeline.negotiate_start(name, op_type)
         self.stall_inspector.record_enqueue(name)
+        self._m_bytes_submitted.inc(nbytes)
         with self._wake:
             self._queue.append(e)
             self._wake.notify()
@@ -291,10 +311,15 @@ class CollectiveEngine:
             self._cycle_count += 1
             self.timeline.mark_cycle(self._cycle_count)
             if batch:
+                self._m_cycles.inc()
+                self._m_queue_depth.set(len(batch))
                 t0 = time.monotonic()
                 misses0 = self.cache.misses
                 nbytes = sum(e.nbytes for e in batch)
                 self._run_cycle(batch)
+                self._m_cycle_seconds.observe(time.monotonic() - t0)
+                self._m_cache_hits.set(self.cache.hits)
+                self._m_cache_misses.set(self.cache.misses)
                 # A cycle that compiled a new XLA executable measures
                 # the compiler, not communication; feeding it to the
                 # tuner would bias the early GP samples (the reference
@@ -348,6 +373,14 @@ class CollectiveEngine:
         for e in singles:
             self._execute_single(e)
 
+    def _next_group(self) -> int:
+        """Next collective-group id (cycle thread only): tags the
+        group's timeline EXEC span and the engine_last_group_id gauge
+        so the trace and metrics planes correlate."""
+        self._group_seq += 1
+        self._m_last_group.set(self._group_seq)
+        return self._group_seq
+
     def _execute_fused_allreduce(self, entries: List[_Entry]):
         names = [e.name for e in entries]
         # xprof span (the reference's NVTX op range, nvtx_op_range.cc):
@@ -385,7 +418,9 @@ class CollectiveEngine:
 
             if len(entries) == 1 and entries[0].payload.ndim >= 1:
                 e = entries[0]
-                self.timeline.activity_start(e.name, "EXEC_ALLREDUCE")
+                self.timeline.activity_start(
+                    e.name, "EXEC_ALLREDUCE",
+                    args={"group": self._next_group()})
                 out = mc.allreduce(
                     zero_joined(e.payload, e.joined_idx), red_op,
                     float(e.prescale), postscale)
@@ -399,7 +434,11 @@ class CollectiveEngine:
             # buffer as a compiler scratch instead of the engine
             # dispatching separate concat/collective/slice programs
             # (the reference's persistent fusion buffer, the XLA way).
-            self.timeline.activity_start_all(names, "EXEC_FUSED_ALLREDUCE")
+            self._m_bytes_fused.inc(sum(e.nbytes for e in entries))
+            self._m_tensors_fused.inc(len(entries))
+            self.timeline.activity_start_all(
+                names, "EXEC_FUSED_ALLREDUCE",
+                args={"group": self._next_group()})
             total = sum(
                 int(np.prod(e.payload.shape[1:], dtype=np.int64))
                 for e in entries)
@@ -428,7 +467,9 @@ class CollectiveEngine:
                     "%s %r submitted while ranks are joined; only "
                     "allreduce supports zero-contribution join"
                     % (e.op_type, e.name))
-            self.timeline.activity_start(e.name, "EXEC_" + e.op_type.upper())
+            self.timeline.activity_start(
+                e.name, "EXEC_" + e.op_type.upper(),
+                args={"group": self._next_group()})
             # xprof span (reference NVTX op range, nvtx_op_range.cc)
             with jax.profiler.TraceAnnotation("hvd.%s" % e.op_type):
                 if e.op_type == _OP_ALLGATHER:

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..common import faultline
+from ..common import faultline, metrics
 from ..common.config import Config
 from ..utils.timeline import Timeline
 from . import xla_ops
@@ -75,6 +75,25 @@ def _size_class(n_elems: int, itemsize: int) -> int:
 def _is_device_array(x) -> bool:
     import jax
     return isinstance(x, jax.Array)
+
+
+def _pow2_class(nbytes: int) -> str:
+    """Pow2-ceil byte class labeling per-collective metric series: ~40
+    distinct values per op across any realistic payload range, so the
+    full 5-op (op, size_class) space (~200 combos worst case) stays
+    inside the default HOROVOD_METRICS_MAX_SERIES cap of 256."""
+    n = max(int(nbytes), 1)
+    return str(1 << (n - 1).bit_length())
+
+
+def _count_path(op: str, nbytes: int, hier: bool):
+    """Path attribution for one executed collective: which plane moved
+    the bytes (hier = proc x local mesh, flat = one-device-per-process)
+    and how many payload bytes it was handed (pre-padding)."""
+    path = "hier" if hier else "flat"
+    metrics.counter("mh_collective_path_total", op=op, path=path).inc()
+    metrics.counter("mh_bus_bytes_total", op=op, path=path).inc(
+        max(int(nbytes), 0))
 
 
 def _chunked_segments(p, n_items, item_start, item_valid, bc, k):
@@ -463,6 +482,8 @@ class GlobalMeshCollectives:
             # them.  Adasum is excluded — its combine is dot-product
             # based over the WHOLE vector, so per-chunk combines would
             # change the math (it stays on the one-device plane).
+            _count_path("allreduce",
+                        lengths[0] * np.dtype(dtype).itemsize, True)
             return [self._hier_allreduce(
                 payloads[0], lengths[0], dtype, red_op, prescale,
                 postscale, notify)]
@@ -479,6 +500,8 @@ class GlobalMeshCollectives:
             from jax.sharding import PartitionSpec as P
             return self._collective_jit(fn, len(lengths), P())
 
+        _count_path("allreduce",
+                    sum(lengths) * np.dtype(dtype).itemsize, False)
         staged = [self._stage(p, (n,), dtype)
                   for p, n in zip(payloads, lengths)]
         outs = self._compiled(key, build, staged, notify)(*staged)
@@ -590,7 +613,9 @@ class GlobalMeshCollectives:
             local = (local.astype(jnp.uint8) if _is_device_array(local)
                      else np.asarray(local).astype(np.uint8))  # graftlint: disable=host-bounce issue=ISSUE-1 -- bool wire-cast; np branch reached only for host-typed inputs
         bucket = _size_class(n, wire.itemsize)
-        if self._hier_eligible(n * wire.itemsize):
+        hier = self._hier_eligible(n * wire.itemsize)
+        _count_path("broadcast", n * wire.itemsize, hier)
+        if hier:
             out = self._hier_broadcast(local, n, bucket, wire, root_idx,
                                        notify)
         else:
@@ -673,7 +698,9 @@ class GlobalMeshCollectives:
         bucket = _size_class(max(lens), dtype.itemsize)
         size = self.size
         my_len = lens[self.my_idx]
-        if self._hier_eligible(bucket * dtype.itemsize):
+        hier = self._hier_eligible(bucket * dtype.itemsize)
+        _count_path("allgather", my_len * dtype.itemsize, hier)
+        if hier:
             g = self._hier_allgather(local, my_len, bucket, dtype,
                                      notify)
         else:
@@ -757,7 +784,10 @@ class GlobalMeshCollectives:
         my_idx = self.my_idx
         offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)  # graftlint: disable=host-bounce issue=ISSUE-1 -- offsets over the negotiated splits row, never payload bytes
 
-        if self._hier_eligible(size * block * dtype.itemsize):
+        hier = self._hier_eligible(size * block * dtype.itemsize)
+        _count_path("alltoall",
+                    int(offs[-1]) * telems * dtype.itemsize, hier)
+        if hier:
             w, stride = self._hier_alltoall(local, sm, offs, telems,
                                             block, dtype, notify)
         else:
@@ -861,8 +891,10 @@ class GlobalMeshCollectives:
         # program per size class (the packed-fusion-bucket treatment).
         seg = _size_class(max(c * telems, 1), dtype.itemsize)
         my_idx = self.my_idx
-        if (red_op in (SUM, AVERAGE, MIN, MAX, PRODUCT)
-                and self._hier_eligible(size * seg * dtype.itemsize)):
+        hier = (red_op in (SUM, AVERAGE, MIN, MAX, PRODUCT)
+                and self._hier_eligible(size * seg * dtype.itemsize))
+        _count_path("reducescatter", d0 * telems * dtype.itemsize, hier)
+        if hier:
             # Adasum (and any other whole-vector combine) stays on the
             # one-device plane: per-chunk combines would change the
             # math — the ``_hier_allreduce`` exclusion.
@@ -1013,6 +1045,28 @@ class MultihostEngine:
         # group, then poisons the engine (a member that died after
         # negotiation leaves the runtime wedged; callers must not hang
         # with it).
+        # Monotonic collective-group id (mirrors the in-process
+        # engine's): tags each negotiated group's timeline EXEC span
+        # and the engine_last_group_id gauge for trace<->metrics
+        # correlation.
+        self._group_seq = 0  # graftlint: owned-by=hvd-tpu-multihost-exec
+        # Fixed unlabeled series resolved once (hot-path discipline);
+        # the exec-cache gauges additionally refresh at most 1/s —
+        # they only change on a compile, and _finish runs per group.
+        self._m_cycles = metrics.counter("engine_cycles_total")
+        self._m_queue_depth = metrics.gauge("engine_queue_depth")
+        self._m_bytes_submitted = metrics.counter(
+            "engine_bytes_submitted_total")
+        self._m_bytes_fused = metrics.counter("engine_bytes_fused_total")
+        self._m_tensors_fused = metrics.counter(
+            "engine_tensors_fused_total")
+        self._m_cache_hits = metrics.gauge("exec_cache_hits")
+        self._m_cache_misses = metrics.gauge("exec_cache_misses")
+        self._m_last_group = metrics.gauge("engine_last_group_id")
+        # Read/written racily from the drain AND completion threads as
+        # a refresh throttle; a lost update costs one extra gauge
+        # refresh, never a wrong value.
+        self._cache_gauge_t = 0.0  # graftlint: owned-by=any
         self._watch_lock = threading.Lock()
         self._watched: Dict[int, dict] = {}  # graftlint: guarded-by=_watch_lock
         self._killed_wids: set = set()  # graftlint: guarded-by=_watch_lock
@@ -1093,6 +1147,8 @@ class MultihostEngine:
                 name, op_type, tuple(arr.shape), np.dtype(arr.dtype),
                 **kw)
             self._pending[ch._h] = (py, arr)
+            self._m_bytes_submitted.inc(int(arr.nbytes))
+            self._m_queue_depth.set(len(self._pending))
         return py
 
     def enqueue_allreduce(self, name, tensor, red_op=SUM, prescale=1.0,
@@ -1171,7 +1227,9 @@ class MultihostEngine:
 
     def _take(self, handle: int):
         with self._lock:
-            return self._pending.pop(handle, (None, None))
+            taken = self._pending.pop(handle, (None, None))
+            self._m_queue_depth.set(len(self._pending))
+            return taken
 
     # -- execution-phase watchdog ------------------------------------------
 
@@ -1346,13 +1404,28 @@ class MultihostEngine:
         # dispatch-scoped).
         wid = self._watch_register(g, names, taken, entries)
         notify = lambda phase: self._watch_compile(wid, phase)  # noqa: E731
+        # One negotiated group = one engine cycle in this mode; the
+        # group id correlates the timeline span, the metrics gauge and
+        # (below, via g) the completion-latency histogram.
+        self._group_seq += 1
+        gid = self._group_seq
+        self._m_cycles.inc()
+        self._m_last_group.set(gid)
+        group_bytes = sum(
+            int(arr.nbytes) for _, arr in taken if arr is not None)
+        if g["op_type"] == "allreduce" and len(entries) > 1:
+            self._m_bytes_fused.inc(group_bytes)
+            self._m_tensors_fused.inc(len(entries))
+        g["_metrics_t0"] = time.monotonic()
+        g["_metrics_class"] = _pow2_class(group_bytes)
         try:
             # Per-tensor timeline span (reference: the EXEC_* phases the
             # native executors record) + an xprof TraceAnnotation so the
             # device program shows up named in jax profiler traces.
             import jax.profiler
             self.timeline.activity_start_all(
-                names, "EXEC_DEVICE_" + g["op_type"].upper())
+                names, "EXEC_DEVICE_" + g["op_type"].upper(),
+                args={"group": gid})
             with jax.profiler.TraceAnnotation(
                     "hvd.mh.%s[%d]" % (g["op_type"], len(entries))):
                 finalize, needs_host, rep = self._dispatch_group(
@@ -1473,6 +1546,26 @@ class MultihostEngine:
         except Exception as exc:  # noqa: BLE001 - keep draining
             self._complete_error(g, names, taken, entries, exc)
             return False
+        # Dispatch-to-resolution latency per (op, pow2 size class);
+        # only clean completions are samples — an error or watchdog
+        # kill is not a latency observation.
+        t0 = g.get("_metrics_t0")
+        if t0 is not None:
+            metrics.histogram(
+                "mh_collective_seconds", op=g["op_type"],
+                size_class=g.get("_metrics_class", "0")).observe(
+                    time.monotonic() - t0)
+            now = time.monotonic()
+            if now - self._cache_gauge_t >= 1.0:
+                # Benign race on the throttle stamp (worst case one
+                # extra refresh); the totals only move on a compile,
+                # so per-completion recomputation would be waste.
+                self._cache_gauge_t = now
+                with self._lock:
+                    caches = [mc._fns
+                              for mc in self._collectives.values()]
+                self._m_cache_hits.set(sum(c.hits for c in caches))
+                self._m_cache_misses.set(sum(c.misses for c in caches))
         return True
 
     def _complete_error(self, g, names, taken, entries, exc):

@@ -23,7 +23,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional, TypeVar
 
-from ..common import faultline
+from ..common import faultline, metrics
 from ..common.envutil import env_float, env_int
 from .http_server import SECRET_HEADER, compute_digest
 
@@ -117,6 +117,7 @@ def request_with_retry(attempt: Callable[[], T], what: str = "rpc",
     failures = 0
     while True:
         try:
+            metrics.counter("rpc_attempts_total").inc()
             if faultline.site("runner.rpc.request"):
                 raise ConnectionResetError(
                     "injected transient RPC failure (faultline "
@@ -125,9 +126,13 @@ def request_with_retry(attempt: Callable[[], T], what: str = "rpc",
         except Exception as exc:  # noqa: BLE001 — classified below
             if not is_transient(exc):
                 raise
+            metrics.counter("rpc_transient_failures_total").inc()
             failures += 1
             now = time.monotonic()
             if failures > retries or now >= give_up_at:
+                metrics.counter("rpc_giveups_total").inc()
+                metrics.event("rpc_giveup", what=what,
+                              failures=failures, error=str(exc))
                 LOG.warning("%s failed after %d attempt(s), giving up "
                             "(retries=%d deadline=%.1fs): %s",
                             what, failures, retries, budget, exc)

@@ -8,6 +8,13 @@ and the re-rendezvous point for elastic mode.
 
 Requests are authenticated with an HMAC of the body/path using the
 launcher-distributed secret (reference: horovod/runner/common/util/secret.py).
+
+``GET /metrics`` is the one unauthenticated path: it serves the metrics
+plane's Prometheus exposition (read-only operational telemetry, no
+payload data, and scrapers cannot compute the launcher HMAC).  By
+default it renders this process's registry; the elastic driver installs
+a provider that merges every worker's snapshot into a fleet-wide scrape
+(``metrics_provider``).
 """
 
 from __future__ import annotations
@@ -69,8 +76,24 @@ class _KvHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.end_headers()
 
+    def _serve_metrics(self):
+        provider = getattr(self.server, "metrics_provider", None)
+        from ..common import metrics as _metrics
+        text = provider() if provider is not None \
+            else _metrics.render_prometheus()
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         try:
+            if self.path == "/metrics":
+                self._serve_metrics()
+                return
             if not self._authorized(self.path.encode()):
                 self.send_response(403)
                 self.end_headers()
@@ -117,7 +140,19 @@ class RendezvousServer:
         self._httpd.store = {}          # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.secret = secret     # type: ignore[attr-defined]
+        # /metrics renderer; None = this process's own registry.
+        self._httpd.metrics_provider = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def metrics_provider(self):
+        return self._httpd.metrics_provider  # type: ignore[attr-defined]
+
+    @metrics_provider.setter
+    def metrics_provider(self, fn):
+        """Install a () -> str renderer for ``GET /metrics`` (the
+        elastic driver's fleet-wide merge)."""
+        self._httpd.metrics_provider = fn  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:

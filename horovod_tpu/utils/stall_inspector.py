@@ -24,6 +24,8 @@ import logging
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common import metrics
+
 LOG = logging.getLogger("horovod_tpu")
 
 
@@ -86,6 +88,12 @@ class StallInspector:
             last_warn = self._warned.get(name, 0.0)
             if now - last_warn >= self.warning_secs:
                 self._warned[name] = now
+                # The r10 stall-abort path must be countable, not just
+                # grep-able: every warning is a counter tick and a
+                # structured event alongside the log line.
+                metrics.counter("stall_detected_total").inc()
+                metrics.event("stall", tensor=name, age_secs=round(age, 3),
+                              missing_ranks=missing)
                 if missing:
                     self._reporter(
                         "Stalled collective: tensor %r has waited %.0f s; "
@@ -99,6 +107,8 @@ class StallInspector:
                         "or ranks issued collectives in different orders."
                         % (name, age))
             if self.shutdown_secs > 0 and age >= self.shutdown_secs:
+                metrics.event("stall_abort", tensor=name,
+                              age_secs=round(age, 3))
                 raise StallError(
                     "Collective %r stalled beyond the shutdown threshold "
                     "(%.0f s); aborting." % (name, self.shutdown_secs))

@@ -7,6 +7,19 @@ journey (NEGOTIATE -> QUEUE -> FUSE -> EXEC -> DONE) is appended to a
 ``HOROVOD_TIMELINE_MARK_CYCLES`` adds an instant event per background-loop
 cycle, like the reference's cycle markers.
 
+Crash durability: the writer keeps the on-disk array *valid* on a
+cadence (``HOROVOD_TIMELINE_FLUSH_SECS``, default 5 s) by writing the
+closing ``]`` after the newest record and seeking back over it before
+the next one — so a preempted or SIGKILLed worker (the r10 drain path's
+force-exit included) leaves a loadable trace instead of a torn JSON
+array.  ``shutdown()`` is idempotent and tolerates being called after
+an abort already tore the process down around it.
+
+Cross-plane correlation: EXEC events carry the dispatching engine's
+monotonic collective-group id in ``args.group`` — the same id the
+metrics plane exposes as ``engine_last_group_id`` — so a latency spike
+in a scraped histogram can be matched to the exact trace span.
+
 On TPU the XLA/PJRT profiler (xprof) covers device-side detail; this
 timeline covers the host-side scheduling story, which is what the
 reference's timeline was for.
@@ -18,6 +31,16 @@ import json
 import threading
 import time
 from typing import Optional
+
+from ..common.envutil import env_float
+
+_TAIL = "\n]\n"
+
+
+def flush_secs() -> float:
+    """Valid-tail cadence (``HOROVOD_TIMELINE_FLUSH_SECS``, default 5 s,
+    floor 0 = after every record)."""
+    return env_float("HOROVOD_TIMELINE_FLUSH_SECS", 5.0, minimum=0.0)
 
 
 class Timeline:
@@ -31,6 +54,12 @@ class Timeline:
         self._start_ts = time.monotonic()
         self._pending_negotiation = {}
         self.mark_cycles = False
+        # Byte offset of the provisional closing tail, when one is on
+        # disk (the array is valid right now); None = tail not written
+        # since the last record.
+        self._tail_pos: Optional[int] = None
+        self._last_tail = 0.0
+        self._flush_secs = 5.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -42,23 +71,33 @@ class Timeline:
                 return
             self._path = path
             self.mark_cycles = mark_cycles
+            # Snapshot the cadence once per trace: the env cannot
+            # meaningfully change mid-run, and the emit path must not
+            # re-parse it per record.
+            self._flush_secs = flush_secs()
             self._fh = open(path, "w")
             self._fh.write("[\n")
             self._first = True
+            self._tail_pos = None
+            self._last_tail = 0.0
 
     def active(self) -> bool:
         return self._fh is not None
 
     def shutdown(self):
+        """Close the trace; safe to call twice, and safe after an
+        abort/drain already invalidated the handle."""
         with self._lock:
             if self._fh is None:
                 return
             try:
-                self._fh.write("\n]\n")
+                if self._tail_pos is None:
+                    self._fh.write(_TAIL)
                 self._fh.close()
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # torn handle on the abort path: best effort
             self._fh = None
+            self._tail_pos = None
 
     # -- low-level emit ----------------------------------------------------
 
@@ -69,27 +108,56 @@ class Timeline:
         with self._lock:
             if self._fh is None:
                 return
-            if not self._first:
-                self._fh.write(",\n")
-            self._first = False
-            self._fh.write(json.dumps(record))
-            self._fh.flush()
+            try:
+                if self._tail_pos is not None:
+                    # Retract the provisional closing tail.
+                    self._fh.seek(self._tail_pos)
+                    self._fh.truncate()
+                    self._tail_pos = None
+                if not self._first:
+                    self._fh.write(",\n")
+                self._first = False
+                self._fh.write(json.dumps(record))
+                self._fh.flush()
+                now = time.monotonic()
+                if now - self._last_tail >= self._flush_secs:
+                    # Leave the array valid: a worker killed between
+                    # cadence ticks loses at most the tail records,
+                    # never the whole trace.
+                    self._last_tail = now
+                    self._tail_pos = self._fh.tell()
+                    self._fh.write(_TAIL)
+                    self._fh.flush()
+            except (OSError, ValueError):
+                # A torn file handle (disk full, abort mid-teardown)
+                # must never take the training loop down with it.
+                try:
+                    self._fh.close()
+                except (OSError, ValueError):
+                    pass
+                self._fh = None
+                self._tail_pos = None
 
     # -- reference-parity API ---------------------------------------------
 
-    def activity_start(self, tensor_name: str, activity: str, rank: int = 0):
+    def activity_start(self, tensor_name: str, activity: str, rank: int = 0,
+                       args: Optional[dict] = None):
         """Begin a phase for one tensor (``Timeline::ActivityStart``)."""
-        self._emit({"name": activity, "ph": "B", "ts": self._us(),
-                    "pid": rank, "tid": tensor_name})
+        record = {"name": activity, "ph": "B", "ts": self._us(),
+                  "pid": rank, "tid": tensor_name}
+        if args:
+            record["args"] = args
+        self._emit(record)
 
     def activity_end(self, tensor_name: str, rank: int = 0):
         """End the innermost phase (``Timeline::ActivityEnd``)."""
         self._emit({"ph": "E", "ts": self._us(),
                     "pid": rank, "tid": tensor_name})
 
-    def activity_start_all(self, tensor_names, activity: str, rank: int = 0):
+    def activity_start_all(self, tensor_names, activity: str, rank: int = 0,
+                           args: Optional[dict] = None):
         for n in tensor_names:
-            self.activity_start(n, activity, rank)
+            self.activity_start(n, activity, rank, args)
 
     def activity_end_all(self, tensor_names, rank: int = 0):
         for n in tensor_names:

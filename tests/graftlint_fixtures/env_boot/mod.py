@@ -1,0 +1,15 @@
+"""Fixture bootstrap module: one documented knob, two undocumented."""
+
+import os
+
+
+def env_int(name, default, minimum=None):
+    return default
+
+
+def knobs():
+    a = env_int("HOROVOD_BOOT_DOCUMENTED", 1)
+    b = env_int("HOROVOD_BOOT_MISSING", 2)
+    c = os.environ.get("HOROVOD_BOOT_RAW_MISSING")
+    d = os.environ.get("NOT_A_KNOB")  # foreign prefix: out of scope
+    return a, b, c, d

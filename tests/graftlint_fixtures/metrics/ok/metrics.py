@@ -1,0 +1,16 @@
+"""Fixture registry: clean — every name declared once and planted."""
+
+NAMES = {
+    "good_total": ("counter", "a counted thing"),
+    "depth": ("gauge", "a measured level"),
+    "latency_seconds": ("histogram", "a timed thing"),
+    "internal_total": ("counter", "used by the registry module itself"),
+}
+
+
+def counter(name, **labels):
+    return None
+
+
+def event(kind):
+    counter("internal_total")

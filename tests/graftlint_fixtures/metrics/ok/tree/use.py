@@ -1,0 +1,10 @@
+"""Fixture call sites: every plant names a registered series with the
+declared kind."""
+
+metrics = None
+
+
+def touch():
+    metrics.counter("good_total").inc()
+    metrics.gauge("depth").set(3)
+    metrics.histogram("latency_seconds").observe(0.5)

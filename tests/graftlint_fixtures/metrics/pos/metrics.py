@@ -1,0 +1,8 @@
+"""Fixture registry: one duplicate declaration and one orphan."""
+
+NAMES = {
+    "x_total": ("counter", "used, fine"),
+    "dup_total": ("counter", "declared twice"),
+    "dup_total": ("counter", "the silent last-wins duplicate"),  # noqa: F601
+    "orphan_total": ("counter", "declared but planted nowhere"),
+}

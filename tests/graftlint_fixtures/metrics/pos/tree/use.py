@@ -1,0 +1,13 @@
+"""Fixture call sites: unregistered, non-literal and kind-mismatched
+plants."""
+
+metrics = None
+DYNAMIC = "x_total"
+
+
+def touch():
+    metrics.counter("x_total").inc()          # fine
+    metrics.counter("dup_total").inc()        # fine (keeps it non-orphan)
+    metrics.counter("nope_total").inc()       # unregistered
+    metrics.counter(DYNAMIC).inc()            # non-literal
+    metrics.gauge("x_total").set(1)           # kind mismatch

@@ -265,3 +265,97 @@ def test_faultline_real_tree_registry_matches_runtime_table():
     parsed = registry_sites(
         os.path.join(REPO, "horovod_tpu", "common", "faultline.py"))
     assert set(parsed) == set(fl.SITES)
+
+
+# -- metric series-name registry -------------------------------------------
+
+def _metrics_cfg(variant):
+    base = os.path.join("metrics", variant)
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(), env_scan_root="absent", hot_path_roots=(),
+        faultline_module="absent/faultline.py", faultline_roots=(),
+        faultline_cc_roots=(),
+        metrics_module=os.path.join(base, "metrics.py"),
+        metrics_roots=(base,),
+        bootstrap_env_files=())
+
+
+def _run_metrics(variant):
+    return run_paths([os.path.join(FIX, "metrics", variant)],
+                     _metrics_cfg(variant))
+
+
+def test_metric_names_clean_fixture():
+    """Registered names used with their declared kinds (including the
+    registry module's own bare-call plants) lint clean."""
+    findings = _run_metrics("ok")
+    assert findings == [], _fmt(findings)
+
+
+def test_metric_names_flags_unregistered_and_nonliteral():
+    findings = [f for f in _run_metrics("pos")
+                if f.check == "metric-unregistered"]
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 2, _fmt(_run_metrics("pos"))
+    assert "nope_total" in msgs and "not a string literal" in msgs
+
+
+def test_metric_names_flags_kind_mismatch():
+    findings = [f for f in _run_metrics("pos")
+                if f.check == "metric-kind-mismatch"]
+    assert len(findings) == 1 and "x_total" in findings[0].message, \
+        _fmt(_run_metrics("pos"))
+
+
+def test_metric_names_flags_duplicate_declaration():
+    findings = [f for f in _run_metrics("pos")
+                if f.check == "metric-duplicate-decl"]
+    assert len(findings) == 1 and "dup_total" in findings[0].message, \
+        _fmt(_run_metrics("pos"))
+
+
+def test_metric_names_flags_orphan_declaration():
+    findings = [f for f in _run_metrics("pos")
+                if f.check == "metric-orphan"]
+    assert len(findings) == 1 and "orphan_total" in findings[0].message, \
+        _fmt(_run_metrics("pos"))
+
+
+def test_metric_real_tree_registry_matches_runtime_table():
+    """The rule parses NAMES statically; the runtime registry must
+    agree, and every declared kind must be one the registry
+    implements."""
+    from graftlint.rules.metric_names import registry_names
+    from horovod_tpu.common import metrics as m
+    parsed, dup_findings = registry_names(
+        os.path.join(REPO, "horovod_tpu", "common", "metrics.py"))
+    assert dup_findings == []
+    assert set(parsed) == set(m.NAMES)
+    assert {kind for kind, _ in parsed.values()} <= {
+        "counter", "gauge", "histogram"}
+
+
+# -- env-drift: bootstrap-module registration ------------------------------
+
+def test_env_drift_flags_undocumented_bootstrap_knobs():
+    """envutil helper reads AND direct os.environ gets in a registered
+    bootstrap module must be documented; foreign-prefix reads are out
+    of scope."""
+    cfg = LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(os.path.join("env_boot", "docs.md"),),
+        env_scan_root="env_boot", hot_path_roots=(),
+        faultline_module="absent/faultline.py", faultline_roots=(),
+        faultline_cc_roots=(), metrics_roots=(),
+        metrics_module="absent/metrics.py",
+        bootstrap_env_files=(os.path.join("env_boot", "mod.py"),))
+    findings = [f for f in run_paths([os.path.join(FIX, "env_boot")], cfg)
+                if f.check == "env-undocumented"]
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 2, msgs
+    assert "HOROVOD_BOOT_MISSING" in msgs
+    assert "HOROVOD_BOOT_RAW_MISSING" in msgs
+    assert "HOROVOD_BOOT_DOCUMENTED" not in msgs

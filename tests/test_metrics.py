@@ -1,0 +1,473 @@
+"""Metrics & structured-events plane tests.
+
+Registry units (thread safety, log2 histogram buckets, label
+cardinality guard, runtime name strictness), Prometheus exposition
+well-formedness, the JSONL journal round trip, instrumented-seam
+assertions (faultline fire -> counter + journal, stall warning ->
+counter, RPC retry counters, /metrics on the rendezvous server,
+timeline valid-tail durability), and — slow-marked, run by the CI
+fault-smoke job — a 2-proc multihost elastic world whose driver
+``/metrics`` is scraped mid-run under fault injection (observability
+certified under injection, the r7 pattern).
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.common import faultline, metrics
+from tests.utils.spawn import scaled_timeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# -- registry units --------------------------------------------------------
+
+def test_counter_gauge_basics():
+    metrics.counter("engine_cycles_total").inc()
+    metrics.counter("engine_cycles_total").inc(4)
+    assert metrics.counter("engine_cycles_total").value == 5
+    metrics.gauge("elastic_epoch").set(7)
+    metrics.gauge("elastic_epoch").set(3)
+    assert metrics.gauge("elastic_epoch").value == 3
+    # Label order must not fork a series.
+    metrics.counter("mh_bus_bytes_total", op="allreduce", path="flat").inc(2)
+    metrics.counter("mh_bus_bytes_total", path="flat", op="allreduce").inc(3)
+    assert metrics.counter("mh_bus_bytes_total", op="allreduce",
+                           path="flat").value == 5
+
+
+def test_unregistered_and_kind_mismatch_raise():
+    with pytest.raises(KeyError):
+        metrics.counter("totally_made_up_series")
+    with pytest.raises(ValueError):
+        metrics.gauge("engine_cycles_total")  # declared as a counter
+    with pytest.raises(ValueError):
+        metrics.counter("elastic_epoch")      # declared as a gauge
+
+
+def test_counter_thread_safety():
+    n_threads, per_thread = 8, 500
+
+    def worker():
+        for _ in range(per_thread):
+            metrics.counter("rpc_attempts_total").inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.counter("rpc_attempts_total").value == \
+        n_threads * per_thread
+
+
+def test_histogram_log2_buckets():
+    h = metrics.histogram("engine_cycle_seconds")
+    h.observe(0.0009)   # <= 2^-10
+    h.observe(0.7)      # <= 2^0
+    h.observe(3.0)      # <= 2^2
+    h.observe(1e9)      # beyond the top finite bucket: +Inf only
+    snap = metrics.snapshot()["engine_cycle_seconds"]["series"][0]
+    assert snap["count"] == 4
+    assert sum(snap["buckets"].values()) == 3  # 1e9 is +Inf-only
+    assert abs(snap["sum"] - (0.0009 + 0.7 + 3.0 + 1e9)) < 1.0
+    text = metrics.render_prometheus()
+    # Cumulative bucket counts, le ascending, +Inf = total count.
+    les = [(float(m.group(1)) if m.group(1) != "+Inf" else float("inf"),
+            int(m.group(2)))
+           for m in re.finditer(
+               r'engine_cycle_seconds_bucket\{le="([^"]+)"\} (\d+)',
+               text)]
+    assert les == sorted(les), text
+    counts = [c for _, c in les]
+    assert counts == sorted(counts) and counts[-1] == 4, text
+
+
+def test_label_cardinality_guard(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS_MAX_SERIES", "4")
+    for i in range(10):
+        metrics.counter("fault_injections_total",
+                        site="site%d" % i, action="drop").inc()
+    fam = metrics.snapshot()["fault_injections_total"]["series"]
+    # 4 real series + the overflow catch-all.
+    assert len(fam) == 5
+    overflow = [s for s in fam if s["labels"] == {"overflow": "true"}]
+    assert overflow and overflow[0]["value"] == 6
+    assert metrics.counter("metrics_dropped_series_total").value == 6
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (\S+)$')
+
+
+def assert_prometheus_wellformed(text: str):
+    """Minimal exposition-format validator: HELP/TYPE comments only,
+    one TYPE per family, parseable sample lines, histogram buckets
+    carry le labels."""
+    assert text.endswith("\n")
+    typed = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = re.match(r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*) (.+)$",
+                         line)
+            assert m, "bad comment line: %r" % line
+            if m.group(1) == "TYPE":
+                assert m.group(2) not in typed, \
+                    "duplicate TYPE for %s" % m.group(2)
+                assert m.group(3) in ("counter", "gauge", "histogram")
+                typed[m.group(2)] = m.group(3)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, "bad sample line: %r" % line
+        float(m.group(3))  # value parses
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        assert base in typed or m.group(1) in typed, \
+            "sample %r precedes its TYPE" % line
+        if m.group(1).endswith("_bucket"):
+            assert 'le="' in (m.group(2) or ""), line
+
+
+def test_prometheus_render_well_formed():
+    metrics.counter("engine_cycles_total").inc()
+    metrics.gauge("engine_queue_depth").set(3)
+    metrics.histogram("mh_collective_seconds", op="allreduce",
+                      size_class="4096").observe(0.01)
+    metrics.counter("events_total", kind="drain_request").inc()
+    assert_prometheus_wellformed(metrics.render_prometheus())
+
+
+def test_render_merged_adds_rank_label():
+    metrics.counter("engine_cycles_total").inc(2)
+    snap = metrics.snapshot()
+    text = metrics.render_merged([("driver", snap), ("1", snap)])
+    assert_prometheus_wellformed(text)
+    assert 'engine_cycles_total{rank="driver"} 2' in text
+    assert 'engine_cycles_total{rank="1"} 2' in text
+    assert text.count("# TYPE engine_cycles_total counter") == 1
+
+
+# -- journal ---------------------------------------------------------------
+
+def test_journal_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_RANK", "2")
+    metrics.event("stall", tensor="t1", age_secs=1.5)
+    metrics.event("drain_request", reason="test")
+    metrics.event("election", root_rank=0)
+    records = list(metrics.iter_events())
+    assert [r["seq"] for r in records] == [1, 2, 3]
+    assert all(r["rank"] == 2 for r in records)
+    assert [r["kind"] for r in records] == \
+        ["stall", "drain_request", "election"]
+    assert records[0]["tensor"] == "t1"
+    # Rank-stamped filename; one file per writer.
+    assert os.listdir(str(tmp_path)) == ["events-r2.jsonl"]
+    # Events mirror into the counter whether or not the journal is on.
+    assert metrics.counter("events_total", kind="stall").value == 1
+
+
+def test_journal_disabled_without_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("HOROVOD_METRICS_DIR", raising=False)
+    metrics.event("stall", tensor="x")
+    assert metrics.counter("events_total", kind="stall").value == 1
+    assert list(metrics.iter_events(str(tmp_path))) == []
+
+
+# -- instrumented seams ----------------------------------------------------
+
+def test_faultline_fire_increments_counter_and_journal(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_FAULT", "engine.cycle.pre:delay:0.0")
+    faultline.reset()
+    try:
+        assert faultline.site("engine.cycle.pre") is False
+        assert metrics.counter("fault_injections_total",
+                               site="engine.cycle.pre",
+                               action="delay").value == 1
+        fires = [r for r in metrics.iter_events()
+                 if r["kind"] == "fault_fire"]
+        assert len(fires) == 1
+        assert fires[0]["site"] == "engine.cycle.pre"
+        assert fires[0]["action"] == "delay"
+    finally:
+        faultline.reset()
+
+
+def test_stall_warning_counts_and_journals(tmp_path, monkeypatch):
+    from horovod_tpu.utils.stall_inspector import StallInspector
+    monkeypatch.setenv("HOROVOD_METRICS_DIR", str(tmp_path))
+    si = StallInspector(warning_secs=0.05, reporter=lambda msg: None)
+    si.record_enqueue("grad_7", missing_ranks=[1, 3])
+    time.sleep(0.12)
+    assert si.check() == ["grad_7"]
+    assert metrics.counter("stall_detected_total").value == 1
+    stalls = [r for r in metrics.iter_events() if r["kind"] == "stall"]
+    assert stalls and stalls[0]["tensor"] == "grad_7"
+    assert stalls[0]["missing_ranks"] == [1, 3]
+
+
+def test_rpc_retry_counters():
+    from horovod_tpu.runner.http_client import request_with_retry
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("flake")
+        return 42
+
+    assert request_with_retry(flaky, what="test", max_retries=5,
+                              backoff=0.001, deadline=5.0) == 42
+    assert metrics.counter("rpc_attempts_total").value == 3
+    assert metrics.counter("rpc_transient_failures_total").value == 2
+    assert metrics.counter("rpc_giveups_total").value == 0
+
+    def always_down():
+        raise ConnectionResetError("down")
+
+    with pytest.raises(ConnectionResetError):
+        request_with_retry(always_down, what="test", max_retries=1,
+                           backoff=0.001, deadline=5.0)
+    assert metrics.counter("rpc_giveups_total").value == 1
+
+
+def test_http_server_metrics_endpoint_unauthenticated():
+    from horovod_tpu.runner.http_server import RendezvousServer
+    metrics.counter("engine_cycles_total").inc(9)
+    server = RendezvousServer(host="127.0.0.1", secret="sekrit")
+    port = server.start()
+    try:
+        url = "http://127.0.0.1:%d/metrics" % port
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "engine_cycles_total 9" in text
+        assert_prometheus_wellformed(text)
+        # The KV paths stay HMAC-authenticated: no free rides.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/some/key" % port, timeout=10)
+        assert err.value.code == 403
+    finally:
+        server.stop()
+
+
+def test_http_server_metrics_provider_override():
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1", secret="s")
+    server.metrics_provider = lambda: "# HELP x y\n# TYPE x counter\nx 1\n"
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port, timeout=10) as resp:
+            assert resp.read().decode().endswith("x 1\n")
+    finally:
+        server.stop()
+
+
+# -- timeline durability ---------------------------------------------------
+
+def test_timeline_tail_stays_loadable_and_stop_is_tolerant(
+        tmp_path, monkeypatch):
+    from horovod_tpu.utils.timeline import Timeline
+    monkeypatch.setenv("HOROVOD_TIMELINE_FLUSH_SECS", "0")
+    path = str(tmp_path / "trace.json")
+    tl = Timeline()
+    tl.initialize(path)
+    for i in range(3):
+        tl.activity_start("t%d" % i, "EXEC_ALLREDUCE",
+                          args={"group": i + 1})
+        # With a zero cadence the on-disk array is valid after EVERY
+        # record — the preempted-worker guarantee, observable.
+        with open(path) as f:
+            records = json.load(f)
+        assert len(records) == i + 1
+        assert records[i]["args"]["group"] == i + 1
+    tl.shutdown()
+    tl.shutdown()  # idempotent
+    with open(path) as f:
+        assert len(json.load(f)) == 3
+
+    # Abort path: the file handle dies under the writer (drain force
+    # exit, disk error) — emits and stop must not raise.
+    tl2 = Timeline()
+    tl2.initialize(str(tmp_path / "trace2.json"))
+    tl2.activity_start("a", "X")
+    tl2._fh.close()
+    tl2.activity_start("b", "Y")   # swallowed, writer disabled
+    tl2.shutdown()                 # tolerated after the abort
+    with open(str(tmp_path / "trace2.json")) as f:
+        assert json.load(f)[0]["name"] == "X"
+
+
+def test_timeline_cadence_batches_tail_writes(tmp_path, monkeypatch):
+    from horovod_tpu.utils.timeline import Timeline
+    monkeypatch.setenv("HOROVOD_TIMELINE_FLUSH_SECS", "3600")
+    path = str(tmp_path / "trace.json")
+    tl = Timeline()
+    tl.initialize(path)
+    tl.activity_start("t", "X")   # first record: tail written (t=0 tick)
+    tl.activity_start("u", "Y")   # inside the cadence window: no tail
+    with open(path) as f:
+        content = f.read()
+    assert not content.rstrip().endswith("]")
+    tl.shutdown()
+    with open(path) as f:
+        assert len(json.load(f)) == 2
+
+
+# -- in-process engine integration ----------------------------------------
+
+def test_engine_series_from_inprocess_world():
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init(devices=jax.devices()[:1])
+    try:
+        out = hvd.allreduce(np.ones((1, 16), np.float32), op=hvd.Sum,
+                            name="metrics_probe")
+        assert float(np.asarray(out).reshape(-1)[0]) == 1.0
+        snap = hvd.metrics_snapshot()
+        assert snap["engine_cycles_total"]["series"][0]["value"] >= 1
+        assert snap["engine_bytes_submitted_total"]["series"][0][
+            "value"] >= 16 * 4
+        assert snap["engine_last_group_id"]["series"][0]["value"] >= 1
+        assert "exec_cache_misses" in snap
+    finally:
+        hvd.shutdown()
+
+
+# -- e2e: fleet-wide scrape under injection (CI fault-smoke) ---------------
+
+E2E_WORKER = """
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(batch=0)
+
+@elastic.run
+def train(state):
+    while not os.path.exists(%(stop)r) or state.batch < 4:
+        out = hvd.allreduce(np.ones(64, np.float32), op=hvd.Sum,
+                            name="b%%d" %% state.batch)
+        assert float(np.asarray(out).reshape(-1)[0]) == float(hvd.size())
+        state.batch += 1
+        time.sleep(0.05)
+        state.commit()
+    print("DONE rank=%%d size=%%d batch=%%d"
+          %% (hvd.rank(), hvd.size(), state.batch), flush=True)
+
+train(state)
+"""
+
+
+@pytest.mark.slow
+def test_metrics_e2e_scrape_2proc(tmp_path, monkeypatch):
+    """ISSUE 6 acceptance: curl the driver's /metrics mid-run on a live
+    2-proc multihost elastic world — well-formed Prometheus text with
+    engine cycle/fusion series, per-collective latency histograms and
+    elastic event counters, all rank-labeled; an injected
+    HVD_TPU_FAULT drop shows up as BOTH a counter increment in the
+    scrape and a fault_fire line in the JSONL journal (observability
+    certified under injection)."""
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    events_dir = tmp_path / "events"
+    stop_file = tmp_path / "stop"
+    script = tmp_path / "train.py"
+    script.write_text(E2E_WORKER % {"stop": str(stop_file)})
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_RANK", None)
+    env.pop("HOROVOD_ELASTIC_DRIVER_ADDR", None)
+    env["HOROVOD_CONTROLLER"] = "multihost"
+    env["HOROVOD_METRICS_DIR"] = str(events_dir)
+    # Fires once per worker at the first rendezvous poll: a bounded,
+    # recoverable drop whose only lasting trace is observability.
+    env["HVD_TPU_FAULT"] = "elastic.rendezvous.poll:drop@times=1"
+    # The driver journals into the same dir (it runs in this process).
+    monkeypatch.setenv("HOROVOD_METRICS_DIR", str(events_dir))
+
+    driver = ElasticDriver(
+        [sys.executable, str(script)],
+        FixedHosts({"127.0.0.1": 1, "127.0.0.2": 1}),
+        min_np=2, max_np=2, env=env)
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.setdefault("rc", driver.run()),
+        daemon=True)
+    t.start()
+    url = "http://127.0.0.1:%d/metrics" % driver._kv.port
+    deadline = time.monotonic() + scaled_timeout(300)
+    text = ""
+    try:
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    text = resp.read().decode()
+            except Exception:
+                time.sleep(1.0)
+                continue
+            if ("engine_cycles_total{" in text
+                    and "mh_collective_seconds_bucket" in text
+                    and "fault_injections_total" in text):
+                break
+            time.sleep(1.0)
+    finally:
+        stop_file.write_text("")  # let the workers finish either way
+    t.join(scaled_timeout(300))
+    assert not t.is_alive(), "driver never finished"
+    assert result.get("rc") == 0
+
+    # The mid-run scrape carried every plane, rank-labeled.
+    assert "mh_collective_seconds_bucket" in text, text[-2000:]
+    assert_prometheus_wellformed(text)
+    assert re.search(r'engine_cycles_total\{rank="[01]"\}', text), text
+    assert "engine_bytes_submitted_total" in text
+    assert re.search(r'mh_collective_seconds_bucket\{[^}]*le="[^"]+"'
+                     r'[^}]*op="allreduce"', text), text
+    assert re.search(r'mh_collective_path_total\{[^}]*rank="[01]"', text)
+    m = re.search(r'elastic_spawn_total\{rank="driver"\} (\d+)', text)
+    assert m and int(m.group(1)) >= 2, text
+    assert 'elastic_epoch{rank="driver"}' in text
+    # Injected drop: counter increment in the scrape ...
+    assert re.search(
+        r'fault_injections_total\{[^}]*site="elastic\.rendezvous\.poll"'
+        r'[^}]*\} 1', text), text
+    # ... and a journal event on disk (one per worker process;
+    # @times=1 bounds it per process, a respawn may add one more).
+    records = list(metrics.iter_events(str(events_dir)))
+    fires = [r for r in records if r["kind"] == "fault_fire"]
+    assert len(fires) >= 2, records
+    assert all(r["site"] == "elastic.rendezvous.poll" for r in fires)
+    # Driver-side lifecycle events journaled too, rank-stamped schema.
+    kinds = {r["kind"] for r in records}
+    assert "spawn" in kinds and "epoch_published" in kinds
+    assert all("seq" in r and "ts" in r for r in records)
