@@ -154,6 +154,16 @@ def lever_attribution(jax, jnp, on_accel, peak):
             "ops": ["allreduce", "allgather", "alltoall",
                     "reducescatter", "broadcast"],
         }
+        # r12 cross-host wire codec: which codec (if any) the hier DCN
+        # leg ran with, so a BENCH delta is attributable to wire
+        # compression — the live wire-bytes/ratio series land in
+        # levers.metrics below (mh_bus_bytes_total is wire bytes).
+        lev["compression"] = {
+            "codec": cfg.cross_host_compression,
+            "scope": "cross_host_leg",
+            "error_feedback_ops": ["allreduce", "reducescatter"],
+            "residual_buckets": int(cfg.compression_residual_buckets),
+        }
         # flash_plan_info validates the env hooks and raises on bad
         # values — attribution must degrade, never kill the headline
         # JSON (e.g. an on-chip block override run on the CPU smoke

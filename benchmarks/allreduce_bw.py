@@ -121,10 +121,30 @@ def main():
                          "(non-allreduce ops need --eager; exercised "
                          "by podcheck's hier A/B so the multi-chip "
                          "legs of every op are pod-measured)")
+    ap.add_argument("--compression", default="none",
+                    choices=["none", "fp16", "bf16", "int8", "fp8"],
+                    help="cross-host wire codec A/B (exports "
+                         "HOROVOD_CROSS_HOST_COMPRESSION before init; "
+                         "engages on the hier leg above the "
+                         "hierarchical threshold).  Bus-bytes math "
+                         "uses the WIRE itemsize so reported GB/s "
+                         "stays NCCL-convention-comparable across "
+                         "codecs")
     args = ap.parse_args()
     if args.op != "allreduce" and not args.eager:
         ap.error("--op %s requires --eager (the jit path and the async "
                  "burst only time allreduce)" % args.op)
+    if args.compression != "none" and not (args.eager
+                                           or args.eager_async):
+        ap.error("--compression requires --eager/--eager-async "
+                 "(the codec lives on the eager multihost hier "
+                 "leg; the raw jit path has no compression seam)")
+    # Export unconditionally: --compression none must OVERRIDE a
+    # pre-set HOROVOD_CROSS_HOST_COMPRESSION (a stale env from the A/B
+    # recipe would otherwise silently compress the baseline leg while
+    # the bus math assumed a full-precision wire).
+    import os
+    os.environ["HOROVOD_CROSS_HOST_COMPRESSION"] = args.compression
 
     if args.cpu_devices:
         import os
@@ -260,6 +280,21 @@ def run_eager(args):
     multihost = jax.process_count() > 1
     dtype = jnp.dtype(args.dtype)
     op = args.op
+    # Codec A/B: ask the engine's OWN gate (codec resolution + hier
+    # eligibility) per size, so the reported wire bytes are exactly
+    # what production would put on DCN — no second copy of the gate
+    # logic to drift.  The in-process world has no cross-host leg; the
+    # codec stays inert there and wire == payload.
+    mc = None
+    if args.compression != "none" and multihost:
+        from horovod_tpu.common import basics
+        mc = basics._get_mh_engine().collectives_for(0)
+    # The RESOLVED codec label (e.g. fp8 falls back to 'fp8-as-bf16'
+    # on jax without float8): the metrics series carry this name, not
+    # the requested one.
+    resolved_codec = (mc._codec.name
+                      if mc is not None and mc._codec is not None
+                      else args.compression)
 
     def run_op(x, name):
         if op == "allreduce":
@@ -328,9 +363,31 @@ def run_eager(args):
                     float(np.asarray(y).reshape(-1)[0])  # fetch barrier
                 return time.perf_counter() - t0
 
+        def _compressed_count():
+            # Engagement observed from the engine's own counter, not a
+            # re-derivation of its per-op gate bytes (padding /
+            # size-class rounding differs per op and would drift).
+            if mc is None:
+                return 0.0
+            from horovod_tpu.common.metrics import series_sum
+            return series_sum("mh_compressed_collectives_total", op=op)
+
+        cc_before = _compressed_count()
         timed(args.warmup)
+        engaged = _compressed_count() > cc_before
         per_op, opw, resolvable = measure_per_op(timed, args.iters)
-        bb = bus_bytes(op, n, elems * dtype.itemsize)
+        payload_bytes = elems * dtype.itemsize
+        # Wire bytes at the engine's accounting: the bus-bytes
+        # convention uses the WIRE itemsize when the codec engaged on
+        # the warmup ops, so GB/s stays NCCL-comparable across codecs
+        # (the A/B measures the same logical transfer, cheaper on the
+        # wire).
+        wire_bytes = payload_bytes
+        codec_obj = mc._wire_codec(dtype) if (mc is not None
+                                              and engaged) else None
+        if codec_obj is not None:
+            wire_bytes = mc._wire_nbytes(codec_obj, elems)
+        bb = bus_bytes(op, n, wire_bytes)
         bus_gbps = bb / per_op / 1e9 if resolvable else None
         rec = {"metric": "%s_bus_bandwidth" % op,
                "path": "eager_async" if args.eager_async else "eager",
@@ -340,6 +397,11 @@ def run_eager(args):
                "ops_per_window": opw,
                "bus_gb_per_sec": (round(bus_gbps, 3)
                                   if bus_gbps is not None else None)}
+        if args.compression != "none":
+            rec["compression"] = args.compression
+            rec["compression_engaged"] = codec_obj is not None
+            rec["wire_bytes"] = int(wire_bytes)
+            rec["payload_bytes"] = int(payload_bytes)
         if not resolvable:
             rec["note"] = ("below timer resolution even amortized "
                            "over %d ops/window" % opw)
@@ -356,10 +418,33 @@ def run_eager(args):
                    "path": ("eager_async" if args.eager_async
                             else "eager"),
                    "value": best, "unit": "GB/s", "ranks": n}
+        if args.compression != "none":
+            summary["compression"] = args.compression
         if args.link_gbps:
             summary["efficiency_vs_link"] = round(best / args.link_gbps,
                                                   4)
         print(json.dumps(summary))
+    if args.compression != "none" and hvd.rank() == 0:
+        # The engine's own wire accounting for the whole run (warmup +
+        # timing windows): what ACTUALLY crossed DCN, per path, plus
+        # the last compression ratio — the self-attribution the e2e
+        # test asserts on instead of trusting printed math.
+        from horovod_tpu.common.metrics import series_sum as series
+
+        print(json.dumps({
+            "metric": "cross_host_wire",
+            "codec": args.compression,
+            "resolved_codec": resolved_codec,
+            "wire_bytes_hier": int(series("mh_bus_bytes_total", op=op,
+                                          path="hier")),
+            "wire_bytes_flat": int(series("mh_bus_bytes_total", op=op,
+                                          path="flat")),
+            "compressed_collectives": int(series(
+                "mh_compressed_collectives_total", op=op,
+                codec=resolved_codec)),
+            "compression_ratio": series("mh_compression_ratio", op=op,
+                                        codec=resolved_codec),
+        }))
     hvd.shutdown()
 
 

@@ -73,6 +73,27 @@ def _parse_hier_mode(v: Optional[str]) -> str:
         "off/0" % v)
 
 
+_COMPRESSION_CODECS = ("none", "fp16", "bf16", "int8", "fp8")
+
+
+def _parse_compression(v: Optional[str]) -> str:
+    """none | fp16 | bf16 | int8 | fp8, failing loudly on anything else
+    (a typo that silently shipped full precision would discard the 4x
+    cross-host wire reduction with no signal)."""
+    s = (v or "").strip().lower()
+    if s in ("", "none", "off", "0", "false", "no"):
+        return "none"
+    if s in ("fp16", "float16"):
+        return "fp16"
+    if s in ("bf16", "bfloat16"):
+        return "bf16"
+    if s in ("int8", "fp8"):
+        return s
+    raise ValueError(
+        "HOROVOD_CROSS_HOST_COMPRESSION=%r: expected one of %s"
+        % (v, "|".join(_COMPRESSION_CODECS)))
+
+
 @dataclasses.dataclass
 class Config:
     """Typed snapshot of all runtime knobs, read once at ``hvd.init()``."""
@@ -126,6 +147,20 @@ class Config:
     # one-device-per-host plane.
     hierarchical_allreduce: str = "auto"  # auto | on | off
     hierarchical_allreduce_threshold: int = 64 * 1024
+
+    # --- cross-host wire compression (hierarchical leg only) ---
+    # Codec for the cross-host (DCN) leg of the hierarchical eager
+    # collectives: payloads that pass the hierarchical gate put int8 /
+    # fp8 / fp16 / bf16 on the wire between hosts while in-host ICI
+    # reassembly stays full precision.  Reduce ops (Sum/Average) get
+    # error-feedback residuals so quantization stays convergent;
+    # data-movement ops get plain quantize/dequantize.  "none"
+    # (default) is reference parity.
+    cross_host_compression: str = "none"  # none|fp16|bf16|int8|fp8
+    # LRU cap on error-feedback residual buckets (one per op x padded
+    # size class x dtype); bounds residual memory on shape-churning
+    # jobs.
+    compression_residual_buckets: int = 64
 
     # --- misc parity knobs ---
     dynamic_process_sets: bool = False
@@ -184,6 +219,10 @@ class Config:
                 _env("HIERARCHICAL_ALLREDUCE")),
             hierarchical_allreduce_threshold=_env_int(
                 "HIERARCHICAL_ALLREDUCE_THRESHOLD", 64 * 1024),
+            cross_host_compression=_parse_compression(
+                _env("CROSS_HOST_COMPRESSION")),
+            compression_residual_buckets=max(
+                1, _env_int("COMPRESSION_RESIDUAL_BUCKETS", 64)),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             num_streams=_env_int("NUM_STREAMS", 1),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),

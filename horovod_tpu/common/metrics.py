@@ -92,10 +92,18 @@ NAMES: Dict[str, Tuple[str, str]] = {
         "histogram", "dispatch-to-completion latency of one negotiated "
                      "group, labeled op + pow2 size_class bytes"),
     "mh_bus_bytes_total": (
-        "counter", "payload bytes submitted to the cross-host "
-                   "collective, labeled op + path (hier|flat)"),
+        "counter", "WIRE bytes submitted to the cross-host collective "
+                   "(post-compression when a codec is active, payload "
+                   "bytes otherwise), labeled op + path (hier|flat)"),
     "mh_collective_path_total": (
         "counter", "collective executions by op + path (hier|flat)"),
+    "mh_compressed_collectives_total": (
+        "counter", "cross-host collectives whose wire leg rode a "
+                   "compression codec, labeled op + codec"),
+    "mh_compression_ratio": (
+        "gauge", "payload-to-wire byte ratio of the most recent "
+                 "compressed cross-host collective, labeled op + "
+                 "codec (4.0 = int8 from f32, incl. scale overhead)"),
     # -- runner control plane (r8 retry/backoff layer) --
     "rpc_attempts_total": (
         "counter", "control-plane RPC attempts (including retries)"),
@@ -344,6 +352,19 @@ def metrics_snapshot() -> Dict[str, Any]:
     Works before/without ``hvd.init()`` — the registry is process-local
     and always on."""
     return snapshot()
+
+
+def series_sum(name: str, **labels) -> float:
+    """Sum of one family's series values whose labels match ``labels``
+    (a subset match) — the one snapshot-reading convenience for
+    benches and tests, so the snapshot schema is consumed in exactly
+    one place."""
+    fam = snapshot().get(name)
+    if not fam:
+        return 0.0
+    return sum(row.get("value", 0.0) for row in fam.get("series", ())
+               if all(row.get("labels", {}).get(k) == v
+                      for k, v in labels.items()))
 
 
 # -- Prometheus text rendering --------------------------------------------

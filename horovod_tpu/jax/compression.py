@@ -3,17 +3,50 @@
 Equivalent of the reference's ``horovod/torch/compression.py`` /
 ``horovod/tensorflow/compression.py``: a ``Compression`` namespace with
 ``none`` and ``fp16`` compressors whose ``compress``/``decompress`` bracket
-the collective.  TPU addition: ``bf16``, the native low-precision format of
-the MXU/ICI (fp16 is kept for API parity; bf16 is what you want on TPU).
+the collective.  TPU additions: ``bf16``, the native low-precision format
+of the MXU/ICI (fp16 is kept for API parity; bf16 is what you want on
+TPU), plus the r12 quantizing wire codecs — ``int8`` (symmetric per-chunk
+absmax) and ``fp8`` (e4m3 cast) — and the :class:`ErrorFeedback` wrapper
+that makes quantized *reductions* convergent by folding the quantization
+error back into the next step (Seide et al. 1-bit SGD / EF-SGD lineage).
+
+The quantizing codecs are what ``HOROVOD_CROSS_HOST_COMPRESSION`` puts on
+the cross-host leg of the hierarchical collectives (``ops/multihost.py``):
+upstream compresses the WHOLE tensor at the framework layer; this repo
+compresses only the DCN-bound leg and keeps in-host ICI full precision.
+All compressors here are stateless pure functions of their inputs (usable
+eagerly or inside jit); only :class:`ErrorFeedback` carries state (the
+per-bucket residual pytree), which is why it is a wrapper, not a
+``Compressor``.
 """
 
 from __future__ import annotations
 
+import collections
+
 import jax.numpy as jnp
+
+# e4m3 is the jax wire dtype for Compression.fp8; older jax has no
+# float8 dtypes — FP8Compressor then fails loudly (and the multihost
+# codec resolver falls back to a bf16 wire with an ERROR log).
+FP8_WIRE_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+# Largest finite e4m3 value: casting past it yields NaN (ml_dtypes
+# saturating-cast semantics do NOT apply through astype), so any
+# engine-side fp8 wire must absmax-scale into this range first.
+E4M3_MAX = 448.0
 
 
 class Compressor:
     """Interface: compress(tensor) -> (compressed, ctx); decompress undoes."""
+
+    #: True when the wire tensor may be handed to a plain summing
+    #: collective (the framework bracket's compress -> allreduce ->
+    #: decompress contract).  The quantizing codecs are NOT: int8
+    #: addition wraps past +-127 and each rank's absmax scale differs,
+    #: so summing raw wire tensors is silent corruption — they belong
+    #: on the engine's cross-host leg (HOROVOD_CROSS_HOST_COMPRESSION),
+    #: which dequantizes before any arithmetic.
+    reduce_safe = True
 
     @staticmethod
     def compress(tensor):
@@ -22,6 +55,24 @@ class Compressor:
     @staticmethod
     def decompress(tensor, ctx):
         raise NotImplementedError
+
+
+def check_reduce_safe(compression, where: str):
+    """Reject a quantizing codec handed to a bracket that sums wire
+    tensors across ranks — loudly, before any collective runs."""
+    if not getattr(compression, "reduce_safe", True):
+        label = getattr(compression, "__name__",
+                        type(compression).__name__)
+        raise ValueError(
+            "%s cannot use %s: the %s bracket allreduces the WIRE "
+            "tensor, and quantized wire tensors must never meet "
+            "reduction arithmetic (int8 wraps, per-rank scales "
+            "diverge).  Set HOROVOD_CROSS_HOST_COMPRESSION=%s for "
+            "quantized reductions (engine-side, dequantized before "
+            "arithmetic, with error feedback), or pass "
+            "Compression.fp16/bf16 here." % (
+                where, label, where,
+                getattr(compression, "codec_name", "int8")))
 
 
 class NoneCompressor(Compressor):
@@ -39,10 +90,12 @@ class _CastCompressor(Compressor):
 
     @classmethod
     def compress(cls, tensor):
-        ctx = tensor.dtype
-        if jnp.issubdtype(ctx, jnp.floating):
-            return tensor.astype(cls.wire_dtype), ctx
-        return tensor, ctx
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(cls.wire_dtype), tensor.dtype
+        # Integer/bool tensors ride the wire untouched: ctx None marks
+        # the no-op so decompress is a TRUE identity (a dtype ctx here
+        # would re-cast — a silent copy — on the way out).
+        return tensor, None
 
     @classmethod
     def decompress(cls, tensor, ctx):
@@ -57,10 +110,191 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class Int8Quantizer(Compressor):
+    """Symmetric per-chunk absmax int8 quantization (4x wire vs fp32).
+
+    Chunks are the rows of the leading axis: an ``[k, m]`` input gets k
+    independent scales (the hierarchical engine stages one row per
+    local chip, so each chip's cross-host wire carries its own scale);
+    a 1-D tensor quantizes as one chunk.  ``ctx`` is ``(scale, dtype)``
+    with ``scale`` broadcastable against the wire tensor; integer and
+    bool tensors pass through with ``ctx=None`` (quantizing an already-
+    discrete payload would corrupt it for nothing).
+
+    Stateless and jit-compatible; math runs in f32 regardless of the
+    payload dtype so bf16 payloads don't lose the absmax to rounding.
+    """
+
+    reduce_safe = False
+    codec_name = "int8"
+
+    @staticmethod
+    def compress(tensor):
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        xf = tensor.astype(jnp.float32)
+        axes = tuple(range(1, xf.ndim)) if xf.ndim > 1 else None
+        amax = (jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+                if axes else jnp.max(jnp.abs(xf)))
+        # All-zero chunks keep scale 1 so q = 0 round-trips to 0
+        # without a 0/0.
+        scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+        q = jnp.clip(jnp.rint(xf / scale), -127, 127).astype(jnp.int8)
+        return q, (scale, tensor.dtype)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        scale, dtype = ctx
+        return (tensor.astype(jnp.float32) * scale).astype(dtype)
+
+
+class FP8Compressor(Compressor):
+    """e4m3 cast (4x wire vs fp32, ~2 decimal digits of mantissa).
+
+    Plain dtype cast — no scales — so it is exactly the
+    :class:`_CastCompressor` contract on jax versions that ship
+    ``float8_e4m3fn``; older jax fails LOUDLY here (and the multihost
+    codec resolver downgrades to a bf16 wire with an ERROR log instead
+    of silently shipping full precision).
+
+    ``reduce_safe = False``: e4m3 has ~2 significant digits — summing
+    wire tensors across ranks compounds the cast error per rank and
+    overflows past +-448; like int8 it belongs on the engine's
+    dequantize-first cross-host leg.
+    """
+
+    reduce_safe = False
+    codec_name = "fp8"
+
+    @staticmethod
+    def compress(tensor):
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        if FP8_WIRE_DTYPE is None:
+            raise RuntimeError(
+                "Compression.fp8 needs jax.numpy.float8_e4m3fn, which "
+                "this jax version does not provide; use int8 or bf16 "
+                "wire compression instead")
+        return tensor.astype(FP8_WIRE_DTYPE), tensor.dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class ScaledFP8Quantizer(Compressor):
+    """Per-chunk absmax-scaled e4m3 — the ENGINE's fp8 wire.
+
+    The plain-cast :class:`FP8Compressor` NaNs past ±448 (e4m3's
+    finite range); scaling each chunk's absmax onto :data:`E4M3_MAX`
+    guarantees in-range representation for any payload and buys the
+    full mantissa near the top of the range.  Chunk semantics, ctx
+    shape, and jit-compatibility match :class:`Int8Quantizer` exactly,
+    so the two are interchangeable at the engine's quantize seams
+    (leg-1 eager encode AND the in-program leg-2 requantize)."""
+
+    reduce_safe = False
+    codec_name = "fp8"
+
+    @staticmethod
+    def compress(tensor):
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        if FP8_WIRE_DTYPE is None:
+            raise RuntimeError(
+                "fp8 wire compression needs jax.numpy.float8_e4m3fn, "
+                "which this jax version does not provide")
+        xf = tensor.astype(jnp.float32)
+        axes = tuple(range(1, xf.ndim)) if xf.ndim > 1 else None
+        amax = (jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+                if axes else jnp.max(jnp.abs(xf)))
+        scale = jnp.where(amax > 0, amax, 1.0) / E4M3_MAX
+        q = (xf / scale).astype(FP8_WIRE_DTYPE)
+        return q, (scale, tensor.dtype)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        scale, dtype = ctx
+        return (tensor.astype(jnp.float32) * scale).astype(dtype)
+
+
+class ErrorFeedback:
+    """Residual-carrying wrapper making quantized reductions convergent.
+
+    EF-SGD / 1-bit-Adam scheme: each step compresses ``tensor +
+    residual`` and keeps ``residual = compensated - dequantized(sent)``
+    for the next step, so quantization error is *delayed*, never lost —
+    a gradient component too small for the current absmax scale
+    accumulates until it fires.  Residuals are keyed per BUCKET (the
+    multihost engine keys by op + padded size class + dtype, matching
+    its fusion-buffer granularity) and held in f32 so bf16 payloads
+    don't round the correction away; an LRU cap bounds the state on
+    shape-churning jobs (``HOROVOD_COMPRESSION_RESIDUAL_BUCKETS``).
+
+    Only meaningful for linear reductions (Sum/Average) — min/max/
+    product and the data-movement collectives get plain quantize/
+    dequantize from the wrapped compressor.
+    """
+
+    def __init__(self, compressor: Compressor, max_buckets: int = 64):
+        self.compressor = compressor
+        self.max_buckets = max(int(max_buckets), 1)
+        # A summing bracket is exactly as safe as the wrapped wire:
+        # EF(int8) must be rejected by check_reduce_safe like bare
+        # int8 (the residual discipline does not make int8 addition
+        # stop wrapping), while EF(fp16) stays accepted.
+        self.reduce_safe = getattr(compressor, "reduce_safe", True)
+        self.codec_name = getattr(compressor, "codec_name", "int8")
+        self._residuals: "collections.OrderedDict" = \
+            collections.OrderedDict()
+
+    def compress(self, tensor, bucket=None):
+        """Compress ``tensor + residual[bucket]``, updating the
+        residual; returns ``(wire, ctx)`` like a Compressor."""
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            # Discrete payloads pass through the wrapped compressor's
+            # no-op path untouched — lifting them to f32 here would
+            # quantize (corrupt) data the codec contract exempts.
+            return self.compressor.compress(tensor)
+        key = bucket if bucket is not None else (
+            tuple(tensor.shape), str(tensor.dtype))
+        comp = tensor.astype(jnp.float32)
+        res = self._residuals.pop(key, None)
+        if res is not None and res.shape == comp.shape:
+            comp = comp + res
+        wire, ctx = self.compressor.compress(comp)
+        if ctx is None:
+            # Pass-through payload (integer): nothing was lost, keep
+            # no residual.
+            return wire, ctx
+        # ``comp`` was lifted to f32, so the inner ctx records f32 as
+        # the restore dtype; rewrite it to the CALLER's dtype so
+        # decompress round-trips bf16 -> bf16, not bf16 -> f32.
+        ctx = ((ctx[0], tensor.dtype) if isinstance(ctx, tuple)
+               else tensor.dtype)
+        sent = self.compressor.decompress(wire, ctx)
+        self._residuals[key] = comp - sent.astype(jnp.float32)
+        while len(self._residuals) > self.max_buckets:
+            self._residuals.popitem(last=False)
+        return wire, ctx
+
+    def decompress(self, tensor, ctx):
+        return self.compressor.decompress(tensor, ctx)
+
+    def reset(self):
+        self._residuals.clear()
+
+
 class Compression:
     """Reference-parity namespace: ``Compression.none``, ``Compression.fp16``
-    (+ TPU-native ``Compression.bf16``)."""
+    (+ TPU-native ``Compression.bf16``, quantizing ``int8``/``fp8``)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Quantizer
+    fp8 = FP8Compressor

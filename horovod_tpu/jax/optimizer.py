@@ -52,6 +52,8 @@ def allreduce_gradients(grads, op: str = AVERAGE,
     ``axis_name=None`` (eager, multi-process tcp world): engine allreduce
     per leaf, fused by the background cycle.
     """
+    from .compression import check_reduce_safe
+    check_reduce_safe(compression, "allreduce_gradients")
     if isinstance(axis_name, (tuple, list)):
         if compression is not Compression.none:
             raise ValueError(

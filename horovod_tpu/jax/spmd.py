@@ -39,6 +39,8 @@ def allreduce(x, op: str = AVERAGE, axis_name: str = DEFAULT_AXIS,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=Compression.none):
     """Cross-replica reduce inside an SPMD program."""
+    from .compression import check_reduce_safe
+    check_reduce_safe(compression, "spmd.allreduce")
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     wire, ctx = compression.compress(x)

@@ -31,6 +31,7 @@ the reference's background thread plays for NCCL kernels).
 
 from __future__ import annotations
 
+import collections
 import logging
 import queue as queue_mod
 import threading
@@ -86,14 +87,90 @@ def _pow2_class(nbytes: int) -> str:
     return str(1 << (n - 1).bit_length())
 
 
-def _count_path(op: str, nbytes: int, hier: bool):
+def _count_path(op: str, nbytes: int, hier: bool, codec=None,
+                wire_bytes=None):
     """Path attribution for one executed collective: which plane moved
     the bytes (hier = proc x local mesh, flat = one-device-per-process)
-    and how many payload bytes it was handed (pre-padding)."""
+    and what actually hit the WIRE.  ``mh_bus_bytes_total`` is a
+    wire-bytes counter: with a cross-host codec active it records the
+    compressed ``wire_bytes`` (payload elements at the wire itemsize
+    plus scale overhead), otherwise the pre-padding payload bytes —
+    the self-attribution the BENCH compression A/B reads."""
     path = "hier" if hier else "flat"
     metrics.counter("mh_collective_path_total", op=op, path=path).inc()
+    wire = (int(wire_bytes) if codec is not None and wire_bytes
+            else max(int(nbytes), 0))
     metrics.counter("mh_bus_bytes_total", op=op, path=path).inc(
-        max(int(nbytes), 0))
+        max(wire, 0))
+    if codec is not None and wire_bytes:
+        metrics.counter("mh_compressed_collectives_total", op=op,
+                        codec=codec.name).inc()
+        metrics.gauge("mh_compression_ratio", op=op,
+                      codec=codec.name).set(
+            round(max(int(nbytes), 0) / float(wire_bytes), 4))
+
+
+class _WireCodec:
+    """Resolved cross-host wire codec (HOROVOD_CROSS_HOST_COMPRESSION):
+    ``kind`` 'cast' rides the existing cross-host legs natively in the
+    narrower ``wire`` dtype (fp16/bf16 arithmetic is well-defined on
+    every backend); ``kind`` 'quant' (int8/fp8) never does arithmetic
+    in the wire dtype — wire payloads move via exchange legs (two-phase
+    reduce-scatter/all-gather for allreduce, masked byte-psum for
+    broadcast, all_to_all/all_gather with per-sender scales elsewhere)
+    and dequantize to f32 on the far side."""
+
+    __slots__ = ("name", "kind", "wire")
+
+    def __init__(self, name: str, kind: str, wire):
+        self.name = name
+        self.kind = kind
+        self.wire = np.dtype(wire)
+
+
+def _resolve_codec(name: str) -> Optional[_WireCodec]:
+    """Config codec string -> _WireCodec (None for 'none').  fp8 on a
+    jax without float8 dtypes downgrades LOUDLY to a bf16 wire (2x,
+    not 4x) instead of silently shipping full precision."""
+    import jax.numpy as jnp
+    if name in (None, "", "none"):
+        return None
+    if name == "fp16":
+        return _WireCodec("fp16", "cast", np.float16)
+    if name == "bf16":
+        return _WireCodec("bf16", "cast", jnp.bfloat16)
+    if name == "int8":
+        return _WireCodec("int8", "quant", np.int8)
+    if name == "fp8":
+        from ..jax.compression import FP8_WIRE_DTYPE
+        if FP8_WIRE_DTYPE is None:
+            LOG.error(
+                "HOROVOD_CROSS_HOST_COMPRESSION=fp8: this jax version "
+                "has no float8_e4m3fn dtype; falling back to a bf16 "
+                "wire (2x reduction instead of 4x)")
+            return _WireCodec("fp8-as-bf16", "cast", jnp.bfloat16)
+        return _WireCodec("fp8", "quant", FP8_WIRE_DTYPE)
+    raise ValueError("unknown cross-host compression codec %r" % name)
+
+
+def _axis0_reduce(deq, red_op, size: int):
+    """Reduce f32 dequantized contributions [members, n] -> [n] per
+    the negotiated op (AVERAGE divides by the full member count, like
+    the uncompressed planes; join cannot reach the compressed leg)."""
+    import jax.numpy as jnp
+    if red_op in (SUM, AVERAGE):
+        r = jnp.sum(deq, axis=0)
+        if red_op == AVERAGE:
+            r = r / size
+    elif red_op == MIN:
+        r = jnp.min(deq, axis=0)
+    elif red_op == MAX:
+        r = jnp.max(deq, axis=0)
+    elif red_op == PRODUCT:
+        r = jnp.prod(deq, axis=0)
+    else:
+        raise NotImplementedError(red_op)
+    return r
 
 
 def _chunked_segments(p, n_items, item_start, item_valid, bc, k):
@@ -216,6 +293,39 @@ class GlobalMeshCollectives:
             self.mesh2 = Mesh(devs2, ("proc", "local"))
             self.local_devices = (list(devs2[self.my_idx])
                                   if self.my_idx >= 0 else [])
+        # Cross-host wire codec (r12): consulted at the SAME gate as
+        # _hier_eligible — only the hier plane has a distinct DCN leg
+        # to compress; in-host reassembly stays in the payload dtype.
+        # Reduce ops (Sum/Average) go through error-feedback residuals
+        # keyed per bucket so quantization error is delayed, not lost.
+        self._codec = (_resolve_codec(cfg.cross_host_compression)
+                       if self.local_size > 1 else None)
+        if (self._codec is None
+                and cfg.cross_host_compression != "none"):
+            LOG.warning(
+                "HOROVOD_CROSS_HOST_COMPRESSION=%s is set but the "
+                "hierarchical plane is unavailable (one local device, "
+                "or mode 'off'): payloads stay full precision",
+                cfg.cross_host_compression)
+        self._quantizer = None
+        self._ef = None
+        if self._codec is not None and self._codec.kind == "quant":
+            # fp8 uses the absmax-SCALED e4m3 quantizer here, not the
+            # framework-surface plain cast: an unscaled cast NaNs past
+            # +-448, and the engine must be range-safe for any payload.
+            from ..jax.compression import (ErrorFeedback, Int8Quantizer,
+                                           ScaledFP8Quantizer)
+            self._quantizer = (Int8Quantizer if self._codec.name == "int8"
+                               else ScaledFP8Quantizer)
+            self._ef = ErrorFeedback(self._quantizer,
+                                     cfg.compression_residual_buckets)
+        # Leg-2 (post-reduce) error-feedback residuals of the two-phase
+        # quantized allreduce: mesh-sharded device arrays carried across
+        # steps as donated program inputs/outputs, LRU-capped like the
+        # eager residual buckets.  Executor-thread only.
+        self._res2: "collections.OrderedDict" = collections.OrderedDict()
+        self._res2_cap = max(int(getattr(
+            cfg, "compression_residual_buckets", 64)), 1)
         # Capacity-bounded LRU like the in-process engine (the
         # reference's HOROVOD_CACHE_CAPACITY): long jobs with varying
         # shapes must not grow compiled programs without bound.
@@ -346,21 +456,102 @@ class GlobalMeshCollectives:
         """Stage ``segments`` as this process's (1, k, chunk) slab of a
         [size, k, chunk] array over the proc x local mesh: the packed
         flat [k*chunk] buffer splits j-major, chunk j committed to
-        local device j (one device-to-device put per chip; numpy
-        payloads cross the host once inside ``_pack_flat``)."""
+        local device j via ``_stage_hier_rows`` (one device-to-device
+        put per chip; numpy payloads cross the host once inside
+        ``_pack_flat``)."""
+        k = self.local_size
+        flat = self._pack_flat(segments, total, chunk * k, np_dtype)
+        return self._stage_hier_rows(flat.reshape(k, chunk))
+
+    def _wire_codec(self, np_dtype, red_op=None) -> Optional[_WireCodec]:
+        """The active cross-host codec for a hier-path payload of
+        ``np_dtype``: the configured codec when the payload is floating
+        and the wire dtype is actually narrower; None otherwise (a
+        discrete payload would be corrupted, a same-width cast wins
+        nothing).  Product reductions are excluded from the QUANT
+        codecs: an element below its chunk's absmax/254 quantizes to
+        exactly 0 and zeroes the whole product — unbounded relative
+        error, unlike the scale/2-bounded Sum/Average/Min/Max cases."""
+        c = self._codec
+        if c is None:
+            return None
+        if red_op == PRODUCT and c.kind == "quant":
+            return None
+        import jax.numpy as jnp
+        dt = np.dtype(np_dtype)
+        if not jnp.issubdtype(dt, jnp.floating):
+            return None
+        if c.wire.itemsize >= dt.itemsize:
+            return None
+        return c
+
+    def _wire_nbytes(self, codec: _WireCodec, n_elems: int) -> int:
+        """Bytes this payload puts on the cross-host wire under
+        ``codec``: payload elements at the wire itemsize, plus the
+        per-chunk f32 absmax scales of the quantizing codecs (bounded
+        by two scale sets per local chunk — the two-phase allreduce
+        carries one per leg)."""
+        w = int(n_elems) * codec.wire.itemsize
+        if codec.kind == "quant":
+            w += self.local_size * 8
+        return w
+
+    def _stage_hier_rows(self, rows2d):  # graftlint: hot-path
+        """Stage an eagerly-encoded per-chunk [k, m] device array (row
+        j -> local device j) as this process's (1, k, m) slab of a
+        [size, k, m] proc x local array — the wire-staging seam: what
+        lands here is exactly what crosses DCN."""
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         k = self.local_size
-        flat = self._pack_flat(segments, total, chunk * k, np_dtype)
+        m = int(rows2d.shape[1])
         rows = [jax.device_put(
-            jax.lax.slice_in_dim(flat, j * chunk, (j + 1) * chunk
-                                 ).reshape(1, 1, chunk), dev)
-                for j, dev in enumerate(self.local_devices)]
+            jax.lax.slice_in_dim(rows2d, j, j + 1).reshape(1, 1, m),
+            dev) for j, dev in enumerate(self.local_devices)]
         return jax.make_array_from_single_device_arrays(
-            (self.size, k, chunk),
+            (self.size, k, m),
             NamedSharding(self.mesh2, P("proc", "local")), rows)
+
+    def _quant_encode(self, flat, ef_key=None):  # graftlint: hot-path
+        """Eagerly encode a packed flat [k*m] buffer for the wire:
+        one row per local chip, quantized per row (absmax int8 /
+        absmax-scaled e4m3) — through the error-feedback residual
+        keyed by ``ef_key`` for the linear reduce ops, plain for data
+        movement.  Returns (wire [k, m], scales [k, 1] f32); the ones
+        fallback covers any scale-free ctx shape."""
+        import jax.numpy as jnp
+        rows = flat.reshape(self.local_size, -1)
+        if ef_key is not None and self._ef is not None:
+            wire, ctx = self._ef.compress(rows, bucket=ef_key)
+        else:
+            wire, ctx = self._quantizer.compress(rows)
+        if isinstance(ctx, tuple):
+            scales = ctx[0].astype(jnp.float32).reshape(
+                self.local_size, 1)
+        else:
+            scales = jnp.ones((self.local_size, 1), jnp.float32)
+        return wire, scales
+
+    def _wire_residual2(self, key, slice_n: int):
+        """Leg-2 residual carrier for the two-phase quantized
+        allreduce: the [size, k, slice_n] f32 array the previous step's
+        program emitted (donated back in this step), or zeros on first
+        touch / geometry change."""
+        import jax.numpy as jnp
+        arr = self._res2.get(key)
+        if arr is not None and arr.shape[2] == int(slice_n):
+            self._res2.move_to_end(key)
+            return arr
+        return self._stage_hier_rows(
+            jnp.zeros((self.local_size, int(slice_n)), jnp.float32))
+
+    def _store_residual2(self, key, arr):
+        self._res2[key] = arr
+        self._res2.move_to_end(key)
+        while len(self._res2) > self._res2_cap:
+            self._res2.popitem(last=False)
 
     def _compiled(self, key, build, example_args=None, notify=None):
         """``notify`` is the per-dispatch cold-compile callback,
@@ -444,7 +635,9 @@ class GlobalMeshCollectives:
 
     def fused_allreduce(self, payloads: Sequence, lengths: Sequence[int],
                         dtype, red_op: str = SUM, prescale: float = 1.0,
-                        postscale: float = 1.0, notify=None) -> List:  # graftlint: hot-path
+                        postscale: float = 1.0, notify=None,
+                        names: Optional[Sequence[str]] = None
+                        ) -> List:  # graftlint: hot-path
         """One compiled program reducing a negotiated fusion group.
 
         ``payloads[i]`` is this process's flat contribution for entry i
@@ -482,11 +675,16 @@ class GlobalMeshCollectives:
             # them.  Adasum is excluded — its combine is dot-product
             # based over the WHOLE vector, so per-chunk combines would
             # change the math (it stays on the one-device plane).
+            codec = self._wire_codec(dtype, red_op)
             _count_path("allreduce",
-                        lengths[0] * np.dtype(dtype).itemsize, True)
+                        lengths[0] * np.dtype(dtype).itemsize, True,
+                        codec,
+                        self._wire_nbytes(codec, lengths[0])
+                        if codec else None)
             return [self._hier_allreduce(
                 payloads[0], lengths[0], dtype, red_op, prescale,
-                postscale, notify)]
+                postscale, notify, codec,
+                names[0] if names else None)]
         key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),
                red_op, float(prescale), float(postscale))
         size = self.size
@@ -508,7 +706,8 @@ class GlobalMeshCollectives:
         return [self._replicated(o) for o in outs]
 
     def _hier_allreduce(self, p, n: int, dtype, red_op, prescale,
-                        postscale, notify=None):  # graftlint: hot-path
+                        postscale, notify=None, codec=None,
+                        ef_name=None):  # graftlint: hot-path
         """Hierarchical allreduce over the proc x local mesh — the
         reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` (NCCL
         reduce-scatter intra-node + cross-node allreduce + allgather,
@@ -531,20 +730,121 @@ class GlobalMeshCollectives:
         import jax
         from jax.sharding import PartitionSpec as P
 
+        import jax.numpy as jnp
+
         k = self.local_size
         chunk = -(-int(n) // k)
         padded = chunk * k
         np_dtype = np.dtype(dtype)
+        size = self.size
+        if codec is not None and codec.kind == "quant":
+            # Two-phase compressed exchange (the 1-bit-Adam scheme):
+            # leg 1 all_to_all's each chip's quantized chunk slices
+            # and dequant-reduces MY slice in f32 (a compressed
+            # reduce-scatter); leg 2 requantizes the reduced slice —
+            # through a SECOND error-feedback residual for the linear
+            # ops, carried across steps as a donated program
+            # input/output — and all_gathers it back (a compressed
+            # all-gather).  Per-chip DCN traffic is ~2*(p-1)/p wire
+            # bytes at ANY world size: the uncompressed psum's
+            # movement shape at 1/4 the byte width, never the O(p)
+            # blow-up of all-gathering the full wire payload.
+            chunk = -(-chunk // size) * size  # leg-1 slices split evenly
+            padded = chunk * k
+            slice_n = chunk // size
+            linear = red_op in (SUM, AVERAGE)
+            flat = self._pack_flat([(p, 0, int(n))], int(n), padded,
+                                   np_dtype)
+            # Residuals key by the tensor NAME when the caller has one
+            # (each named gradient keeps its OWN delayed error — EF
+            # theory wants per-tensor residuals); the packed fusion
+            # bucket has no stable name and falls back to its size
+            # class, the reference fusion-buffer granularity.
+            ef_key = (("allreduce", padded, str(np_dtype), ef_name)
+                      if linear else None)
+            wireq, scales = self._quant_encode(flat, ef_key)
+            qarr = self._stage_hier_rows(wireq)
+            sarr = self._stage_hier_rows(scales)
+            key = ("hier_allreduce", int(chunk), str(np_dtype), red_op,
+                   float(prescale), float(postscale), k, codec.name)
+
+            def _leg1(q, s):
+                # Compressed reduce-scatter: exchange wire slices,
+                # dequantize with per-sender scales, reduce in f32.
+                y = q[0, 0].reshape(size, slice_n)
+                w = jax.lax.all_to_all(y, "proc", split_axis=0,
+                                       concat_axis=0)  # [size, slice_n]
+                sg = jax.lax.all_gather(s[0, 0], "proc")   # [size, 1]
+                deq = self._scaled(w.astype(jnp.float32) * sg, prescale)
+                return self._scaled(
+                    _axis0_reduce(deq, red_op, size), postscale)
+
+            def _requant(rc):
+                # ONE quantization definition for both legs: the same
+                # jit-compatible quantizer that encoded leg 1 (1-D
+                # input = one chunk), so the two legs can never drift
+                # — and the fp8 path absmax-scales, never NaN-casting
+                # a reduced value past e4m3's +-448 range.
+                q2, ctx2 = self._quantizer.compress(rc)
+                return q2, ctx2[0].astype(jnp.float32)
+
+            def _leg2(q2, s2):
+                # Compressed all-gather of the reduced slices, then
+                # payload-dtype reassembly over in-host ICI.
+                g = jax.lax.all_gather(q2, "proc")  # [size, slice_n]
+                s2g = jax.lax.all_gather(s2.reshape(1), "proc")
+                out = (g.astype(jnp.float32) * s2g).reshape(
+                    chunk).astype(np_dtype)
+                return jax.lax.all_gather(out, "local", tiled=True)
+
+            if linear:
+                def build():
+                    def fn(q, s, res2):
+                        rc = _leg1(q, s) + res2[0, 0]
+                        q2, s2 = _requant(rc)
+                        nres = rc - q2.astype(jnp.float32) * s2
+                        return _leg2(q2, s2), nres[None, None]
+                    return self._collective_jit(
+                        fn, 3, (P(), P("proc", "local")),
+                        mesh=self.mesh2, in_spec=P("proc", "local"))
+
+                res2 = self._wire_residual2(ef_key, slice_n)
+                out_g, nres = self._compiled(
+                    key, build, (qarr, sarr, res2),
+                    notify)(qarr, sarr, res2)
+                self._store_residual2(ef_key, nres)
+                out = self._replicated(out_g)
+            else:
+                def build():
+                    def fn(q, s):
+                        q2, s2 = _requant(_leg1(q, s))
+                        return _leg2(q2, s2)
+                    return self._collective_jit(
+                        fn, 2, P(), mesh=self.mesh2,
+                        in_spec=P("proc", "local"))
+
+                out = self._replicated(
+                    self._compiled(key, build, (qarr, sarr),
+                                   notify)(qarr, sarr))
+            return out[:int(n)] if padded > n else out
+        # Cast codec (fp16/bf16): the staging pack casts to the wire
+        # dtype, the cross-host reduce runs natively in it, and the
+        # result returns to the payload dtype before the in-host
+        # reassembly leg.
+        stage_dtype = codec.wire if codec is not None else np_dtype
         garr = self._stage_hier([(p, 0, int(n))], int(n), chunk,
-                                np_dtype)
+                                stage_dtype)
 
         key = ("hier_allreduce", int(chunk), str(np_dtype), red_op,
-               float(prescale), float(postscale), k)
+               float(prescale), float(postscale), k,
+               codec.name if codec is not None else "none")
 
         def build():
             def fn(x):
                 r = self._reduce_block(x[0, 0], red_op, prescale,
                                        postscale, self.size)
+                if r.dtype != np_dtype:
+                    r = r.astype(np_dtype)
                 return jax.lax.all_gather(r, "local", tiled=True)
             return self._collective_jit(
                 fn, 1, P(), mesh=self.mesh2, in_spec=P("proc", "local"))
@@ -614,10 +914,12 @@ class GlobalMeshCollectives:
                      else np.asarray(local).astype(np.uint8))  # graftlint: disable=host-bounce issue=ISSUE-1 -- bool wire-cast; np branch reached only for host-typed inputs
         bucket = _size_class(n, wire.itemsize)
         hier = self._hier_eligible(n * wire.itemsize)
-        _count_path("broadcast", n * wire.itemsize, hier)
+        codec = self._wire_codec(wire) if hier else None
+        _count_path("broadcast", n * wire.itemsize, hier, codec,
+                    self._wire_nbytes(codec, n) if codec else None)
         if hier:
             out = self._hier_broadcast(local, n, bucket, wire, root_idx,
-                                       notify)
+                                       notify, codec)
         else:
             key = ("broadcast", str(wire), int(bucket), int(root_idx))
 
@@ -639,7 +941,7 @@ class GlobalMeshCollectives:
         return out.astype(jnp.bool_) if is_bool else out
 
     def _hier_broadcast(self, p, n: int, bucket: int, wire, root_idx,
-                        notify=None):  # graftlint: hot-path
+                        notify=None, codec=None):  # graftlint: hot-path
         """Broadcast over the proc x local mesh: the root's payload
         scatters into k chunks across its local chips (staging), each
         chunk rides a masked cross-host psum over that chip's own
@@ -655,7 +957,59 @@ class GlobalMeshCollectives:
 
         k = self.local_size
         chunk = -(-int(bucket) // k)
-        key = ("hier_broadcast", str(wire), int(chunk), int(root_idx), k)
+        segments = ([(p, 0, int(n))] if self.my_idx == root_idx else [])
+        if codec is not None and codec.kind == "quant":
+            # Data-movement op: plain quantize/dequantize (no error
+            # feedback — nothing is reduced, the error never
+            # compounds).  The root's quantized payload rides a masked
+            # psum over the wire BYTES (bitcast to u8: non-roots
+            # contribute exact zeros, so the byte sum IS the root's
+            # wire — no quantized arithmetic, any 1-byte wire dtype,
+            # and the uncompressed broadcast's ~2*(p-1)/p movement
+            # shape at 1/4 the byte width — never an O(p) full-wire
+            # all_gather).  The root's scale rides the same masked
+            # psum.
+            np_pay = np.dtype(wire)
+            wire_jnp = codec.wire
+            if self.my_idx == root_idx:
+                flat = self._pack_flat(segments, int(n), chunk * k,
+                                       np_pay)
+                wireq, scales = self._quant_encode(flat)
+            else:
+                # Non-roots contribute nothing: stage zero wire rows
+                # and unit scales directly instead of paying a full
+                # quantization pass over a zero buffer the in-program
+                # root mask discards anyway.
+                with jax.default_device(self.device):
+                    wireq = jnp.zeros((k, chunk), wire_jnp)
+                    scales = jnp.ones((k, 1), jnp.float32)
+            qarr = self._stage_hier_rows(wireq)
+            sarr = self._stage_hier_rows(scales)
+            key = ("hier_broadcast", str(wire), int(chunk),
+                   int(root_idx), k, codec.name)
+
+            def build():
+                def fn(q, s):
+                    idx = jax.lax.axis_index("proc")
+                    qv = jnp.where(idx == root_idx, q[0, 0],
+                                   jnp.zeros_like(q[0, 0]))
+                    sv = jnp.where(idx == root_idx, s[0, 0],
+                                   jnp.zeros_like(s[0, 0]))
+                    qb = jax.lax.psum(jax.lax.bitcast_convert_type(
+                        qv, jnp.uint8), "proc")
+                    qr = jax.lax.bitcast_convert_type(qb, wire_jnp)
+                    sr = jax.lax.psum(sv, "proc")      # [1] f32
+                    deq = (qr.astype(jnp.float32) * sr).astype(np_pay)
+                    return jax.lax.all_gather(deq, "local", tiled=True)
+                return self._collective_jit(fn, 2, P(), mesh=self.mesh2,
+                                            in_spec=P("proc", "local"))
+
+            return self._replicated(
+                self._compiled(key, build, (qarr, sarr),
+                               notify)(qarr, sarr))
+        stage_dtype = codec.wire if codec is not None else wire
+        key = ("hier_broadcast", str(wire), int(chunk), int(root_idx), k,
+               codec.name if codec is not None else "none")
 
         def build():
             def fn(x):
@@ -663,13 +1017,14 @@ class GlobalMeshCollectives:
                 v = jnp.where(idx == root_idx, x[0, 0],
                               jnp.zeros_like(x[0, 0]))
                 r = jax.lax.psum(v, "proc")
+                if r.dtype != np.dtype(wire):
+                    r = r.astype(wire)
                 return jax.lax.all_gather(r, "local", tiled=True)
             return self._collective_jit(fn, 1, P(), mesh=self.mesh2,
                                         in_spec=P("proc", "local"))
 
-        segments = ([(p, 0, int(n))] if self.my_idx == root_idx else [])
         garr = self._stage_hier(
-            segments, int(n) if segments else 0, chunk, wire)
+            segments, int(n) if segments else 0, chunk, stage_dtype)
         return self._replicated(
             self._compiled(key, build, (garr,), notify)(garr))
 
@@ -699,10 +1054,12 @@ class GlobalMeshCollectives:
         size = self.size
         my_len = lens[self.my_idx]
         hier = self._hier_eligible(bucket * dtype.itemsize)
-        _count_path("allgather", my_len * dtype.itemsize, hier)
+        codec = self._wire_codec(dtype) if hier else None
+        _count_path("allgather", my_len * dtype.itemsize, hier, codec,
+                    self._wire_nbytes(codec, my_len) if codec else None)
         if hier:
             g = self._hier_allgather(local, my_len, bucket, dtype,
-                                     notify)
+                                     notify, codec)
         else:
             key = ("allgather", str(dtype), int(bucket))
 
@@ -722,7 +1079,7 @@ class GlobalMeshCollectives:
                 else parts[0])
 
     def _hier_allgather(self, p, my_len: int, bucket: int, np_dtype,
-                        notify=None):  # graftlint: hot-path
+                        notify=None, codec=None):  # graftlint: hot-path
         """Allgather over the proc x local mesh: each member's padded
         bucket splits into k chunks across its local chips; chunk j
         all_gathers over the ``proc`` axis from local device j (every
@@ -738,18 +1095,50 @@ class GlobalMeshCollectives:
         k = self.local_size
         chunk = -(-int(bucket) // k)
         size = self.size
-        key = ("hier_allgather", str(np_dtype), int(chunk), k)
+        if codec is not None and codec.kind == "quant":
+            # Data-movement op: plain quantize/dequantize.  The
+            # cross-host all_gather moves the WIRE payload (+ one f32
+            # scale per chunk); each member's rows dequantize with its
+            # own scale before the in-host reassembly leg.
+            np_d = np.dtype(np_dtype)
+            flat = self._pack_flat([(p, 0, int(my_len))], int(my_len),
+                                   chunk * k, np_d)
+            wireq, scales = self._quant_encode(flat)
+            qarr = self._stage_hier_rows(wireq)
+            sarr = self._stage_hier_rows(scales)
+            key = ("hier_allgather", str(np_dtype), int(chunk), k,
+                   codec.name)
+
+            def build():
+                def fn(q, s):
+                    g = jax.lax.all_gather(q[0, 0], "proc")   # [p,chunk]
+                    sg = jax.lax.all_gather(s[0, 0], "proc")  # [p,1]
+                    deq = (g.astype(jnp.float32) * sg).astype(np_d)
+                    gg = jax.lax.all_gather(deq, "local")  # [k,p,chunk]
+                    return jnp.swapaxes(gg, 0, 1).reshape(
+                        size, k * chunk)
+                return self._collective_jit(fn, 2, P(), mesh=self.mesh2,
+                                            in_spec=P("proc", "local"))
+
+            return self._replicated(
+                self._compiled(key, build, (qarr, sarr),
+                               notify)(qarr, sarr))
+        stage_dtype = codec.wire if codec is not None else np_dtype
+        key = ("hier_allgather", str(np_dtype), int(chunk), k,
+               codec.name if codec is not None else "none")
 
         def build():
             def fn(x):
                 g = jax.lax.all_gather(x[0, 0], "proc")  # [size, chunk]
+                if g.dtype != np.dtype(np_dtype):
+                    g = g.astype(np_dtype)
                 gg = jax.lax.all_gather(g, "local")      # [k, size, chunk]
                 return jnp.swapaxes(gg, 0, 1).reshape(size, k * chunk)
             return self._collective_jit(fn, 1, P(), mesh=self.mesh2,
                                         in_spec=P("proc", "local"))
 
         garr = self._stage_hier([(p, 0, int(my_len))], int(my_len),
-                                chunk, np_dtype)
+                                chunk, stage_dtype)
         return self._replicated(
             self._compiled(key, build, (garr,), notify)(garr))
 
@@ -785,11 +1174,15 @@ class GlobalMeshCollectives:
         offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)  # graftlint: disable=host-bounce issue=ISSUE-1 -- offsets over the negotiated splits row, never payload bytes
 
         hier = self._hier_eligible(size * block * dtype.itemsize)
+        codec = self._wire_codec(dtype) if hier else None
         _count_path("alltoall",
-                    int(offs[-1]) * telems * dtype.itemsize, hier)
+                    int(offs[-1]) * telems * dtype.itemsize, hier,
+                    codec,
+                    self._wire_nbytes(codec, int(offs[-1]) * telems)
+                    if codec else None)
         if hier:
             w, stride = self._hier_alltoall(local, sm, offs, telems,
-                                            block, dtype, notify)
+                                            block, dtype, notify, codec)
         else:
             stride = block
             key = ("alltoall", str(dtype), int(block))
@@ -827,7 +1220,8 @@ class GlobalMeshCollectives:
         return out, recv_splits
 
     def _hier_alltoall(self, p, sm, offs, telems: int, block: int,
-                       np_dtype, notify=None):  # graftlint: hot-path
+                       np_dtype, notify=None,
+                       codec=None):  # graftlint: hot-path
         """Alltoall over the proc x local mesh: every destination block
         splits into k chunks across the local chips; local device j
         runs the cross-host ``all_to_all`` for chunk j of every block
@@ -844,13 +1238,53 @@ class GlobalMeshCollectives:
         blockk = bc * k
         size = self.size
         my_idx = self.my_idx
-        key = ("hier_alltoall", str(np_dtype), int(bc), k)
+        segments = _chunked_segments(
+            p, size, [int(offs[m]) * telems for m in range(size)],
+            [int(sm[my_idx, m]) * telems for m in range(size)], bc, k)
+        if codec is not None and codec.kind == "quant":
+            # Data-movement op: plain quantize/dequantize.  The
+            # cross-host all_to_all exchanges the WIRE payload; each
+            # received row m dequantizes with sender m's this-chunk
+            # scale (one scalar all_gather rides along) before the
+            # in-host reassembly leg.
+            np_d = np.dtype(np_dtype)
+            flat = self._pack_flat(segments, size * blockk,
+                                   size * bc * k, np_d)
+            wireq, scales = self._quant_encode(flat)
+            qarr = self._stage_hier_rows(wireq)
+            sarr = self._stage_hier_rows(scales)
+            key = ("hier_alltoall", str(np_dtype), int(bc), k,
+                   codec.name)
+
+            def build():
+                def fn(q, s):
+                    y = q[0, 0].reshape(size, bc)
+                    w = jax.lax.all_to_all(y, "proc", split_axis=0,
+                                           concat_axis=0)  # [size, bc]
+                    sg = jax.lax.all_gather(s[0, 0], "proc")  # [size,1]
+                    deq = (w.astype(jnp.float32) * sg).astype(np_d)
+                    ww = jax.lax.all_gather(deq, "local")  # [k,size,bc]
+                    return jnp.swapaxes(ww, 0, 1).reshape(
+                        1, size * blockk)
+                return self._collective_jit(fn, 2, P("proc"),
+                                            mesh=self.mesh2,
+                                            in_spec=P("proc", "local"))
+
+            w = self._my_row(
+                self._compiled(key, build, (qarr, sarr),
+                               notify)(qarr, sarr))
+            return w, blockk
+        stage_dtype = codec.wire if codec is not None else np_dtype
+        key = ("hier_alltoall", str(np_dtype), int(bc), k,
+               codec.name if codec is not None else "none")
 
         def build():
             def fn(x):
                 y = x[0, 0].reshape(size, bc)
                 w = jax.lax.all_to_all(y, "proc", split_axis=0,
                                        concat_axis=0)   # [size, bc]
+                if w.dtype != np.dtype(np_dtype):
+                    w = w.astype(np_dtype)
                 ww = jax.lax.all_gather(w, "local")     # [k, size, bc]
                 return jnp.swapaxes(ww, 0, 1).reshape(
                     1, size * blockk)
@@ -858,17 +1292,14 @@ class GlobalMeshCollectives:
                                         mesh=self.mesh2,
                                         in_spec=P("proc", "local"))
 
-        segments = _chunked_segments(
-            p, size, [int(offs[m]) * telems for m in range(size)],
-            [int(sm[my_idx, m]) * telems for m in range(size)], bc, k)
         garr = self._stage_hier(segments, size * blockk, size * bc,
-                                np_dtype)
+                                stage_dtype)
         w = self._my_row(
             self._compiled(key, build, (garr,), notify)(garr))
         return w, blockk
 
-    def reducescatter(self, local, red_op: str = SUM,
-                      notify=None):  # graftlint: hot-path
+    def reducescatter(self, local, red_op: str = SUM, notify=None,
+                      name=None):  # graftlint: hot-path
         """Reduce then scatter dim-0 shards as real ``psum_scatter``
         HLO (uneven chunks follow the reference's earlier-ranks-larger
         split: each chunk is padded to the largest inside the program,
@@ -893,13 +1324,18 @@ class GlobalMeshCollectives:
         my_idx = self.my_idx
         hier = (red_op in (SUM, AVERAGE, MIN, MAX, PRODUCT)
                 and self._hier_eligible(size * seg * dtype.itemsize))
-        _count_path("reducescatter", d0 * telems * dtype.itemsize, hier)
+        codec = self._wire_codec(dtype, red_op) if hier else None
+        _count_path("reducescatter", d0 * telems * dtype.itemsize, hier,
+                    codec,
+                    self._wire_nbytes(codec, d0 * telems)
+                    if codec else None)
         if hier:
             # Adasum (and any other whole-vector combine) stays on the
             # one-device plane: per-chunk combines would change the
             # math — the ``_hier_allreduce`` exclusion.
             out = self._hier_reducescatter(local, rows, offs, telems,
-                                           seg, dtype, red_op, notify)
+                                           seg, dtype, red_op, notify,
+                                           codec, name)
             my_n = rows[my_idx] * telems
             return out[:my_n].reshape((rows[my_idx],) + trailing)
         key = ("reducescatter", str(dtype), int(seg), red_op)
@@ -943,8 +1379,8 @@ class GlobalMeshCollectives:
         return out[:my_n].reshape((rows[my_idx],) + trailing)
 
     def _hier_reducescatter(self, p, rows, offs, telems: int, seg: int,
-                            np_dtype, red_op,
-                            notify=None):  # graftlint: hot-path
+                            np_dtype, red_op, notify=None, codec=None,
+                            ef_name=None):  # graftlint: hot-path
         """Reducescatter over the proc x local mesh: every member
         segment splits into k chunks across the local chips; local
         device j reduces+scatters chunk j of every segment over the
@@ -960,7 +1396,48 @@ class GlobalMeshCollectives:
         k = self.local_size
         sc = -(-int(seg) // k)      # segment chunk per local chip
         size = self.size
-        key = ("hier_reducescatter", str(np_dtype), int(sc), red_op, k)
+        segments = _chunked_segments(
+            p, size, [int(offs[m]) * telems for m in range(size)],
+            [int(rows[m]) * telems for m in range(size)], sc, k)
+        if codec is not None and codec.kind == "quant":
+            # Only the cross-host REDUCE leg is compressed: the
+            # quantized member segments exchange via all_to_all (the
+            # reduce-scatter's wire movement), dequantize to f32 with
+            # per-sender scales, and reduce locally; the in-host
+            # reassembly all_gather stays in the payload dtype.
+            # Error feedback for the linear ops, plain otherwise.
+            np_d = np.dtype(np_dtype)
+            flat = self._pack_flat(segments, size * sc * k,
+                                   size * sc * k, np_d)
+            ef_key = (("reducescatter", size * sc * k, str(np_d),
+                       ef_name)
+                      if red_op in (SUM, AVERAGE) else None)
+            wireq, scales = self._quant_encode(flat, ef_key)
+            qarr = self._stage_hier_rows(wireq)
+            sarr = self._stage_hier_rows(scales)
+            key = ("hier_reducescatter", str(np_dtype), int(sc), red_op,
+                   k, codec.name)
+
+            def build():
+                def fn(q, s):
+                    y = q[0, 0].reshape(size, sc)
+                    w = jax.lax.all_to_all(y, "proc", split_axis=0,
+                                           concat_axis=0)  # [size, sc]
+                    sg = jax.lax.all_gather(s[0, 0], "proc")  # [size,1]
+                    deq = w.astype(jnp.float32) * sg
+                    r = _axis0_reduce(deq, red_op, size).astype(np_d)
+                    return jax.lax.all_gather(
+                        r, "local", tiled=True)[None]
+                return self._collective_jit(fn, 2, P("proc"),
+                                            mesh=self.mesh2,
+                                            in_spec=P("proc", "local"))
+
+            return self._my_row(
+                self._compiled(key, build, (qarr, sarr),
+                               notify)(qarr, sarr))
+        stage_dtype = codec.wire if codec is not None else np_dtype
+        key = ("hier_reducescatter", str(np_dtype), int(sc), red_op, k,
+               codec.name if codec is not None else "none")
 
         def build():
             def fn(x):
@@ -974,16 +1451,15 @@ class GlobalMeshCollectives:
                             else w // size
                 else:
                     w = alltoall_chunk_reduce(y, "proc", size, red_op)
+                if w.dtype != np.dtype(np_dtype):
+                    w = w.astype(np_dtype)
                 return jax.lax.all_gather(w, "local", tiled=True)[None]
             return self._collective_jit(fn, 1, P("proc"),
                                         mesh=self.mesh2,
                                         in_spec=P("proc", "local"))
 
-        segments = _chunked_segments(
-            p, size, [int(offs[m]) * telems for m in range(size)],
-            [int(rows[m]) * telems for m in range(size)], sc, k)
         garr = self._stage_hier(segments, size * sc * k, size * sc,
-                                np_dtype)
+                                stage_dtype)
         return self._my_row(
             self._compiled(key, build, (garr,), notify)(garr))
 
@@ -1623,7 +2099,8 @@ class MultihostEngine:
             lengths = [int(n) for n in g["aux_sizes"]]
             outs = mc.fused_allreduce(
                 [arr for _, arr in taken], lengths, dtype,
-                g["red_op"], g["prescale"], g["postscale"], notify)
+                g["red_op"], g["prescale"], g["postscale"], notify,
+                names=[e["name"] for e in g["entries"]])
             needs_host = any(arr is None or not _is_device_array(arr)
                              for _, arr in taken)
 
@@ -1667,7 +2144,8 @@ class MultihostEngine:
             return ((lambda: [(self._match(out, arr), recv)]),
                     needs_host, out)
         if op == "reducescatter":
-            out = mc.reducescatter(arr, g["red_op"], notify)
+            out = mc.reducescatter(arr, g["red_op"], notify,
+                                   name=g["entries"][0]["name"])
             return (lambda: [self._match(out, arr)]), needs_host, out
         raise NotImplementedError("multihost op %r" % op)
 

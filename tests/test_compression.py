@@ -1,0 +1,293 @@
+"""Unit tests for the r12 cross-host wire-compression plane: the
+quantizing codecs (int8 absmax / fp8 e4m3), the error-feedback wrapper
+(convergence on a deterministic toy where plain quantization provably
+stalls), the `_CastCompressor` integer no-op regression, and the codec
+resolution / env parsing seams.  The 2-proc hier e2e with the wire-byte
+accounting assertions lives in test_multihost.py (slow-marked)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.jax.compression import (FP8_WIRE_DTYPE, Compression,
+                                         ErrorFeedback, FP8Compressor,
+                                         FP16Compressor, Int8Quantizer,
+                                         ScaledFP8Quantizer)
+
+
+def test_int8_roundtrip_error_bound():
+    # Symmetric absmax quantization: |x - deq(q(x))| <= scale/2
+    # elementwise, scale = absmax/127.
+    rng = np.random.RandomState(7)
+    for shape in ((513,), (4, 1024), (3, 7, 11)):
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32) * 5.0)
+        q, ctx = Int8Quantizer.compress(x)
+        assert q.dtype == jnp.int8
+        scale, dtype = ctx
+        assert dtype == x.dtype
+        d = Int8Quantizer.decompress(q, ctx)
+        assert d.dtype == x.dtype
+        bound = np.broadcast_to(np.asarray(scale), shape) / 2 + 1e-6
+        assert np.all(np.abs(np.asarray(d) - np.asarray(x)) <= bound)
+
+
+def test_int8_per_chunk_scales():
+    # Rows of the leading axis are independent chunks: a tiny row next
+    # to a huge row keeps its own absmax, so its error bound is its OWN
+    # scale/2 — a global scale would wipe it out entirely.
+    x = jnp.asarray(np.stack([
+        np.linspace(-1e-3, 1e-3, 256),
+        np.linspace(-1e3, 1e3, 256)]).astype(np.float32))
+    q, (scale, _) = Int8Quantizer.compress(x)
+    assert scale.shape == (2, 1)
+    d = np.asarray(Int8Quantizer.decompress(q, (scale, x.dtype)))
+    assert np.max(np.abs(d[0] - np.asarray(x)[0])) <= 1e-3 / 254 + 1e-9
+    # With one global scale the small row would have quantized to all
+    # zeros (1e-3 << 1e3/254); per-chunk it round-trips.
+    assert np.any(np.asarray(q)[0] != 0)
+
+
+def test_int8_all_zero_chunk_roundtrips():
+    x = jnp.zeros((3, 64), jnp.float32)
+    q, ctx = Int8Quantizer.compress(x)
+    np.testing.assert_array_equal(
+        np.asarray(Int8Quantizer.decompress(q, ctx)), 0.0)
+
+
+def test_int8_integer_passthrough_identity():
+    x = jnp.arange(32, dtype=jnp.int32)
+    q, ctx = Int8Quantizer.compress(x)
+    assert ctx is None and q is x
+    assert Int8Quantizer.decompress(q, ctx) is x
+
+
+@pytest.mark.skipif(FP8_WIRE_DTYPE is None,
+                    reason="this jax has no float8_e4m3fn")
+def test_fp8_roundtrip_error_bound():
+    # e4m3: 3 mantissa bits -> relative error <= 2^-4 for values well
+    # inside the (+-448) range.
+    x = jnp.asarray(np.linspace(-100.0, 100.0, 1001,
+                                dtype=np.float32))
+    w, ctx = FP8Compressor.compress(x)
+    assert w.dtype == FP8_WIRE_DTYPE
+    d = np.asarray(FP8Compressor.decompress(w, ctx))
+    rel = np.abs(d - np.asarray(x)) / np.maximum(np.abs(np.asarray(x)),
+                                                 1e-6)
+    assert np.max(rel[np.abs(np.asarray(x)) > 1e-3]) <= 2 ** -4 + 1e-6
+
+
+@pytest.mark.skipif(FP8_WIRE_DTYPE is None,
+                    reason="this jax has no float8_e4m3fn")
+def test_scaled_fp8_is_range_safe_where_plain_cast_nans():
+    # e4m3's finite range ends at +-448 and astype past it yields NaN;
+    # the ENGINE's fp8 wire (ScaledFP8Quantizer) absmax-scales into
+    # range, so reduced values of any magnitude survive both wire legs.
+    x = jnp.asarray(np.linspace(-5000.0, 5000.0, 513,
+                                dtype=np.float32))
+    assert np.isnan(np.asarray(
+        FP8Compressor.compress(x)[0], dtype=np.float32)).any()
+    q, ctx = ScaledFP8Quantizer.compress(x)
+    d = np.asarray(ScaledFP8Quantizer.decompress(q, ctx))
+    assert np.all(np.isfinite(d))
+    # Bounded relative error (3 mantissa bits -> <= 2^-4) plus the
+    # scaled absolute floor near zero.
+    assert np.all(np.abs(d - np.asarray(x))
+                  <= np.abs(np.asarray(x)) * 0.07 + 2.0)
+
+
+def test_error_feedback_preserves_payload_dtype():
+    # The f32 lift inside ErrorFeedback must not leak: decompress
+    # returns the CALLER's dtype (bf16 in, bf16 out), like the bare
+    # compressors do.
+    for comp in (Int8Quantizer, FP16Compressor):
+        ef = ErrorFeedback(comp)
+        x = jnp.linspace(-1.0, 1.0, 16).astype(jnp.bfloat16)
+        w, ctx = ef.compress(x, bucket="dt")
+        assert ef.decompress(w, ctx).dtype == jnp.bfloat16
+
+
+def test_cast_compressor_integer_noop_regression():
+    # The pre-r12 bug: integer tensors passed through with ctx set to
+    # their dtype, so decompress re-cast (a silent copy) instead of
+    # being a true identity.  ctx must be None and decompress must
+    # return the SAME object.
+    x = jnp.arange(16, dtype=jnp.int64)
+    w, ctx = FP16Compressor.compress(x)
+    assert ctx is None
+    assert w is x
+    assert FP16Compressor.decompress(w, ctx) is x
+    # Floating tensors still cast + restore.
+    f = jnp.ones((4,), jnp.float32)
+    w, ctx = FP16Compressor.compress(f)
+    assert w.dtype == jnp.float16 and ctx == jnp.float32
+    assert FP16Compressor.decompress(w, ctx).dtype == jnp.float32
+
+
+def test_compression_namespace_exports():
+    assert Compression.int8 is Int8Quantizer
+    assert Compression.fp8 is FP8Compressor
+
+
+def test_quantizers_rejected_by_summing_brackets():
+    # The framework bracket (compress -> allreduce of the wire tensor
+    # -> decompress) sums wire tensors across ranks: int8 addition
+    # wraps and per-rank scales diverge, so handing it a quantizing
+    # codec must fail LOUDLY before any collective runs — the engine
+    # env (HOROVOD_CROSS_HOST_COMPRESSION) is the quantized-reduction
+    # path.
+    from horovod_tpu.jax.optimizer import allreduce_gradients
+    from horovod_tpu.jax.spmd import allreduce as spmd_allreduce
+    for codec in (Compression.int8, Compression.fp8):
+        with pytest.raises(ValueError,
+                           match="HOROVOD_CROSS_HOST_COMPRESSION"):
+            allreduce_gradients({"g": jnp.ones((4,))},
+                                compression=codec)
+        with pytest.raises(ValueError,
+                           match="HOROVOD_CROSS_HOST_COMPRESSION"):
+            spmd_allreduce(jnp.ones((4,)), compression=codec)
+    # The cast compressors stay accepted (reduce-safe by construction;
+    # outside any mesh axis the call fails later on the axis, not on
+    # the codec).
+    from horovod_tpu.jax.compression import check_reduce_safe
+    check_reduce_safe(Compression.fp16, "test")
+    check_reduce_safe(Compression.bf16, "test")
+    check_reduce_safe(Compression.none, "test")
+    # An ErrorFeedback WRAPPER is exactly as safe as its wrapped wire:
+    # EF(int8) must be rejected (residuals don't stop int8 addition
+    # from wrapping), EF(fp16) accepted.
+    with pytest.raises(ValueError,
+                       match="HOROVOD_CROSS_HOST_COMPRESSION"):
+        check_reduce_safe(ErrorFeedback(Int8Quantizer), "test")
+    check_reduce_safe(ErrorFeedback(FP16Compressor), "test")
+
+
+def test_error_feedback_recovers_quadratic_optimum_plain_int8_stalls():
+    # Deterministic 2-worker data-parallel toy: worker gradients are
+    # g_i = +-b + (w - c)/2 with a large pin component keeping BOTH
+    # workers' absmax (and so the int8 scale) constant.  The true
+    # summed gradient is (w - c): plain per-worker quantization rounds
+    # the useful signal away EXACTLY (|g/2| < scale/2, b on the quant
+    # grid), so w NEVER moves; error feedback accumulates the signal
+    # in the residual until it crosses a quantization step — driving w
+    # to the fp32 optimum.  No randomness anywhere: the contrast is
+    # exact, not statistical.
+    d = 16
+    c = np.linspace(0.02, 0.08, d).astype(np.float32)     # optimum
+    pin = np.float32(12.7)                                 # scale 0.1
+    b = np.full(d, 6.0, np.float32)                        # on-grid
+    lr = 0.02
+    steps = 400
+
+    def run(use_ef):
+        efs = [ErrorFeedback(Int8Quantizer) for _ in range(2)]
+        w = np.zeros(d, np.float32)
+        for _ in range(steps):
+            g = (w - c) / 2.0
+            total = np.zeros(d, np.float32)
+            for i, sign in enumerate((1.0, -1.0)):
+                vec = jnp.asarray(np.concatenate(
+                    [[pin], sign * b + g]).astype(np.float32))
+                if use_ef:
+                    q, ctx = efs[i].compress(vec, bucket="g")
+                else:
+                    q, ctx = Int8Quantizer.compress(vec)
+                deq = np.asarray(Int8Quantizer.decompress(q, ctx))
+                total += deq[1:]
+            w = w - lr * total
+        return w
+
+    w_plain = run(use_ef=False)
+    w_ef = run(use_ef=True)
+    # Plain int8: the stall is exact — not one step moved the weights.
+    np.testing.assert_array_equal(w_plain, np.zeros(d, np.float32))
+    # Error feedback: at the fp32 optimum within the EF offset bound
+    # (lr * residual cap), far inside the plain error.
+    assert np.max(np.abs(w_ef - c)) < 0.01, np.max(np.abs(w_ef - c))
+    assert np.max(np.abs(w_ef - c)) < 0.2 * np.max(np.abs(w_plain - c))
+
+
+def test_error_feedback_residual_telescopes():
+    # sum_t sent_t = T*x + res_0 - res_T: the mean of T compressed
+    # steps of a CONSTANT tensor converges on the tensor itself.
+    x = jnp.asarray(np.linspace(-1.0, 1.0, 512).astype(np.float32))
+    ef = ErrorFeedback(Int8Quantizer)
+    T = 32
+    acc = np.zeros(512, np.float64)
+    for _ in range(T):
+        q, ctx = ef.compress(x, bucket="t")
+        acc += np.asarray(ef.decompress(q, ctx), dtype=np.float64)
+    single_step_bound = 1.0 / 254.0
+    assert np.max(np.abs(acc / T - np.asarray(x))) < \
+        2 * single_step_bound / T + 1e-7
+
+
+def test_error_feedback_bucket_lru_cap():
+    ef = ErrorFeedback(Int8Quantizer, max_buckets=3)
+    for i in range(8):
+        ef.compress(jnp.ones((4,), jnp.float32) * (i + 1),
+                    bucket=("b", i))
+    assert len(ef._residuals) == 3
+    assert ("b", 7) in ef._residuals and ("b", 0) not in ef._residuals
+    ef.reset()
+    assert not ef._residuals
+
+
+def test_error_feedback_integer_passthrough_keeps_no_residual():
+    ef = ErrorFeedback(Int8Quantizer)
+    x = jnp.arange(8, dtype=jnp.int32)
+    q, ctx = ef.compress(x, bucket="i")
+    assert ctx is None and not ef._residuals
+
+
+def test_parse_compression_env():
+    from horovod_tpu.common.config import _parse_compression
+    assert _parse_compression(None) == "none"
+    assert _parse_compression("INT8") == "int8"
+    assert _parse_compression("bfloat16") == "bf16"
+    assert _parse_compression("fp8") == "fp8"
+    with pytest.raises(ValueError, match="CROSS_HOST_COMPRESSION"):
+        _parse_compression("int4")
+
+
+def test_codec_resolution():
+    from horovod_tpu.ops.multihost import _resolve_codec
+    assert _resolve_codec("none") is None
+    c = _resolve_codec("int8")
+    assert (c.kind, c.wire.itemsize) == ("quant", 1)
+    c = _resolve_codec("bf16")
+    assert (c.kind, c.wire.itemsize) == ("cast", 2)
+    with pytest.raises(ValueError):
+        _resolve_codec("zfp")
+
+
+def test_quant_codec_excludes_product():
+    # An element below its chunk's absmax/254 quantizes to exactly 0
+    # and zeroes a Product reduction — unbounded relative error, so
+    # the quant codecs must route Product to the uncompressed plane
+    # (the cast codecs keep it: bounded relative error).
+    import types
+
+    from horovod_tpu.ops.multihost import (PRODUCT, SUM,
+                                           GlobalMeshCollectives,
+                                           _resolve_codec)
+    wc = GlobalMeshCollectives._wire_codec
+    quant = types.SimpleNamespace(_codec=_resolve_codec("int8"))
+    assert wc(quant, np.float32, PRODUCT) is None
+    assert wc(quant, np.float32, SUM) is not None
+    cast = types.SimpleNamespace(_codec=_resolve_codec("bf16"))
+    assert wc(cast, np.float32, PRODUCT) is not None
+
+
+def test_codec_fp8_fallback_is_loud(monkeypatch, caplog):
+    # A jax without float8 dtypes must downgrade fp8 to a bf16 wire
+    # with an ERROR log — never silently ship full precision.
+    import logging
+
+    from horovod_tpu.jax import compression as comp
+    from horovod_tpu.ops import multihost as mh
+    monkeypatch.setattr(comp, "FP8_WIRE_DTYPE", None)
+    with caplog.at_level(logging.ERROR, logger="horovod_tpu"):
+        c = mh._resolve_codec("fp8")
+    assert c.kind == "cast" and c.wire.itemsize == 2
+    assert any("fp8" in rec.message for rec in caplog.records)

@@ -60,6 +60,30 @@ def test_multihost_single_local_device():
                                 extra_env={"TEST_HIER_OPS": "0"}))
 
 
+COMPRESSION_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "utils",
+    "multihost_compression_worker.py")
+
+
+@pytest.mark.slow
+def test_multihost_cross_host_compression_int8():
+    # ISSUE 7 acceptance: with HOROVOD_CROSS_HOST_COMPRESSION=int8 the
+    # hier legs of all five eager collectives put int8 (+ per-chunk f32
+    # scales) on the cross-host wire — numerics inside the quantization
+    # error bounds, error feedback canceling the error across repeated
+    # steps, and mh_bus_bytes_total / mh_compression_ratio asserting a
+    # >= 3.5x wire-byte reduction vs the uncompressed payload IN the
+    # worker (not just printed).  Sub-threshold payloads stay flat,
+    # uncompressed and bit-exact.  slow-marked per the r9/r10 gating
+    # pattern (CI perf-smoke runs it by node id); the 2-proc x 4-local
+    # world is the cheapest shape that exercises a real proc x local
+    # mesh.
+    _assert_ok(_spawn_multihost(2, extra_env={
+        "HOROVOD_CROSS_HOST_COMPRESSION": "int8",
+        "HVD_TPU_DUMP_HLO": "1",
+    }, worker=COMPRESSION_WORKER), marker="MH_COMPRESSION_OK")
+
+
 DP_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "utils", "multihost_dp_worker.py")
 
