@@ -284,6 +284,7 @@ class LintConfig:
         "horovod_tpu/common/metrics.py",
         "horovod_tpu/utils/timeline.py",
         "horovod_tpu/elastic/spill.py",
+        "horovod_tpu/elastic/scheduler.py",
         "horovod_tpu/runner/http_client.py",
     )
 

@@ -175,15 +175,27 @@ def init(devices: Optional[Sequence] = None,
             backends = order_from_env(backends, env_order)
         _state.op_manager = OpManager(backends)
 
-        _ps.reset_registry()
+        # Re-derive the registry against the NEW world instead of
+        # wiping it: sets registered before an elastic resize survive
+        # when their ranks still exist, and sets holding ranks beyond
+        # the new world are dropped loudly (their ids detach so stale
+        # handles raise instead of aliasing a recycled id).
+        _ps.reset_registry(world_size=_state.topology.size
+                           if _state.topology is not None else None)
         # Mark initialized BEFORE registering init-time process sets:
         # registration mirrors each set into the native core (tcp /
         # multihost modes), which the registry only does for an
         # initialized runtime.
         _state.initialized = True
+        _ps.remirror_registered_sets()
         if process_sets:
             for ps in process_sets:
-                _ps.add_process_set(ps)
+                # Idempotent across shutdown/re-init: registrations
+                # survive the cycle, so a set that re-derived into the
+                # new world is reused, not re-added (the duplicate-
+                # ranks check would otherwise fail the second init).
+                if _ps.registered_equivalent(ps) is None:
+                    _ps.add_process_set(ps)
         atexit.register(shutdown)
 
 
@@ -208,7 +220,11 @@ def shutdown():
             from .multihost import shutdown_jax_distributed
             shutdown_jax_distributed()
         get_timeline().shutdown()
-        _ps.reset_registry()
+        # The registry SURVIVES shutdown (its core mirrors died with
+        # the core): an elastic resize is shutdown()+init(), and the
+        # next init re-derives every registration against the new
+        # world, dropping dangling sets loudly and re-mirroring the
+        # survivors into the fresh core.
         _state.initialized = False
         _state.topology = None
 

@@ -134,6 +134,20 @@ SITES: Dict[str, str] = {
         "elastic spill, write: one durable commit spill for one rank "
         "(drop = the write is torn mid-blob, leaving a truncated file "
         "the CRC-checked restore must detect and skip)",
+    "scheduler.admit":
+        "pod scheduler, PodScheduler.admit entry: one tenant admission "
+        "request (drop = the admission is refused as if the pod had no "
+        "capacity; running tenants must be untouched by the refusal)",
+    "scheduler.preempt.notice":
+        "pod scheduler, the scheduler->tenant-driver preemption seam "
+        "(drop = the preemption order is lost this scheduling tick; "
+        "the replanner must re-issue it on the next tick — preemption "
+        "application is idempotent)",
+    "tenant.worker.die":
+        "elastic state, State.commit: the tenant-targeted kill seam "
+        "(die/wedge conditioned @tenant=<id> takes down one tenant's "
+        "workers at the commit boundary; isolation certification "
+        "asserts the OTHER tenants' worlds keep advancing)",
 }
 
 ACTIONS = ("delay", "drop", "die", "wedge")
@@ -152,6 +166,8 @@ DROP_SITES = frozenset({
     "worker.preempt.sigterm",
     "driver.drain.ack",
     "elastic.state.spill",
+    "scheduler.admit",
+    "scheduler.preempt.notice",
 })
 
 _COND_ENV = {
@@ -159,6 +175,11 @@ _COND_ENV = {
     "slot": "HOROVOD_ELASTIC_SLOT",
     "host": "HOROVOD_HOSTNAME",
     "epoch": "HOROVOD_ELASTIC_EPOCH",
+    # Multi-tenant pods: one env value travels to EVERY tenant's
+    # workers; @tenant= selects one tenant's processes (the scheduler
+    # exports HOROVOD_TENANT_ID per tenant) so isolation tests can
+    # kill tenant A while asserting tenant B's progress.
+    "tenant": "HOROVOD_TENANT_ID",
 }
 
 _DEFAULT_ARG = {"delay": 0.25, "die": 43.0, "wedge": 3600.0}

@@ -137,6 +137,21 @@ NAMES: Dict[str, Tuple[str, str]] = {
     "spill_crc_failures_total": (
         "counter", "spill/replica blobs rejected by CRC/length "
                    "validation (torn writes, bit flips)"),
+    # -- multi-tenant pod scheduler --
+    "tenant_slots": (
+        "gauge", "pod-scheduler slot bookkeeping per tenant, labeled "
+                 "tenant + state (allocated = slots currently assigned; "
+                 "pending = shortfall below the tenant's min_np while "
+                 "it waits for capacity)"),
+    "tenant_preemptions_total": (
+        "counter", "scheduler-initiated drain preemptions, labeled "
+                   "tenant (planned removals via the r10 drain path — "
+                   "never a blacklist entry or failure count)"),
+    "tenant_wait_seconds": (
+        "histogram", "time a tenant spent waiting for capacity, "
+                     "labeled tenant: admission->first slots and "
+                     "preemption->resume (the scheduler's fairness/"
+                     "latency series)"),
     # -- cross-cutting --
     "stall_detected_total": (
         "counter", "stall-inspector warnings (a collective outlived "

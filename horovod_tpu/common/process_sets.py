@@ -10,8 +10,11 @@ cache partition so compiled collectives are keyed per set.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Sequence
+
+LOG = logging.getLogger("horovod_tpu.process_sets")
 
 GLOBAL_PROCESS_SET_ID = 0
 
@@ -80,10 +83,50 @@ class ProcessSetTable:
             GLOBAL_PROCESS_SET_ID: global_process_set}
         self._next_id = 1
 
-    def reset(self, world_size: Optional[int] = None):
+    def reset(self, world_size: Optional[int] = None) -> List[ProcessSet]:
+        """Re-seed the table for a (possibly resized) world.
+
+        With ``world_size`` (the elastic re-init path) registered sets
+        are **re-derived** against the new world: a set whose ranks all
+        fit keeps its registration — ids renumbered densely in the
+        original registration order, which is identical on every rank
+        (the same-order registration contract), so ids still agree
+        across the world.  A set holding ranks ``>= world_size`` is
+        **dropped loudly**: an ERROR is logged and its
+        ``process_set_id`` becomes ``None``, so any further use raises
+        instead of silently aliasing a recycled id (the pre-fix
+        dangling-handle bug: after a shrink, a stale id could resolve
+        to a *different* set registered later under the same number).
+
+        Without ``world_size`` the table is wiped entirely, detaching
+        every registered set's id for the same loud-failure reason.
+
+        Returns the surviving sets ordered by their new ids.
+        """
         with self._lock:
+            old = [ps for psid, ps in sorted(self._by_id.items())
+                   if psid != GLOBAL_PROCESS_SET_ID]
             self._by_id = {GLOBAL_PROCESS_SET_ID: global_process_set}
             self._next_id = 1
+            survivors: List[ProcessSet] = []
+            for ps in old:
+                ps.process_set_id = None
+                if world_size is None:
+                    continue
+                if ps.ranks is not None and any(
+                        r < 0 or r >= world_size for r in ps.ranks):
+                    LOG.error(
+                        "process set with ranks %s dropped at world "
+                        "resize to %d: it holds ranks that no longer "
+                        "exist; re-register a set that fits the new "
+                        "world (stale handles to it now raise)",
+                        ps.ranks, world_size)
+                    continue
+                ps.process_set_id = self._next_id
+                self._by_id[ps.process_set_id] = ps
+                self._next_id += 1
+                survivors.append(ps)
+            return survivors
 
     def add(self, ps: ProcessSet) -> int:
         from . import basics
@@ -160,6 +203,22 @@ def add_process_set(process_set) -> ProcessSet:
     return process_set
 
 
+def registered_equivalent(process_set) -> Optional[ProcessSet]:
+    """The already-registered set with the same ranks, if any.  The
+    idempotent half of ``hvd.init(process_sets=...)`` across a
+    shutdown/re-init cycle: registrations now SURVIVE the cycle, so a
+    second init passing the same sets must reuse the survivors instead
+    of tripping the duplicate-ranks check mid-init."""
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    with _table._lock:
+        for existing in _table._by_id.values():
+            if existing == process_set and \
+                    existing.process_set_id != GLOBAL_PROCESS_SET_ID:
+                return existing
+    return None
+
+
 def remove_process_set(process_set: ProcessSet) -> bool:
     """Deregister (``hvd.remove_process_set`` parity). Returns success."""
     try:
@@ -177,5 +236,32 @@ def process_set_ids() -> List[int]:
     return _table.ids()
 
 
-def reset_registry():
-    _table.reset()
+def reset_registry(world_size: Optional[int] = None) -> List[ProcessSet]:
+    """Re-seed the registry (see :meth:`ProcessSetTable.reset`): with
+    ``world_size`` registered sets are re-derived against the new world
+    (the elastic-resize survival path), without it the table is wiped.
+    Returns the surviving sets."""
+    return _table.reset(world_size)
+
+
+def remirror_registered_sets():
+    """Mirror every surviving registered set into a freshly initialized
+    native core (the tcp/multihost re-init after an elastic resize):
+    registration order — and therefore ids — is identical on every
+    rank, so the core must hand back the registry's own ids."""
+    from . import basics
+    if not basics.is_initialized() or basics._controller_is_spmd():
+        return
+    for psid in _table.ids():
+        if psid == GLOBAL_PROCESS_SET_ID:
+            continue
+        ps = _table.get(psid)
+        if ps.ranks is None:
+            continue
+        core_id = basics._get_tcp_core().add_process_set(ps.ranks)
+        if core_id != psid:
+            raise RuntimeError(
+                "process-set id mismatch while re-mirroring after a "
+                "world resize: registry holds %d, native core assigned "
+                "%d; register sets in the same order on every rank"
+                % (psid, core_id))

@@ -31,6 +31,7 @@ const char* CondEnv(const std::string& key) {
   if (key == "slot") return "HOROVOD_ELASTIC_SLOT";
   if (key == "host") return "HOROVOD_HOSTNAME";
   if (key == "epoch") return "HOROVOD_ELASTIC_EPOCH";
+  if (key == "tenant") return "HOROVOD_TENANT_ID";
   return nullptr;
 }
 

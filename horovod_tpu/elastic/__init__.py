@@ -11,6 +11,7 @@ from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
 from .driver import ElasticDriver, elastic_run
 from .registration import WorkerStateRegistry
 from .sampler import ElasticSampler
+from .scheduler import PodScheduler, TenantSpec
 from .state import JaxState, ObjectState, State, StateSyncError, run
 from .worker import (DRAIN_EXIT_CODE, HostsUpdatedInterrupt,
                      WorkerDrained, WorkerNotificationManager,
@@ -23,4 +24,5 @@ __all__ = [
     "elastic_run", "HostDiscovery", "HostDiscoveryScript", "FixedHosts",
     "HostManager", "HostUpdateResult", "WorkerStateRegistry",
     "WorkerNotificationManager", "notification_manager",
+    "PodScheduler", "TenantSpec",
 ]

@@ -58,11 +58,22 @@ class ElasticDriver:
                  start_timeout: float = 120.0,
                  ssh_port: int = 22,
                  respawn_backoff_base: float = 1.0,
-                 respawn_backoff_cap: float = 30.0):
+                 respawn_backoff_cap: float = 30.0,
+                 tenant_id: Optional[str] = None,
+                 tenant_priority: Optional[int] = None):
         self.command = command
         self.min_np = max(1, min_np)
         self.max_np = max_np
         self.env = dict(env or {})
+        # Multi-tenant pods (elastic/scheduler.py): this driver manages
+        # ONE tenant's world.  The id is exported to the workers
+        # (HOROVOD_TENANT_ID — it scopes their KV namespace, spill
+        # subdirectory, and faultline @tenant= targeting) and labels
+        # this driver's metric series so several tenant drivers in one
+        # scheduler process never collapse into one series.
+        self.tenant_id = tenant_id
+        self.tenant_priority = tenant_priority
+        self._mlabels = {"tenant": tenant_id} if tenant_id else {}
         self.elastic_timeout = elastic_timeout
         self.discovery_interval = discovery_interval
         self.start_timeout = start_timeout
@@ -123,6 +134,10 @@ class ElasticDriver:
         self._discovery_failures = 0
         self._shutdown = threading.Event()
         self._below_min_since: Optional[float] = None  # graftlint: guarded-by=_lock
+        # Scheduler hold: a preempted tenant's driver parks with an
+        # EMPTY world on purpose — the below-min_np deadline must not
+        # fail the run while the pod scheduler is holding its slots.
+        self._held = False  # graftlint: guarded-by=_lock
         # Highest epoch a worker has demanded via min_epoch (its world
         # broke in a way the driver cannot observe); the discovery loop
         # rebuilds when it passes the current epoch.
@@ -270,7 +285,7 @@ class ElasticDriver:
                 rank += 1
                 local_rank += 1
         self._published = True
-        metrics.gauge("elastic_epoch").set(self._epoch)
+        metrics.gauge("elastic_epoch", **self._mlabels).set(self._epoch)
         metrics.event("epoch_published", epoch=self._epoch,
                       ranks=len(self._target),
                       hosts=len(hosts_in_order))
@@ -373,6 +388,73 @@ class ElasticDriver:
         safe_shell_exec.terminate_all(
             [mp for mp in to_stop if mp.poll() is None])
 
+    # -- pod-scheduler integration (elastic/scheduler.py) ------------------
+
+    def scheduler_preempt(self, reason: str):
+        """Scheduler-initiated preemption of this whole tenant world:
+        a PLANNED removal riding the exact r10 drain path for every
+        live slot — SIGTERM leads (``terminate_all``), the workers
+        commit + spill and exit with the drain code inside the grace
+        window, and NOTHING books as a failure: no blacklist entry, no
+        ``HOROVOD_HOST_FAILURE_THRESHOLD`` count, respawn backoff
+        reset, proactive epoch bump.  The driver then parks (held)
+        with its below-min deadline suspended until
+        :meth:`scheduler_resume`.
+
+        Idempotent: the scheduler re-issues it every tick until the
+        tenant's slot view is actually empty (the
+        ``scheduler.preempt.notice`` drop injection loses one issue,
+        the next tick repeats it)."""
+        with self._lock:
+            self._held = True
+            self._below_min_since = None
+            # Preemption is not a spawn failure: the next spawn of
+            # these slots (at resume) starts from the base interval.
+            self._spawn_backoff.clear()
+            # Exits that race the recompute below must still book as
+            # planned removals, whatever their rc.  Counting happens
+            # at the reap (the ONE site incrementing
+            # elastic_drain_total), not here.
+            live = len(self._procs)
+            for slot in self._procs:
+                self._draining.add(slot)
+        metrics.event("tenant_preempt_order", tenant=self.tenant_id,
+                      reason=reason, live_slots=live)
+        LOG.warning("scheduler preemption (%s): draining tenant %s's "
+                    "world as a planned removal", reason, self.tenant_id)
+        try:
+            # The slot view the scheduler already emptied must reach
+            # the HostManager before the recompute reads it.
+            self._hosts.update_available_hosts()
+        except Exception:  # noqa: BLE001 — view facade cannot really fail
+            LOG.debug("preempt-time discovery refresh failed",
+                      exc_info=True)
+        self._recompute_world("scheduler preemption (%s)" % reason)
+
+    def scheduler_resume(self):
+        """Hand a preempted tenant its slots back: un-hold, refresh the
+        slot view, and re-form the world — respawned workers restore
+        from their r10 spill at the committed step during sync()."""
+        with self._lock:
+            self._held = False
+            self._below_min_since = None
+        metrics.event("tenant_resume_order", tenant=self.tenant_id)
+        try:
+            self._hosts.update_available_hosts()
+        except Exception:  # noqa: BLE001 — view facade cannot really fail
+            LOG.debug("resume-time discovery refresh failed",
+                      exc_info=True)
+        self._recompute_world("scheduler resume")
+
+    def held(self) -> bool:
+        with self._lock:
+            return self._held
+
+    def request_stop(self):
+        """Ask :meth:`run` to exit its reap loop and tear the world
+        down (scheduler shutdown).  Thread-safe, idempotent."""
+        self._shutdown.set()
+
     def _worker_env(self, slot: Slot) -> Dict[str, str]:
         host, idx = slot
         env = dict(self.env)
@@ -385,6 +467,13 @@ class ElasticDriver:
             "HOROVOD_SECRET_KEY": self._secret,
             "HOROVOD_ELASTIC_TIMEOUT": str(self.elastic_timeout),
         })
+        if self.tenant_id is not None:
+            # Tenant identity travels with the worker: KV namespace,
+            # spill subdirectory and @tenant= fault targeting all key
+            # off it (docs/elastic.md §Multi-tenant scheduling).
+            env["HOROVOD_TENANT_ID"] = str(self.tenant_id)
+            if self.tenant_priority is not None:
+                env["HOROVOD_TENANT_PRIORITY"] = str(self.tenant_priority)
         return env
 
     def _make_worker_proc(self, slot: Slot, env: Dict[str, str]):
@@ -460,7 +549,7 @@ class ElasticDriver:
                     # backoff to the base interval.
                     self._spawn_backoff.pop(slot, None)
             if not stale:
-                metrics.counter("elastic_spawn_total").inc()
+                metrics.counter("elastic_spawn_total", **self._mlabels).inc()
                 metrics.event("spawn", host=host, slot=idx)
             if stale:
                 # The pending guard means no replacement proc can exist
@@ -544,6 +633,17 @@ class ElasticDriver:
                     continue  # alive, or replaced while we polled
                 del self._procs[slot]
                 if slot in self._stopped:
+                    # A stopped slot that was ALSO marked draining (a
+                    # scheduler preemption) still counts as a drain —
+                    # exactly once, here at the reap — while keeping
+                    # the stopped slot's exemption from every other
+                    # bookkeeping branch.
+                    if slot in self._draining:
+                        self._draining.discard(slot)
+                        metrics.counter("elastic_drain_total",
+                                        **self._mlabels).inc()
+                        metrics.event("drained", host=slot[0],
+                                      slot=slot[1], rc=rc)
                     continue
                 drained = (slot in self._draining
                            or rc == DRAIN_EXIT_CODE)
@@ -559,7 +659,8 @@ class ElasticDriver:
                     self._spawn_backoff.pop(slot, None)
                     self._registry.record_success(slot[0])
                     drained_slots.append(slot)
-                    metrics.counter("elastic_drain_total").inc()
+                    metrics.counter("elastic_drain_total",
+                                    **self._mlabels).inc()
                     metrics.event("drained", host=slot[0], slot=slot[1],
                                   rc=rc)
                     LOG.warning("worker %s:%d drained (rc=%d): planned "
@@ -573,7 +674,8 @@ class ElasticDriver:
                     # starts from the base interval.
                     self._spawn_backoff.pop(slot, None)
                 else:
-                    metrics.counter("elastic_worker_failures_total").inc()
+                    metrics.counter("elastic_worker_failures_total",
+                                    **self._mlabels).inc()
                     metrics.event("worker_failed", host=slot[0],
                                   slot=slot[1], rc=rc)
                     LOG.warning("worker %s:%d failed (rc=%d)",
@@ -612,7 +714,8 @@ class ElasticDriver:
         for host in set(failed_hosts):
             if self._registry.record_failure(host):
                 cooldown = self._registry.cooldown_for(host)
-                metrics.counter("elastic_blacklist_total").inc()
+                metrics.counter("elastic_blacklist_total",
+                                **self._mlabels).inc()
                 metrics.event("blacklist", host=host,
                               cooldown_secs=cooldown)
                 LOG.warning(
@@ -629,7 +732,12 @@ class ElasticDriver:
             # instead of discovering the hole via a failed collective.
             self._recompute_world("worker drained")
         with self._lock:
-            if (self._below_min_since is not None
+            # A held driver (scheduler preemption) parks below min_np
+            # BY DESIGN: the deadline belongs to worlds that cannot
+            # re-form, not to tenants whose slots the pod scheduler is
+            # deliberately holding.
+            if (not self._held
+                    and self._below_min_since is not None
                     and time.monotonic() - self._below_min_since
                     > self.elastic_timeout):
                 LOG.error("gave up: below min_np for %.0fs",
@@ -678,27 +786,39 @@ class ElasticDriver:
         return metrics.render_merged(models)
 
     def run(self) -> int:
-        metrics.set_journal_tag("driver")
+        if self.tenant_id is None:
+            # A tenant driver runs INSIDE the scheduler process, whose
+            # journal tag ("scheduler") covers the whole process — a
+            # per-tenant override here would misattribute every other
+            # thread's events written after this point.
+            metrics.set_journal_tag("driver")
         self._server.start()
         self._kv.start()
-        deadline = time.monotonic() + self.start_timeout
-        while True:
-            try:
-                self._hosts.update_available_hosts()
-            except Exception as exc:  # noqa: BLE001 — flaky script
-                LOG.warning("startup discovery failed: %s", exc)
-            if len(self._hosts.ordered_slots(self.max_np)) >= self.min_np:
-                break
-            if time.monotonic() > deadline:
-                LOG.error("discovery never found min_np=%d hosts",
-                          self.min_np)
-                return 1
-            time.sleep(1.0)
-        self._recompute_world("startup")
-        disc = threading.Thread(target=self._discovery_loop, daemon=True)
-        disc.start()
         try:
-            while not self._check_procs():
+            deadline = time.monotonic() + self.start_timeout
+            while True:
+                try:
+                    self._hosts.update_available_hosts()
+                except Exception as exc:  # noqa: BLE001 — flaky script
+                    LOG.warning("startup discovery failed: %s", exc)
+                if len(self._hosts.ordered_slots(self.max_np)) \
+                        >= self.min_np:
+                    break
+                if self._shutdown.is_set():
+                    return self._rc
+                if time.monotonic() > deadline and not self.held():
+                    LOG.error("discovery never found min_np=%d hosts",
+                              self.min_np)
+                    return 1
+                time.sleep(1.0)
+            self._recompute_world("startup")
+            disc = threading.Thread(target=self._discovery_loop,
+                                    daemon=True)
+            disc.start()
+            # The shutdown event doubles as the scheduler's stop
+            # request (request_stop): a managed tenant driver must be
+            # stoppable without its world ever reaching "done".
+            while not self._shutdown.is_set() and not self._check_procs():
                 time.sleep(0.1)
             return self._rc
         finally:

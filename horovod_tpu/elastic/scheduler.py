@@ -1,0 +1,509 @@
+"""Pod-level multi-tenant scheduler: process-set QoS over one slot pool.
+
+"Millions of users" means many jobs sharing one pod; the reference
+(arXiv:1802.05799 §6) deliberately left scheduling to external systems
+— one tenant per world, and a misbehaving job takes the pod with it.
+This module composes three planes that already exist separately into a
+pod scheduler that exceeds that scope:
+
+* **Process-set partitioning** — every admitted tenant runs on a
+  disjoint subset of the pod's slots, managed by its OWN
+  :class:`~.driver.ElasticDriver` (own epoch, own rendezvous KV, own
+  secret, own blacklist): a tenant's failures can only ever book
+  against its own world.  Worker-side isolation rides the tenant id
+  the driver exports (``HOROVOD_TENANT_ID``): tenant-scoped KV
+  namespaces (runner/http_client.py), tenant-scoped spill
+  subdirectories (elastic/spill.py) and ``@tenant=`` fault targeting.
+* **Elastic resize** — each tenant's driver discovers its slots
+  through a scheduler-owned view facade; growing or shrinking a tenant
+  is just the facade changing, observed by the driver's existing
+  discovery/resize machinery (slack capacity flows to starved tenants
+  with no new mechanism).
+* **Drain-based preemption (r10)** — a higher-priority admission
+  preempts the lowest-priority tenant via SIGTERM→drain: the workers
+  finish the in-flight step, commit (+ spill), and exit with the
+  distinguished drain code inside the grace window; the driver books a
+  PLANNED removal — no blacklist churn, no failure counts, respawn
+  backoff reset, proactive epoch bump — and the tenant resumes from
+  its spill at the committed step when capacity returns.
+
+Packing policy (deterministic, priority-strict): tenants sorted by
+(priority desc, admission order) each get ``min_np`` slots or nothing;
+remaining slack is handed out in the same order up to ``max_np``
+(unbounded tenants absorb the rest).  A tenant that cannot get
+``min_np`` waits (``pending``) or is drain-preempted (``preempted``);
+the plan is recomputed every tick, so a lost preemption order
+(injectable via ``scheduler.preempt.notice``) is re-issued until the
+pod converges on the plan.
+
+Injection certification (tests/test_scheduler.py): with
+``tenant.worker.die@tenant=A`` armed, tenant A's death must never
+stall tenant B's progress, blacklist B's hosts, or misbook B's slots —
+and a scheduler preemption must never increment failure counts at all.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common import faultline, metrics
+from ..common.envutil import env_float
+from .discovery import HostDiscovery, HostManager
+from .driver import ElasticDriver
+
+LOG = logging.getLogger("horovod_tpu.elastic.scheduler")
+
+# Tenant lifecycle states (exported via PodScheduler.tenant_state and
+# the tenant_slots metric's companion events).
+PENDING = "pending"        # admitted, waiting for first capacity
+RUNNING = "running"        # slots allocated, driver live
+PREEMPTED = "preempted"    # drain-preempted, slots held by the pod
+DONE = "done"              # driver ran to rc=0
+FAILED = "failed"          # driver exited non-zero
+REJECTED = "rejected"      # admission refused (injected / duplicate)
+
+_ACTIVE = (PENDING, RUNNING, PREEMPTED)
+
+# Finished (done/failed) tenant records retained for introspection;
+# older ones are pruned so a pod that cycles through many tenant ids
+# never grows its bookkeeping without bound (the metric registry's
+# own HOROVOD_METRICS_MAX_SERIES guard backstops label cardinality).
+_FINISHED_RETENTION = 256
+
+
+def scheduler_tick_secs() -> float:
+    """Replan cadence of the pod scheduler
+    (``HOROVOD_SCHEDULER_TICK_SECS``, default 1.0, floor 0.05): every
+    tick reaps finished tenants, refreshes the pod slot pool, and
+    converges allocations — including re-issuing preemption orders
+    lost to injection."""
+    return max(0.05, env_float("HOROVOD_SCHEDULER_TICK_SECS", 1.0))
+
+
+class TenantSpec:
+    """One tenant's admission request: identity, QoS and the worker
+    command.  ``priority`` is strict (higher preempts lower);
+    ``min_np`` is the admission floor (all-or-nothing), ``max_np``
+    bounds elastic growth (None = absorb any slack)."""
+
+    def __init__(self, tenant_id: str, command: List[str],
+                 priority: int = 0, min_np: int = 1,
+                 max_np: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None):
+        if not tenant_id:
+            raise ValueError("tenant_id must be a non-empty string")
+        if min_np < 1:
+            raise ValueError("min_np must be >= 1")
+        if max_np is not None and max_np < min_np:
+            raise ValueError("max_np (%d) < min_np (%d)"
+                             % (max_np, min_np))
+        self.tenant_id = str(tenant_id)
+        self.command = list(command)
+        self.priority = int(priority)
+        self.min_np = int(min_np)
+        self.max_np = max_np if max_np is None else int(max_np)
+        self.env = dict(env or {})
+
+
+class _TenantSlotView(HostDiscovery):
+    """The scheduler-owned discovery facade one tenant driver sees:
+    its world IS whatever the scheduler last allocated.  Thread-safe —
+    the scheduler thread writes, the tenant driver's discovery thread
+    reads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hosts: Dict[str, int] = {}
+
+    def set(self, hosts: Dict[str, int]):
+        with self._lock:
+            self._hosts = {h: int(n) for h, n in hosts.items() if n > 0}
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._hosts)
+
+
+class _Tenant:
+    """Scheduler-internal record for one admitted tenant."""
+
+    def __init__(self, spec: TenantSpec, seq: int):
+        self.spec = spec
+        self.seq = seq                      # admission order tiebreak
+        self.state = PENDING
+        self.view = _TenantSlotView()
+        self.driver: Optional[ElasticDriver] = None
+        self.thread: Optional[threading.Thread] = None
+        self.rc: Optional[int] = None
+        # Wait-latency bookkeeping: admission→first slots, and each
+        # preemption→resume, both observed into tenant_wait_seconds.
+        self.wait_since: Optional[float] = time.monotonic()
+        self.preemptions = 0
+
+    @property
+    def tenant_id(self) -> str:
+        return self.spec.tenant_id
+
+    def allocated(self) -> int:
+        return sum(self.view.find_available_hosts_and_slots().values())
+
+
+class PodScheduler:
+    """Admits tenant jobs onto one pod's slot pool and arbitrates
+    under contention (see module docstring for the policy).
+
+    ``discovery`` yields the POD's total slots (the same
+    ``HostDiscovery`` shapes the elastic driver uses); each tenant
+    driver sees only its scheduler-allocated share through a view
+    facade.  ``driver_factory(tenant)`` is injectable for tests; the
+    default builds a real :class:`ElasticDriver` with the tenant's
+    identity wired through (``tenant_id``/``tenant_priority`` env
+    exports, tenant-labeled metrics).
+
+    Thread model: ``tick()`` is the ONE scheduling pass (reap, replan,
+    apply) and may be driven by the built-in loop (``start()``) or
+    directly by tests.  Decisions are made under the scheduler lock;
+    driver calls (spawn/preempt/resume — potentially slow: a drain
+    preemption waits out the grace window) run outside it.
+    """
+
+    def __init__(self, discovery: HostDiscovery,
+                 env: Optional[Dict[str, str]] = None,
+                 tick_secs: Optional[float] = None,
+                 elastic_timeout: float = 600.0,
+                 driver_factory=None,
+                 **driver_kwargs):
+        self._pod = HostManager(discovery, lambda host: False)
+        self._base_env = dict(env or {})
+        self._tick_secs = (tick_secs if tick_secs is not None
+                           else scheduler_tick_secs())
+        self._elastic_timeout = elastic_timeout
+        self._driver_factory = driver_factory or self._make_driver
+        self._driver_kwargs = dict(driver_kwargs)
+        self._lock = threading.RLock()
+        self._tenants: Dict[str, _Tenant] = {}  # graftlint: guarded-by=_lock
+        self._admit_seq = 0  # graftlint: guarded-by=_lock
+        self._shutdown = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, spec: TenantSpec) -> str:
+        """Admit one tenant; returns its state after an immediate
+        scheduling pass (``running`` when slots were granted,
+        ``pending`` when it must wait, ``rejected`` when admission was
+        refused).  Admission itself never preempts synchronously — the
+        pass it triggers does, through the normal plan."""
+        if faultline.site("scheduler.admit"):
+            LOG.warning("admission of tenant %r refused (faultline "
+                        "scheduler.admit)", spec.tenant_id)
+            metrics.event("tenant_rejected", tenant=spec.tenant_id,
+                          reason="faultline scheduler.admit")
+            return REJECTED
+        with self._lock:
+            if spec.tenant_id in self._tenants and \
+                    self._tenants[spec.tenant_id].state in _ACTIVE:
+                raise ValueError(
+                    "tenant %r is already admitted" % spec.tenant_id)
+            tenant = _Tenant(spec, self._admit_seq)
+            self._admit_seq += 1
+            self._tenants[spec.tenant_id] = tenant
+        metrics.event("tenant_admit", tenant=spec.tenant_id,
+                      priority=spec.priority, min_np=spec.min_np,
+                      max_np=spec.max_np)
+        LOG.info("tenant %s admitted (priority=%d, np=[%d, %s])",
+                 spec.tenant_id, spec.priority, spec.min_np,
+                 spec.max_np if spec.max_np is not None else "inf")
+        self.tick()
+        self._wake.set()
+        return self.tenant_state(spec.tenant_id)
+
+    # -- introspection -----------------------------------------------------
+
+    def tenant_state(self, tenant_id: str) -> str:
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            return t.state if t is not None else REJECTED
+
+    def tenant_rc(self, tenant_id: str) -> Optional[int]:
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            return t.rc if t is not None else None
+
+    def allocation(self, tenant_id: str) -> Dict[str, int]:
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            return (t.view.find_available_hosts_and_slots()
+                    if t is not None else {})
+
+    def tenant_driver(self, tenant_id: str) -> Optional[ElasticDriver]:
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            return t.driver if t is not None else None
+
+    # -- planning ----------------------------------------------------------
+
+    @staticmethod
+    def _take(free: Dict[str, int], want: int) -> Dict[str, int]:
+        """Take up to ``want`` slots from ``free`` (mutated), host
+        order preserved — deterministic packing."""
+        got: Dict[str, int] = {}
+        for host in list(free):
+            if want <= 0:
+                break
+            n = min(free[host], want)
+            if n > 0:
+                got[host] = n
+                free[host] -= n
+                want -= n
+        return got
+
+    def _plan(self, pod: Dict[str, int],
+              order: List[_Tenant]) -> Dict[str, Dict[str, int]]:
+        """Deterministic packing of active tenants over the pod's
+        slots: min_np all-or-nothing by (priority desc, admit order),
+        then slack in the same order up to max_np."""
+        free = {h: int(n) for h, n in pod.items() if n > 0}
+        alloc: Dict[str, Dict[str, int]] = {}
+        for t in order:
+            got = self._take(free, t.spec.min_np)
+            if sum(got.values()) < t.spec.min_np:
+                for h, n in got.items():  # give the partial fill back
+                    free[h] += n
+                alloc[t.tenant_id] = {}
+            else:
+                alloc[t.tenant_id] = got
+        for t in order:
+            cur = alloc[t.tenant_id]
+            if not cur:
+                continue
+            have = sum(cur.values())
+            room = (sum(free.values()) if t.spec.max_np is None
+                    else t.spec.max_np - have)
+            for h, n in self._take(free, room).items():
+                cur[h] = cur.get(h, 0) + n
+        return alloc
+
+    # -- the scheduling pass -----------------------------------------------
+
+    def tick(self):
+        """One scheduling pass: reap finished tenants, refresh the pod
+        slot pool, recompute the plan, and converge every tenant onto
+        it (start / grow / shrink / preempt / resume)."""
+        try:
+            self._pod.update_available_hosts()
+        except Exception as exc:  # noqa: BLE001 — keep last good view
+            LOG.warning("pod discovery failed (%s); planning on the "
+                        "last good slot view", exc)
+        pod = self._pod.current_hosts
+
+        starts: List[_Tenant] = []
+        preempts: List[_Tenant] = []
+        resumes: List[_Tenant] = []
+        with self._lock:
+            # Reap: tenant threads that returned flip to DONE/FAILED
+            # and free their slots for the plan below; their gauges
+            # zero out once here (the exposition loop below only
+            # tracks ACTIVE tenants).
+            for t in self._tenants.values():
+                if t.state in (RUNNING, PREEMPTED) and t.rc is not None:
+                    t.state = DONE if t.rc == 0 else FAILED
+                    t.view.set({})
+                    metrics.gauge("tenant_slots", tenant=t.tenant_id,
+                                  state="allocated").set(0)
+                    metrics.gauge("tenant_slots", tenant=t.tenant_id,
+                                  state="pending").set(0)
+                    metrics.event("tenant_finished", tenant=t.tenant_id,
+                                  rc=t.rc, state=t.state)
+                    LOG.info("tenant %s finished: %s (rc=%d)",
+                             t.tenant_id, t.state, t.rc)
+            # Bound the books: keep only the newest finished records.
+            finished = [t for t in sorted(self._tenants.values(),
+                                          key=lambda t: t.seq)
+                        if t.state not in _ACTIVE]
+            for t in finished[:-_FINISHED_RETENTION]:
+                del self._tenants[t.tenant_id]
+            order = sorted(
+                (t for t in self._tenants.values()
+                 if t.state in _ACTIVE),
+                key=lambda t: (-t.spec.priority, t.seq))
+            plan = self._plan(pod, order)
+            now = time.monotonic()
+            for t in order:
+                want = plan[t.tenant_id]
+                n = sum(want.values())
+                if t.state == PENDING and n >= t.spec.min_np:
+                    t.view.set(want)
+                    t.state = RUNNING
+                    if t.wait_since is not None:
+                        metrics.histogram(
+                            "tenant_wait_seconds",
+                            tenant=t.tenant_id).observe(
+                                now - t.wait_since)
+                        t.wait_since = None
+                    starts.append(t)
+                elif t.state == RUNNING and n == 0:
+                    # Preemption rides the drain path; the notice seam
+                    # is injectable — a dropped order leaves the tenant
+                    # RUNNING and the next tick re-issues it.
+                    if faultline.site("scheduler.preempt.notice"):
+                        LOG.warning(
+                            "preemption order for tenant %s lost "
+                            "(faultline scheduler.preempt.notice); "
+                            "re-issuing next tick", t.tenant_id)
+                        continue
+                    t.view.set({})
+                    t.state = PREEMPTED
+                    t.wait_since = now
+                    t.preemptions += 1
+                    metrics.counter("tenant_preemptions_total",
+                                    tenant=t.tenant_id).inc()
+                    metrics.event("tenant_preempt", tenant=t.tenant_id,
+                                  preemptions=t.preemptions)
+                    LOG.warning("tenant %s preempted (priority "
+                                "contention): draining its world",
+                                t.tenant_id)
+                    preempts.append(t)
+                elif t.state == PREEMPTED and n >= t.spec.min_np:
+                    t.view.set(want)
+                    t.state = RUNNING
+                    if t.wait_since is not None:
+                        metrics.histogram(
+                            "tenant_wait_seconds",
+                            tenant=t.tenant_id).observe(
+                                now - t.wait_since)
+                        t.wait_since = None
+                    metrics.event("tenant_resume", tenant=t.tenant_id)
+                    LOG.info("tenant %s resumed with %d slot(s)",
+                             t.tenant_id, n)
+                    resumes.append(t)
+                elif t.state == RUNNING and n > 0 and \
+                        want != t.view.find_available_hosts_and_slots():
+                    # Grow/shrink in place: the tenant driver's own
+                    # discovery tick observes the new view and resizes
+                    # elastically (a shrunk slot leaves via the drain
+                    # path of ManagedProcess.terminate's SIGTERM).
+                    t.view.set(want)
+                    metrics.event("tenant_resize", tenant=t.tenant_id,
+                                  slots=n)
+            # Fairness/latency exposition: allocated slots and the
+            # min_np shortfall for every ACTIVE tenant (finished ones
+            # were zeroed once at the reap above).
+            for t in order:
+                n = t.allocated()
+                metrics.gauge("tenant_slots", tenant=t.tenant_id,
+                              state="allocated").set(n)
+                metrics.gauge("tenant_slots", tenant=t.tenant_id,
+                              state="pending").set(
+                                  max(0, t.spec.min_np - n))
+
+        # Driver calls OUTSIDE the scheduler lock: a drain preemption
+        # can legitimately take the whole grace window, and admit()/
+        # introspection must not block behind it.  Preemptions drain
+        # FIRST (terminate_all waits out the shared grace window), so
+        # in the common path a displacing tenant starts onto slots
+        # whose previous owner has already committed, spilled and
+        # exited — a preemption order lost to injection leaves at most
+        # one tick of transient overcommit, converged by the replan.
+        # Each call is guarded: one tenant's driver failing must never
+        # take the scheduling pass (or the loop) down with it.
+        for t in preempts:
+            if t.driver is not None:
+                self._guarded(t, "preempt", lambda d=t.driver:
+                              d.scheduler_preempt("priority contention"))
+        for t in starts:
+            self._guarded(t, "start", lambda t=t: self._start_tenant(t))
+        for t in resumes:
+            if t.driver is not None:
+                self._guarded(t, "resume", lambda d=t.driver:
+                              d.scheduler_resume())
+
+    def _guarded(self, tenant: _Tenant, what: str, fn):
+        """Apply one per-tenant action, containing its failures to the
+        tenant (the pod must keep scheduling)."""
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — blast-radius containment
+            LOG.exception("tenant %s: %s action failed; the next tick "
+                          "re-converges", tenant.tenant_id, what)
+
+    # -- tenant drivers ----------------------------------------------------
+
+    def _make_driver(self, tenant: _Tenant) -> ElasticDriver:
+        spec = tenant.spec
+        env = dict(self._base_env)
+        env.update(spec.env)
+        return ElasticDriver(
+            spec.command, tenant.view,
+            min_np=spec.min_np, max_np=spec.max_np, env=env,
+            elastic_timeout=self._elastic_timeout,
+            tenant_id=spec.tenant_id, tenant_priority=spec.priority,
+            **self._driver_kwargs)
+
+    def _start_tenant(self, tenant: _Tenant):
+        with self._lock:
+            if tenant.driver is not None or self._shutdown.is_set():
+                return
+            tenant.driver = self._driver_factory(tenant)
+            tenant.thread = threading.Thread(
+                target=self._drive, args=(tenant,), daemon=True,
+                name="tenant-%s" % tenant.tenant_id)
+        LOG.info("tenant %s starting with %d slot(s)",
+                 tenant.tenant_id, tenant.allocated())
+        metrics.event("tenant_start", tenant=tenant.tenant_id,
+                      slots=tenant.allocated())
+        tenant.thread.start()
+
+    def _drive(self, tenant: _Tenant):
+        try:
+            rc = tenant.driver.run()
+        except Exception:  # noqa: BLE001 — a tenant must never kill the pod
+            LOG.exception("tenant %s driver crashed", tenant.tenant_id)
+            rc = 1
+        with self._lock:
+            tenant.rc = rc
+        self._wake.set()  # free slots promptly: replan now
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Run the scheduling loop in a background thread."""
+        if self._thread is not None:
+            return
+        metrics.set_journal_tag("scheduler")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pod-scheduler")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._shutdown.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                LOG.exception("scheduling tick failed; retrying next "
+                              "tick")
+            self._wake.wait(self._tick_secs)
+            self._wake.clear()
+
+    def stop(self, timeout: float = 30.0):
+        """Stop the pod: every live tenant driver is asked to stop (its
+        teardown drains workers under one shared grace window) and the
+        scheduling loop exits."""
+        self._shutdown.set()
+        self._wake.set()
+        with self._lock:
+            tenants = list(self._tenants.values())
+        for t in tenants:
+            if t.driver is not None:
+                t.driver.request_stop()
+        deadline = time.monotonic() + timeout
+        for t in tenants:
+            if t.thread is not None:
+                t.thread.join(max(0.1, deadline - time.monotonic()))
+        if self._thread is not None:
+            self._thread.join(max(0.1, deadline - time.monotonic()))
+            self._thread = None

@@ -57,8 +57,21 @@ class SpillCorrupt(ValueError):
 
 def spill_dir() -> Optional[str]:
     """The durable-commit directory (``HOROVOD_STATE_SPILL_DIR``);
-    None disables spilling entirely."""
-    return os.environ.get("HOROVOD_STATE_SPILL_DIR") or None
+    None disables spilling entirely.
+
+    Multi-tenant pods: restore scans EVERY writer's blobs in the
+    directory, so two tenants sharing one spill dir would adopt each
+    other's state.  With ``HOROVOD_TENANT_ID`` set (the pod scheduler
+    exports it per tenant) each tenant spills into its own
+    ``tenant-<id>`` subdirectory — tenant A's commits can never be
+    restored into tenant B."""
+    base = os.environ.get("HOROVOD_STATE_SPILL_DIR") or None
+    if base is None:
+        return None
+    tenant = os.environ.get("HOROVOD_TENANT_ID")
+    if tenant:
+        return os.path.join(base, "tenant-%s" % tenant)
+    return base
 
 
 def keep_last() -> int:

@@ -79,6 +79,10 @@ class State:
 
     def commit(self):
         faultline.site("elastic.state.commit")
+        # Tenant-targeted kill seam: multi-tenant isolation tests arm
+        # die/wedge here with @tenant=<id> so exactly one tenant's
+        # workers go down while every tenant runs identical user code.
+        faultline.site("tenant.worker.die")
         self._commit_id += 1
         self.save()
         self._persist()

@@ -16,6 +16,7 @@ from __future__ import annotations
 import errno
 import http.client
 import logging
+import os
 import random
 import socket
 import time
@@ -145,10 +146,24 @@ def request_with_retry(attempt: Callable[[], T], what: str = "rpc",
 
 
 class RendezvousClient:
-    def __init__(self, addr: str, secret: Optional[str] = None):
+    def __init__(self, addr: str, secret: Optional[str] = None,
+                 namespace: Optional[str] = None):
         # addr: "host:port"
         self.base = "http://" + addr
         self.secret = secret
+        # Tenant-scoped key namespace: on a multi-tenant pod every
+        # client prefixes its keys with the tenant id (the scheduler
+        # exports HOROVOD_TENANT_ID per tenant), so one tenant's
+        # coordinator/address-table entries can never collide with
+        # another tenant's — even against a shared KV server.  An
+        # explicit ``namespace`` argument wins over the env; empty/
+        # unset means the un-prefixed single-tenant namespace.
+        if namespace is None:
+            namespace = os.environ.get("HOROVOD_TENANT_ID")
+        self._prefix = "/tenant-%s" % namespace if namespace else ""
+
+    def _path(self, key: str) -> str:
+        return self._prefix + "/" + key.lstrip("/")
 
     def _headers(self, payload: bytes) -> dict:
         if not self.secret:
@@ -156,7 +171,7 @@ class RendezvousClient:
         return {SECRET_HEADER: compute_digest(self.secret, payload)}
 
     def put(self, key: str, value: str):
-        path = "/" + key.lstrip("/")
+        path = self._path(key)
         body = value.encode()
 
         def attempt():
@@ -171,7 +186,7 @@ class RendezvousClient:
         request_with_retry(attempt, what="rendezvous PUT %s" % key)
 
     def get(self, key: str) -> Optional[str]:
-        path = "/" + key.lstrip("/")
+        path = self._path(key)
 
         def attempt():
             req = urllib.request.Request(self.base + path, method="GET",
@@ -202,7 +217,7 @@ class RendezvousClient:
             time.sleep(jittered(interval))
 
     def delete(self, key: str):
-        path = "/" + key.lstrip("/")
+        path = self._path(key)
 
         def attempt():
             req = urllib.request.Request(self.base + path,

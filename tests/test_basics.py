@@ -63,6 +63,65 @@ def test_process_set_registry(hvd_world):
     assert not hvd.remove_process_set(ps)  # already gone
 
 
+def test_process_set_registry_reset_rederives_and_drops_dangling():
+    """Pinned semantics of ``reset(world_size)`` across an elastic
+    resize: sets whose ranks fit the new world SURVIVE (ids renumbered
+    densely in registration order, identical on every rank); sets
+    holding ranks >= the new world size are dropped LOUDLY — their
+    ``process_set_id`` detaches to None so stale handles raise instead
+    of silently aliasing a recycled id."""
+    from horovod_tpu.common import process_sets as psm
+    psm.reset_registry()
+    try:
+        a = psm.ProcessSet([0, 1])
+        b = psm.ProcessSet([1, 3])    # rank 3 dies in a shrink to 2
+        c = psm.ProcessSet([0])
+        for ps in (a, b, c):
+            psm._table.add(ps)
+        assert (a.process_set_id, b.process_set_id,
+                c.process_set_id) == (1, 2, 3)
+        survivors = psm.reset_registry(world_size=2)
+        assert survivors == [a, c]
+        # Dense renumbering in the original registration order.
+        assert (a.process_set_id, c.process_set_id) == (1, 2)
+        assert psm.process_set_ids() == [0, 1, 2]
+        # The dangling set detached loudly: its handle cannot resolve.
+        assert b.process_set_id is None
+        with pytest.raises(KeyError):
+            psm.process_set_by_id(b.process_set_id)
+        # A full wipe (no world size) detaches EVERY registered set, so
+        # a recycled id can only ever name a set registered after it.
+        psm.reset_registry()
+        assert a.process_set_id is None and c.process_set_id is None
+        fresh = psm.ProcessSet([0])
+        psm._table.add(fresh)
+        assert fresh.process_set_id == 1  # recycled by a NEW set only
+    finally:
+        psm.reset_registry()
+
+
+def test_init_process_sets_idempotent_across_reinit():
+    """Registrations survive shutdown()+init(), so a second
+    init(process_sets=...) with the same sets must REUSE the survivors
+    (same object or equal ranks) instead of tripping the
+    duplicate-ranks check mid-init."""
+    hvd.shutdown()
+    ps = hvd.ProcessSet([0, 1])
+    try:
+        hvd.init(process_sets=[ps])
+        assert ps.process_set_id == 1
+        hvd.shutdown()
+        hvd.init(process_sets=[ps])          # same object: reused
+        assert ps.process_set_id == 1
+        hvd.shutdown()
+        hvd.init(process_sets=[[0, 1]])      # equal ranks: reused too
+        assert hvd.process_set_ids() == [0, 1]
+        assert ps.process_set_id == 1
+    finally:
+        hvd.remove_process_set(ps)
+        hvd.shutdown()
+
+
 def test_config_env_parsing(monkeypatch):
     from horovod_tpu.common.config import Config
     monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))

@@ -1,0 +1,635 @@
+"""Multi-tenant pod scheduler tests (ISSUE 8).
+
+Fast units exercise the packing plan, admission/preemption arbitration
+(stub drivers), the REAL ElasticDriver's scheduler-preemption
+bookkeeping (planned removal: no blacklist, no failure counts, backoff
+reset, epoch bump), cross-tenant isolation of the drivers' books under
+a simulated ``tenant.worker.die``, the tenant-scoped KV/spill
+namespaces, and the tenant-labeled metric series in the merged
+/metrics render.  The 2-tenant real-process e2es (injected tenant-A
+death with tenant-B progress asserted; scheduler preemption restoring
+from the r10 spill at the committed step) are ``slow``-marked to keep
+the tier-1 wall-clock budget intact — CI runs them by node id.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common import faultline, metrics
+from horovod_tpu.elastic.discovery import FixedHosts
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.scheduler import (DONE, PENDING, PREEMPTED,
+                                           REJECTED, RUNNING,
+                                           PodScheduler, TenantSpec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(cond, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- packing plan ----------------------------------------------------------
+
+def test_tenant_spec_validation():
+    with pytest.raises(ValueError):
+        TenantSpec("", ["true"])
+    with pytest.raises(ValueError):
+        TenantSpec("t", ["true"], min_np=0)
+    with pytest.raises(ValueError):
+        TenantSpec("t", ["true"], min_np=4, max_np=2)
+
+
+def test_plan_priority_packing_and_slack():
+    sched = PodScheduler(FixedHosts({}), driver_factory=lambda t: None)
+
+    class _T:
+        def __init__(self, tid, prio, seq, min_np, max_np):
+            self.spec = TenantSpec(tid, ["true"], priority=prio,
+                                   min_np=min_np, max_np=max_np)
+            self.seq = seq
+            self.tenant_id = tid
+
+    hi = _T("hi", 9, 1, 2, None)       # later admit, higher priority
+    lo = _T("lo", 1, 0, 2, 3)
+    tiny = _T("tiny", 1, 2, 3, 3)      # cannot fit: all-or-nothing
+    order = sorted([lo, hi, tiny],
+                   key=lambda t: (-t.spec.priority, t.seq))
+    assert [t.tenant_id for t in order] == ["hi", "lo", "tiny"]
+    plan = sched._plan({"h1": 2, "h2": 2}, order)
+    # hi (priority 9) fills first, lo takes the rest, tiny gets NOTHING
+    # rather than a useless partial fill below its min_np.
+    assert sum(plan["hi"].values()) == 2
+    assert sum(plan["lo"].values()) == 2
+    assert plan["tiny"] == {}
+    # With more capacity slack flows in priority order up to max_np —
+    # the unbounded tenant absorbs the remainder, deterministically
+    # host-ordered.
+    plan = sched._plan({"h1": 4, "h2": 4},
+                       [t for t in order if t is not tiny])
+    assert sum(plan["hi"].values()) == 6   # 8 - lo's min of 2
+    assert sum(plan["lo"].values()) == 2   # slack went to hi first
+    assert plan["hi"] == {"h1": 2, "h2": 4}
+    assert plan["lo"] == {"h1": 2}
+
+
+# -- admission / preemption arbitration (stub drivers) ---------------------
+
+class _StubDriver:
+    def __init__(self, tenant):
+        self.tenant = tenant
+        self.preempts = []
+        self.resumes = 0
+        self._stop = threading.Event()
+
+    def run(self):
+        self._stop.wait()
+        return 0
+
+    def scheduler_preempt(self, reason):
+        self.preempts.append(reason)
+
+    def scheduler_resume(self):
+        self.resumes += 1
+
+    def request_stop(self):
+        self._stop.set()
+
+    def finish(self):
+        self._stop.set()
+
+
+def _stub_scheduler(pod):
+    return PodScheduler(FixedHosts(pod), driver_factory=_StubDriver,
+                        tick_secs=0.05)
+
+
+def test_admission_preemption_and_resume_cycle():
+    metrics.reset()
+    sched = _stub_scheduler({"h1": 2})
+    try:
+        assert sched.admit(TenantSpec("A", ["true"], priority=1,
+                                      min_np=2, max_np=2)) == RUNNING
+        assert sched.allocation("A") == {"h1": 2}
+        # Higher-priority admission preempts A via the drain path.
+        assert sched.admit(TenantSpec("B", ["true"], priority=5,
+                                      min_np=2, max_np=2)) == RUNNING
+        assert sched.tenant_state("A") == PREEMPTED
+        assert sched.allocation("A") == {}
+        assert sched.tenant_driver("A").preempts == \
+            ["priority contention"]
+        # Fairness series moved: A books a preemption + a pending
+        # shortfall, B holds the slots.
+        assert metrics.series_sum("tenant_preemptions_total",
+                                  tenant="A") == 1
+        assert metrics.series_sum("tenant_slots", tenant="A",
+                                  state="pending") == 2
+        assert metrics.series_sum("tenant_slots", tenant="B",
+                                  state="allocated") == 2
+        # B finishes -> the freed slots resume A at the next tick.
+        sched.tenant_driver("B").finish()
+        assert _wait_for(lambda: sched.tenant_rc("B") == 0)
+        sched.tick()
+        assert sched.tenant_state("B") == DONE
+        assert sched.tenant_state("A") == RUNNING
+        assert sched.tenant_driver("A").resumes == 1
+        assert sched.allocation("A") == {"h1": 2}
+        # A's wait latency (preempt -> resume) was observed.
+        snap = metrics.snapshot()["tenant_wait_seconds"]["series"]
+        waits = [r for r in snap if r["labels"].get("tenant") == "A"]
+        assert waits and waits[0]["count"] >= 1
+    finally:
+        sched.stop(timeout=5)
+
+
+def test_admission_pends_without_capacity_then_starts():
+    sched = _stub_scheduler({"h1": 1})
+    try:
+        assert sched.admit(TenantSpec("A", ["true"], priority=3,
+                                      min_np=1, max_np=1)) == RUNNING
+        # Equal priority cannot preempt: B waits instead.
+        assert sched.admit(TenantSpec("B", ["true"], priority=3,
+                                      min_np=1, max_np=1)) == PENDING
+        assert sched.tenant_state("A") == RUNNING
+        sched.tenant_driver("A").finish()
+        assert _wait_for(lambda: sched.tenant_rc("A") == 0)
+        sched.tick()
+        assert sched.tenant_state("B") == RUNNING
+    finally:
+        sched.stop(timeout=5)
+
+
+def test_admit_injection_refused_leaves_tenants_untouched(monkeypatch):
+    sched = _stub_scheduler({"h1": 2})
+    try:
+        assert sched.admit(TenantSpec("A", ["true"], priority=1,
+                                      min_np=2)) == RUNNING
+        monkeypatch.setenv("HVD_TPU_FAULT", "scheduler.admit:drop")
+        faultline.reset()
+        assert sched.admit(TenantSpec("B", ["true"],
+                                      priority=9, min_np=1)) == REJECTED
+        # The refusal never disturbed the running tenant.
+        assert sched.tenant_state("A") == RUNNING
+        assert sched.allocation("A") == {"h1": 2}
+        assert sched.tenant_driver("A").preempts == []
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        faultline.reset()
+        sched.stop(timeout=5)
+
+
+def test_lost_preempt_notice_is_reissued(monkeypatch):
+    """scheduler.preempt.notice drop: the preemption order is lost for
+    one tick; the replanner must re-issue it on the next tick until the
+    pod converges (idempotent preemption application)."""
+    sched = _stub_scheduler({"h1": 1})
+    try:
+        assert sched.admit(TenantSpec("A", ["true"], priority=1,
+                                      min_np=1)) == RUNNING
+        monkeypatch.setenv("HVD_TPU_FAULT",
+                           "scheduler.preempt.notice:drop@times=1")
+        faultline.reset()
+        sched.admit(TenantSpec("B", ["true"], priority=9, min_np=1))
+        # The admit-tick's preemption order was dropped: A still runs.
+        assert sched.tenant_state("A") == RUNNING
+        assert sched.tenant_driver("A").preempts == []
+        # The next tick re-issues it.
+        sched.tick()
+        assert sched.tenant_state("A") == PREEMPTED
+        assert sched.tenant_driver("A").preempts == \
+            ["priority contention"]
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        faultline.reset()
+        sched.stop(timeout=5)
+
+
+# -- real-driver bookkeeping -----------------------------------------------
+
+class _AliveProc:
+    """Fake worker process: alive until the test (or terminate) sets an
+    exit code.  terminate() exits with the DRAIN code — a drain-capable
+    worker answering SIGTERM."""
+
+    def __init__(self):
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self, grace=None):
+        from horovod_tpu.elastic.worker import DRAIN_EXIT_CODE
+        self.terminated = True
+        if self.rc is None:
+            self.rc = DRAIN_EXIT_CODE
+
+
+def _close_driver(driver):
+    driver._server._server.server_close()
+    driver._kv._httpd.server_close()
+
+
+def test_scheduler_preemption_is_planned_removal():
+    """ISSUE 8 satellite: a scheduler preemption rides the EXACT rc=85
+    drained-slot bookkeeping from r10 — it never increments
+    HOROVOD_HOST_FAILURE_THRESHOLD counts, never lands a host on the
+    blacklist, resets the respawn backoff, and bumps the epoch
+    proactively; resume respawns the world."""
+    from horovod_tpu.elastic.scheduler import _TenantSlotView
+    view = _TenantSlotView()
+    view.set({"h": 2})
+    d = ElasticDriver(["true"], view, min_np=2, max_np=2,
+                      failure_threshold=1, elastic_timeout=0.2,
+                      tenant_id="low", tenant_priority=1)
+    d._make_worker_proc = lambda slot, env: _AliveProc()
+    try:
+        d._hosts.update_available_hosts()
+        d._recompute_world("startup")
+        assert len(d._procs) == 2
+        epoch0 = d._epoch
+        d._spawn_backoff[("h", 0)] = 16.0  # pre-existing throttle
+        view.set({})
+        d.scheduler_preempt("higher-priority admission")
+        assert d.held()
+        assert d._epoch == epoch0 + 1          # proactive epoch bump
+        # Every worker was drain-terminated, not killed.
+        procs = list(d._procs.values())
+        assert procs and all(p.terminated for p in procs)
+        assert d._check_procs() is False       # reap the rc=85 exits
+        # The removal is PLANNED: zero failure counts, zero blacklist
+        # entries, respawn backoff reset.
+        assert d._registry._failures == {}
+        assert d._registry.blacklisted_hosts() == []
+        assert d._spawn_backoff == {}
+        # Held: the below-min deadline (elastic_timeout=0.2) must NOT
+        # fail the parked driver.
+        time.sleep(0.3)
+        assert d._check_procs() is False
+        # Resume re-forms the world from the handed-back slots.
+        view.set({"h": 2})
+        d.scheduler_resume()
+        assert not d.held()
+        assert d._epoch == epoch0 + 2
+        assert len(d._procs) == 2
+        assert d._registry.blacklisted_hosts() == []
+    finally:
+        _close_driver(d)
+
+
+def test_cross_tenant_isolation_bookkeeping():
+    """ISSUE 8 satellite (fast half of the injection certification):
+    tenant A's worker dies — as ``tenant.worker.die`` would kill it —
+    and every book of tenant B stays untouched: no blacklist entry, no
+    failure count, no epoch bump, allocation intact, worker alive."""
+    spawned = []  # (tenant_id, slot, proc) per spawn, in spawn order
+
+    def factory(tenant):
+        d = ElasticDriver(
+            ["true"], tenant.view, min_np=tenant.spec.min_np,
+            max_np=tenant.spec.max_np, failure_threshold=10,
+            discovery_interval=0.05, start_timeout=5,
+            respawn_backoff_base=0.05, respawn_backoff_cap=0.2,
+            tenant_id=tenant.tenant_id,
+            tenant_priority=tenant.spec.priority)
+
+        def mk(slot, env, d=d):
+            p = _AliveProc()
+            spawned.append((d.tenant_id, slot, p))
+            return p
+
+        d._make_worker_proc = mk
+        return d
+
+    def procs_of(tid):
+        return [p for t, _s, p in spawned if t == tid]
+
+    sched = PodScheduler(FixedHosts({"hA": 1, "hB": 1}),
+                         driver_factory=factory, tick_secs=0.05)
+    try:
+        assert sched.admit(TenantSpec("A", ["true"], priority=1,
+                                      min_np=1, max_np=1)) == RUNNING
+        assert sched.admit(TenantSpec("B", ["true"], priority=1,
+                                      min_np=1, max_np=1)) == RUNNING
+        da, db = sched.tenant_driver("A"), sched.tenant_driver("B")
+        assert _wait_for(lambda: len(procs_of("A")) == 1
+                         and len(procs_of("B")) == 1)
+        assert _wait_for(lambda: db._epoch >= 1)
+        host_a = [s for t, s, _p in spawned if t == "A"][0][0]
+        host_b = [s for t, s, _p in spawned if t == "B"][0][0]
+        assert host_a != host_b  # disjoint slot partitions
+        epoch_b = db._epoch
+        # tenant.worker.die@tenant=A fires: A's worker drops dead.
+        procs_of("A")[0].rc = 43
+        # A's own driver books the failure and re-forms A's world
+        # (epoch bump + respawn) ...
+        assert _wait_for(lambda: da._registry._failures.get(
+            host_a, 0) >= 1)
+        assert _wait_for(lambda: da._epoch > 1)
+        assert _wait_for(lambda: len(procs_of("A")) >= 2)  # respawned
+        # ... while EVERY book of tenant B is untouched: no blacklist,
+        # no failure counts, no epoch bump, allocation + worker intact.
+        time.sleep(0.3)  # several scheduler + driver ticks
+        assert db._registry.blacklisted_hosts() == []
+        assert db._registry._failures == {}
+        assert db._epoch == epoch_b
+        assert sched.allocation("B") == {host_b: 1}
+        assert procs_of("B")[0].rc is None  # B's worker never touched
+        assert len(procs_of("B")) == 1      # and never respawned
+        assert sched.tenant_state("B") == RUNNING
+        # And B's host never shows in A's books either (disjoint sets).
+        assert host_b not in da._registry._failures
+        # A's failure NEVER blacklisted a host at threshold 10.
+        assert da._registry.blacklisted_hosts() == []
+    finally:
+        sched.stop(timeout=5)
+
+
+def test_tenant_worker_die_targeting(monkeypatch):
+    """@tenant= conditions select exactly one tenant's processes, and
+    the commit-seam plant fires into the metrics plane."""
+    monkeypatch.setenv("HVD_TPU_FAULT",
+                       "tenant.worker.die:delay:0@tenant=A")
+    faultline.reset()
+    metrics.reset()
+    try:
+        monkeypatch.setenv("HOROVOD_TENANT_ID", "B")
+        assert faultline.armed("tenant.worker.die") is None
+        monkeypatch.setenv("HOROVOD_TENANT_ID", "A")
+        assert faultline.armed("tenant.worker.die") is not None
+        # The State.commit plant fires it (delay:0 = observable no-op).
+        from horovod_tpu.elastic.state import ObjectState
+        ObjectState(batch=0).commit()
+        assert metrics.series_sum("fault_injections_total",
+                                  site="tenant.worker.die") == 1
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        monkeypatch.delenv("HOROVOD_TENANT_ID")
+        faultline.reset()
+        metrics.reset()
+
+
+# -- tenant-scoped namespaces ----------------------------------------------
+
+def test_rendezvous_kv_tenant_namespace(monkeypatch):
+    """One shared KV server, two tenants, the same key: the namespace
+    prefix keeps the entries disjoint, and HOROVOD_TENANT_ID wires the
+    default."""
+    from horovod_tpu.runner.http_client import RendezvousClient
+    from horovod_tpu.runner.http_server import RendezvousServer
+    monkeypatch.delenv("HOROVOD_TENANT_ID", raising=False)
+    server = RendezvousServer(host="127.0.0.1", secret="s")
+    port = server.start()
+    try:
+        addr = "127.0.0.1:%d" % port
+        a = RendezvousClient(addr, secret="s", namespace="A")
+        b = RendezvousClient(addr, secret="s", namespace="B")
+        plain = RendezvousClient(addr, secret="s")
+        a.put("jax_coordinator:0", "10.0.0.1:99")
+        b.put("jax_coordinator:0", "10.0.0.2:99")
+        assert a.get("jax_coordinator:0") == "10.0.0.1:99"
+        assert b.get("jax_coordinator:0") == "10.0.0.2:99"
+        assert plain.get("jax_coordinator:0") is None
+        # Env-wired default namespace matches the explicit one.
+        monkeypatch.setenv("HOROVOD_TENANT_ID", "A")
+        env_client = RendezvousClient(addr, secret="s")
+        assert env_client.get("jax_coordinator:0") == "10.0.0.1:99"
+        a.delete("jax_coordinator:0")
+        assert a.get("jax_coordinator:0") is None
+        assert b.get("jax_coordinator:0") == "10.0.0.2:99"
+    finally:
+        server.stop()
+
+
+def test_spill_dir_tenant_namespace(tmp_path, monkeypatch):
+    """Two tenants sharing HOROVOD_STATE_SPILL_DIR spill into disjoint
+    subdirectories: tenant B can never restore tenant A's state."""
+    from horovod_tpu.elastic import spill
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_TENANT_ID", "A")
+    spill.write(3, b"tenant-A-state", "r0")
+    assert spill.load_newest() == (3, b"tenant-A-state")
+    assert (tmp_path / "tenant-A").is_dir()
+    monkeypatch.setenv("HOROVOD_TENANT_ID", "B")
+    assert spill.load_newest() is None
+    assert not spill.have_evidence()
+    spill.write(1, b"tenant-B-state", "r0")
+    assert spill.load_newest() == (1, b"tenant-B-state")
+    monkeypatch.setenv("HOROVOD_TENANT_ID", "A")
+    assert spill.load_newest() == (3, b"tenant-A-state")
+    # Without a tenant id the legacy un-namespaced path is untouched.
+    monkeypatch.delenv("HOROVOD_TENANT_ID")
+    assert spill.load_newest() is None
+
+
+def test_merged_render_labels_tenant_series():
+    """ISSUE 8 satellite: the fleet-wide /metrics merge rank-labels
+    tenant series correctly — tenant labels survive the merge and each
+    source keeps its own rank label."""
+    metrics.reset()
+    try:
+        metrics.gauge("tenant_slots", tenant="A",
+                      state="allocated").set(2)
+        metrics.counter("tenant_preemptions_total", tenant="A").inc()
+        driver_model = metrics.snapshot()
+        metrics.reset()
+        metrics.counter("engine_cycles_total").inc(5)
+        worker_model = metrics.snapshot()
+        text = metrics.render_merged([("scheduler", driver_model),
+                                      ("0", worker_model)])
+        assert ('tenant_slots{rank="scheduler",state="allocated",'
+                'tenant="A"} 2') in text
+        assert ('tenant_preemptions_total{rank="scheduler",'
+                'tenant="A"} 1') in text
+        assert 'engine_cycles_total{rank="0"} 5' in text
+        # One HELP/TYPE per family, as the exposition format requires.
+        assert text.count("# TYPE tenant_slots gauge") == 1
+    finally:
+        metrics.reset()
+
+
+# -- real-process e2e (slow: 2 tenants, real elastic worlds) ---------------
+
+TENANT_WORKER = """
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(batch=0)
+
+def note(line):
+    with open(os.environ["TENANT_LOG"], "a") as f:
+        f.write(line + "\\n")
+
+@elastic.run
+def train(state):
+    note("ENTER batch=%d commit=%d" % (state.batch, state._commit_id))
+    while state.batch < int(os.environ["TENANT_BATCHES"]):
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.batch += 1
+        note("STEP %d" % state.batch)
+        time.sleep(float(os.environ.get("TENANT_STEP_SECS", "0.05")))
+        state.commit()
+    note("DONE batch=%d" % state.batch)
+
+train(state)
+"""
+
+
+def _tenant_env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_RANK", None)
+    env.pop("HOROVOD_ELASTIC_DRIVER_ADDR", None)
+    env.update(extra or {})
+    return env
+
+
+def _lines(path):
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+@pytest.mark.slow
+def test_scheduler_two_tenant_isolation_e2e(tmp_path):
+    """ISSUE 8 acceptance: with ``tenant.worker.die`` armed against
+    tenant A (die at A's 3rd epoch-1 commit), tenant B completes all
+    its steps with NO blacklist entries and NO drained-slot misbooking
+    — and A itself recovers (the respawn runs in a later epoch, where
+    the injection no longer fires) and finishes."""
+    script = tmp_path / "train.py"
+    script.write_text(TENANT_WORKER)
+    log_a, log_b = tmp_path / "a.log", tmp_path / "b.log"
+    base = _tenant_env({
+        "HVD_TPU_FAULT":
+            "tenant.worker.die:die:43@tenant=A@epoch=1@after=2",
+    })
+    sched = PodScheduler(
+        FixedHosts({"127.0.0.1": 2}), env=base, tick_secs=0.2,
+        failure_threshold=10,       # A's own death must not strand A
+        start_timeout=60)
+    try:
+        sched.start()
+        assert sched.admit(TenantSpec(
+            "A", [sys.executable, str(script)], priority=1,
+            min_np=1, max_np=1,
+            env={"TENANT_LOG": str(log_a), "TENANT_BATCHES": "6"},
+        )) == RUNNING
+        assert sched.admit(TenantSpec(
+            "B", [sys.executable, str(script)], priority=1,
+            min_np=1, max_np=1,
+            env={"TENANT_LOG": str(log_b), "TENANT_BATCHES": "6"},
+        )) == RUNNING
+        assert _wait_for(lambda: sched.tenant_state("A") == DONE
+                         and sched.tenant_state("B") == DONE,
+                         timeout=240, interval=0.25), (
+            "A=%s B=%s\nA log: %r\nB log: %r"
+            % (sched.tenant_state("A"), sched.tenant_state("B"),
+               _lines(log_a), _lines(log_b)))
+        da, db = sched.tenant_driver("A"), sched.tenant_driver("B")
+        # The injection really fired: A died once and re-entered at
+        # its committed step (the epoch-2 worker restores commit 3).
+        a_lines = _lines(log_a)
+        assert a_lines.count("DONE batch=6") == 1, a_lines
+        assert len([l for l in a_lines if l.startswith("ENTER")]) >= 2, \
+            a_lines
+        # The failure was reaped and A's world re-formed (a clean
+        # recovery rightly CLEARS the streak — r8 record_success — so
+        # the monotonic counter and epoch are the injection's proof).
+        assert metrics.series_sum("elastic_worker_failures_total",
+                                  tenant="A") >= 1
+        assert da._epoch >= 2
+        # Isolation: B's books are spotless — no blacklist, no failure
+        # counts, no epoch churn — and B advanced through all steps.
+        b_lines = _lines(log_b)
+        assert "DONE batch=6" in b_lines, b_lines
+        assert [l for l in b_lines if l.startswith("STEP")] == \
+            ["STEP %d" % i for i in range(1, 7)], b_lines
+        assert db._registry.blacklisted_hosts() == []
+        assert db._registry._failures == {}
+        assert db._epoch == 1
+    finally:
+        sched.stop(timeout=30)
+
+
+@pytest.mark.slow
+def test_scheduler_preemption_restores_from_spill_e2e(tmp_path):
+    """ISSUE 8 acceptance: a higher-priority admission drain-preempts
+    the running tenant (planned removal: commit + spill + rc=85, no
+    blacklist), the displacing tenant completes, and the preempted
+    tenant resumes FROM ITS r10 SPILL at the committed step."""
+    script = tmp_path / "train.py"
+    script.write_text(TENANT_WORKER)
+    log_low, log_high = tmp_path / "low.log", tmp_path / "high.log"
+    base = _tenant_env({
+        "HOROVOD_STATE_SPILL_DIR": str(tmp_path / "spills"),
+        "HOROVOD_PREEMPT_GRACE_SECS": "20",
+    })
+    sched = PodScheduler(FixedHosts({"127.0.0.1": 1}), env=base,
+                         tick_secs=0.2, start_timeout=60)
+    try:
+        sched.start()
+        assert sched.admit(TenantSpec(
+            "low", [sys.executable, str(script)], priority=1,
+            min_np=1, max_np=1,
+            env={"TENANT_LOG": str(log_low), "TENANT_BATCHES": "40",
+                 "TENANT_STEP_SECS": "0.2"},
+        )) == RUNNING
+        # Let the low tenant make real committed progress first.
+        assert _wait_for(
+            lambda: len([l for l in _lines(log_low)
+                         if l.startswith("STEP")]) >= 3,
+            timeout=120, interval=0.25), _lines(log_low)
+        assert sched.admit(TenantSpec(
+            "high", [sys.executable, str(script)], priority=9,
+            min_np=1, max_np=1,
+            env={"TENANT_LOG": str(log_high), "TENANT_BATCHES": "3",
+                 "TENANT_STEP_SECS": "0.05"},
+        )) in (RUNNING, PENDING)
+        assert _wait_for(lambda: sched.tenant_state("low") == PREEMPTED,
+                         timeout=60, interval=0.25)
+        d_low = sched.tenant_driver("low")
+        # The preemption is a PLANNED removal: nothing booked as a
+        # failure while low is parked.
+        assert d_low._registry.blacklisted_hosts() == []
+        assert d_low._registry._failures == {}
+        assert sched.allocation("low") == {}
+        # The displacing tenant runs to completion on the freed slot,
+        # then low resumes ...
+        assert _wait_for(lambda: sched.tenant_state("high") == DONE,
+                         timeout=240, interval=0.25), _lines(log_high)
+        assert _wait_for(lambda: sched.tenant_state("low") == RUNNING,
+                         timeout=60, interval=0.25)
+        # ... from its spill at the committed step, NOT from zero: the
+        # resumed worker's ENTER line carries the pre-preemption
+        # commit.
+        def resumed_enter():
+            enters = [l for l in _lines(log_low)
+                      if l.startswith("ENTER")]
+            return len(enters) >= 2 and enters[-1] != enters[0]
+        assert _wait_for(resumed_enter, timeout=120, interval=0.25), \
+            _lines(log_low)
+        enters = [l for l in _lines(log_low) if l.startswith("ENTER")]
+        resumed_batch = int(enters[-1].split("batch=")[1].split()[0])
+        assert resumed_batch >= 3, enters
+        assert d_low._registry.blacklisted_hosts() == []
+        assert metrics.series_sum("tenant_preemptions_total",
+                                  tenant="low") >= 1
+    finally:
+        sched.stop(timeout=30)
