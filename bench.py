@@ -200,6 +200,16 @@ def lever_attribution(jax, jnp, on_accel, peak):
         lev["metrics"] = _metrics.metrics_snapshot()
     except Exception as exc:  # noqa: BLE001 - attribution is optional
         print("metrics snapshot degraded: %s" % exc, file=sys.stderr)
+    try:
+        # Collective-plan plane attribution: cache path, hit/miss and
+        # per-source apply counters, schema version, plan source and
+        # the per-(op, size_class) hier/flat decision table — so a
+        # BENCH delta is attributable to a warm-started (or re-tuned)
+        # plan rather than a whole round.
+        from horovod_tpu.utils import plancache
+        lev["plan"] = plancache.describe()
+    except Exception as exc:  # noqa: BLE001 - attribution is optional
+        print("plan attribution degraded: %s" % exc, file=sys.stderr)
     return lev
 
 

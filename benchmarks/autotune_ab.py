@@ -16,18 +16,130 @@ Worlds:
   negotiation cycle, so the tuner scores real communication time)
 
 Set HOROVOD_AUTOTUNE=1 [HOROVOD_AUTOTUNE_LOG=samples.csv] for the B arm.
+
+Plan-cache A/B (the persistent collective-plan cache, r14):
+    python benchmarks/autotune_ab.py --plan-ab --cpu-devices 2 \
+        --steps 80 --tensors 4
+runs the SAME loop twice in child processes sharing one
+HOROVOD_PLAN_CACHE_DIR: a cold run (empty cache; the GP tuner samples
+from scratch and persists its operating point at shutdown) and a warm
+run (primed cache; ``hvd.init`` warm-starts the tuner from the blob).
+The summary line reports steps-to-converged-throughput for both arms,
+the warm run's ``plan_cache_hits_total`` / ``plan_apply_total{source=
+cache}`` counters, and the GP sample counts — a working cache shows
+the warm run converging sooner with strictly fewer tuner samples.
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _steps_to_converged(step_secs, window=10, slack=1.15):
+    """First step index from which a ``window``-step rolling mean stays
+    within ``slack`` of the converged floor (median of the last
+    quarter) — the cold-vs-warm headline: a warm start lands inside the
+    converged regime immediately instead of sampling its way there."""
+    if len(step_secs) < max(window, 8):
+        return None
+    tail = sorted(step_secs[-max(len(step_secs) // 4, window):])
+    floor = tail[len(tail) // 2]
+    means = [sum(step_secs[i:i + window]) / window
+             for i in range(len(step_secs) - window + 1)]
+    for i, m in enumerate(means):
+        if m <= slack * floor and all(mm <= slack * floor
+                                      for mm in means[i:]):
+            return i
+    return len(step_secs)
+
+
+def _tuner_snapshot():
+    """(samples, warmup_left, frozen) from whichever tuner this world
+    runs — the Python ParameterManager (in-process) or the native core
+    (tcp/multihost) — read BEFORE shutdown persists it."""
+    from horovod_tpu.common import basics
+    eng = getattr(basics._state, "engine", None)
+    pm = getattr(eng, "parameter_manager", None)
+    if pm is not None:
+        return {"samples": pm.samples_done,
+                "warmup_left": pm.warmup_left,
+                "frozen": bool(pm.frozen)}
+    core = getattr(basics._state, "tcp_core", None)
+    if core is not None:
+        st = core.autotune_state()
+        if st is not None:
+            return {"samples": st["samples"],
+                    "warmup_left": st["warmup_left"],
+                    "frozen": bool(st["converged"])}
+    return None
+
+
+def _run_plan_ab(args, passthrough):
+    """Cold-vs-warm orchestrator: two child runs of this script sharing
+    one plan-cache dir; child JSON is compared on convergence speed and
+    the warm run's cache counters."""
+    cache_dir = args.plan_cache_dir or tempfile.mkdtemp(
+        prefix="hvd-plan-ab-")
+    child_cmd = [sys.executable, os.path.abspath(__file__)] + passthrough
+
+    def run_child(tag):
+        env = dict(os.environ)
+        env["HOROVOD_PLAN_CACHE_DIR"] = cache_dir
+        env["HOROVOD_PLAN_CACHE"] = "1"
+        env["HOROVOD_AUTOTUNE"] = "1"
+        # Fast-converging tuner settings so the cold arm actually
+        # persists a converged point inside a short run; explicit
+        # operator envs still win.
+        env.setdefault("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+        env.setdefault("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "2")
+        proc = subprocess.run(child_cmd, capture_output=True, text=True,
+                              env=env)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            raise RuntimeError("%s plan-ab child failed" % tag)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.strip().startswith("{")]
+        return json.loads(lines[-1])
+
+    cold = run_child("cold")
+    warm = run_child("warm")
+
+    def arm(rec):
+        plan = rec.get("plan") or {}
+        return {
+            "steps_per_sec": rec["value"],
+            "steps_to_converged": rec.get("steps_to_converged"),
+            "tuner": rec.get("tuner"),
+            "cache_hits": plan.get("hits", 0),
+            "cache_misses": plan.get("misses", 0),
+            "apply": plan.get("apply", {}),
+        }
+
+    cold_arm, warm_arm = arm(cold), arm(warm)
+    cold_samples = (cold_arm["tuner"] or {}).get("samples", 0)
+    warm_samples = (warm_arm["tuner"] or {}).get("samples", 0)
+    print(json.dumps({
+        "metric": "autotune_plan_ab",
+        "unit": "steps",
+        "plan_cache_dir": cache_dir,
+        "cold": cold_arm,
+        "warm": warm_arm,
+        # The acceptance gates: a working cache means the warm arm hit
+        # the blob, applied it, and sampled strictly less.
+        "warm_cache_hit": warm_arm["cache_hits"] > 0,
+        "warm_applied_from_cache":
+            warm_arm["apply"].get("cache", 0) > 0,
+        "tuner_samples_saved": cold_samples - warm_samples,
+    }))
 
 
 def main():
@@ -46,7 +158,34 @@ def main():
                          "grouped-bucket BURST shape (one negotiation "
                          "+ one fused device program per step) — "
                          "instead of per-tensor asyncs")
+    ap.add_argument("--plan-ab", action="store_true",
+                    help="cold-vs-warm plan-cache A/B: run the loop "
+                         "twice in children sharing one "
+                         "HOROVOD_PLAN_CACHE_DIR and compare steps-to-"
+                         "converged-throughput + tuner sample counts")
+    ap.add_argument("--plan-cache-dir", default=None,
+                    help="shared cache dir for --plan-ab (default: a "
+                         "fresh temp dir, so the first arm is truly "
+                         "cold)")
     args = ap.parse_args()
+
+    if args.plan_ab:
+        passthrough = []
+        skip = False
+        for tok in sys.argv[1:]:
+            if skip:
+                skip = False
+                continue
+            if tok == "--plan-ab":
+                continue
+            if tok == "--plan-cache-dir":
+                skip = True
+                continue
+            if tok.startswith("--plan-cache-dir="):
+                continue
+            passthrough.append(tok)
+        _run_plan_ab(args, passthrough)
+        return
 
     if args.cpu_devices:
         flags = os.environ.get("XLA_FLAGS", "")
@@ -98,16 +237,29 @@ def main():
         step(s)
     t0 = time.perf_counter()
     out = None
+    step_secs = []
     for s in range(args.steps):
+        ts = time.perf_counter()
         out = step(s)
+        step_secs.append(time.perf_counter() - ts)
     # Force the last result so async tails are inside the clock.
     float(np.asarray(out).reshape(-1)[0])
     dt = time.perf_counter() - t0
 
+    # Plan-cache attribution, read BEFORE shutdown (shutdown persists
+    # and tears down the live tuner this snapshot reads).
+    plan_info = tuner_info = None
+    try:
+        from horovod_tpu.utils import plancache
+        plan_info = plancache.describe()
+        tuner_info = _tuner_snapshot()
+    except Exception as exc:  # noqa: BLE001 - attribution is optional
+        print("plan attribution degraded: %s" % exc, file=sys.stderr)
+
     total_bytes = sum(
         (g.nbytes if multiproc else g.nbytes // n) for g in grads)
     if hvd.rank() == 0:
-        print(json.dumps({
+        rec = {
             "metric": "autotune_ab_steps_per_sec",
             "value": round(args.steps / dt, 2),
             "unit": "steps/sec",
@@ -118,7 +270,13 @@ def main():
             "ranks": n,
             "mb_per_sec": round(
                 total_bytes * args.steps / dt / 1e6, 1),
-        }))
+            "steps_to_converged": _steps_to_converged(step_secs),
+        }
+        if plan_info is not None:
+            rec["plan"] = plan_info
+        if tuner_info is not None:
+            rec["tuner"] = tuner_info
+        print(json.dumps(rec))
     hvd.shutdown()
 
 

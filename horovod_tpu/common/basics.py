@@ -110,11 +110,21 @@ def init(devices: Optional[Sequence] = None,
         if config.timeline:
             timeline.initialize(config.timeline, config.timeline_mark_cycles)
 
+        # Collective-plan plane (persistent autotuned plans): fresh
+        # state per init — an elastic re-init re-loads/adopts against
+        # the (possibly resized) world's fingerprint.
+        from ..utils import plancache
+        plancache.reset()
+
         if mode == "inprocess":
             import jax
             from ..ops.engine import CollectiveEngine
             devs = list(devices) if devices is not None else list(jax.devices())
             _state.topology = inprocess_topology(devs)
+            # Plan bootstrap BEFORE the engine: the cached tuned
+            # operating point must land in config before the cycle
+            # loop reads it.
+            plancache.bootstrap(config, _state.topology, mode)
             _state.engine = CollectiveEngine(
                 devs, config, timeline, _resolve_process_set_ranks)
             if config.autotune:
@@ -123,7 +133,8 @@ def init(devices: Optional[Sequence] = None,
                     config.fusion_threshold_bytes, config.cycle_time_ms,
                     log_path=config.autotune_log,
                     warmup=config.autotune_warmup_samples,
-                    steps_per_sample=config.autotune_steps_per_sample)
+                    steps_per_sample=config.autotune_steps_per_sample,
+                    warm_start=plancache.tuned_warm_start())
         elif mode in ("tcp", "multihost"):
             from ..core.client import TcpCore
             _state.topology = multiprocess_topology(
@@ -136,6 +147,12 @@ def init(devices: Optional[Sequence] = None,
                 from .multihost import init_jax_distributed
                 init_jax_distributed(config, _state.topology.rank,
                                      _state.topology.size)
+            # Plan bootstrap: rank 0 loads its cache and publishes to
+            # the rendezvous KV; other members adopt the published
+            # copy so every member routes identically (late joiners
+            # and respawned workers warm-start from the pod's
+            # best-known plan instead of re-tuning).
+            plancache.bootstrap(config, _state.topology, mode)
             _state.tcp_core = TcpCore(_state.topology, config)
             try:
                 _state.tcp_core.initialize()
@@ -148,6 +165,16 @@ def init(devices: Optional[Sequence] = None,
                     pass
                 _state.tcp_core = None
                 raise
+            ws = plancache.tuned_warm_start()
+            if ws is not None:
+                # Native warm start, NOT gated on config.autotune: the
+                # controller reads params_->fusion_threshold() every
+                # negotiation round whether or not the tuner samples,
+                # so a rerun with autotuning off still runs AT the
+                # cached operating point (the natural "reuse the tuned
+                # plan" rerun).  Rank 0's coordinator broadcasts the
+                # values; a harmless store on workers.
+                _state.tcp_core.autotune_warm_start(*ws)
             if mode == "multihost":
                 from ..ops.multihost import MultihostEngine
                 _state.mh_engine = MultihostEngine(
@@ -204,6 +231,13 @@ def shutdown():
     with _state.lock:
         if not _state.initialized:
             return
+        # Persist the collective-plan plane FIRST, while the live
+        # tuners (in-process ParameterManager / native core) can still
+        # be read: the merged plan (per-class decisions + tuned point
+        # + flash blocks) is what the next run warm-starts from.
+        from ..utils import plancache
+        plancache.finalize(tcp_core=_state.tcp_core,
+                           engine=_state.engine)
         if _state.engine is not None:
             _state.engine.shutdown()
             _state.engine = None

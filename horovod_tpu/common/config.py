@@ -57,6 +57,25 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _env_opt_bool(name: str) -> Optional[bool]:
+    """Tri-state bool: None when unset (caller picks the follow-on
+    default), else the usual truthiness parse."""
+    v = _env(name)
+    if v is None or not v.strip():
+        return None
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_explicit(name: str) -> bool:
+    """Whether the operator explicitly set ``HVD_TPU_<name>`` or
+    ``HOROVOD_<name>`` — the plan cache's env-precedence probe: an
+    explicit knob wins over any persisted plan AND suppresses pinning
+    (the r9 flash-block convention), which needs set-ness, not the
+    parsed value."""
+    return (os.environ.get("HVD_TPU_" + name) is not None
+            or os.environ.get("HOROVOD_" + name) is not None)
+
+
 def _parse_hier_mode(v: Optional[str]) -> str:
     """auto | on | off, failing loudly on anything else (a typo that
     silently pinned the one-device plane would discard the multi-chip
@@ -110,6 +129,21 @@ class Config:
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+
+    # --- collective-plan cache (persistent autotuned plans) ---
+    # Versioned on-disk plan cache keyed by topology fingerprint: the
+    # per-(op, size_class) hier/codec decision table, the tuned
+    # (fusion, cycle) operating point and the flash-block registry,
+    # loaded at init() so reruns cold-start at the tuned point and
+    # persisted at shutdown (utils/plancache.py).  Unset dir = no
+    # on-disk persistence (a rendezvous KV still fleet-shares plans;
+    # with neither the plane is inert); HOROVOD_PLAN_CACHE=0 disables
+    # the plane entirely.
+    plan_cache: bool = True
+    plan_cache_dir: Optional[str] = None
+    # Per-(op, size_class) plan tuning enable (the widened search
+    # space).  None (unset) follows HOROVOD_AUTOTUNE.
+    plan_autotune: Optional[bool] = None
 
     # --- timeline (chrome trace) ---
     timeline: Optional[str] = None
@@ -196,6 +230,9 @@ class Config:
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
             autotune_steps_per_sample=_env_int(
                 "AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            plan_cache=_env_bool("PLAN_CACHE", True),
+            plan_cache_dir=_env("PLAN_CACHE_DIR"),
+            plan_autotune=_env_opt_bool("PLAN_AUTOTUNE"),
             timeline=_env("TIMELINE"),
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             stall_warning_secs=_env_float(

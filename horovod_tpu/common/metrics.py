@@ -104,6 +104,24 @@ NAMES: Dict[str, Tuple[str, str]] = {
         "gauge", "payload-to-wire byte ratio of the most recent "
                  "compressed cross-host collective, labeled op + "
                  "codec (4.0 = int8 from f32, incl. scale overhead)"),
+    # -- collective-plan cache (persistent autotuned plans) --
+    "plan_cache_hits_total": (
+        "counter", "persisted collective-plan blobs successfully "
+                   "loaded at init (topology-fingerprint match, valid "
+                   "CRC and schema)"),
+    "plan_cache_misses_total": (
+        "counter", "plan-cache probes that found no usable blob "
+                   "(absent, corrupt, schema- or fingerprint-"
+                   "mismatched — the latter are warned about loudly)"),
+    "plan_apply_total": (
+        "counter", "plan decisions applied to live routing or tuner "
+                   "warm starts, labeled source (cache|kv|tuned|"
+                   "default); counted once per (op, size_class) "
+                   "resolution, not per collective"),
+    "plan_tune_samples_total": (
+        "counter", "per-class plan-tuner samples scored by the GP/EI "
+                   "sweep, labeled op + size_class (zero on a "
+                   "warm-started rerun = the cache skipped re-tuning)"),
     # -- runner control plane (r8 retry/backoff layer) --
     "rpc_attempts_total": (
         "counter", "control-plane RPC attempts (including retries)"),

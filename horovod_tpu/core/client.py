@@ -126,6 +126,22 @@ def load_library():
     lib.hvd_tcp_autotune_observe.argtypes = [ctypes.c_ulonglong,
                                              ctypes.c_double]
     lib.hvd_tcp_autotune_observe.restype = None
+    try:
+        # r14 symbols: a stale pre-plan-cache .so must degrade the warm
+        # start (TcpCore guards the call sites), never fail library
+        # load for every tcp/multihost init.
+        lib.hvd_tcp_autotune_warm_start.argtypes = [ctypes.c_ulonglong,
+                                                    ctypes.c_double,
+                                                    ctypes.c_int]
+        lib.hvd_tcp_autotune_warm_start.restype = None
+        lib.hvd_tcp_autotune_state.argtypes = [
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.hvd_tcp_autotune_state.restype = None
+    except AttributeError:
+        pass
     lib.hvd_tcp_kernel_tune_record.argtypes = [ctypes.c_int,
                                                ctypes.c_double]
     lib.hvd_tcp_kernel_tune_record.restype = None
@@ -444,6 +460,40 @@ class TcpCore:
         """Report a device-plane allreduce group's (bytes, time-to-
         completion) to rank 0's autotuner (no-op elsewhere)."""
         self._lib.hvd_tcp_autotune_observe(int(nbytes), float(secs))
+
+    def autotune_warm_start(self, fusion_threshold: int,
+                            cycle_time_ms: float, converged: bool):
+        """Adopt a persisted plan's tuned operating point (plan-cache
+        warm start): converged plans freeze the rank-0 tuner at the
+        point; unconverged ones resume sampling there with a single
+        warm-up cycle left.  No-op on a stale .so."""
+        try:
+            fn = self._lib.hvd_tcp_autotune_warm_start
+        except AttributeError:  # stale .so: degrade, don't fail init
+            return
+        fn(int(fusion_threshold), float(cycle_time_ms),
+           1 if converged else 0)
+
+    def autotune_state(self) -> Optional[dict]:
+        """Native tuner snapshot for plan persistence, or None on a
+        stale .so without the symbol."""
+        try:
+            fn = self._lib.hvd_tcp_autotune_state
+        except AttributeError:  # stale .so: degrade, don't fail shutdown
+            return None
+        fusion = ctypes.c_ulonglong()
+        cycle = ctypes.c_double()
+        converged = ctypes.c_int()
+        samples = ctypes.c_int()
+        warmup = ctypes.c_int()
+        fn(ctypes.byref(fusion), ctypes.byref(cycle),
+           ctypes.byref(converged), ctypes.byref(samples),
+           ctypes.byref(warmup))
+        return {"fusion_threshold": int(fusion.value),
+                "cycle_time_ms": float(cycle.value),
+                "converged": bool(converged.value),
+                "samples": int(samples.value),
+                "warmup_left": int(warmup.value)}
 
     def kernel_tune_record(self, choice: int, score: float):
         """Report one kernel-parameter sample (flash block-shape sweep)

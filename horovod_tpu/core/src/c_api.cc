@@ -118,6 +118,27 @@ void hvd_tcp_autotune_observe(unsigned long long bytes, double secs) {
   CoreState::Get().AutotuneObserve(static_cast<uint64_t>(bytes), secs);
 }
 
+// Plan-cache warm start: adopt a persisted tuned operating point —
+// sampling starts there with the warm-up window skipped, a converged
+// plan freezes the tuner.  Meaningful on the rank-0 coordinator (the
+// only registered tuner); a harmless value store elsewhere.
+void hvd_tcp_autotune_warm_start(unsigned long long fusion,
+                                 double cycle_ms, int converged) {
+  CoreState::Get().params().WarmStart(static_cast<uint64_t>(fusion),
+                                      cycle_ms, converged != 0);
+}
+
+// Tuner state snapshot for plan persistence; any out pointer may be
+// null.
+void hvd_tcp_autotune_state(unsigned long long* fusion, double* cycle_ms,
+                            int* converged, int* samples,
+                            int* warmup_left) {
+  uint64_t f = 0;
+  CoreState::Get().params().State(&f, cycle_ms, converged, samples,
+                                  warmup_left);
+  if (fusion) *fusion = static_cast<unsigned long long>(f);
+}
+
 // Kernel-parameter tuner (flash-attention block shapes): the Python
 // sweep reports per-choice scores; Best() is the argmax-by-mean
 // choice index, -1 before any sample.

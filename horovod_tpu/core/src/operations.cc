@@ -69,8 +69,15 @@ Status CoreState::Initialize(int rank, int size,
   bool autotune = EnvBool("HVD_TPU_AUTOTUNE", "HOROVOD_AUTOTUNE", false);
   const char* at_log = EnvStr("HVD_TPU_AUTOTUNE_LOG",
                               "HOROVOD_AUTOTUNE_LOG");
+  // Rank-stamped log writer (the journal convention, mirrored by the
+  // Python AutotuneLog): ranks or concurrent worlds sharing one
+  // HOROVOD_AUTOTUNE_LOG value own separate ".r<rank>" files and
+  // append instead of clobbering, so CSV rows never interleave.
+  std::string at_log_path =
+      at_log ? std::string(at_log) + ".r" + std::to_string(rank)
+             : std::string();
   params_.Configure(fusion, cycle_time_ms_, autotune && rank == 0,
-                    at_log ? at_log : "",
+                    at_log_path,
                     static_cast<int>(EnvU64(
                         "HVD_TPU_AUTOTUNE_WARMUP_CYCLES",
                         "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 5)),

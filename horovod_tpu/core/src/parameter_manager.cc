@@ -130,10 +130,52 @@ void ParameterManager::Configure(uint64_t fusion_threshold,
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
   if (enabled && !log_path.empty()) {
-    log_ = std::fopen(log_path.c_str(), "w");
-    if (log_)
-      std::fprintf(log_, "sample,fusion_bytes,cycle_ms,score_bytes_per_s\n");
+    // Append, never truncate (the r11 journal conventions, mirrored by
+    // utils/autotune.py AutotuneLog): the caller rank-stamps the path
+    // so each writer owns its file, "a" puts the fd in O_APPEND so a
+    // restarted run extends rather than clobbers, and each record is
+    // one fprintf of a full line.  The header lands only in an empty
+    // file.
+    log_ = std::fopen(log_path.c_str(), "a");
+    if (log_) {
+      std::fseek(log_, 0, SEEK_END);
+      if (std::ftell(log_) == 0) {
+        std::fprintf(log_,
+                     "sample,fusion_bytes,cycle_ms,score_bytes_per_s\n");
+        std::fflush(log_);
+      }
+    }
   }
+}
+
+void ParameterManager::WarmStart(uint64_t fusion_threshold,
+                                 double cycle_time_ms, bool converged) {
+  std::lock_guard<std::mutex> lk(mu_);
+  fusion_threshold_ = fusion_threshold;
+  cycle_time_ms_ = cycle_time_ms;
+  // Converged plans freeze the tuner, so no warm-up is needed; an
+  // unconverged point resumes sampling and keeps ONE warm-up cycle to
+  // discard the rerun's compile-skewed first observation (the Python
+  // ParameterManager mirrors this).
+  warmup_ = converged ? 0 : std::min(warmup_, 1);
+  converged_ = converged;
+  if (log_) {
+    std::fprintf(log_, "# warm-start: fusion=%llu cycle=%.3f converged=%d\n",
+                 static_cast<unsigned long long>(fusion_threshold_),
+                 cycle_time_ms_, converged ? 1 : 0);
+    std::fflush(log_);
+  }
+}
+
+void ParameterManager::State(uint64_t* fusion, double* cycle_ms,
+                             int* converged, int* samples_done,
+                             int* warmup_left) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (fusion) *fusion = fusion_threshold_;
+  if (cycle_ms) *cycle_ms = cycle_time_ms_;
+  if (converged) *converged = converged_ ? 1 : 0;
+  if (samples_done) *samples_done = samples_done_;
+  if (warmup_left) *warmup_left = warmup_ > 0 ? warmup_ : 0;
 }
 
 void ParameterManager::Apply(int grid_index) {

@@ -70,6 +70,19 @@ class ParameterManager {
   // completion times (hvd_tcp_autotune_observe).
   bool Observe(uint64_t bytes, double secs);
 
+  // Plan-cache warm start (hvd_tcp_autotune_warm_start): adopt a
+  // persisted tuned operating point — sampling starts AT the point
+  // with the warm-up window skipped, and a converged plan freezes the
+  // tuner entirely, so a rerun never re-walks the grid it already
+  // searched.
+  void WarmStart(uint64_t fusion_threshold, double cycle_time_ms,
+                 bool converged);
+
+  // Snapshot for plan persistence (hvd_tcp_autotune_state); any out
+  // pointer may be null.
+  void State(uint64_t* fusion, double* cycle_ms, int* converged,
+             int* samples_done, int* warmup_left) const;
+
   uint64_t fusion_threshold() const {
     std::lock_guard<std::mutex> lk(mu_);
     return fusion_threshold_;

@@ -164,6 +164,9 @@ class CollectiveEngine:
             shutdown_secs=config.stall_shutdown_secs,
             enabled=not config.stall_check_disable)
         self.parameter_manager = None  # installed by basics when autotuning
+        # One-shot latch: the converged GP point is staged into the
+        # plan cache exactly once (cycle-thread only).
+        self._pm_converged_noted = False  # graftlint: owned-by=hvd-tpu-cycle
         # Ranks marked out-of-data (reference JoinOp): they contribute
         # zeros to allreduces until every rank has joined.  Ordered so
         # finalize can report the LAST rank to join, like the core.
@@ -333,6 +336,21 @@ class CollectiveEngine:
                         self.parameter_manager.fusion_threshold)
                     self.config.cycle_time_ms = (
                         self.parameter_manager.cycle_time_ms)
+                    if (self.parameter_manager.frozen
+                            and self.parameter_manager.samples_done > 0
+                            and not self._pm_converged_noted):
+                        # Stage the converged operating point for the
+                        # plan cache the moment the GP pins it —
+                        # convergence is only observable here, and
+                        # shutdown persists whatever was staged.
+                        # samples_done > 0 excludes a PM that was
+                        # BORN frozen from a cache warm start: its
+                        # point is cached provenance, not tuned.
+                        self._pm_converged_noted = True
+                        from ..utils import plancache
+                        plancache.note_tuned(
+                            self.parameter_manager.fusion_threshold,
+                            self.parameter_manager.cycle_time_ms, True)
             try:
                 self.stall_inspector.check()
             except Exception as exc:  # StallError -> fail outstanding ops

@@ -326,6 +326,20 @@ class GlobalMeshCollectives:
         self._res2: "collections.OrderedDict" = collections.OrderedDict()
         self._res2_cap = max(int(getattr(
             cfg, "compression_residual_buckets", 64)), 1)
+        # Collective-plan plane (persistent autotuned plans): per-(op,
+        # size_class) routing decisions — hier/flat leg + codec
+        # engagement — from the plan loaded/adopted at init().  None
+        # when the plane is disabled or this mesh's topology differs
+        # from the tuned fingerprint (process-set sub-meshes); routing
+        # then falls back to the global byte-threshold gate unchanged.
+        self._plan_ctl = None
+        try:
+            from ..utils import plancache
+            self._plan_ctl = plancache.controller_for(
+                self.size, self.local_size,
+                getattr(devs[0], "device_kind", devs[0].platform))
+        except Exception:  # noqa: BLE001 - plans must never block a mesh
+            self._plan_ctl = None
         # Capacity-bounded LRU like the in-process engine (the
         # reference's HOROVOD_CACHE_CAPACITY): long jobs with varying
         # shapes must not grow compiled programs without bound.
@@ -451,6 +465,19 @@ class GlobalMeshCollectives:
         return (self.local_size > 1
                 and (self._hier_mode == "on"
                      or int(nbytes) >= self._hier_threshold))
+
+    def _route(self, op: str, nbytes: int):  # graftlint: hot-path
+        """(use_hier, engage_codec) for one dispatch: the per-(op,
+        size_class) plan wins when the plan plane is active (explicit
+        gate envs win over it and suppress pinning, resolved at
+        controller construction), otherwise the global byte-threshold
+        gate with the codec left to ``_wire_codec``.  Every member
+        resolves identically — the plan is shared via the cache blob /
+        KV adoption — so negotiated programs never diverge."""
+        hier = self._hier_eligible(nbytes)
+        if self._plan_ctl is None:
+            return hier, True
+        return self._plan_ctl.route(op, _pow2_class(nbytes), hier)
 
     def _stage_hier(self, segments, total: int, chunk: int, np_dtype):
         """Stage ``segments`` as this process's (1, k, chunk) slab of a
@@ -667,15 +694,18 @@ class GlobalMeshCollectives:
             return self._fused_allreduce_packed(
                 payloads, lengths, dtype, red_op, prescale, postscale,
                 notify)
-        if (len(lengths) == 1 and red_op != ADASUM
-                and self._hier_eligible(
-                    lengths[0] * np.dtype(dtype).itemsize)):
+        hier = codec_on = False
+        if len(lengths) == 1 and red_op != ADASUM:
+            hier, codec_on = self._route(
+                "allreduce", lengths[0] * np.dtype(dtype).itemsize)
+        if hier:
             # Multi-chip hierarchical path: every local chip moves 1/k
             # of the bytes cross-host instead of chip 0 moving all of
             # them.  Adasum is excluded — its combine is dot-product
             # based over the WHOLE vector, so per-chunk combines would
             # change the math (it stays on the one-device plane).
-            codec = self._wire_codec(dtype, red_op)
+            codec = (self._wire_codec(dtype, red_op) if codec_on
+                     else None)
             _count_path("allreduce",
                         lengths[0] * np.dtype(dtype).itemsize, True,
                         codec,
@@ -913,8 +943,8 @@ class GlobalMeshCollectives:
             local = (local.astype(jnp.uint8) if _is_device_array(local)
                      else np.asarray(local).astype(np.uint8))  # graftlint: disable=host-bounce issue=ISSUE-1 -- bool wire-cast; np branch reached only for host-typed inputs
         bucket = _size_class(n, wire.itemsize)
-        hier = self._hier_eligible(n * wire.itemsize)
-        codec = self._wire_codec(wire) if hier else None
+        hier, codec_on = self._route("broadcast", n * wire.itemsize)
+        codec = self._wire_codec(wire) if hier and codec_on else None
         _count_path("broadcast", n * wire.itemsize, hier, codec,
                     self._wire_nbytes(codec, n) if codec else None)
         if hier:
@@ -1053,8 +1083,8 @@ class GlobalMeshCollectives:
         bucket = _size_class(max(lens), dtype.itemsize)
         size = self.size
         my_len = lens[self.my_idx]
-        hier = self._hier_eligible(bucket * dtype.itemsize)
-        codec = self._wire_codec(dtype) if hier else None
+        hier, codec_on = self._route("allgather", bucket * dtype.itemsize)
+        codec = self._wire_codec(dtype) if hier and codec_on else None
         _count_path("allgather", my_len * dtype.itemsize, hier, codec,
                     self._wire_nbytes(codec, my_len) if codec else None)
         if hier:
@@ -1173,8 +1203,9 @@ class GlobalMeshCollectives:
         my_idx = self.my_idx
         offs = np.concatenate([[0], np.cumsum(sm[my_idx])]).astype(int)  # graftlint: disable=host-bounce issue=ISSUE-1 -- offsets over the negotiated splits row, never payload bytes
 
-        hier = self._hier_eligible(size * block * dtype.itemsize)
-        codec = self._wire_codec(dtype) if hier else None
+        hier, codec_on = self._route("alltoall",
+                                     size * block * dtype.itemsize)
+        codec = self._wire_codec(dtype) if hier and codec_on else None
         _count_path("alltoall",
                     int(offs[-1]) * telems * dtype.itemsize, hier,
                     codec,
@@ -1322,9 +1353,12 @@ class GlobalMeshCollectives:
         # program per size class (the packed-fusion-bucket treatment).
         seg = _size_class(max(c * telems, 1), dtype.itemsize)
         my_idx = self.my_idx
-        hier = (red_op in (SUM, AVERAGE, MIN, MAX, PRODUCT)
-                and self._hier_eligible(size * seg * dtype.itemsize))
-        codec = self._wire_codec(dtype, red_op) if hier else None
+        hier = codec_on = False
+        if red_op in (SUM, AVERAGE, MIN, MAX, PRODUCT):
+            hier, codec_on = self._route("reducescatter",
+                                         size * seg * dtype.itemsize)
+        codec = (self._wire_codec(dtype, red_op) if hier and codec_on
+                 else None)
         _count_path("reducescatter", d0 * telems * dtype.itemsize, hier,
                     codec,
                     self._wire_nbytes(codec, d0 * telems)

@@ -22,6 +22,7 @@ the CPU test world exercises the same kernel code path.
 from __future__ import annotations
 
 import functools
+import logging
 import math
 
 import jax
@@ -30,6 +31,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ..common import jax_compat  # noqa: F401 - installs jax.typeof shim
+
+LOG = logging.getLogger("horovod_tpu")
 
 _NEG_INF = -1e30
 
@@ -207,6 +210,34 @@ _TUNED_BLOCKS: dict = {}
 
 _BLOCK_Q_DEFAULTS = (512, 256, 128, 64)
 _BLOCK_K_DEFAULTS = (1024, 512, 256, 128, 64)
+
+
+def export_tuned_blocks() -> dict:
+    """The pinned-block registry as a JSON-safe dict
+    (``"<seq>x<d_pad>" -> [block_q, block_k]``) — the flash-block leg
+    of the persistent plan cache (``utils/plancache.py``), so kernel
+    and collective plans persist in one plane."""
+    return {"%dx%d" % key: [int(bq), int(bk)]
+            for key, (bq, bk) in _TUNED_BLOCKS.items()}
+
+
+def seed_tuned_blocks(blocks: dict):
+    """Seed the registry from a persisted plan (``hvd.init()`` warm
+    start).  Entries a live ``autotune_flash_blocks`` sweep pins later
+    overwrite these; env block overrides are handled by the CALLER
+    (they win and suppress seeding, the r9 precedence rule) and by
+    ``_plan`` itself at trace time.  Malformed entries are skipped
+    loudly — a corrupt plan must never pin an invalid block shape."""
+    for key, pair in (blocks or {}).items():
+        try:
+            s, d_pad = (int(v) for v in str(key).split("x"))
+            bq, bk = int(pair[0]), int(pair[1])
+            if min(bq, bk) < 64 or bq % 16 or bk % 16 or s % bq or s % bk:
+                raise ValueError("invalid block pair")
+            _TUNED_BLOCKS[(s, d_pad)] = (bq, bk)
+        except (ValueError, TypeError, IndexError):
+            LOG.warning("ignoring malformed tuned-block entry %r: %r",
+                        key, pair)
 
 
 def _d_pad(d: int) -> int:

@@ -202,6 +202,18 @@ class RendezvousClient:
 
         return request_with_retry(attempt, what="rendezvous GET %s" % key)
 
+    def put_json(self, key: str, obj):
+        """PUT one JSON document (the collective-plan plane publishes
+        plan sets through the KV with this)."""
+        import json
+        self.put(key, json.dumps(obj, sort_keys=True))
+
+    def get_json(self, key: str):
+        """GET one JSON document, or None for a missing key."""
+        import json
+        v = self.get(key)
+        return json.loads(v) if v is not None else None
+
     def get_blocking(self, key: str, timeout: float = 60.0,
                      interval: float = 0.1) -> str:
         deadline = time.monotonic() + timeout

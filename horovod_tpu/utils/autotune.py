@@ -20,8 +20,9 @@ engine and is also importable for tests of the math itself.
 from __future__ import annotations
 
 import math
+import os
 import time
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -200,25 +201,165 @@ class KernelBlockTuner:
         return self.choices[int(np.argmax(self.scores_vector()))]
 
 
+class PlanTuner:
+    """GP/EI proposer over one (op, size_class)'s candidate plan grid —
+    the widened search space of ROADMAP item 1: hier-vs-flat leg
+    choice x cross-host codec engagement (``utils/plancache.py`` builds
+    the candidate list from what the world actually supports).
+
+    Each candidate is a coordinate (e.g. ``(hier, codec)`` in {0,1}^2);
+    every candidate is bootstrapped once, then the same GP surrogate +
+    expected-improvement acquisition as the fusion/cycle tuner proposes
+    further samples until ``max_samples``, after which :meth:`best` is
+    the argmax-by-mean.  SPMD contract: in a multi-member world the
+    caller must cross-rank AVERAGE each score before :meth:`record`
+    (``tune_collective_plans`` does) — proposals and the final argmax
+    are then pure functions of identical state on every member, so all
+    members pin the same plan.
+    """
+
+    def __init__(self, coords: Sequence[Sequence[float]],
+                 max_samples: Optional[int] = None, xi: float = 0.01):
+        self.coords = np.atleast_2d(np.asarray(coords, np.float64))
+        # atleast_2d turns an empty list into shape (1, 0); size catches
+        # that where len() would not.
+        self.n = len(self.coords) if self.coords.size else 0
+        if self.n < 1:
+            raise ValueError("PlanTuner needs at least 1 candidate")
+        self.max_samples = int(max_samples or max(2 * self.n, self.n + 1))
+        self.xi = float(xi)
+        self.points: List[int] = []
+        self.scores: List[float] = []
+        self.gp = GaussianProcess(length_scale=0.8)
+
+    @property
+    def samples(self) -> int:
+        return len(self.scores)
+
+    @property
+    def converged(self) -> bool:
+        if self.n == 1:
+            return self.samples >= 1
+        return self.samples >= self.max_samples
+
+    def propose(self) -> int:
+        """Next candidate index to sample: each candidate once first
+        (deterministic bootstrap), then EI over the grid."""
+        sampled = set(self.points)
+        for i in range(self.n):
+            if i not in sampled:
+                return i
+        y = np.asarray(self.scores)
+        s = y.std()
+        yn = (y - y.mean()) / (s if s > 0 else 1.0)
+        self.gp.fit(self.coords[self.points], yn)
+        mu, sigma = self.gp.predict(self.coords)
+        ei = expected_improvement(mu, sigma, float(yn.max()), self.xi)
+        return int(np.argmax(ei))
+
+    def record(self, index: int, score: float):
+        if not 0 <= index < self.n:
+            raise IndexError("candidate index %d out of range [0, %d)"
+                             % (index, self.n))
+        self.points.append(int(index))
+        self.scores.append(float(score))
+
+    def mean_scores(self) -> List[Optional[float]]:
+        by: dict = {}
+        for p, s in zip(self.points, self.scores):
+            by.setdefault(p, []).append(s)
+        return [float(np.mean(by[i])) if i in by else None
+                for i in range(self.n)]
+
+    def best(self) -> int:
+        if not self.scores:
+            raise RuntimeError("no samples recorded")
+        means = self.mean_scores()
+        return int(max((i for i in range(self.n)
+                        if means[i] is not None),
+                       key=lambda i: means[i]))
+
+
+class AutotuneLog:
+    """Crash-safe autotune CSV writer (the r11 journal conventions).
+
+    The old ``open(path, "w")`` writer clobbered peers' logs and
+    interleaved partial lines across a multi-process world.  This one
+    rank-stamps the filename (``<path>.r<rank>``, pid fallback — one
+    writer per file, like ``events-<writer>.jsonl``) and appends each
+    record as ONE ``os.write`` on an ``O_APPEND`` fd: concurrent
+    writers can interleave lines, never bytes, and a crash tears at
+    most nothing (a line is a single atomic append).  The header is
+    written only when this writer's file is empty, so restarted runs
+    append instead of restamping."""
+
+    HEADER = "sample,fusion_bytes,cycle_ms,score_bytes_per_s"
+
+    def __init__(self, path: str, tag: Optional[str] = None):
+        if tag is None:
+            rank = os.environ.get("HOROVOD_RANK")
+            tag = "r%s" % rank if rank is not None \
+                else "pid%d" % os.getpid()
+        self.path = "%s.%s" % (path, tag)
+        self._fd: Optional[int] = None
+        try:
+            self._fd = os.open(
+                self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+            if os.fstat(self._fd).st_size == 0:
+                self.write_line(self.HEADER)
+        except OSError:
+            # A bad log path degrades observability, never tuning.
+            self._fd = None
+
+    def write_line(self, line: str):
+        if self._fd is None:
+            return
+        try:
+            os.write(self._fd, (line + "\n").encode())
+        except OSError:
+            pass
+
+    def close(self):
+        if self._fd is not None:
+            try:
+                os.close(self._fd)
+            finally:
+                self._fd = None
+
+    def __del__(self):
+        # GC-finalizer parity with the file-object writer this
+        # replaced: a ParameterManager dropped at shutdown/re-init
+        # must not leak its O_APPEND fd across elastic init cycles.
+        self.close()
+
+
 class ParameterManager:
     """Drives sampling from the engine's cycle loop (parameter_manager.cc).
 
     ``observe(bytes, secs)`` is called once per non-empty cycle; samples are
     scored by aggregate throughput over ``steps_per_sample`` cycles.
+
+    ``warm_start=(fusion, cycle_ms, converged)`` adopts a persisted
+    plan's operating point (``utils/plancache.py``): a converged plan
+    freezes the tuner entirely (warm-up skipped — the rerun cold-starts
+    where the last run ended instead of re-walking the grid); an
+    unconverged one runs the adopted point through a single warm-up
+    cycle (the fresh process's compile skew must not enter the GP) and
+    then resumes the sweep.
     """
 
     def __init__(self, fusion_threshold: int, cycle_time_ms: float,
                  log_path: Optional[str] = None, warmup: int = 3,
-                 steps_per_sample: int = 10, max_samples: int = 30):
+                 steps_per_sample: int = 10, max_samples: int = 30,
+                 warm_start: Optional[Tuple[int, float, bool]] = None,
+                 log_tag: Optional[str] = None):
         self.bo = BayesianOptimizer()
         self.fusion_threshold = fusion_threshold
         self.cycle_time_ms = cycle_time_ms
         self.warmup = warmup
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples
-        self._log = open(log_path, "w") if log_path else None
-        if self._log:
-            self._log.write("sample,fusion_bytes,cycle_ms,score_bytes_per_s\n")
+        self._log = AutotuneLog(log_path, log_tag) if log_path else None
         self._cycle_bytes = 0.0
         self._max_secs = 0.0
         self._cycles_seen = 0
@@ -226,6 +367,29 @@ class ParameterManager:
         self._samples_done = 0
         self._current_idx: Optional[int] = None
         self.frozen = False
+        if warm_start is not None:
+            f, c, converged = warm_start
+            self.fusion_threshold = int(f)
+            self.cycle_time_ms = float(c)
+            # Converged: nothing left to sample, skip warm-up outright.
+            # Unconverged: keep ONE warm-up cycle — the rerun's first
+            # observation carries fresh-process compile skew, exactly
+            # what the warm-up window exists to discard.
+            self.warmup = 0 if converged else min(int(warmup), 1)
+            self.frozen = bool(converged)
+            if self._log:
+                self._log.write_line(
+                    "# warm-start: fusion=%d cycle=%.3f converged=%d"
+                    % (self.fusion_threshold, self.cycle_time_ms,
+                       int(self.frozen)))
+
+    @property
+    def samples_done(self) -> int:
+        return self._samples_done
+
+    @property
+    def warmup_left(self) -> int:
+        return max(int(self.warmup), 0)
 
     def _apply(self, idx: int):
         f_log, c_log = self.bo.grid[idx]
@@ -275,18 +439,17 @@ class ParameterManager:
         self.bo.record(self._current_idx, score)
         self._samples_done += 1
         if self._log:
-            self._log.write("%d,%d,%.3f,%.1f\n" % (
+            self._log.write_line("%d,%d,%.3f,%.1f" % (
                 self._samples_done, self.fusion_threshold,
                 self.cycle_time_ms, score))
-            self._log.flush()
         self._cycle_bytes = self._max_secs = 0.0
         self._cycles_seen = 0
         if self._samples_done >= self.max_samples:
             self._apply(self.bo.best_index())
             self.frozen = True
             if self._log:
-                self._log.write("# converged: fusion=%d cycle=%.3f\n" % (
-                    self.fusion_threshold, self.cycle_time_ms))
-                self._log.flush()
+                self._log.write_line("# converged: fusion=%d cycle=%.3f"
+                                     % (self.fusion_threshold,
+                                        self.cycle_time_ms))
         else:
             self._apply(self.bo.next_index())

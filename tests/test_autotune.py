@@ -89,10 +89,13 @@ def test_bo_with_ls_fit_converges_on_synthetic_throughput_surface():
 
 
 def test_parameter_manager_samples_and_freezes(tmp_path):
-    log = tmp_path / "autotune.csv"
+    # The r14 crash-safe writer rank-stamps the path (one writer per
+    # file); pin the tag so the read-back path is deterministic.
+    log = tmp_path / "autotune.csv.r0"
     pm = ParameterManager(fusion_threshold=1 << 20, cycle_time_ms=5.0,
-                          log_path=str(log), warmup=1,
-                          steps_per_sample=2, max_samples=3)
+                          log_path=str(tmp_path / "autotune.csv"),
+                          warmup=1, steps_per_sample=2, max_samples=3,
+                          log_tag="r0")
     # throughput is higher for larger fusion thresholds
     for _ in range(1 + 2 * 3 + 2):
         pm.observe(nbytes=pm.fusion_threshold, secs=1e-3)

@@ -43,6 +43,13 @@ def test_bench_emits_one_valid_json_line():
     assert set(lev["hier"]["ops"]) == {
         "allreduce", "allgather", "alltoall", "reducescatter",
         "broadcast"}
+    # Collective-plan plane attribution (the persistent plan cache):
+    # present even when the plane is off — the bench must always say
+    # whether a warm start was in play.
+    plan = lev["plan"]
+    assert "enabled" in plan and "schema" in plan
+    assert set(plan["apply"]) == {"cache", "kv", "tuned", "default"}
+    assert "hits" in plan and "misses" in plan
 
 
 def test_allreduce_bw_amortization_math():

@@ -104,7 +104,9 @@ def test_tcp_hierarchical_uneven_groups():
 
 def test_tcp_autotune_samples_written(tmp_path):
     # rank 0 runs the BO autotuner in the C++ core: with pacing lowered
-    # it must SCORE samples (data rows), not just write the csv header
+    # it must SCORE samples (data rows), not just write the csv header.
+    # The r14 crash-safe writer rank-stamps the path (".r<rank>", one
+    # writer per file, O_APPEND) so concurrent worlds never interleave.
     log = str(tmp_path / "autotune.csv")
     _assert_ok(_spawn_world(2, "autotune", extra_env={
         "HOROVOD_AUTOTUNE": "1",
@@ -112,9 +114,21 @@ def test_tcp_autotune_samples_written(tmp_path):
         "HVD_TPU_AUTOTUNE_WARMUP_CYCLES": "1",
         "HVD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
     }))
-    lines = open(log).read().strip().splitlines()
+    assert not os.path.exists(log)  # no writer at the raw path anymore
+    lines = open(log + ".r0").read().strip().splitlines()
     assert lines[0].startswith("sample,")
     assert len(lines) >= 3, lines  # header + >=2 scored samples
+    # A rerun sharing the log path appends instead of clobbering, and
+    # the header is not restamped.
+    _assert_ok(_spawn_world(2, "autotune", extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": log,
+        "HVD_TPU_AUTOTUNE_WARMUP_CYCLES": "1",
+        "HVD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+    }))
+    lines2 = open(log + ".r0").read().strip().splitlines()
+    assert len(lines2) > len(lines), (lines, lines2)
+    assert sum(1 for ln in lines2 if ln.startswith("sample,")) == 1
 
 
 def test_tcp_hierarchical_interleaved_hosts():

@@ -1,0 +1,90 @@
+"""Worker for the plan-cache warm-start e2e (test_plancache.py + the
+CI perf-smoke step): one rank of a 2-proc tcp world run TWICE against
+a shared HOROVOD_PLAN_CACHE_DIR.
+
+PLAN_PHASE=cold — empty cache: asserts the probe was a loud miss, then
+drives enough steady allreduce traffic for the rank-0 native GP tuner
+to converge; shutdown persists the plan blob.
+
+PLAN_PHASE=warm — primed cache: asserts ``plan_cache_hits_total`` > 0
+and ``plan_apply_total{source="cache"}`` > 0 right after ``init()``,
+that the tuner's warm-up window was skipped BEFORE any traffic, and —
+when the persisted plan was converged — that the rerun records ZERO new
+GP samples (re-tuning skipped entirely).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics, metrics
+from horovod_tpu.utils import plancache
+
+
+def main():
+    phase = os.environ["PLAN_PHASE"]
+    steps = int(os.environ.get("PLAN_STEPS", "60"))
+    # The spawn harness pins HOROVOD_CYCLE_TIME for fast test cycles —
+    # but an explicit operator cycle-time env legitimately suppresses
+    # the tuned-point warm start (env wins, the precedence rule this
+    # plane inherits from r9).  Clear the pin so this world models a
+    # default-config rerun, which is what the warm start is for.
+    os.environ.pop("HOROVOD_CYCLE_TIME", None)
+    os.environ.pop("HVD_TPU_CYCLE_TIME", None)
+    hvd.init()
+    rank = hvd.rank()
+    size = hvd.size()
+    core = basics._state.tcp_core
+    assert core is not None, "this worker needs a tcp world"
+    st0 = core.autotune_state()
+
+    if phase == "cold":
+        assert metrics.series_sum("plan_cache_hits_total") == 0
+        assert metrics.series_sum("plan_cache_misses_total") == 1
+        assert metrics.series_sum("plan_apply_total", source="cache") == 0
+        if rank == 0:
+            assert st0["warmup_left"] > 0, st0  # cold tuner warms up
+    else:
+        assert phase == "warm", phase
+        assert metrics.series_sum("plan_cache_hits_total") > 0
+        assert metrics.series_sum(
+            "plan_apply_total", source="cache") > 0
+        if rank == 0:
+            # The cached operating point was adopted with the warm-up
+            # window skipped — before ANY traffic ran.
+            assert st0["warmup_left"] == 0, st0
+
+    # Steady allreduce traffic: the cold run samples its way to a
+    # converged operating point, the warm run must already be there.
+    x = np.full((4096,), float(rank), np.float32)
+    out = None
+    for it in range(steps):
+        out = hvd.synchronize(
+            hvd.allreduce_async(x, op=hvd.Sum, name="t.%d" % (it % 3)))
+    np.testing.assert_allclose(np.asarray(out), float(sum(range(size))))
+
+    st1 = core.autotune_state()
+    if rank == 0:
+        if phase == "cold":
+            assert st1["samples"] > 0, st1
+        elif st0["converged"]:
+            # A converged plan freezes the tuner: the rerun skips
+            # re-tuning entirely, not just the warm-up window.
+            assert st1["samples"] == 0, st1
+    hvd.shutdown()
+
+    if phase == "cold" and rank == 0:
+        # The blob must exist before the warm run starts.
+        d = os.environ["HOROVOD_PLAN_CACHE_DIR"]
+        blobs = [f for f in os.listdir(d) if f.endswith(".plan")]
+        assert blobs, "cold run persisted no plan blob in %s" % d
+    print("PLAN_%s_OK rank=%d" % (phase.upper(), rank))
+
+
+if __name__ == "__main__":
+    main()
