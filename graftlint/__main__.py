@@ -7,6 +7,7 @@ CI line and the tier-1 test are both just ``python -m graftlint``.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -24,6 +25,10 @@ def main(argv=None) -> int:
                              "horovod_tpu/ tree)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print every check id and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output: one JSON object "
+                             "with repo-relative findings (CI and "
+                             "editor tooling); exit code unchanged")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the summary line")
     args = parser.parse_args(argv)
@@ -36,6 +41,18 @@ def main(argv=None) -> int:
     cfg = LintConfig()
     paths = args.paths or [cfg.resolve("horovod_tpu")]
     findings = run_paths(paths, cfg)
+    if args.json:
+        print(json.dumps({
+            "root": cfg.repo_root,
+            "paths": [os.path.relpath(p, cfg.repo_root)
+                      for p in map(os.path.abspath, paths)],
+            "count": len(findings),
+            "findings": [
+                {"path": os.path.relpath(f.path, cfg.repo_root),
+                 "line": f.line, "check": f.check,
+                 "message": f.message} for f in findings],
+        }, indent=2, sort_keys=True))
+        return 1 if findings else 0
     for f in findings:
         print(f.render(cfg.repo_root))
     if not args.quiet:

@@ -40,7 +40,7 @@ MARKER = "graftlint:"
 # a finding wherever it appears.
 KNOWN_KEYS = frozenset({"owned-by", "guarded-by", "thread",
                         "requires-lock"})
-KNOWN_FLAGS = frozenset({"hot-path"})
+KNOWN_FLAGS = frozenset({"hot-path", "spmd-uniform"})
 
 # Matches the issue citation inside a suppression: issue=<ref> where the
 # ref names a tracker entry (ISSUE-1, GH-123, ROADMAP:multistream, ...).
@@ -79,17 +79,15 @@ class Suppression:
     used: bool = False
 
 
-class SourceFile:
-    """One parsed Python source: AST + per-line graftlint comments."""
+class SuppressionMixin:
+    """Shared ``disable=<check> issue=<REF> -- reason`` parsing and
+    used/unused bookkeeping: SourceFile's ``#`` comments and CcSource's
+    ``//`` comments carry the identical citation contract, so the
+    hygiene rules live once, here."""
 
-    def __init__(self, path: str, text: Optional[str] = None):
-        self.path = path
-        if text is None:
-            with open(path, "r", encoding="utf-8") as f:
-                text = f.read()
-        self.text = text
-        self.tree = ast.parse(text, filename=path)
-        self.annotations: Dict[int, Annotation] = {}
+    path: str
+
+    def _init_suppressions(self):
         self.suppressions: Dict[int, List[Suppression]] = {}
         self.parse_errors: List[Finding] = []
         # Check ids some rule actually evaluated for this file; the
@@ -97,38 +95,6 @@ class SourceFile:
         # ran here (a scoped `python -m graftlint horovod_tpu/elastic`
         # must not flag hot-path suppressions it never evaluated).
         self.checked: Set[str] = set()
-        self._scan_comments()
-
-    # -- comment scanning --------------------------------------------------
-
-    def _scan_comments(self):
-        try:
-            tokens = tokenize.generate_tokens(
-                io.StringIO(self.text).readline)
-            comments = [(t.start[0], t.string) for t in tokens
-                        if t.type == tokenize.COMMENT]
-        except tokenize.TokenError:  # pragma: no cover - ast parsed OK
-            comments = []
-        for line, comment in comments:
-            body = comment.lstrip("#").strip()
-            if not body.startswith(MARKER):
-                continue
-            rest = body[len(MARKER):].strip()
-            if rest.startswith("disable="):
-                self._parse_suppression(line, rest)
-            else:
-                self._parse_annotation(line, rest)
-
-    def _parse_annotation(self, line: int, rest: str):
-        pairs: Dict[str, str] = {}
-        flags: List[str] = []
-        for tok in rest.split():
-            if "=" in tok:
-                k, v = tok.split("=", 1)
-                pairs[k] = v
-            else:
-                flags.append(tok)
-        self.annotations[line] = Annotation(line, pairs, flags, rest)
 
     def _parse_suppression(self, line: int, rest: str):
         # disable=<check> issue=<REF> -- <free-text reason>
@@ -157,6 +123,76 @@ class SourceFile:
                 self.path, line, "bad-suppression",
                 "suppression must carry a reason after '--': %r" % rest))
 
+    def suppressed(self, line: int, check: str) -> bool:
+        for sup in self.suppressions.get(line, []):
+            if sup.check == check:
+                sup.used = True
+                return True
+        return False
+
+    def _unused_suppression_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for sups in self.suppressions.values():
+            for sup in sups:
+                if sup.check and not sup.used \
+                        and sup.check in self.checked:
+                    out.append(Finding(
+                        self.path, sup.line, "unused-suppression",
+                        "suppression for %r no longer matches any "
+                        "finding on this line; delete it" % sup.check))
+        return out
+
+
+class SourceFile(SuppressionMixin):
+    """One parsed Python source: AST + per-line graftlint comments."""
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = path
+        if text is None:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.annotations: Dict[int, Annotation] = {}
+        self._init_suppressions()
+        self._scan_comments()
+
+    # -- comment scanning --------------------------------------------------
+
+    def _scan_comments(self):
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenError:  # pragma: no cover - ast parsed OK
+            comments = []
+        for line, comment in comments:
+            body = comment.lstrip("#").strip()
+            if not body.startswith(MARKER):
+                continue
+            rest = body[len(MARKER):].strip()
+            if rest.startswith("disable="):
+                self._parse_suppression(line, rest)
+            else:
+                self._parse_annotation(line, rest)
+
+    def _parse_annotation(self, line: int, rest: str):
+        # Tokens after ' -- ' are a free-text justification (barrier
+        # annotations especially should say WHY a value is uniform);
+        # they are kept on the Annotation but parsed as prose, not
+        # key/flag tokens.
+        head, _, _reason = rest.partition("--")
+        pairs: Dict[str, str] = {}
+        flags: List[str] = []
+        for tok in head.split():
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                pairs[k] = v
+            else:
+                flags.append(tok)
+        self.annotations[line] = Annotation(line, pairs, flags, rest)
+
     def def_annotation(self, node) -> Optional[Annotation]:
         """Annotation on a def line, or anywhere in the signature span
         (multi-line signatures put the comment where it fits)."""
@@ -166,15 +202,6 @@ class SourceFile:
             if ann is not None:
                 return ann
         return None
-
-    # -- suppression application ------------------------------------------
-
-    def suppressed(self, line: int, check: str) -> bool:
-        for sup in self.suppressions.get(line, []):
-            if sup.check == check:
-                sup.used = True
-                return True
-        return False
 
     def hygiene_findings(self) -> List[Finding]:
         out = list(self.parse_errors)
@@ -191,14 +218,7 @@ class SourceFile:
                         self.path, line, "bad-annotation",
                         "unknown annotation flag %r (known: %s)"
                         % (flag, sorted(KNOWN_FLAGS))))
-        for sups in self.suppressions.values():
-            for sup in sups:
-                if sup.check and not sup.used \
-                        and sup.check in self.checked:
-                    out.append(Finding(
-                        self.path, sup.line, "unused-suppression",
-                        "suppression for %r no longer matches any "
-                        "finding on this line; delete it" % sup.check))
+        out += self._unused_suppression_findings()
         if "ownership-shared" in self.checked:
             for ann in self.annotations.values():
                 if (("owned-by" in ann.pairs
@@ -212,13 +232,162 @@ class SourceFile:
         return out
 
 
+# -- interprocedural call-graph layer ---------------------------------------
+#
+# Shared by the deep passes (spmd-uniform's rank-taint dataflow and
+# cpp-guarded-by's lock-state propagation): both need the same three
+# things — qualified nodes carrying per-function summaries, name-based
+# resolution of call targets (exact when the receiver's class is known,
+# conservative any-name otherwise), and a worklist fixpoint that re-runs
+# a summary step until nothing changes.  Neither pass is a pointer
+# analysis; resolution is by (class, name) with a deliberate
+# over-approximation for unknown receivers, which is the right trade for
+# lint-grade precision on this tree.
+
+class CallGraph:
+    """Qualified function/method nodes with name-indexed resolution.
+
+    ``qualname`` is ``"Class.name"`` for methods and ``"name"`` for free
+    functions; ``payload`` is whatever per-node summary the rule keeps.
+    """
+
+    def __init__(self):
+        self.nodes: Dict[str, object] = {}
+        self._by_name: Dict[str, List[str]] = {}
+
+    def add(self, qualname: str, payload) -> None:
+        self.nodes[qualname] = payload
+        name = qualname.rsplit(".", 1)[-1]
+        self._by_name.setdefault(name, []).append(qualname)
+
+    def get(self, qualname: str):
+        return self.nodes.get(qualname)
+
+    def resolve(self, name: str, cls: Optional[str] = None) -> List[object]:
+        """Payloads a call of ``name`` may target.  With a known
+        receiver class the match is exact (``Class.name`` or nothing);
+        without one, every node of that name — the conservative
+        over-approximation both passes want for unknown receivers."""
+        if cls is not None:
+            hit = self.nodes.get("%s.%s" % (cls, name))
+            return [hit] if hit is not None else []
+        return [self.nodes[q] for q in self._by_name.get(name, ())]
+
+    def fixpoint(self, step) -> int:
+        """Run ``step(qualname, payload) -> bool(changed)`` over every
+        node until a full sweep changes nothing; returns sweep count."""
+        sweeps = 0
+        changed = True
+        while changed:
+            changed = False
+            sweeps += 1
+            for qualname, payload in self.nodes.items():
+                if step(qualname, payload):
+                    changed = True
+        return sweeps
+
+
+# -- C++ source model --------------------------------------------------------
+
+_CC_COMMENT_RE = re.compile(r"//\s*" + re.escape(MARKER) + r"\s*(.*)$")
+
+
+class CcSource(SuppressionMixin):
+    """One C++ source (.h/.cc): raw text, a comment/string-stripped
+    twin for structural scanning, and ``// graftlint:`` suppressions
+    with the same cited-issue hygiene as the Python side."""
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = path
+        if text is None:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        self.text = text
+        self.code = _strip_cc_noise(text)
+        self._init_suppressions()
+        for i, line in enumerate(text.splitlines(), 1):
+            m = _CC_COMMENT_RE.search(line)
+            if m and m.group(1).strip().startswith("disable="):
+                self._parse_suppression(i, m.group(1).strip())
+
+    def hygiene_findings(self) -> List[Finding]:
+        return list(self.parse_errors) \
+            + self._unused_suppression_findings()
+
+
+def _strip_cc_noise(text: str) -> str:
+    """Comments and string/char literal contents replaced by spaces,
+    newlines preserved — downstream scanning sees real structure at the
+    original line numbers."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                out.append(" ")
+                i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            out.append("  ")
+            i += 2
+            while i + 1 < n and not (text[i] == "*"
+                                     and text[i + 1] == "/"):
+                out.append("\n" if text[i] == "\n" else " ")
+                i += 1
+            if i + 1 < n:
+                out.append("  ")
+                i += 2
+        elif c in "\"'":
+            if c == "'" and i > 0 and (text[i - 1].isalnum()
+                                       or text[i - 1] == "_"):
+                # C++14 digit separator (64'000'000), not a char
+                # literal: treating it as an opener would blank real
+                # code — lock declarations included — up to the next
+                # apostrophe anywhere in the file.
+                out.append(c)
+                i += 1
+                continue
+            quote = c
+            out.append(quote)
+            i += 1
+            while i < n and text[i] != quote:
+                if text[i] == "\\" and i + 1 < n:
+                    out.append("  ")
+                    i += 2
+                    continue
+                out.append("\n" if text[i] == "\n" else " ")
+                i += 1
+            if i < n:
+                out.append(quote)
+                i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 # -- per-run source cache --------------------------------------------------
 
 _CACHE: Dict[str, Tuple[Optional["SourceFile"], List[Finding]]] = {}
+_CC_CACHE: Dict[str, Tuple[Optional["CcSource"], List[Finding]]] = {}
 
 
 def reset_cache():
     _CACHE.clear()
+    _CC_CACHE.clear()
+
+
+def get_cc_source(path: str) -> Tuple[Optional[CcSource], List[Finding]]:
+    """Load (or reuse) a CcSource; load errors surface once."""
+    path = os.path.abspath(path)
+    hit = _CC_CACHE.get(path)
+    if hit is None:
+        try:
+            hit = (CcSource(path), [])
+        except OSError as exc:
+            hit = (None, [Finding(path, 1, "parse-error", str(exc))])
+        _CC_CACHE[path] = hit
+    return hit
 
 
 def get_source(path: str) -> Tuple[Optional[SourceFile], List[Finding]]:
@@ -287,6 +456,48 @@ class LintConfig:
         "horovod_tpu/elastic/scheduler.py",
         "horovod_tpu/runner/http_client.py",
     )
+    # env-drift rule: test-harness modules whose hard env pins must be
+    # documented (the spawn harness pinning HOROVOD_CYCLE_TIME=1
+    # silently suppressed the r14 plan warm starts in every
+    # spawned-world test — an undocumented pin IS config drift).
+    harness_env_files: Sequence[str] = ("tests/utils/spawn.py",)
+    harness_doc_files: Sequence[str] = ("tests/README.md",)
+    # spmd-uniform rule: the Python collective-routing plane — every
+    # file whose decisions feed negotiated/compiled collective programs
+    # and therefore MUST resolve identically on every member.
+    spmd_roots: Sequence[str] = (
+        "horovod_tpu/ops/engine.py",
+        "horovod_tpu/ops/multihost.py",
+        "horovod_tpu/utils/plancache.py",
+        "horovod_tpu/utils/autotune.py",
+        "horovod_tpu/common/process_sets.py",
+        "horovod_tpu/elastic/driver.py",
+    )
+    # Envs that legitimately differ per rank/tenant: reading one into a
+    # routing decision is a divergence source (uniform envs — the
+    # documented config contract — are not).
+    spmd_rank_envs: Sequence[str] = (
+        "HOROVOD_RANK", "HOROVOD_LOCAL_RANK", "HOROVOD_TENANT_ID",
+        "HOROVOD_HOSTNAME", "HVD_TPU_RANK", "HVD_TPU_LOCAL_RANK",
+    )
+    # Callee names whose arguments are routing/negotiation decisions
+    # (the sinks of the rank-taint analysis).
+    spmd_sink_calls: Sequence[str] = (
+        "route", "pin", "force", "PlanController",   # plan routing
+        "_route", "_hier_eligible", "_wire_codec",   # multihost gates
+        "_size_class", "_pow2_class", "_bucket",     # size classes
+        "publish_kv", "put_json",                    # KV-published plans
+        "add_process_set",                           # set membership
+    )
+    # Attribute writes that steer fusion order / cycle pacing — the
+    # negotiated schedule levers.
+    spmd_sink_attrs: Sequence[str] = (
+        "fusion_threshold_bytes", "cycle_time_ms",
+    )
+    # cpp-guarded-by rule: native-core trees whose .h/.cc annotations
+    # (GUARDED_BY / REQUIRES / EXCLUDES, core/src/common.h) are
+    # verified against actual lock scopes in the .cc bodies.
+    cpp_lock_roots: Sequence[str] = ("horovod_tpu/core/src",)
 
     def resolve(self, rel: str) -> str:
         return os.path.join(self.repo_root, rel)
@@ -345,9 +556,29 @@ def run_paths(paths: Sequence[str],
     if in_scope(cfg.metrics_module) \
             or any(in_scope(r) for r in cfg.metrics_roots):
         findings += metric_names.check(cfg)
+    from .rules import cpp_guarded_by, spmd_uniform
+    spmd_roots = [r for r in cfg.spmd_roots if in_scope(r)]
+    if spmd_roots:
+        # The taint analysis is interprocedural across the WHOLE
+        # routing plane: a narrowed path still analyzes every spmd
+        # file (helper summaries would lie otherwise) but only reports
+        # findings inside the requested scope.
+        findings += [
+            f for f in spmd_uniform.check(cfg)
+            if any(os.path.abspath(f.path) == os.path.abspath(
+                       cfg.resolve(r))
+                   for r in spmd_roots)]
+    cpp_roots = [r for r in cfg.cpp_lock_roots if in_scope(r)]
+    if cpp_roots:
+        findings += cpp_guarded_by.check_roots(
+            [cfg.resolve(r) for r in cpp_roots])
     for src, errs in _CACHE.values():
         findings += errs
         if src is not None:
             findings += src.hygiene_findings()
+    for cc, errs in _CC_CACHE.values():
+        findings += errs
+        if cc is not None:
+            findings += cc.hygiene_findings()
     findings.sort(key=lambda f: (f.path, f.line, f.check))
     return findings

@@ -1,0 +1,388 @@
+"""Enforced C++ thread-safety annotations for the native core.
+
+``core/src/common.h`` defines ``GUARDED_BY`` / ``PT_GUARDED_BY`` /
+``REQUIRES`` / ``EXCLUDES`` in the clang/abseil convention — under
+clang they expand to real ``-Wthread-safety`` attributes, but the
+default g++ build compiles them away, which made every annotation pure
+documentation no tool enforced (the r6 state).  This pass is the
+lightweight enforcer: it parses the annotations out of the headers and
+verifies the ``.cc`` bodies against them, so the lock story stated in
+the type declarations is machine-checked on every lint run.
+
+Checks (per ``LintConfig.cpp_lock_roots``):
+
+* **`cpp-guarded-by`** — an access to a ``GUARDED_BY(mu)`` field in an
+  out-of-line ``Class::Method`` body must sit inside a
+  ``std::lock_guard`` / ``std::unique_lock`` / ``std::scoped_lock``
+  scope on ``mu``, or the method must be declared ``REQUIRES(mu)``
+  (the caller-holds-the-lock convention).
+* **`cpp-requires`** — a bare (implicit-``this``) call to a
+  ``REQUIRES(mu)`` method without ``mu`` held at the call site.
+* **`cpp-excludes`** — a bare call to an ``EXCLUDES(mu)`` method
+  *while holding* ``mu``: the callee acquires ``mu`` itself, so the
+  call is a guaranteed self-deadlock.
+
+Method-call resolution rides the shared
+:class:`~graftlint.core.CallGraph` layer (same-class exact matches).
+Suppression: ``// graftlint: disable=<check> issue=<REF> -- reason``
+on the access line, with the cited-issue hygiene every rule shares.
+
+Deliberate limits: lexical ``with``-style scoping only (a
+``lk.unlock()`` before scope end is not modeled), constructor
+member-init lists are skipped (single-threaded by construction),
+inline method bodies in headers are not scanned (the annotated hot
+classes implement out of line), and brace-init in initializer lists is
+handled heuristically.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import CallGraph, CcSource, Finding, get_cc_source
+
+CHECKS = (
+    ("cpp-guarded-by",
+     "GUARDED_BY field accessed without its mutex held (no lock scope "
+     "in the body, method not REQUIRES)"),
+    ("cpp-requires",
+     "call to a REQUIRES(mu) method without holding mu"),
+    ("cpp-excludes",
+     "call to an EXCLUDES(mu) method while holding mu (self-deadlock)"),
+)
+
+_CHECK_IDS = tuple(c for c, _ in CHECKS)
+
+_CLASS_RE = re.compile(r"\b(class|struct)\s+([A-Za-z_]\w*)")
+_FIELD_RE = re.compile(
+    r"\b([A-Za-z_]\w*)\s+(?:GUARDED_BY|PT_GUARDED_BY)\s*\(\s*"
+    r"([A-Za-z_][\w.]*)\s*\)")
+# A declaration may stack several annotations (`REQUIRES(mu_)
+# EXCLUDES(io_mu_)` — common.h supports the full clang set), so the
+# method match captures the whole clause run and _ANN_CLAUSE_RE
+# iterates the individual contracts.
+_METHOD_ANN_RE = re.compile(
+    r"\b([A-Za-z_]\w*)\s*\([^;{}()]*\)\s*(?:const\s*)?"
+    r"((?:\b(?:REQUIRES|EXCLUDES)\s*\(\s*[^)]*?\s*\)\s*)+)")
+_ANN_CLAUSE_RE = re.compile(
+    r"\b(REQUIRES|EXCLUDES)\s*\(\s*([^)]*?)\s*\)")
+_DEF_RE = re.compile(r"\b([A-Za-z_]\w*)::(~?[A-Za-z_]\w*)\s*\(")
+_LOCK_RE = re.compile(
+    r"\bstd::(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^;{}<>]*>)?\s*[A-Za-z_]\w*\s*\(\s*"
+    r"(?:this->)?([A-Za-z_][\w.]*)")
+
+
+class _ClassFacts:
+    __slots__ = ("guarded", "requires", "excludes")
+
+    def __init__(self):
+        # field -> (mutex, decl path, decl line)
+        self.guarded: Dict[str, Tuple[str, str, int]] = {}
+        # method -> set of mutexes
+        self.requires: Dict[str, Set[str]] = {}
+        self.excludes: Dict[str, Set[str]] = {}
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _class_spans(code: str) -> List[Tuple[str, int, int]]:
+    """(class name, body start, body end) for each class/struct whose
+    ``{`` follows the declaration (forward declarations skipped)."""
+    spans = []
+    for m in _CLASS_RE.finditer(code):
+        i = m.end()
+        # Skip base clause / whitespace up to '{' or ';'.
+        depth = 0
+        while i < len(code):
+            c = code[i]
+            if c == ";" and depth == 0:
+                i = -1
+                break
+            if c == "{":
+                break
+            if c in "(<":
+                depth += 1
+            elif c in ")>":
+                depth = max(depth - 1, 0)
+            i += 1
+        if i < 0 or i >= len(code):
+            continue
+        end = _match_brace(code, i)
+        if end > 0:
+            spans.append((m.group(2), i, end))
+    return spans
+
+
+def _match_brace(code: str, open_pos: int) -> int:
+    depth = 0
+    for i in range(open_pos, len(code)):
+        c = code[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return -1
+
+
+def _enclosing_class(spans, pos: int) -> Optional[str]:
+    best = None
+    for name, start, end in spans:
+        if start <= pos <= end:
+            if best is None or start > best[1]:
+                best = (name, start)
+    return best[0] if best else None
+
+
+def collect_annotations(sources: List[CcSource]) -> Dict[str, _ClassFacts]:
+    """Per-class annotation tables from every .h/.cc in scope."""
+    classes: Dict[str, _ClassFacts] = {}
+    for src in sources:
+        spans = _class_spans(src.code)
+        for m in _FIELD_RE.finditer(src.code):
+            cls = _enclosing_class(spans, m.start())
+            if cls is None:
+                continue
+            facts = classes.setdefault(cls, _ClassFacts())
+            facts.guarded[m.group(1)] = (
+                m.group(2), src.path, _line_of(src.code, m.start()))
+        for m in _METHOD_ANN_RE.finditer(src.code):
+            cls = _enclosing_class(spans, m.start())
+            if cls is None:
+                continue
+            facts = classes.setdefault(cls, _ClassFacts())
+            for clause in _ANN_CLAUSE_RE.finditer(m.group(2)):
+                mutexes = {t.strip() for t in clause.group(2).split(",")
+                           if t.strip()}
+                table = (facts.requires if clause.group(1) == "REQUIRES"
+                         else facts.excludes)
+                table.setdefault(m.group(1), set()).update(mutexes)
+    return classes
+
+
+def _method_bodies(code: str) -> List[Tuple[str, str, int, int]]:
+    """(class, method, body start, body end) for out-of-line
+    ``Class::Method(...) { ... }`` definitions."""
+    out = []
+    for m in _DEF_RE.finditer(code):
+        # Find the parameter list's closing paren.
+        i = m.end() - 1  # at the '('
+        depth = 0
+        while i < len(code):
+            c = code[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        if i >= len(code):
+            continue
+        i += 1
+        # Scan to the body '{' or a ';' (declaration / pointer-to-
+        # member expression).  Member-init lists ride here: paren
+        # groups are skipped; `ident{...}` brace-inits are skipped by
+        # the identifier-adjacency heuristic.
+        in_init = False
+        body_start = -1
+        while i < len(code):
+            c = code[i]
+            if c == ";":
+                break
+            if c == ":" and code[i:i + 2] != "::":
+                in_init = True
+                i += 1
+                continue
+            if c == "(":
+                j = i
+                d = 0
+                while j < len(code):
+                    if code[j] == "(":
+                        d += 1
+                    elif code[j] == ")":
+                        d -= 1
+                        if d == 0:
+                            break
+                    j += 1
+                i = j + 1
+                continue
+            if c == "{":
+                prev = code[:i].rstrip()[-1:] if code[:i].rstrip() else ""
+                if in_init and (prev.isalnum() or prev in "_>"):
+                    # Brace-init of a member: skip the group.
+                    end = _match_brace(code, i)
+                    if end < 0:
+                        break
+                    i = end + 1
+                    continue
+                body_start = i
+                break
+            i += 1
+        if body_start < 0:
+            continue
+        body_end = _match_brace(code, body_start)
+        if body_end > 0:
+            out.append((m.group(1), m.group(2), body_start, body_end))
+    return out
+
+
+def _lock_scopes(code: str, start: int,
+                 end: int) -> List[Tuple[str, int, int]]:
+    """(mutex, scope start, scope end) for every lexical lock in the
+    body: from the lock declaration to the close of its enclosing
+    brace block."""
+    scopes = []
+    for m in _LOCK_RE.finditer(code, start, end):
+        # Enclosing block: walk back tracking depth.
+        depth = 0
+        open_pos = start
+        for i in range(m.start() - 1, start - 1, -1):
+            c = code[i]
+            if c == "}":
+                depth += 1
+            elif c == "{":
+                if depth == 0:
+                    open_pos = i
+                    break
+                depth -= 1
+        close = _match_brace(code, open_pos)
+        if close < 0 or close > end:
+            close = end
+        scopes.append((m.group(1).replace("this->", ""),
+                       m.start(), close))
+    return scopes
+
+
+def _held_at(scopes, requires: Set[str], pos: int) -> Set[str]:
+    held = set(requires)
+    for mutex, s, e in scopes:
+        if s <= pos <= e:
+            held.add(mutex)
+    return held
+
+
+def check_roots(roots) -> List[Finding]:
+    findings: List[Finding] = []
+    sources: List[CcSource] = []
+    for root in roots:
+        if os.path.isfile(root):
+            paths = [root]
+        elif os.path.isdir(root):
+            paths = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != ".git"]
+                for fn in sorted(filenames):
+                    if fn.endswith((".h", ".hpp", ".cc", ".cpp")):
+                        paths.append(os.path.join(dirpath, fn))
+        else:
+            continue
+        for path in paths:
+            src, errs = get_cc_source(path)
+            findings += errs
+            if src is not None:
+                src.checked.update(_CHECK_IDS)
+                sources.append(src)
+    if not sources:
+        return findings
+
+    classes = collect_annotations(sources)
+
+    # Shared interprocedural layer: ONE node per annotated method
+    # carrying BOTH contract sets (a stacked `REQUIRES(a) EXCLUDES(b)`
+    # declaration must not lose either — CallGraph.add overwrites by
+    # qualname), so bare calls inside a body resolve exactly (same
+    # class) and every fact travels with the node.
+    graph = CallGraph()
+    for cls, facts in classes.items():
+        for method in set(facts.requires) | set(facts.excludes):
+            graph.add("%s.%s" % (cls, method),
+                      (frozenset(facts.requires.get(method, ())),
+                       frozenset(facts.excludes.get(method, ()))))
+
+    word_cache: Dict[str, re.Pattern] = {}
+
+    def word_re(name: str) -> re.Pattern:
+        r = word_cache.get(name)
+        if r is None:
+            r = re.compile(r"(?<![\w.])%s\b" % re.escape(name))
+            word_cache[name] = r
+        return r
+
+    for src in sources:
+        if not src.path.endswith((".cc", ".cpp")):
+            continue
+        code = src.code
+        for cls, method, bstart, bend in _method_bodies(code):
+            facts = classes.get(cls)
+            if facts is None:
+                continue
+            requires = set(facts.requires.get(method, ()))
+            scopes = _lock_scopes(code, bstart, bend)
+            # Guarded-field accesses.
+            for field, (mutex, _dp, _dl) in sorted(facts.guarded.items()):
+                for m in word_re(field).finditer(code, bstart, bend):
+                    before = code[max(m.start() - 2, 0):m.start()]
+                    if before.endswith(("->", ".")) \
+                            and not code[:m.start()].rstrip(
+                                " \t")[-6:].endswith("this->"):
+                        continue  # member of another object
+                    held = _held_at(scopes, requires, m.start())
+                    line = _line_of(code, m.start())
+                    if mutex not in held \
+                            and not src.suppressed(line,
+                                                   "cpp-guarded-by"):
+                        findings.append(Finding(
+                            src.path, line, "cpp-guarded-by",
+                            "%s::%s accesses %s (GUARDED_BY(%s)) "
+                            "without holding %s — wrap it in a "
+                            "std::lock_guard scope or declare the "
+                            "method REQUIRES(%s)"
+                            % (cls, method, field, mutex, mutex,
+                               mutex)))
+            # Bare same-class calls vs REQUIRES/EXCLUDES contracts.
+            callee_names = set(facts.requires) | set(facts.excludes)
+            for name in sorted(callee_names):
+                if name == method:
+                    continue
+                for m in word_re(name).finditer(code, bstart, bend):
+                    after = code[m.end():m.end() + 1]
+                    if after != "(":
+                        continue
+                    before = code[max(m.start() - 2, 0):m.start()]
+                    if before.endswith(("->", ".", "::", "&")):
+                        continue  # another object / address-of
+                    held = _held_at(scopes, requires, m.start())
+                    line = _line_of(code, m.start())
+                    for node in graph.resolve(name, cls):
+                        req_mx, exc_mx = node
+                        missing = sorted(mx for mx in req_mx
+                                         if mx not in held)
+                        if missing and not src.suppressed(
+                                line, "cpp-requires"):
+                            findings.append(Finding(
+                                src.path, line, "cpp-requires",
+                                "%s::%s calls %s() [REQUIRES(%s)] "
+                                "without holding %s"
+                                % (cls, method, name,
+                                   ", ".join(sorted(req_mx)),
+                                   ", ".join(missing))))
+                        clash = sorted(mx for mx in exc_mx
+                                       if mx in held)
+                        if clash and not src.suppressed(
+                                line, "cpp-excludes"):
+                            findings.append(Finding(
+                                src.path, line, "cpp-excludes",
+                                "%s::%s calls %s() [EXCLUDES(%s)] "
+                                "while holding %s — the callee "
+                                "acquires it itself (self-deadlock)"
+                                % (cls, method, name,
+                                   ", ".join(sorted(exc_mx)),
+                                   ", ".join(clash))))
+    return findings

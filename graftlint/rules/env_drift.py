@@ -4,7 +4,7 @@
 own module docstring says so, mirroring the reference's
 ``env_parser.cc``): every ``HOROVOD_*``/``HVD_TPU_*`` knob is read once
 there, and the docs are the contract reference users migrate against.
-Three ways that story drifts, each mechanically checkable:
+Four ways that story drifts, each mechanically checkable:
 
 * **`env-undocumented`** — a key read in config.py whose ``HOROVOD_*``
   name (or ``HVD_TPU_*`` alias) appears in none of the doc files
@@ -17,6 +17,14 @@ Three ways that story drifts, each mechanically checkable:
   reads (bootstrap paths that legitimately run before ``hvd.init()``)
   disagreeing with each other about the same key's default.  Defaults
   are compared numerically when both parse as numbers ("600" == 600.0).
+* **`env-harness-pin`** — a test-harness module
+  (``LintConfig.harness_env_files``) writing a ``HOROVOD_*``/
+  ``HVD_TPU_*`` key into the envs it spawns worlds with, documented in
+  none of ``LintConfig.harness_doc_files``.  An undocumented pin
+  silently reconfigures every spawned-world test: the
+  ``HOROVOD_CYCLE_TIME=1`` pin suppressed the r14 plan-cache warm
+  start in every such test via the env-wins precedence rule, and
+  nobody could see why from the test or the docs.
 
 Config-module defaults are deliberately NOT compared against direct
 reads: bootstrap context can differ by design (elastic re-rendezvous
@@ -40,6 +48,9 @@ CHECKS = (
     ("env-duplicate-read", "config key parsed more than once in config.py"),
     ("env-default-conflict",
      "direct os.environ reads of one key with contradictory defaults"),
+    ("env-harness-pin",
+     "test harness pins a HOROVOD_*/HVD_TPU_* env documented nowhere "
+     "in the harness docs"),
 )
 
 _ENV_HELPERS = {"_env", "_env_int", "_env_float", "_env_bool", "opt_int"}
@@ -123,6 +134,40 @@ def _is_environ(node) -> bool:
     return isinstance(node, ast.Attribute) and node.attr == "environ"
 
 
+def harness_pins(path: str) -> List[Tuple[str, int]]:
+    """(full-key, line) for every env WRITE in a test-harness module:
+    dict-literal keys (the ``env.update({...})`` pin blocks),
+    ``env["KEY"] = ...`` subscript stores, and ``setdefault`` calls.
+    Reads (``os.environ.get``) are out of scope — a pin is something
+    the harness FORCES into every spawned world, which is config the
+    worker under test cannot see coming (the HOROVOD_CYCLE_TIME=1 pin
+    silently suppressed the plan-cache warm start in every
+    spawned-world test until r15)."""
+    src, _ = get_source(path)
+    if src is None:
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                key = _const_str(k)
+                if key is not None and key.startswith(_PREFIXES):
+                    out.append((key, k.lineno))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    key = _const_str(tgt.slice)
+                    if key is not None and key.startswith(_PREFIXES):
+                        out.append((key, tgt.lineno))
+        elif isinstance(node, ast.Call) and node.args \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "setdefault":
+            key = _const_str(node.args[0])
+            if key is not None and key.startswith(_PREFIXES):
+                out.append((key, node.lineno))
+    return out
+
+
 def direct_reads(root: str) -> List[Tuple[str, Optional[str], str, int]]:
     """(full-key, default-literal, path, line) for every direct
     ``os.environ`` get/[]/setdefault of a ``HOROVOD_*``/``HVD_TPU_*``
@@ -153,9 +198,9 @@ def direct_reads(root: str) -> List[Tuple[str, Optional[str], str, int]]:
     return out
 
 
-def _doc_text(cfg: LintConfig) -> str:
+def _doc_text(cfg: LintConfig, files=None) -> str:
     chunks = []
-    for rel in cfg.doc_files:
+    for rel in (cfg.doc_files if files is None else files):
         path = cfg.resolve(rel)
         if os.path.isdir(path):
             for dirpath, _dirs, files in os.walk(path):
@@ -227,6 +272,37 @@ def check(cfg: LintConfig) -> List[Finding]:
                 path, line, "env-undocumented",
                 "%s is read here but documented nowhere in %s"
                 % (key, list(cfg.doc_files))))
+
+    # Test harnesses (LintConfig.harness_env_files) force envs into
+    # every world they spawn; an undocumented pin IS config drift — the
+    # worker under test runs a configuration nobody can see in the
+    # docs.  Each pinned key must appear in the harness docs
+    # (tests/README.md), same contract as config.py's vs docs/.
+    harness_docs = _doc_text(cfg, getattr(cfg, "harness_doc_files", ()))
+    for rel in getattr(cfg, "harness_env_files", ()):
+        path = cfg.resolve(rel)
+        if not os.path.isfile(path):
+            continue  # fixture configs legitimately aim elsewhere
+        fsrc, _ = get_source(path)
+        if fsrc is None:
+            continue
+        fsrc.checked.add("env-harness-pin")
+        seen_pins: set = set()
+        for key, line in harness_pins(path):
+            if key in seen_pins:
+                continue
+            seen_pins.add(key)
+            if re.search(r"\b%s\b" % re.escape(key), harness_docs):
+                continue
+            if fsrc.suppressed(line, "env-harness-pin"):
+                continue
+            findings.append(Finding(
+                path, line, "env-harness-pin",
+                "harness pins %s into every spawned world but it is "
+                "documented in none of %s — an undocumented pin "
+                "silently reconfigures every test (the r14 plan "
+                "warm-start suppression)" % (
+                    key, list(getattr(cfg, "harness_doc_files", ())))))
 
     by_key: Dict[str, List[Tuple[str, str, int]]] = {}
     for key, default, path, line in direct_reads(

@@ -1,0 +1,777 @@
+"""SPMD-divergence taint analysis for the collective-routing plane.
+
+Horovod's whole correctness story (arXiv:1802.05799) rests on every
+rank executing the IDENTICAL collective schedule: the negotiated
+response names the ops, the routing plane decides hier-vs-flat legs,
+codec engagement, size classes and fusion order, and the resulting XLA
+programs must match bit-for-bit across the world.  A member that
+routes one class differently from rank 0 does not get a slowdown — it
+gets a distributed hang (divergent compiled programs waiting on each
+other), the exact bug class the r14 review caught by luck in the plan
+KV-adoption fallback.
+
+This pass makes that invariant a machine-checked fact.  It is a
+rank-taint dataflow analysis over ``LintConfig.spmd_roots`` (the
+Python collective-routing plane), interprocedural via the shared
+:class:`~graftlint.core.CallGraph` layer:
+
+* **Sources** — values that can differ between member processes:
+  ``rank()`` / ``local_rank()`` / ``jax.process_index()`` calls;
+  per-rank envs (``LintConfig.spmd_rank_envs`` — ``HOROVOD_RANK``,
+  ``HOROVOD_TENANT_ID``, ...; *uniform* envs, the documented config
+  contract, are not sources); wall-clock reads (``time.monotonic()``
+  and friends); filesystem reads (``open``/``os.listdir``/...);
+  pid/hostname/uuid/RNG; and iteration over ``set``-constructed
+  values feeding ordered decisions (``sorted()`` sanitizes that kind).
+
+* **Sinks** — routing/negotiation decisions
+  (``LintConfig.spmd_sink_calls``): ``PlanController.route``/``pin``/
+  ``force`` and controller construction, the multihost ``_route`` /
+  ``_hier_eligible`` / ``_wire_codec`` gates, size-class computation
+  (``_size_class``/``_pow2_class``/``_bucket``), KV-published plans
+  (``publish_kv``/``put_json``) and process-set membership
+  (``add_process_set``) — plus writes to the fusion/cycle levers
+  (``LintConfig.spmd_sink_attrs``).
+
+* **Barriers** — ``# graftlint: spmd-uniform -- <why>`` declares a
+  reviewed uniformity point: cross-rank averaging, the
+  rank-0-publish -> blocking-adopt protocol, an env-pinned constant.
+  On a call/assignment line the produced value is clean; on a ``def``
+  line the whole function is a vouched barrier (its return is uniform
+  and its internals are not re-litigated).  Any source -> sink path
+  not crossing a barrier is a finding.
+
+Deliberate limits (lint-grade, not a proof system): explicit flows
+only (``if rank(): x = 1`` does not taint ``x`` — per-rank *data* is
+the SPMD model itself; only routed *values* matter), no cross-object
+attribute dataflow except through classes whose type the light
+var/attr type tracking can resolve, no per-instance attribute
+splitting (class-level attribute taint), property reads untracked.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import CallGraph, Finding, LintConfig, SourceFile, get_source
+
+CHECK = "spmd-uniform"
+
+CHECKS = (
+    (CHECK,
+     "rank-divergent value (rank/per-rank env/clock/filesystem/"
+     "set-iteration) reaches a collective-routing decision with no "
+     "declared uniformity barrier"),
+)
+
+_RANK_CALLS = frozenset({
+    "rank", "local_rank", "cross_rank", "node_rank", "process_index",
+})
+_CLOCK_ATTRS = frozenset({
+    "time", "monotonic", "perf_counter", "process_time",
+    "monotonic_ns", "time_ns", "perf_counter_ns", "now", "utcnow",
+})
+_CLOCK_OWNERS = frozenset({"time", "datetime", "date"})
+_FS_CALLS = frozenset({
+    "listdir", "scandir", "walk", "glob", "iglob", "read_text",
+    "read_bytes", "getmtime", "getsize",
+})
+_FS_OWNERS = frozenset({"os", "path", "glob", "pathlib", "Path"})
+_ID_CALLS = frozenset({
+    "getpid", "gethostname", "getfqdn", "uuid1", "uuid4", "getnode",
+    "urandom",
+})
+_RNG_OWNERS = frozenset({"random", "secrets"})
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "push",
+})
+_SET_ITER = "set-iteration-order"
+
+
+def _final_name(func) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _owner_name(func) -> Optional[str]:
+    """Last owner segment of an attribute call (``time.monotonic`` ->
+    ``time``; ``np.random.randn`` -> ``random``)."""
+    if isinstance(func, ast.Attribute):
+        v = func.value
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Attribute):
+            return v.attr
+    return None
+
+
+def _is_environ(node) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "environ"
+    return isinstance(node, ast.Attribute) and node.attr == "environ"
+
+
+def _env_key(node) -> Optional[str]:
+    """Constant env-key of an ``os.environ`` get/[]/setdefault or
+    ``os.getenv`` read, else None."""
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and node.args:
+            if func.attr in ("get", "setdefault") \
+                    and _is_environ(func.value):
+                pass
+            elif func.attr == "getenv":
+                pass
+            else:
+                return None
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                return arg.value
+    elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value
+    return None
+
+
+def _is_set_expr(node) -> bool:
+    """Iterating this expression has rank-dependent ORDER: a set
+    literal / comprehension, or a ``set()``/``frozenset()`` call."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("set", "frozenset"))
+
+
+class _Func:
+    """One function/method node of the shared call graph, carrying the
+    taint summaries the global fixpoint converges."""
+
+    __slots__ = ("qualname", "name", "cls", "node", "src", "params",
+                 "barrier", "ret", "param_ret", "param_sink",
+                 "param_attr")
+
+    def __init__(self, qualname: str, cls: Optional[str],
+                 node, src: SourceFile):
+        self.qualname = qualname
+        self.name = node.name
+        self.cls = cls
+        self.node = node
+        self.src = src
+        args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        if cls is not None and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        self.params: List[str] = params
+        ann = src.def_annotation(node)
+        self.barrier = ann is not None and "spmd-uniform" in ann.flags
+        if ann is not None and "spmd-uniform" in ann.flags:
+            ann.attached = True
+        self.ret: Set[str] = set()
+        self.param_ret: Set[int] = set()
+        self.param_sink: Dict[int, str] = {}
+        self.param_attr: Dict[int, Set[Tuple[str, str]]] = {}
+
+
+class _Analysis:
+    """Whole-plane state: call graph, class-attribute taint, light
+    type bindings, and (in the final pass) findings."""
+
+    def __init__(self, cfg: LintConfig, files: List[SourceFile]):
+        self.cfg = cfg
+        self.files = files
+        self.graph = CallGraph()
+        self.attr_taint: Dict[Tuple[str, str], Set[str]] = {}
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        self.global_types: Dict[str, str] = {}
+        self.classes: Set[str] = set()
+        # path -> top-level imported names: attribute calls through a
+        # module alias (``plancache.note_tuned(...)``) resolve by bare
+        # name; attribute calls on UNKNOWN receivers do not — a
+        # ``somedict.get()`` must never resolve to an unrelated class's
+        # ``get`` and smear its taint across the plane.
+        self.module_aliases: Dict[str, Set[str]] = {}
+        self.findings: List[Finding] = []
+        self.reporting = False
+        self._reported: Set[Tuple[str, int, str]] = set()
+        self.sink_calls = frozenset(cfg.spmd_sink_calls)
+        self.sink_attrs = frozenset(cfg.spmd_sink_attrs)
+        self.rank_envs = frozenset(cfg.spmd_rank_envs)
+        for src in files:
+            self._collect(src)
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self, src: SourceFile):
+        def register_nested_barriers(outer, cls):
+            # Nested defs are analyzed as part of their parent's env
+            # (closures share locals); the only ones that need their
+            # OWN node are declared barriers (`def avg_scalar` inside
+            # the tuning sweep), so calls to them resolve as clean.
+            for sub in ast.walk(outer):
+                if sub is outer or not isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                ann = src.def_annotation(sub)
+                if ann is not None and "spmd-uniform" in ann.flags:
+                    self.graph.add(sub.name,
+                                   _Func(sub.name, cls, sub, src))
+
+        aliases = self.module_aliases.setdefault(src.path, set())
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.graph.add(node.name, _Func(node.name, None, node,
+                                                src))
+                register_nested_barriers(node, None)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.add(node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qn = "%s.%s" % (node.name, item.name)
+                        fn = _Func(qn, node.name, item, src)
+                        self.graph.add(qn, fn)
+                        register_nested_barriers(item, node.name)
+                        if item.name == "__init__":
+                            # Constructor calls resolve by class name
+                            # with the same arg mapping (self elided).
+                            self.graph.nodes[node.name] = fn
+                            self.graph._by_name.setdefault(
+                                node.name, []).append(node.name)
+            elif isinstance(node, ast.Assign):
+                # Module-level singletons: `_plane = _PlanPlane()`
+                # binds the name's type so attr reads resolve.
+                v = node.value
+                if isinstance(v, ast.Call) \
+                        and isinstance(v.func, ast.Name):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.global_types[tgt.id] = v.func.id
+
+    # -- the fixpoint -------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        # global_types may name classes collected later; keep only the
+        # bindings that resolve to known classes.
+        self.global_types = {k: v for k, v in self.global_types.items()
+                             if v in self.classes}
+        self.graph.fixpoint(self._summarize)
+        self.reporting = True
+        seen: Set[int] = set()
+        for payload in list(self.graph.nodes.values()):
+            if id(payload) in seen:
+                continue  # class-name alias of __init__, analyzed once
+            seen.add(id(payload))
+            self._analyze(payload)
+        return self.findings
+
+    def _summarize(self, qualname: str, fn: _Func) -> bool:
+        if qualname == fn.cls:
+            return False  # alias row
+        before = (set(fn.ret), set(fn.param_ret), dict(fn.param_sink),
+                  {k: set(v) for k, v in fn.param_attr.items()},
+                  {k: set(v) for k, v in self.attr_taint.items()})
+        self._analyze(fn)
+        if fn.barrier:
+            fn.ret = set()
+            fn.param_ret = set()
+            fn.param_sink = {}
+            fn.param_attr = {}
+        after = (fn.ret, fn.param_ret, fn.param_sink, fn.param_attr,
+                 self.attr_taint)
+        return (before[0] != after[0] or before[1] != after[1]
+                or before[2] != after[2]
+                or {k: set(v) for k, v in before[3].items()}
+                != {k: set(v) for k, v in after[3].items()}
+                or before[4] != {k: set(v)
+                                 for k, v in after[4].items()})
+
+    # -- per-function analysis ----------------------------------------------
+
+    def _analyze(self, fn: _Func):
+        if fn.barrier:
+            # A vouched barrier is opaque in BOTH directions: its
+            # return is uniform AND its internal stores/sinks are part
+            # of what the author reviewed (cross-rank averaging writes
+            # per-rank scores into shared tuner state by design).
+            return
+        env = _Env(self, fn)
+        for _ in range(10):
+            if not env.sweep():
+                break
+
+    def report(self, fn: _Func, line: int, message: str):
+        if not self.reporting:
+            return
+        if fn.src.suppressed(line, CHECK):
+            return
+        key = (fn.src.path, line, message)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.findings.append(Finding(fn.src.path, line, CHECK,
+                                         message))
+
+
+class _Env:
+    """One function's flow-insensitive taint environment."""
+
+    def __init__(self, an: _Analysis, fn: _Func):
+        self.an = an
+        self.fn = fn
+        self.var_taint: Dict[str, Set[str]] = {
+            p: {"@param%d" % i} for i, p in enumerate(fn.params)}
+        self.var_type: Dict[str, str] = {}
+        self.changed = False
+
+    # -- helpers ------------------------------------------------------------
+
+    def _barrier_line(self, line: int) -> bool:
+        ann = self.fn.src.annotations.get(line)
+        if ann is not None and "spmd-uniform" in ann.flags:
+            ann.attached = True
+            return True
+        return False
+
+    def _bind(self, name: str, taint: Set[str]):
+        cur = self.var_taint.setdefault(name, set())
+        if not taint <= cur:
+            cur |= taint
+            self.changed = True
+
+    def _bind_attr(self, key: Tuple[str, str], taint: Set[str]):
+        real = {t for t in taint if not t.startswith("@")}
+        if real:
+            cur = self.an.attr_taint.setdefault(key, set())
+            if not real <= cur:
+                cur |= real
+                self.changed = True
+        for t in taint:
+            if t.startswith("@param"):
+                i = int(t[len("@param"):])
+                dst = self.fn.param_attr.setdefault(i, set())
+                if key not in dst:
+                    dst.add(key)
+                    self.changed = True
+
+    def _type_of(self, expr) -> Optional[str]:
+        """Best-effort class of an expression under the light type
+        tracking: typed locals/globals, ``ClassName(...)`` calls, and
+        one level of typed-attribute chasing."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return self.fn.cls
+            return (self.var_type.get(expr.id)
+                    or self.an.global_types.get(expr.id))
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Name) \
+                and expr.func.id in self.an.classes:
+            return expr.func.id
+        if isinstance(expr, ast.Attribute):
+            owner = self._type_of(expr.value)
+            if owner is not None:
+                return self.an.attr_types.get((owner, expr.attr))
+        return None
+
+    def _receiver_class(self, func) -> Optional[str]:
+        """Resolved class of a method call's receiver, if the light
+        type tracking knows it."""
+        return self._type_of(func.value)
+
+    # -- source classification ----------------------------------------------
+
+    def _source_kinds(self, node: ast.Call) -> Set[str]:
+        name = _final_name(node.func)
+        owner = _owner_name(node.func)
+        if name in _RANK_CALLS:
+            return {"%s()" % name}
+        if name in _CLOCK_ATTRS and owner in _CLOCK_OWNERS:
+            return {"%s.%s()" % (owner, name)}
+        if name == "open" and isinstance(node.func, ast.Name):
+            return {"filesystem read (open)"}
+        if name in _FS_CALLS and (owner in _FS_OWNERS or owner is None):
+            return {"filesystem read (%s)" % name}
+        if name in _ID_CALLS:
+            return {"per-process identity (%s)" % name}
+        if owner in _RNG_OWNERS:
+            return {"unseeded RNG (%s.%s)" % (owner, name)}
+        key = _env_key(node)
+        if key is not None and key in self.an.rank_envs:
+            return {"per-rank env %s" % key}
+        return set()
+
+    # -- expression taint ---------------------------------------------------
+
+    def taint_of(self, node) -> Set[str]:
+        if node is None or isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Name):
+            return set(self.var_taint.get(node.id, ()))
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.fn.cls is not None:
+                return set(self.an.attr_taint.get(
+                    (self.fn.cls, node.attr), ()))
+            owner = None
+            if isinstance(base, ast.Name):
+                owner = (self.var_type.get(base.id)
+                         or self.an.global_types.get(base.id))
+            if owner is not None:
+                return set(self.an.attr_taint.get((owner, node.attr),
+                                                  ()))
+            return set()
+        if isinstance(node, ast.Subscript):
+            key = _env_key(node)
+            if key is not None:
+                return ({"per-rank env %s" % key}
+                        if key in self.an.rank_envs else set())
+            # Selection by a tainted index is divergent selection —
+            # the slice taints the result along with the base.
+            return self.taint_of(node.value) | self.taint_of(node.slice)
+        if isinstance(node, ast.Call):
+            return self._taint_of_call(node)
+        if isinstance(node, ast.IfExp):
+            # Explicit flows only: per-rank CONTROL over per-rank DATA
+            # is the SPMD model; the test does not taint the value.
+            return self.taint_of(node.body) | self.taint_of(node.orelse)
+        if isinstance(node, ast.BoolOp):
+            return set().union(*(self.taint_of(v) for v in node.values))
+        if isinstance(node, ast.BinOp):
+            return self.taint_of(node.left) | self.taint_of(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint_of(node.operand)
+        if isinstance(node, ast.Compare):
+            out = self.taint_of(node.left)
+            for c in node.comparators:
+                out |= self.taint_of(c)
+            return out
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return set().union(set(),
+                               *(self.taint_of(e) for e in node.elts))
+        if isinstance(node, ast.Dict):
+            out: Set[str] = set()
+            for k in node.keys:
+                out |= self.taint_of(k)
+            for v in node.values:
+                out |= self.taint_of(v)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            return set().union(set(),
+                               *(self.taint_of(v) for v in node.values))
+        if isinstance(node, ast.FormattedValue):
+            return self.taint_of(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            return self.taint_of(node.elt)
+        if isinstance(node, ast.DictComp):
+            return self.taint_of(node.key) | self.taint_of(node.value)
+        if isinstance(node, (ast.Await, ast.Starred, ast.NamedExpr)):
+            return self.taint_of(node.value)
+        if isinstance(node, ast.Slice):
+            out = set()
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    out |= self.taint_of(part)
+            return out
+        if isinstance(node, ast.Lambda):
+            return set()
+        return set()
+
+    def _taint_of_call(self, node: ast.Call) -> Set[str]:
+        if self._barrier_line(node.lineno):
+            # Evaluate args anyway so mutator bookkeeping stays sound,
+            # then declare the RESULT uniform.
+            for a in node.args:
+                self.taint_of(a)
+            return set()
+        name = _final_name(node.func)
+        arg_taints = [self.taint_of(a) for a in node.args]
+        kw_taint: Set[str] = set()
+        for kw in node.keywords:
+            kw_taint |= self.taint_of(kw.value)
+        src_kinds = self._source_kinds(node)
+        if src_kinds:
+            return src_kinds
+        if name == "sorted":
+            # Deterministic ordering sanitizes the iteration-order
+            # kind (and only that kind).
+            merged = set().union(set(), *arg_taints) | kw_taint
+            return merged - {_SET_ITER}
+        base_taint: Set[str] = set()
+        candidates = []
+        if isinstance(node.func, ast.Attribute):
+            base_taint = self.taint_of(node.func.value)
+            cls = self._receiver_class(node.func)
+            if cls is not None:
+                candidates = self.an.graph.resolve(name, cls)
+            elif isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in \
+                    self.an.module_aliases.get(self.fn.src.path, ()):
+                # Module-alias call (`plancache.note_tuned(...)`):
+                # bare-name resolution across the plane.
+                candidates = self.an.graph.resolve(name)
+            # Unknown receiver: NO name-based guessing — a stray
+            # `.get()`/`.add()` must not alias an unrelated class's
+            # method (conservative arg-union instead, below).
+        elif name is not None:
+            candidates = self.an.graph.resolve(name)
+        result = set(base_taint)
+        if candidates:
+            for cand in candidates:
+                result |= cand.ret
+                # Map taint by parameter index: positional args by
+                # position, keyword args by the callee's parameter
+                # names — `helper(plan=tainted)` must flow exactly
+                # like the positional form.  A keyword matching no
+                # parameter (**kwargs catch-alls) degrades to
+                # pass-through on the result.
+                by_idx: Dict[int, Set[str]] = dict(
+                    enumerate(arg_taints))
+                params = getattr(cand, "params", None) or []
+                for kw in node.keywords:
+                    t = self.taint_of(kw.value)
+                    if kw.arg is not None and kw.arg in params:
+                        i = params.index(kw.arg)
+                        by_idx[i] = by_idx.get(i, set()) | t
+                    else:
+                        result |= t
+                for i in cand.param_ret:
+                    result |= by_idx.get(i, set())
+                for i, sink in cand.param_sink.items():
+                    if i not in by_idx:
+                        continue
+                    self._hit_sink(
+                        node, by_idx[i],
+                        "%s() [which routes it to %s]"
+                        % (name, sink))
+                for i, attrs in cand.param_attr.items():
+                    for key in attrs:
+                        self._bind_attr(key, by_idx.get(i, set()))
+        else:
+            # Unknown callable: conservative pass-through (int(x),
+            # max(xs), json.loads(raw) keep their argument's taint).
+            result |= set().union(set(), *arg_taints) | kw_taint
+        if name in self.an.sink_calls:
+            for t in arg_taints:
+                self._hit_sink(node, t, "%s()" % name)
+            self._hit_sink(node, kw_taint, "%s()" % name)
+        return result
+
+    def _hit_sink(self, node: ast.Call, taint: Set[str], sink: str):
+        real = sorted(t for t in taint if not t.startswith("@"))
+        if real:
+            self.an.report(
+                self.fn, node.lineno,
+                "rank-divergent value (%s) reaches routing sink %s in "
+                "%s(); members could compile different collective "
+                "programs (distributed hang) — negotiate the value or "
+                "declare '# graftlint: spmd-uniform -- <why>' at its "
+                "uniformity point" % (", ".join(real), sink,
+                                      self.fn.qualname))
+        for t in taint:
+            if t.startswith("@param"):
+                self.fn.param_sink.setdefault(
+                    int(t[len("@param"):]), sink)
+
+    # -- statement sweep ----------------------------------------------------
+
+    def _walk(self):
+        """ast.walk minus the bodies of nested defs DECLARED as
+        barriers: a vouched `def avg():  # graftlint: spmd-uniform`
+        is opaque — its internals are not re-litigated in the parent's
+        env (it has its own graph node, skipped as a barrier there
+        too).  Non-barrier nested defs/lambdas (the traced build()
+        closures) share this env deliberately: a closure routing by a
+        captured tainted local is the same divergence."""
+        stack = [self.fn.node]
+        while stack:
+            node = stack.pop()
+            if node is not self.fn.node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ann = self.fn.src.def_annotation(node)
+                if ann is not None and "spmd-uniform" in ann.flags:
+                    ann.attached = True
+                    continue
+            stack.extend(ast.iter_child_nodes(node))
+            yield node
+
+    def sweep(self) -> bool:
+        self.changed = False
+        fn = self.fn
+        for node in self._walk():
+            if isinstance(node, ast.Assign):
+                self._assign(node.targets, node.value, node.lineno)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._assign([node.target], node.value, node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                self._assign([node.target], node.value, node.lineno)
+            elif isinstance(node, ast.For):
+                t = self.taint_of(node.iter)
+                if _is_set_expr(node.iter):
+                    t = t | {_SET_ITER}
+                self._bind_target(node.target, t, node.lineno)
+            elif isinstance(node, ast.comprehension):
+                t = self.taint_of(node.iter)
+                if _is_set_expr(node.iter):
+                    t = t | {_SET_ITER}
+                self._bind_target(node.target, t,
+                                  getattr(node.iter, "lineno", 0))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        t = self.taint_of(item.context_expr)
+                        if self._barrier_line(node.lineno):
+                            t = set()
+                        self._bind_target(item.optional_vars, t,
+                                          node.lineno)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                t = self.taint_of(node.value)
+                if self._barrier_line(node.lineno):
+                    t = set()
+                real = {x for x in t if not x.startswith("@")}
+                if not real <= fn.ret:
+                    fn.ret |= real
+                    self.changed = True
+                for x in t:
+                    if x.startswith("@param"):
+                        i = int(x[len("@param"):])
+                        if i not in fn.param_ret:
+                            fn.param_ret.add(i)
+                            self.changed = True
+            elif isinstance(node, ast.Expr):
+                self.taint_of(node.value)
+                self._mutator(node.value)
+            elif isinstance(node, (ast.If, ast.While)):
+                # The most common gate shape IS a conditional —
+                # `if ctl.route(...):` / `if _hier_eligible(...)` —
+                # so test expressions must be taint-evaluated for
+                # their sink hits (the branch outcome itself stays
+                # untracked: explicit flows only).
+                self.taint_of(node.test)
+            elif isinstance(node, ast.Assert):
+                self.taint_of(node.test)
+            elif isinstance(node, ast.Raise):
+                if node.exc is not None:
+                    self.taint_of(node.exc)
+            elif isinstance(node, ast.Call):
+                # Calls in non-Expr positions still hit sinks via
+                # taint_of when their parent expression is evaluated;
+                # mutator bookkeeping wants the call node directly.
+                self._mutator(node)
+        return self.changed
+
+    def _mutator(self, node):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _MUTATORS:
+            return
+        t: Set[str] = set()
+        for a in node.args:
+            t |= self.taint_of(a)
+        for kw in node.keywords:
+            t |= self.taint_of(kw.value)
+        if self._barrier_line(node.lineno):
+            t = set()
+        base = node.func.value
+        if isinstance(base, ast.Name):
+            self._bind(base.id, t)
+        elif isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and self.fn.cls is not None:
+            self._bind_attr((self.fn.cls, base.attr), t)
+
+    def _assign(self, targets, value, line: int):
+        t = self.taint_of(value)
+        if self._barrier_line(line):
+            t = set()
+        # Light type tracking: `x = ClassName(...)`, `x = _singleton`
+        # and `x = obj.typed_attr` bind x's class so later
+        # `x.method(...)` resolves exactly.
+        bind_cls = self._type_of(value)
+        for tgt in targets:
+            self._bind_target(tgt, t, line, bind_cls=bind_cls)
+
+    def _bind_target(self, tgt, taint: Set[str], line: int,
+                     bind_cls: Optional[str] = None):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind_target(el, taint, line)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._bind_target(tgt.value, taint, line)
+            return
+        if isinstance(tgt, ast.Name):
+            self._bind(tgt.id, taint)
+            if bind_cls is not None \
+                    and self.var_type.get(tgt.id) != bind_cls:
+                self.var_type[tgt.id] = bind_cls
+                self.changed = True
+            return
+        if isinstance(tgt, ast.Subscript):
+            # Element stores into LOCAL containers do not taint the
+            # container (a telemetry stamp parked in a group dict must
+            # not poison every negotiated value riding in it); the
+            # cross-method state channel is class attributes, which DO
+            # keep element-store taint.
+            inner = tgt.value
+            if isinstance(inner, ast.Attribute):
+                owner = self._type_of(inner.value)
+                if owner is not None:
+                    self._bind_attr((owner, inner.attr), taint)
+            return
+        if isinstance(tgt, ast.Attribute):
+            owner = self._type_of(tgt.value)
+            if owner is not None:
+                self._bind_attr((owner, tgt.attr), taint)
+                if bind_cls is not None:
+                    key = (owner, tgt.attr)
+                    if self.an.attr_types.get(key) != bind_cls:
+                        self.an.attr_types[key] = bind_cls
+                        self.changed = True
+            if tgt.attr in self.an.sink_attrs:
+                real = sorted(x for x in taint if not x.startswith("@"))
+                if real and not self.fn.src.suppressed(line, CHECK):
+                    self.an.report(
+                        self.fn, line,
+                        "rank-divergent value (%s) written to routing "
+                        "lever .%s in %s(); the fusion/cycle schedule "
+                        "would diverge across members — negotiate the "
+                        "value or declare '# graftlint: spmd-uniform "
+                        "-- <why>'" % (", ".join(real), tgt.attr,
+                                       self.fn.qualname))
+                for x in taint:
+                    if x.startswith("@param"):
+                        self.fn.param_sink.setdefault(
+                            int(x[len("@param"):]),
+                            ".%s write" % tgt.attr)
+
+
+def check(cfg: LintConfig) -> List[Finding]:
+    files: List[SourceFile] = []
+    for rel in cfg.spmd_roots:
+        path = cfg.resolve(rel)
+        if not os.path.isfile(path):
+            continue  # fixture configs legitimately aim elsewhere
+        src, _errs = get_source(path)
+        if src is None:
+            continue
+        src.checked.add(CHECK)
+        files.append(src)
+    if not files:
+        return []
+    return _Analysis(cfg, files).run()

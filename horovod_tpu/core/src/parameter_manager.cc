@@ -123,12 +123,24 @@ void ParameterManager::Configure(uint64_t fusion_threshold,
                                  const std::string& log_path,
                                  int warmup_cycles, int cycles_per_sample,
                                  int max_samples) {
+  // Init-time callers never hold mu_, and Observe/WarmStart can
+  // already be live on other threads by the time a late Configure
+  // lands (elastic re-init), so the writes below need the same lock
+  // every other mutator takes.
+  std::lock_guard<std::mutex> lk(mu_);
   fusion_threshold_ = fusion_threshold;
   cycle_time_ms_ = cycle_time_ms;
   enabled_ = enabled;
   warmup_ = warmup_cycles;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
+  if (log_) {
+    // Elastic re-init lands here with a stream from the previous
+    // configuration: close it so re-Configure neither leaks the fd
+    // nor keeps appending to the old run's rank-stamped path.
+    std::fclose(log_);
+    log_ = nullptr;
+  }
   if (enabled && !log_path.empty()) {
     // Append, never truncate (the r11 journal conventions, mirrored by
     // utils/autotune.py AutotuneLog): the caller rank-stamps the path

@@ -18,6 +18,7 @@
 #include <utility>
 #include <vector>
 
+#include "common.h"
 #include "gaussian_process.h"
 
 namespace hvdtpu {
@@ -47,13 +48,14 @@ class BayesianOptimization {
 // world has a rank-0 aggregation point across runs.
 class KernelTuner {
  public:
-  void Record(int choice, double score);
-  int Best() const;       // -1 when no samples recorded
-  int Samples() const;
+  void Record(int choice, double score) EXCLUDES(mu_);
+  int Best() const EXCLUDES(mu_);     // -1 when no samples recorded
+  int Samples() const EXCLUDES(mu_);
 
  private:
   mutable std::mutex mu_;
-  std::map<int, std::pair<double, int>> agg_;  // choice -> (sum, n)
+  // choice -> (sum, n)
+  std::map<int, std::pair<double, int>> agg_ GUARDED_BY(mu_);
 };
 
 class ParameterManager {
@@ -61,14 +63,14 @@ class ParameterManager {
   void Configure(uint64_t fusion_threshold, double cycle_time_ms,
                  bool enabled, const std::string& log_path,
                  int warmup_cycles = 5, int cycles_per_sample = 20,
-                 int max_samples = 25);
+                 int max_samples = 25) EXCLUDES(mu_);
   // Called once per non-empty cycle with reduced bytes and cycle seconds.
   // Returns true if the tuned values changed (so the coordinator should
   // re-broadcast them).
   // Thread-safe: called from the background cycle loop AND, in
   // multihost mode, from the Python executor reporting device-plane
   // completion times (hvd_tcp_autotune_observe).
-  bool Observe(uint64_t bytes, double secs);
+  bool Observe(uint64_t bytes, double secs) EXCLUDES(mu_);
 
   // Plan-cache warm start (hvd_tcp_autotune_warm_start): adopt a
   // persisted tuned operating point — sampling starts AT the point
@@ -76,12 +78,12 @@ class ParameterManager {
   // tuner entirely, so a rerun never re-walks the grid it already
   // searched.
   void WarmStart(uint64_t fusion_threshold, double cycle_time_ms,
-                 bool converged);
+                 bool converged) EXCLUDES(mu_);
 
   // Snapshot for plan persistence (hvd_tcp_autotune_state); any out
   // pointer may be null.
   void State(uint64_t* fusion, double* cycle_ms, int* converged,
-             int* samples_done, int* warmup_left) const;
+             int* samples_done, int* warmup_left) const EXCLUDES(mu_);
 
   uint64_t fusion_threshold() const {
     std::lock_guard<std::mutex> lk(mu_);
@@ -97,24 +99,25 @@ class ParameterManager {
   }
 
  private:
-  void Apply(int grid_index);
+  void Apply(int grid_index) REQUIRES(mu_);
 
-  BayesianOptimization bo_;
-  uint64_t fusion_threshold_ = 64ull << 20;
-  double cycle_time_ms_ = 5.0;
-  bool enabled_ = false;
-  bool converged_ = false;
-  int warmup_ = 5;
-  int cycles_per_sample_ = 20;
-  int max_samples_ = 25;
-  int current_idx_ = -1;
-  int cycles_seen_ = 0;
-  int samples_done_ = 0;
-  double acc_bytes_ = 0, max_secs_ = 0;
-  std::chrono::steady_clock::time_point sample_start_{};
-  std::chrono::steady_clock::time_point last_obs_end_{};
   mutable std::mutex mu_;
-  FILE* log_ = nullptr;
+  BayesianOptimization bo_ GUARDED_BY(mu_);
+  uint64_t fusion_threshold_ GUARDED_BY(mu_) = 64ull << 20;
+  double cycle_time_ms_ GUARDED_BY(mu_) = 5.0;
+  bool enabled_ GUARDED_BY(mu_) = false;
+  bool converged_ GUARDED_BY(mu_) = false;
+  int warmup_ GUARDED_BY(mu_) = 5;
+  int cycles_per_sample_ GUARDED_BY(mu_) = 20;
+  int max_samples_ GUARDED_BY(mu_) = 25;
+  int current_idx_ GUARDED_BY(mu_) = -1;
+  int cycles_seen_ GUARDED_BY(mu_) = 0;
+  int samples_done_ GUARDED_BY(mu_) = 0;
+  double acc_bytes_ GUARDED_BY(mu_) = 0;
+  double max_secs_ GUARDED_BY(mu_) = 0;
+  std::chrono::steady_clock::time_point sample_start_ GUARDED_BY(mu_){};
+  std::chrono::steady_clock::time_point last_obs_end_ GUARDED_BY(mu_){};
+  FILE* log_ GUARDED_BY(mu_) = nullptr;
 };
 
 }  // namespace hvdtpu

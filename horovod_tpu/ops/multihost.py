@@ -273,7 +273,7 @@ class GlobalMeshCollectives:
                 "one device" % (name, missing))
         devs = [by_proc[p][0] for p in self.procs]
         self.mesh = Mesh(np.asarray(devs), ("proc",))
-        self.device = devs[self.my_idx] if self.my_idx >= 0 else None
+        self.device = devs[self.my_idx] if self.my_idx >= 0 else None  # graftlint: spmd-uniform -- device HANDLE: names where this process STAGES payload bytes (per-rank placement is the SPMD model); no routing decision ever reads it
         from ..common.config import Config as _Cfg
         cfg = _Cfg.from_env()
         # Multi-chip payload plane (reference hierarchical allreduce,

@@ -391,13 +391,13 @@ class ParameterManager:
     def warmup_left(self) -> int:
         return max(int(self.warmup), 0)
 
-    def _apply(self, idx: int):
+    def _apply(self, idx: int):  # graftlint: spmd-uniform -- in-process tuner: ParameterManager is installed only by the single-process engine (common/basics.py, mode == "inprocess"), so there is no peer to diverge from; the multi-member planes tune through tune_collective_plans' cross-rank-averaged sweep instead
         f_log, c_log = self.bo.grid[idx]
         self.fusion_threshold = int(2 ** f_log)
         self.cycle_time_ms = float(2 ** c_log - 1.0)
         self._current_idx = idx
 
-    def observe(self, nbytes: int, secs: float):
+    def observe(self, nbytes: int, secs: float):  # graftlint: spmd-uniform -- in-process tuner: installed only by the single-process engine (common/basics.py, mode == "inprocess"); its wall-clock scores feed a private BO with no peer to diverge from, and the multi-member sweep (tune_collective_plans) cross-rank-averages before ITS tuner sees a score
         if self.frozen:
             return
         if self.warmup > 0:

@@ -445,48 +445,23 @@ def _apply_flash(plan: dict):
     pallas_kernels.seed_tuned_blocks(plan["flash_blocks"])
 
 
-def bootstrap(config, topology, mode: str) -> Optional[dict]:
-    """Load-and-apply at ``hvd.init()``: resolve the topology
-    fingerprint, load the local cache (rank 0) or adopt rank 0's
-    KV-published copy (other members — identical routing everywhere),
-    warm-start the fusion/cycle tuner, seed the flash registry, and
-    install the per-class routing controller (multihost mode).
-    Returns the active plan (may be empty) or None when disabled."""
-    plane = _plane
-    plane.rank = topology.rank if topology is not None else None
-    plane.enabled = bool(getattr(config, "plan_cache", True))
-    plane.tune_enabled = (config.plan_autotune
-                          if getattr(config, "plan_autotune", None)
-                          is not None else bool(config.autotune))
-    plane.dir = getattr(config, "plan_cache_dir", None)
-    if not plane.enabled:
-        return None
-    n_procs = topology.size if topology is not None else 1
-    # KV-only operation (ephemeral-disk pods): with no cache dir the
-    # rendezvous KV still carries fleet sharing — rank 0 republishes
-    # its live-tuned plan at shutdown, so respawned workers and the
-    # next KV-bootstrapped run adopt it.  With neither dir nor KV
-    # there is nothing to load or share: the plane is inert.
-    kv_world = (mode in ("tcp", "multihost") and config.rendezvous_addr
-                and n_procs > 1)
-    if not plane.dir and not kv_world:
-        plane.enabled = False
-        return None
-    local = 1
-    kind = "host"
-    if mode in ("inprocess", "multihost"):
-        try:
-            import jax
-            devs = jax.local_devices()
-            kind = getattr(devs[0], "device_kind", devs[0].platform)
-            if mode == "multihost":
-                local = len(devs)
-        except Exception:  # noqa: BLE001 - fingerprint must not kill init
-            pass
-    plane.fingerprint = topology_fingerprint(n_procs, local, kind)
+def _agree_plan(plane, config, mode, n_procs, kv_world,
+                local_plan):  # graftlint: spmd-uniform -- rank-0-publish -> blocking-adopt: rank 0's answer (its local blob, or the KV's prior one) is published under the fingerprint key; every other member blocks on that key and REPLACES its local view with the adopted answer or raises (multihost), so all members leave with the identical plan.  A KV-less multihost world drops the local blob entirely (per-host cache files may differ).
+    """World agreement on the active plan.
 
-    plan = (load(plane.dir, plane.fingerprint) if plane.dir else None)
-    plane.source = "cache" if plan is not None else None
+    The local cache blob is a per-host filesystem read — two hosts can
+    legitimately hold different blobs (independent disks, one stale
+    rerun) — so it must never steer routing directly on a multi-member
+    world.  Rank 0's view becomes THE plan by publishing it to the
+    rendezvous KV; members adopt that published answer (blocking) or
+    fail loudly.  Without a KV to agree through, a multihost world
+    gets no plan at all: divergent per-class hier/flat choices compile
+    divergent XLA programs — a distributed hang, not a slowdown (the
+    r14 bug class).  tcp mode has no routing controller, so it keeps
+    its local view (fusion/cycle pacing only, composition is
+    negotiated per cycle).
+    """
+    plan = local_plan
     if kv_world:
         from ..runner.http_client import RendezvousClient
         plane.kv = RendezvousClient(config.rendezvous_addr,
@@ -536,6 +511,68 @@ def bootstrap(config, topology, mode: str) -> Optional[dict]:
                 plan = adopted
                 plane.source = ("kv" if plan_has_content(adopted)
                                 else None)
+    elif mode == "multihost" and n_procs > 1 and plan is not None:
+        # No KV to agree through: members CANNOT verify their local
+        # blobs match, and applying them anyway is precisely the
+        # divergent-routing hang spmd-uniform exists to ban.  Drop the
+        # blob (the run degrades to threshold routing and static
+        # fusion defaults, still identical everywhere) and say why.
+        LOG.warning(
+            "plan cache: multihost world with no rendezvous KV — "
+            "dropping the local plan blob (%s); per-host cache files "
+            "cannot be proven identical, and divergent routing hangs "
+            "the world.  Set HOROVOD_RENDEZVOUS_ADDR to share plans.",
+            plane.dir)
+        plan = None
+        plane.source = None
+    return plan
+
+
+def bootstrap(config, topology, mode: str) -> Optional[dict]:
+    """Load-and-apply at ``hvd.init()``: resolve the topology
+    fingerprint, load the local cache (rank 0) or adopt rank 0's
+    KV-published copy (other members — identical routing everywhere),
+    warm-start the fusion/cycle tuner, seed the flash registry, and
+    install the per-class routing controller (multihost mode).
+    Returns the active plan (may be empty) or None when disabled."""
+    plane = _plane
+    plane.rank = topology.rank if topology is not None else None
+    plane.enabled = bool(getattr(config, "plan_cache", True))
+    plane.tune_enabled = (config.plan_autotune
+                          if getattr(config, "plan_autotune", None)
+                          is not None else bool(config.autotune))
+    plane.dir = getattr(config, "plan_cache_dir", None)
+    if not plane.enabled:
+        return None
+    n_procs = topology.size if topology is not None else 1
+    # KV-only operation (ephemeral-disk pods): with no cache dir the
+    # rendezvous KV still carries fleet sharing — rank 0 republishes
+    # its live-tuned plan at shutdown, so respawned workers and the
+    # next KV-bootstrapped run adopt it.  With neither dir nor KV
+    # there is nothing to load or share: the plane is inert.
+    kv_world = (mode in ("tcp", "multihost") and config.rendezvous_addr
+                and n_procs > 1)
+    if not plane.dir and not kv_world:
+        plane.enabled = False
+        return None
+    local = 1
+    kind = "host"
+    if mode in ("inprocess", "multihost"):
+        try:
+            import jax
+            devs = jax.local_devices()
+            kind = getattr(devs[0], "device_kind", devs[0].platform)
+            if mode == "multihost":
+                local = len(devs)
+        except Exception:  # noqa: BLE001 - fingerprint must not kill init
+            pass
+    plane.fingerprint = topology_fingerprint(n_procs, local, kind)
+
+    local_plan = (load(plane.dir, plane.fingerprint)
+                  if plane.dir else None)
+    plane.source = "cache" if local_plan is not None else None
+    plan = _agree_plan(plane, config, mode, n_procs, kv_world,
+                       local_plan)
     plane.loaded = plan
     if plan is None:
         plan = empty_plan(plane.fingerprint)
@@ -655,7 +692,7 @@ def persist(publish: bool = True) -> Optional[str]:
     if plane.rank in (None, 0) and plane.dir:
         path = store(plan, plane.dir)
     if publish and plane.kv is not None and plane.rank in (None, 0):
-        publish_kv(plane.kv, plan)
+        publish_kv(plane.kv, plan)  # graftlint: spmd-uniform -- rank-0-only republish: this blob is the NEXT run's adoption point (never read back into this run's routing); members hit the rank guard above
     return path
 
 
@@ -804,7 +841,7 @@ def tune_collective_plans(sizes_bytes=(1 << 20,), ops=("allreduce",),
             candidates.append({"path": "hier", "codec": ctl.codec_name})
             coords.append((1.0, 1.0))
 
-    def avg_scalar(x: float) -> float:
+    def avg_scalar(x: float) -> float:  # graftlint: spmd-uniform -- cross-rank Average over the collective plane: every member contributes its local score and receives the identical mean, so GP proposals and the final argmax match on all members
         # Cross-rank mean via the regular collective plane: identical
         # inputs ordering -> bit-identical result on every member.
         v = np.asarray([x], np.float32)

@@ -1,0 +1,15 @@
+#include "gadget.h"
+
+void Gadget::Set(int v) {
+  std::lock_guard<std::mutex> lk(mu_);
+  value_ = v;  // clean: under the lock
+  Bump();      // clean: mu_ held for the REQUIRES callee
+}
+
+void Gadget::Bump() {
+  value_ += 1;  // clean: REQUIRES(mu_) — caller holds the lock
+}
+
+int Gadget::Peek() const {
+  return value_;  // graftlint: disable=cpp-guarded-by issue=ISSUE-10 -- racy monitoring hint only; a torn read is harmless here
+}

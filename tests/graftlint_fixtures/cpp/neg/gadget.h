@@ -1,0 +1,18 @@
+// Negative fixture: every annotated access is under a matching lock
+// scope, REQUIRES-covered, or carries a cited suppression.  Must lint
+// clean.
+#pragma once
+
+#include <mutex>
+
+class Gadget {
+ public:
+  void Set(int v) EXCLUDES(mu_);
+  int Peek() const EXCLUDES(mu_);
+
+ private:
+  void Bump() REQUIRES(mu_);
+
+  mutable std::mutex mu_;
+  int value_ GUARDED_BY(mu_) = 0;
+};

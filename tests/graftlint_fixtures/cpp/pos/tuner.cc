@@ -1,0 +1,39 @@
+#include "tuner.h"
+
+void ParamTuner::Configure(int v) {
+  // The digit separator must not open a char literal in the stripper
+  // (it would blank everything below, hiding both findings).
+  const int kScale = 1'000;
+  value_ = v * kScale;  // EXPECT cpp-guarded-by: no lock, not REQUIRES
+  Apply(v);             // EXPECT cpp-requires: Apply needs mu_ held
+}
+
+void ParamTuner::Flush() {
+  std::lock_guard<std::mutex> a(mu_);
+  std::lock_guard<std::mutex> b(io_mu_);
+  Publish();  // EXPECT cpp-excludes via the SECOND stacked annotation
+}
+
+void ParamTuner::Publish() {
+  value_ = 0;  // clean: REQUIRES(mu_)
+}
+
+void ParamTuner::Reset() {
+  Publish();  // EXPECT cpp-requires: the stacked declaration keeps
+}             // its REQUIRES(mu_) alongside the EXCLUDES(io_mu_)
+
+int ParamTuner::Get() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  Observe(0);     // EXPECT cpp-excludes: callee acquires mu_ itself
+  return value_;  // clean: under the lock scope
+}
+
+bool ParamTuner::Observe(int v) {
+  std::lock_guard<std::mutex> lk(mu_);
+  Apply(v);  // clean: mu_ held at the call site
+  return value_ > 0;
+}
+
+void ParamTuner::Apply(int v) {
+  value_ = v;  // clean: REQUIRES(mu_) — the caller holds the lock
+}

@@ -1,0 +1,26 @@
+// Positive fixture for the cpp-guarded-by / cpp-requires /
+// cpp-excludes checks.  The annotation macros are never expanded here
+// (the rule parses them textually); Configure's unlocked writes mirror
+// the exact ParameterManager::Configure shape fixed in the live tree —
+// reverting that fix re-creates what tuner.cc seeds.
+#pragma once
+
+#include <mutex>
+
+class ParamTuner {
+ public:
+  void Configure(int v) EXCLUDES(mu_);
+  bool Observe(int v) EXCLUDES(mu_);
+  int Get() const EXCLUDES(mu_);
+  void Flush() EXCLUDES(mu_, io_mu_);
+  void Reset() EXCLUDES(mu_, io_mu_);
+
+ private:
+  void Apply(int v) REQUIRES(mu_);
+  // Stacked annotations: BOTH contracts must be parsed and enforced.
+  void Publish() REQUIRES(mu_) EXCLUDES(io_mu_);
+
+  mutable std::mutex mu_;
+  mutable std::mutex io_mu_;
+  int value_ GUARDED_BY(mu_) = 0;
+};

@@ -1,0 +1,15 @@
+"""env-harness-pin fixture: a spawn-style harness with one documented
+pin, one ghost pin (EXPECT a finding), and a plain read that must NOT
+count as a pin."""
+
+import os
+
+
+def spawn(worker):
+    env = dict(os.environ)
+    env.update({
+        "HOROVOD_DOCUMENTED_PIN": "1",
+    })
+    env["HOROVOD_GHOST_PIN"] = "1"  # EXPECT env-harness-pin
+    scale = os.environ.get("HOROVOD_SOME_READ", "1")  # read, not a pin
+    return worker, env, scale

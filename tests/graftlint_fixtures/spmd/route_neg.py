@@ -1,0 +1,55 @@
+"""Negative fixture: route_pos's shapes made uniform via declared
+barriers (cross-rank averaging, rank-0-publish -> blocking-adopt,
+sorted iteration) plus the explicit-flow limit (rank-gated DATA is the
+SPMD model; only routed VALUES matter).  Must lint clean."""
+
+
+def rank():
+    return 0
+
+
+class PlanController:
+    def __init__(self, plan):
+        self.plan = plan
+
+    def route(self, op, klass, default):
+        return default
+
+
+def _averaged_score(x):  # graftlint: spmd-uniform -- cross-rank mean over the collective plane: every member contributes its local score and receives the identical average
+    return x
+
+
+def adopt(kv):
+    plan = kv.get_blocking("plan")  # graftlint: spmd-uniform -- rank-0-publish -> blocking-adopt: every member leaves with rank 0's blob or raises
+    ctl = PlanController(plan)
+    return ctl
+
+
+def route_scored(ctl, score):
+    s = _averaged_score(score)
+    ctl.route("allreduce", s, True)
+
+
+def publish_order(kv, names):
+    acc = []
+    for n in sorted(set(names)):
+        acc.append(n)
+    publish_kv(kv, acc)
+
+
+def tune(kv, score):
+    # A NESTED barrier def is opaque: its internals (which feed
+    # per-rank scores into the shared publish by design) are vouched,
+    # not re-litigated in this function's env.
+    def avg(x):  # graftlint: spmd-uniform -- cross-rank mean: every member contributes and receives the identical average
+        s = rank() + x
+        publish_kv(kv, s)
+        return s
+    return avg(score)
+
+
+def rank_gated_data(x):
+    # Per-rank CONTROL over per-rank DATA: the test does not taint the
+    # value (explicit flows only).
+    return x * 2 if rank() > 0 else x

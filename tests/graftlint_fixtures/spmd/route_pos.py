@@ -1,0 +1,72 @@
+"""Seeded spmd-uniform violations — every shape the rule must catch.
+
+``adopt_local`` is the r14 divergent-routing bug, reconstructed: a
+member with no KV to agree through routes by its own filesystem blob
+while rank 0 routes by its plan — divergent XLA programs, distributed
+hang.  The rest cover taint through a helper call, a wall-clock write
+to a schedule lever, and set-iteration order feeding a published plan.
+"""
+
+import os
+import time
+
+
+def rank():
+    return 0
+
+
+class PlanController:
+    def __init__(self, plan):
+        self.plan = plan
+
+    def route(self, op, klass, default):
+        return default
+
+
+def _tenant_gate():
+    # Taint must survive the helper call: the per-rank env is read
+    # here, the routing decision is in the caller.
+    return os.environ.get("HOROVOD_TENANT_ID", "0")
+
+
+def adopt_local(path):
+    # r14 shape: no KV agreement, so this member steers routing by its
+    # own per-host cache blob.
+    blob = open(path).read()
+    ctl = PlanController(blob)  # EXPECT spmd-uniform (filesystem)
+    return ctl
+
+
+def route_by_tenant(ctl):
+    klass = _tenant_gate()
+    ctl.route("allreduce", klass, True)  # EXPECT spmd-uniform (env)
+
+
+def gate_in_condition(ctl):
+    # The gate shape itself: a tainted routing call in an if-test.
+    klass = rank()
+    if ctl.route("allreduce", klass, True):  # EXPECT spmd-uniform
+        return True
+    return False
+
+
+def pace_by_clock(engine):
+    t = time.monotonic()
+    engine.cycle_time_ms = t  # EXPECT spmd-uniform (clock -> lever)
+
+
+def _route_via(ctl, klass):
+    ctl.route("allreduce", klass, True)
+
+
+def route_kw(ctl):
+    # Keyword args must flow like positional ones through the callee's
+    # parameter summaries.
+    _route_via(ctl, klass=rank())  # EXPECT spmd-uniform (kw arg)
+
+
+def publish_order(kv, names):
+    acc = []
+    for n in set(names):
+        acc.append(n)
+    publish_kv(kv, acc)  # EXPECT spmd-uniform (set-iteration order)

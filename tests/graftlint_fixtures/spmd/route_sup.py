@@ -1,0 +1,12 @@
+"""Suppression hygiene for spmd-uniform: a real violation silenced by
+a cited suppression lints clean; the citation rules are the shared
+ones (bad_sup.py / unused_sup.py cover the failure modes)."""
+
+
+def rank():
+    return 0
+
+
+def route_debug(ctl):
+    klass = rank()
+    ctl.route("debug", klass, True)  # graftlint: disable=spmd-uniform issue=ISSUE-10 -- debug-only path, never reaches a negotiated world

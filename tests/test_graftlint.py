@@ -359,3 +359,161 @@ def test_env_drift_flags_undocumented_bootstrap_knobs():
     assert "HOROVOD_BOOT_MISSING" in msgs
     assert "HOROVOD_BOOT_RAW_MISSING" in msgs
     assert "HOROVOD_BOOT_DOCUMENTED" not in msgs
+
+
+# -- spmd-uniform: rank-taint dataflow --------------------------------------
+
+def _spmd_cfg(*names):
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(), env_scan_root="absent", hot_path_roots=(),
+        faultline_module="absent/faultline.py", faultline_roots=(),
+        faultline_cc_roots=(), metrics_module="absent/metrics.py",
+        metrics_roots=(), bootstrap_env_files=(),
+        harness_env_files=(),
+        spmd_roots=tuple(os.path.join("spmd", n) for n in names),
+        cpp_lock_roots=())
+
+
+def _run_spmd(*names):
+    return run_paths([os.path.join(FIX, "spmd", n) for n in names],
+                     _spmd_cfg(*names))
+
+
+def test_spmd_uniform_flags_every_seeded_shape():
+    """route_pos seeds every source/flow shape: filesystem blob into
+    the controller (the r14 reconstruction), per-rank env through a
+    helper call (interprocedural), a rank() keyword arg through a
+    routing helper, wall-clock into a schedule lever, and
+    set-iteration order into a published plan."""
+    findings = _run_spmd("route_pos.py")
+    assert _checks(findings) == ["spmd-uniform"] * 6, _fmt(findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "filesystem read (open)" in msgs
+    assert "per-rank env HOROVOD_TENANT_ID" in msgs
+    assert "time.monotonic()" in msgs
+    assert "set-iteration-order" in msgs
+    assert "_route_via() [which routes it to route()]" in msgs
+    assert "gate_in_condition" in msgs  # sink inside an if-test
+
+
+def test_spmd_uniform_r14_reconstruction_names_the_routing_sink():
+    """The r14 bug shape — a member routing by its own per-host blob
+    with no KV agreement — is reported AT the controller construction,
+    naming the divergence source."""
+    findings = [f for f in _run_spmd("route_pos.py")
+                if "filesystem" in f.message]
+    assert len(findings) == 1, _fmt(findings)
+    assert "PlanController()" in findings[0].message
+    assert "adopt_local" in findings[0].message
+
+
+def test_spmd_uniform_barriers_and_sorted_iteration_are_clean():
+    """Declared barriers (def-level and call-line), sorted() over a
+    set, and rank-gated DATA (explicit flows only) all lint clean —
+    and the barrier annotations are not called dangling."""
+    findings = _run_spmd("route_neg.py")
+    assert findings == [], _fmt(findings)
+
+
+def test_spmd_uniform_cited_suppression_is_clean_and_used():
+    findings = _run_spmd("route_sup.py")
+    assert findings == [], _fmt(findings)
+
+
+# -- cpp-guarded-by / cpp-requires / cpp-excludes ---------------------------
+
+def _cpp_cfg(variant):
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(), env_scan_root="absent", hot_path_roots=(),
+        faultline_module="absent/faultline.py", faultline_roots=(),
+        faultline_cc_roots=(), metrics_module="absent/metrics.py",
+        metrics_roots=(), bootstrap_env_files=(),
+        harness_env_files=(), spmd_roots=(),
+        cpp_lock_roots=(os.path.join("cpp", variant),))
+
+
+def _run_cpp(variant):
+    return run_paths([os.path.join(FIX, "cpp", variant)],
+                     _cpp_cfg(variant))
+
+
+def test_cpp_rules_flag_configure_shape_requires_and_excludes():
+    """tuner.cc mirrors the live-tree ParameterManager::Configure fix:
+    reverting that fix re-creates exactly the unlocked-write +
+    unlocked-REQUIRES-call shape seeded here.  Flush exercises a
+    STACKED annotation (REQUIRES + EXCLUDES on one declaration — both
+    contracts must parse) and Configure plants a C++14 digit separator
+    in front of the violations (the stripper must not eat them)."""
+    findings = _run_cpp("pos")
+    checks = _checks(findings)
+    assert checks.count("cpp-guarded-by") == 1, _fmt(findings)
+    assert checks.count("cpp-requires") == 2, _fmt(findings)
+    assert checks.count("cpp-excludes") == 2, _fmt(findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "Configure" in msgs and "value_" in msgs
+    assert "GUARDED_BY(mu_)" in msgs
+    assert "Publish() [EXCLUDES(io_mu_)]" in msgs
+    # Both stacked contracts survive: Reset (neither lock held) trips
+    # the REQUIRES side of the same declaration.
+    assert "Reset calls Publish() [REQUIRES(mu_)]" in msgs
+
+
+def test_cpp_rules_locked_requires_and_cited_suppression_are_clean():
+    findings = _run_cpp("neg")
+    assert findings == [], _fmt(findings)
+
+
+# -- env-drift: harness pins ------------------------------------------------
+
+def test_env_harness_pin_flags_ghost_pin_only():
+    """Dict-literal and subscript pins of HOROVOD_*/HVD_TPU_* keys in a
+    registered harness must be documented; plain env READS are not
+    pins."""
+    cfg = LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(), env_scan_root="harness", hot_path_roots=(),
+        faultline_module="absent/faultline.py", faultline_roots=(),
+        faultline_cc_roots=(), metrics_module="absent/metrics.py",
+        metrics_roots=(), bootstrap_env_files=(),
+        harness_env_files=(os.path.join("harness", "harness.py"),),
+        harness_doc_files=(os.path.join("harness", "docs.md"),),
+        spmd_roots=(), cpp_lock_roots=())
+    findings = [f for f in run_paths([os.path.join(FIX, "harness")],
+                                     cfg)
+                if f.check == "env-harness-pin"]
+    assert len(findings) == 1, _fmt(findings)
+    assert "HOROVOD_GHOST_PIN" in findings[0].message
+    assert "DOCUMENTED_PIN" not in _fmt(findings)
+
+
+def test_spawn_harness_pins_documented_in_tests_readme():
+    """The real harness's pin set is exactly what tests/README.md
+    documents (a new undocumented pin fails the real-tree baseline,
+    which is how the HOROVOD_CYCLE_TIME warm-start suppression should
+    have been caught)."""
+    from graftlint.rules.env_drift import harness_pins
+    pins = {k for k, _ in harness_pins(
+        os.path.join(REPO, "tests", "utils", "spawn.py"))}
+    assert pins == {"HOROVOD_RANK", "HOROVOD_SIZE",
+                    "HOROVOD_PORT_BASE", "HOROVOD_CYCLE_TIME"}
+
+
+# -- machine-readable output ------------------------------------------------
+
+def test_cli_json_zero_findings_shape(capsys):
+    """`python -m graftlint --json` emits one JSON object with
+    repo-relative findings; the real tree is the committed
+    zero-findings baseline."""
+    import json
+
+    from graftlint.__main__ import main
+    rc = main(["--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert data["count"] == 0 and data["findings"] == []
+    assert data["paths"] == ["horovod_tpu"]

@@ -339,6 +339,45 @@ def test_bootstrap_disabled_or_dirless_is_inert(tmp_path):
     assert plancache.bootstrap(cfg, _topo(), mode="tcp") is None
 
 
+def test_bootstrap_multihost_without_kv_drops_local_plan(
+        tmp_path, caplog, monkeypatch):
+    """Regression for the spmd-uniform finding: a multihost world with
+    a plan dir but NO rendezvous KV used to apply each host's local
+    cache blob to routing — per-host files can differ (independent
+    disks, one stale rerun), which is the r14 divergent-routing hang
+    class.  The blob must be dropped, loudly."""
+    for var in ("HVD_TPU_FUSION_THRESHOLD", "HOROVOD_FUSION_THRESHOLD",
+                "HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME"):
+        monkeypatch.delenv(var, raising=False)
+    # Resolve the fingerprint exactly as bootstrap will for this host.
+    plancache.bootstrap(Config(plan_cache_dir=str(tmp_path)),
+                        _topo(size=4), mode="multihost")
+    fp = plancache._plane.fingerprint
+    plancache.reset()
+    plancache.store(_plan(fp), str(tmp_path))
+    cfg = Config(plan_cache_dir=str(tmp_path))
+    defaults = (cfg.fusion_threshold_bytes, cfg.cycle_time_ms)
+    with caplog.at_level(logging.WARNING):
+        plan = plancache.bootstrap(cfg, _topo(rank=1, size=4),
+                                   mode="multihost")
+    assert plan is not None and not plancache.plan_has_content(plan)
+    assert plancache.tuned_warm_start() is None
+    assert (cfg.fusion_threshold_bytes, cfg.cycle_time_ms) == defaults
+    assert "no rendezvous KV" in caplog.text
+    # The controller exists but routes by the EMPTY (agreed) plan.
+    ctl = plancache._plane.controller
+    assert ctl is not None and ctl.route("allreduce", "20", True)[0] \
+        is True
+    plancache.reset()
+    # tcp mode keeps its local view: no routing controller to diverge,
+    # fusion/cycle pacing is per-process by design there.
+    cfg_tcp = Config(plan_cache_dir=str(tmp_path))
+    plancache.store(_plan(plancache.topology_fingerprint(4, 1, "host")),
+                    str(tmp_path))
+    plancache.bootstrap(cfg_tcp, _topo(rank=1, size=4), mode="tcp")
+    assert plancache.tuned_warm_start() is not None
+
+
 def test_finalize_persists_inprocess_tuner_point(tmp_path):
     cfg = Config(plan_cache_dir=str(tmp_path))
     plancache.bootstrap(cfg, _topo(), mode="tcp")
@@ -632,8 +671,14 @@ def test_warm_cache_run_skips_retuning_2proc(tmp_path):
     }
     for phase in ("cold", "warm"):
         env["PLAN_PHASE"] = phase
+        # pop_env: the warm start only engages on a default-config
+        # rerun (an explicit cycle-time env wins over the tuned
+        # point), so neither the harness pin nor an inherited operator
+        # env may reach the workers.
         results = spawn_world(worker, 2, extra_env=dict(env),
-                              timeout=180)
+                              timeout=180,
+                              pop_env=("HOROVOD_CYCLE_TIME",
+                                       "HVD_TPU_CYCLE_TIME"))
         for rank, (rc, out, err) in enumerate(results):
             assert rc == 0, "%s rank %d failed:\n%s\n%s" % (
                 phase, rank, out, err)

@@ -29,13 +29,13 @@ from horovod_tpu.utils import plancache
 def main():
     phase = os.environ["PLAN_PHASE"]
     steps = int(os.environ.get("PLAN_STEPS", "60"))
-    # The spawn harness pins HOROVOD_CYCLE_TIME for fast test cycles —
-    # but an explicit operator cycle-time env legitimately suppresses
-    # the tuned-point warm start (env wins, the precedence rule this
-    # plane inherits from r9).  Clear the pin so this world models a
-    # default-config rerun, which is what the warm start is for.
-    os.environ.pop("HOROVOD_CYCLE_TIME", None)
-    os.environ.pop("HVD_TPU_CYCLE_TIME", None)
+    # An explicit operator cycle-time env legitimately suppresses the
+    # tuned-point warm start (env wins, the precedence rule this plane
+    # inherits from r9), so this world must start with the cycle-time
+    # keys UNSET — the spawner passes them via ``pop_env``, which also
+    # keeps the harness's own fast-cycle pin off.
+    assert "HOROVOD_CYCLE_TIME" not in os.environ
+    assert "HVD_TPU_CYCLE_TIME" not in os.environ
     hvd.init()
     rank = hvd.rank()
     size = hvd.size()

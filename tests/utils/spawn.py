@@ -112,8 +112,17 @@ def spawn_world(worker, size, extra_env=None, timeout=240, retry=True,
             "HOROVOD_RANK": str(rank),
             "HOROVOD_SIZE": str(size),
             "HOROVOD_PORT_BASE": str(base),
-            "HOROVOD_CYCLE_TIME": "1",
         })
+        # Default pin, caller-overridable: 1 ms negotiation cycles keep
+        # spawn-heavy tests fast, but an explicit cycle-time env
+        # legitimately suppresses the plan-cache tuned-point warm start
+        # (env wins under the config precedence rule), so a world that
+        # must model a default-config rerun names the key in
+        # ``pop_env`` and gets a truly unset env — not a silent pin.
+        # Every key pinned by this harness must be documented in
+        # tests/README.md (the env-harness-pin lint check enforces it).
+        if "HOROVOD_CYCLE_TIME" not in pop_env:
+            env["HOROVOD_CYCLE_TIME"] = "1"
         env.update(extra_env or {})
         # Each rank leads its own process group (start_new_session) so
         # teardown can kill the whole tree: a worker that itself forked
