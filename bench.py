@@ -201,6 +201,33 @@ def lever_attribution(jax, jnp, on_accel, peak):
     except Exception as exc:  # noqa: BLE001 - attribution is optional
         print("metrics snapshot degraded: %s" % exc, file=sys.stderr)
     try:
+        # Serving-plane attribution (ISSUE 11): the continuous-batching
+        # knobs and autoscale policy a deployment on this box would run
+        # with, plus whether the r14 plan cache would warm-start a
+        # fresh replica (cold-start lever).  Additive key; the serving
+        # headline itself comes from benchmarks/serving_bw.py.
+        from horovod_tpu.serving import replica as _replica
+        from horovod_tpu.serving import router as _router
+        lev["serving"] = {
+            "max_batch": _router.max_batch(),
+            "max_wait_micros": _router.max_wait_micros(),
+            "autoscale": {
+                "up_qdepth": _replica.autoscale_up_qdepth(),
+                "down_qdepth": _replica.autoscale_down_qdepth(),
+                "interval_s": _replica.autoscale_interval_secs(),
+                "cooldown_s": _replica.autoscale_cooldown_secs(),
+            },
+        }
+        from horovod_tpu.utils import plancache as _plancache
+        _pd = _plancache.describe()
+        lev["serving"]["plan_warm_start"] = {
+            "enabled": _pd.get("enabled"),
+            "source": _pd.get("source"),
+            "hits": _pd.get("hits"),
+        }
+    except Exception as exc:  # noqa: BLE001 - attribution is optional
+        print("serving attribution degraded: %s" % exc, file=sys.stderr)
+    try:
         # Collective-plan plane attribution: cache path, hit/miss and
         # per-source apply counters, schema version, plan source and
         # the per-(op, size_class) hier/flat decision table — so a

@@ -1,0 +1,208 @@
+"""Serving-plane throughput/latency harness — the serving arc's
+headline metric next to resnet/transformer (ISSUE 11).
+
+Synthetic continuous-batching load against the in-process replica set
+(serving/replica.py ``ReplicaSet``, the latency path): ``--clients``
+closed-loop clients submit ``--requests`` total requests through the
+router; replicas coalesce them under the max-batch/max-wait admission
+policy and "decode" ``--tokens-per-request`` tokens each at a simulated
+``--service-micros`` per-batch step (one batched forward pass costs
+one step regardless of batch size — exactly why request coalescing is
+the dominant throughput lever, Orca-style).
+
+Reports (one JSON summary line, bench-idiom):
+
+* ``p50_ms`` / ``p99_ms``   — arrival→completion request latency
+* ``tokens_per_sec``        — the headline value
+* ``cold_start_s``          — ReplicaSet.start() → first completed
+  request, the cold-start-to-first-token SLO (a fresh replica adopts
+  the fleet's r14 tuned plan before taking traffic; the adoption is
+  attributed in ``levers.serving.plan``)
+* ``levers.serving``        — batching knobs, autoscale policy, swap
+  roll, plan-cache warm-start — so a delta is attributable to ONE
+  lever
+
+Mid-run a new model version is published through the VersionStore and
+hot-swapped across replicas (``--hot-swap``, default on); the summary
+asserts the roll dropped nothing (``dropped == 0``) and reports the
+version every replica converged on.
+
+CPU-fallback smoke (the CI `serving-smoke` leg):
+
+    JAX_PLATFORMS=cpu python benchmarks/serving_bw.py --requests 64
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, frac):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(frac * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def run(args):
+    from horovod_tpu.serving import (Autoscaler, ReplicaSet, Router,
+                                     VersionStore)
+
+    router = Router(max_batch_size=args.max_batch,
+                    max_wait_us=args.max_wait_micros)
+    store_dir = args.store_dir or tempfile.mkdtemp(prefix="serving-bw-")
+    store = VersionStore(store_dir)
+    store.publish(1, {"version": 1})
+
+    service_s = args.service_micros / 1e6
+
+    def model_fn(weights, payloads):
+        # One batched "decode step" costs one service window no matter
+        # how many requests rode it — the continuous-batching premise.
+        time.sleep(service_s)
+        v = int((weights or {}).get("version", 0))
+        return [{"tokens": args.tokens_per_request, "version": v}
+                for _ in payloads]
+
+    rset = ReplicaSet(args.deployment, model_fn, router, store=store,
+                      min_replicas=1, max_replicas=args.replicas)
+    scaler = Autoscaler(
+        depth_fn=lambda: router.depth(args.deployment),
+        current_fn=rset.ready_count,
+        apply_fn=rset.scale,
+        min_replicas=1, max_replicas=args.replicas,
+        deployment=args.deployment,
+        interval=0.05, cooldown=0.5)
+
+    latencies = []
+    lat_lock = threading.Lock()
+    outcomes = {"ok": 0, "deadline": 0, "dropped": 0}
+    per_request = args.requests // args.clients
+    remainder = args.requests - per_request * args.clients
+
+    def client(n):
+        mine = []
+        for i in range(n):
+            req = router.serve(args.deployment, {"i": i},
+                               timeout_s=args.timeout_s)
+            outcome = req.outcome if req.done else "deadline"
+            mine.append((outcome, time.monotonic() - req.arrival))
+        with lat_lock:
+            for outcome, lat in mine:
+                outcomes[outcome] = outcomes.get(outcome, 0) + 1
+                if outcome == "ok":
+                    latencies.append(lat)
+
+    t0 = time.monotonic()
+    rset.start(1)           # cold start: 1 replica, autoscaler grows it
+    scaler.start()
+    threads = [threading.Thread(
+        target=client,
+        args=(per_request + (1 if c < remainder else 0),), daemon=True)
+        for c in range(args.clients)]
+    for t in threads:
+        t.start()
+    if args.hot_swap:
+        # Publish a new version once the run is warm; replicas swap
+        # between batches — the roll must drop nothing.
+        time.sleep(max(0.2, args.service_micros / 1e6 * 4))
+        store.publish(2, {"version": 2})
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    versions = sorted(set(rset.versions()))
+    scaler.stop()
+    rset.stop()
+
+    latencies.sort()
+    ok = outcomes.get("ok", 0)
+    summary = {
+        "metric": "serving_tokens_per_sec",
+        "value": round(ok * args.tokens_per_request / wall, 2),
+        "unit": "tokens/s",
+        "requests": args.requests,
+        "ok": ok,
+        "deadline": outcomes.get("deadline", 0),
+        "dropped": outcomes.get("dropped", 0),
+        "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(latencies, 0.99) * 1e3, 3),
+        "cold_start_s": round(rset.cold_start_seconds() or 0.0, 4),
+        "wall_s": round(wall, 3),
+        "replica_versions": versions,
+        "levers": {"serving": serving_levers(args, rset, scaler)},
+    }
+    return summary
+
+
+def serving_levers(args, rset, scaler):
+    """The self-attribution block: every knob that can move the
+    headline number, plus what the plan-cache warm start actually did
+    (mirrors bench.py's ``levers.serving``)."""
+    from horovod_tpu.serving.replica import (autoscale_down_qdepth,
+                                             autoscale_up_qdepth)
+    return {
+        "max_batch": args.max_batch,
+        "max_wait_micros": args.max_wait_micros,
+        "replicas": {"min": 1, "max": args.replicas,
+                     "decisions": scaler.decisions,
+                     "scale_up_converge_s": scaler.last_scale_up_secs},
+        "autoscale": {
+            "up_qdepth": (scaler.up_qdepth
+                          if scaler.up_qdepth is not None
+                          else autoscale_up_qdepth()),
+            "down_qdepth": (scaler.down_qdepth
+                            if scaler.down_qdepth is not None
+                            else autoscale_down_qdepth()),
+            "cooldown_s": scaler.cooldown,
+        },
+        "hot_swap": bool(args.hot_swap),
+        "plan": rset.plan,  # r14 plan-cache warm-start attribution
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--requests", type=int, default=512)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--max-batch", type=int,
+                   default=int(os.environ.get(
+                       "HOROVOD_SERVING_MAX_BATCH", "8")))
+    p.add_argument("--max-wait-micros", type=int,
+                   default=int(os.environ.get(
+                       "HOROVOD_SERVING_MAX_WAIT_MICROS", "2000")))
+    p.add_argument("--service-micros", type=int, default=2000,
+                   help="simulated per-batch decode-step cost")
+    p.add_argument("--tokens-per-request", type=int, default=16)
+    p.add_argument("--timeout-s", type=float, default=30.0)
+    p.add_argument("--deployment", default="bench")
+    p.add_argument("--store-dir", default=None,
+                   help="VersionStore directory (default: fresh tmp)")
+    p.add_argument("--hot-swap", dest="hot_swap", action="store_true",
+                   default=True)
+    p.add_argument("--no-hot-swap", dest="hot_swap",
+                   action="store_false")
+    args = p.parse_args()
+    if args.requests < 1 or args.clients < 1:
+        raise SystemExit("--requests and --clients must be >= 1")
+    args.clients = min(args.clients, args.requests)
+    summary = run(args)
+    print(json.dumps(summary))
+    if summary["dropped"] or summary["deadline"]:
+        # The harness itself asserts the zero-drop invariant: synthetic
+        # in-harness load with generous timeouts must resolve every
+        # request ok, hot swap included.
+        raise SystemExit("serving_bw: %d dropped / %d deadline"
+                         % (summary["dropped"], summary["deadline"]))
+
+
+if __name__ == "__main__":
+    main()

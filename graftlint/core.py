@@ -455,6 +455,10 @@ class LintConfig:
         "horovod_tpu/elastic/spill.py",
         "horovod_tpu/elastic/scheduler.py",
         "horovod_tpu/runner/http_client.py",
+        # Serving plane (r16): the router's admission knobs and the
+        # autoscale policy are read pre-Config by design.
+        "horovod_tpu/serving/router.py",
+        "horovod_tpu/serving/replica.py",
     )
     # env-drift rule: test-harness modules whose hard env pins must be
     # documented (the spawn harness pinning HOROVOD_CYCLE_TIME=1

@@ -148,6 +148,23 @@ SITES: Dict[str, str] = {
         "(die/wedge conditioned @tenant=<id> takes down one tenant's "
         "workers at the commit boundary; isolation certification "
         "asserts the OTHER tenants' worlds keep advancing)",
+    "serving.request.drop":
+        "serving router, Router.submit: one inference request at the "
+        "admission seam (drop = the request is refused before it ever "
+        "queues, outcome=dropped; certifies the router's terminal-"
+        "outcome accounting and that refused admissions never disturb "
+        "queued traffic)",
+    "serving.replica.die":
+        "serving replica, the batch-execution seam (in-process replica "
+        "loop AND the process-mode serve_from_queue loop): die/wedge "
+        "takes a replica down mid-service — the hot-swap e2e certifies "
+        "no request is lost (claimed work is requeued and served by "
+        "survivors, who elect the newest model version)",
+    "serving.swap.stall":
+        "serving replica, the weight hot-swap seam (swap_to / replica "
+        "swap check): delay/wedge stalls a replica's version load — "
+        "requests must keep queueing (zero downtime) and the other "
+        "replicas must keep serving while one swap drags",
 }
 
 ACTIONS = ("delay", "drop", "die", "wedge")
@@ -168,6 +185,7 @@ DROP_SITES = frozenset({
     "elastic.state.spill",
     "scheduler.admit",
     "scheduler.preempt.notice",
+    "serving.request.drop",
 })
 
 _COND_ENV = {

@@ -170,6 +170,22 @@ NAMES: Dict[str, Tuple[str, str]] = {
                      "labeled tenant: admission->first slots and "
                      "preemption->resume (the scheduler's fairness/"
                      "latency series)"),
+    # -- serving plane (continuous-batching request router + replicas) --
+    "serving_requests_total": (
+        "counter", "inference requests by TERMINAL outcome, labeled "
+                   "deployment + outcome (ok|deadline|dropped); a "
+                   "requeued batch is not terminal — its requests "
+                   "count exactly once, when they finally resolve"),
+    "serving_batch_size": (
+        "histogram", "requests coalesced into one dispatched batch "
+                     "(the continuous-batching analog of tensor-fusion "
+                     "efficiency)"),
+    "serving_queue_depth": (
+        "gauge", "requests queued and not yet dispatched, labeled "
+                 "deployment (the autoscaler's primary input)"),
+    "serving_request_seconds": (
+        "histogram", "arrival-to-completion latency of one inference "
+                     "request, labeled deployment (p50/p99 SLO series)"),
     # -- cross-cutting --
     "stall_detected_total": (
         "counter", "stall-inspector warnings (a collective outlived "

@@ -62,8 +62,8 @@ class ElasticDriver:
                  tenant_id: Optional[str] = None,
                  tenant_priority: Optional[int] = None):
         self.command = command
-        self.min_np = max(1, min_np)
-        self.max_np = max_np
+        self.min_np = max(1, min_np)  # graftlint: guarded-by=_lock
+        self.max_np = max_np  # graftlint: guarded-by=_lock
         self.env = dict(env or {})
         # Multi-tenant pods (elastic/scheduler.py): this driver manages
         # ONE tenant's world.  The id is exported to the workers
@@ -450,6 +450,37 @@ class ElasticDriver:
         with self._lock:
             return self._held
 
+    def set_np_bounds(self, min_np: int, max_np: Optional[int]):
+        """Adjust a LIVE driver's world-size bounds (the scheduler's
+        ``resize`` propagation).  The driver snapshots ``min_np`` /
+        ``max_np`` at construction and truncates every recomputed
+        target to ``max_np`` — without this hook a scheduler resize
+        would widen the tenant's slot view while the driver kept
+        capping its world at the admission-time bound, and a serving
+        scale-up could never converge.  Safe from any thread; triggers
+        an immediate recompute (the widened view may already be
+        visible) and the normal discovery poll re-derives after the
+        next replan either way."""
+        with self._lock:
+            self.min_np = max(1, int(min_np))
+            self.max_np = max_np
+        self._recompute_world("np bounds resize")
+
+    def live_worker_count(self) -> int:
+        """Worker processes currently installed (spawned and not yet
+        reaped).  The serving autoscaler's feedback signal: a resize
+        order has ACTUALLY landed only when this converges on the new
+        target — the gap between order and convergence is the
+        cold-start window the serving SLO measures."""
+        with self._lock:
+            return len(self._procs)
+
+    def target_world_size(self) -> int:
+        """Slots in the current target world (0 while parked below
+        min_np or held by the pod scheduler)."""
+        with self._lock:
+            return len(self._target)
+
     def request_stop(self):
         """Ask :meth:`run` to exit its reap loop and tear the world
         down (scheduler shutdown).  Thread-safe, idempotent."""
@@ -801,14 +832,15 @@ class ElasticDriver:
                     self._hosts.update_available_hosts()
                 except Exception as exc:  # noqa: BLE001 — flaky script
                     LOG.warning("startup discovery failed: %s", exc)
-                if len(self._hosts.ordered_slots(self.max_np)) \
-                        >= self.min_np:
+                with self._lock:
+                    lo, hi = self.min_np, self.max_np
+                if len(self._hosts.ordered_slots(hi)) >= lo:
                     break
                 if self._shutdown.is_set():
                     return self._rc
                 if time.monotonic() > deadline and not self.held():
                     LOG.error("discovery never found min_np=%d hosts",
-                              self.min_np)
+                              lo)
                     return 1
                 time.sleep(1.0)
             self._recompute_world("startup")

@@ -222,6 +222,49 @@ class PodScheduler:
         self._wake.set()
         return self.tenant_state(spec.tenant_id)
 
+    def poke(self):
+        """Event-driven replan: wake the scheduling loop NOW instead of
+        waiting out the rest of ``HOROVOD_SCHEDULER_TICK_SECS``.  The
+        serving autoscaler calls this after :meth:`resize` so a scale
+        decision applies on the next tick, not a full cadence later;
+        safe from any thread, a no-op when the loop is already awake."""
+        self._wake.set()
+
+    def resize(self, tenant_id: str, min_np: Optional[int] = None,
+               max_np: Optional[int] = None):
+        """Adjust one active tenant's slot bounds in place (the
+        serving plane's autoscale hook: the traffic-driven desired
+        replica count lands in ``max_np``; ``min_np`` is the SLO floor
+        and is normally left alone — raising it on a contended pod can
+        legitimately preempt the tenant under the all-or-nothing
+        packing rule).  Takes effect at the next scheduling pass;
+        callers follow with :meth:`poke` (or use the autoscaler, which
+        does)."""
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is None or t.state not in _ACTIVE:
+                raise KeyError("tenant %r is not active" % tenant_id)
+            new_min = t.spec.min_np if min_np is None else int(min_np)
+            new_max = t.spec.max_np if max_np is None else int(max_np)
+            if new_min < 1:
+                raise ValueError("min_np must be >= 1")
+            if new_max is not None and new_max < new_min:
+                raise ValueError("max_np (%d) < min_np (%d)"
+                                 % (new_max, new_min))
+            t.spec.min_np = new_min
+            t.spec.max_np = new_max
+            driver = t.driver
+        if driver is not None:
+            # The live driver snapshots its np bounds at construction
+            # and truncates every world recompute to them — the new
+            # bounds must land there too, or the widened slot view
+            # could never be taken up.
+            driver.set_np_bounds(new_min, new_max)
+        metrics.event("tenant_resize_order", tenant=tenant_id,
+                      min_np=new_min, max_np=new_max)
+        LOG.info("tenant %s resized to np=[%d, %s]", tenant_id, new_min,
+                 new_max if new_max is not None else "inf")
+
     # -- introspection -----------------------------------------------------
 
     def tenant_state(self, tenant_id: str) -> str:

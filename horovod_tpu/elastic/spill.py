@@ -116,11 +116,18 @@ def _filename(commit_id: int, tag: str) -> str:
     return "state-%020d-%s%s" % (commit_id, tag, _SUFFIX)
 
 
-def write(commit_id: int, payload: bytes, tag: str) -> Optional[str]:
+def write(commit_id: int, payload: bytes, tag: str,
+          d: Optional[str] = None) -> Optional[str]:
     """Spill one commit blob atomically; returns the path, or None when
     spilling is disabled.  Never raises into the commit path — a full
-    disk must degrade durability, not kill training mid-step."""
-    d = spill_dir()
+    disk must degrade durability, not kill training mid-step.
+
+    ``d`` overrides the destination directory: the serving plane's
+    model version store (serving/replica.py ``VersionStore``) reuses
+    this exact format — MAGIC + version-as-commit-id + CRC, atomic
+    rename, keep-last-K — for published model weights, in its OWN
+    directory so model blobs and training-state spills never mix."""
+    d = d if d is not None else spill_dir()
     if d is None:
         return None
     t0 = time.monotonic()

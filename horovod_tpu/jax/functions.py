@@ -88,19 +88,41 @@ def allgather_object(obj: Any, name: Optional[str] = None,
     return core.allgather_object(obj, name=name)
 
 
-def elect_state_root(record: dict, name: Optional[str] = None):
-    """Allgather one small commit-metadata record per rank and elect
-    the max-progress rank as the state-sync root, identically on every
-    rank: max ``commit_id`` wins, ties go to the LOWEST rank (so a
-    fresh world with no commits anywhere degenerates to the
-    reference's rank-0 broadcast).  Used by ``elastic.state`` — our
-    driver does not guarantee survivors keep low ranks after a
-    reshuffle, so the root must be elected, not assumed.
+def _election_key(record: dict, keys) -> tuple:
+    """The deterministic, order-independent comparison key shared by
+    every election in the tree: evidence fields descending in ``keys``
+    order, ties broken by the LOWEST rank."""
+    return tuple(int(record.get(k, 0)) for k in keys) + \
+        (-int(record.get("rank", 0)),)
+
+
+def elect_newest(records, keys=("commit_id",)) -> dict:
+    """Pure election over already-gathered records (no transport): the
+    record with the greatest ``keys`` evidence tuple wins, ties to the
+    lowest rank.  The serving plane's in-process replica sets use this
+    with ``keys=("version",)`` — "newest model version wins" — over
+    records gathered from their own threads; multi-process worlds
+    gather via :func:`elect_state_root` instead."""
+    return max(records, key=lambda r: _election_key(r, keys))
+
+
+def elect_state_root(record: dict, name: Optional[str] = None,
+                     keys=("commit_id",)):
+    """Allgather one small evidence record per rank and elect the
+    max-evidence rank as the sync root, identically on every rank: the
+    greatest ``keys`` tuple wins, ties go to the LOWEST rank (so a
+    fresh world with no evidence anywhere degenerates to the
+    reference's rank-0 broadcast).  Used by ``elastic.state`` with the
+    default ``keys=("commit_id",)`` — our driver does not guarantee
+    survivors keep low ranks after a reshuffle, so the root must be
+    elected, not assumed — and by the serving plane's weight hot-swap
+    with ``keys=("version", "commit_id")``: after a replica death the
+    survivors elect the NEWEST MODEL VERSION (progress as tiebreak) so
+    a mid-roll failure can never resurrect stale weights.
 
     Returns ``(root_record, all_records)``; the election key is order-
     independent, so any transport ordering of the gathered records
     yields the same winner everywhere."""
     records = allgather_object(record, name=name or "elastic.sync.election")
-    root = max(records, key=lambda r: (int(r.get("commit_id", 0)),
-                                       -int(r.get("rank", 0))))
+    root = elect_newest(records, keys)
     return root, records

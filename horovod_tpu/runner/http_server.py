@@ -60,6 +60,36 @@ class _KvHandler(BaseHTTPRequestHandler):
         except Exception:  # noqa: BLE001 — socket already gone
             pass
 
+    def do_POST(self):
+        """``POST /serve/<deployment>`` — the serving plane's request
+        endpoint (serving/router.py installs the provider).  Rides the
+        same HMAC auth as the KV paths: in-harness synthetic load
+        holds the launcher secret; a public front door would terminate
+        auth upstream.  No provider installed = 404 (this server is a
+        rendezvous KV first)."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            if not self._authorized(body):
+                self.send_response(403)
+                self.end_headers()
+                return
+            provider = getattr(self.server, "serving_provider", None)
+            if provider is None or not self.path.startswith("/serve/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            deployment = self.path[len("/serve/"):]
+            out = provider(deployment, body)
+        except Exception as exc:  # noqa: BLE001 — report as 5xx
+            self._server_error(exc)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
     def do_PUT(self):
         try:
             length = int(self.headers.get("Content-Length", "0"))
@@ -142,6 +172,8 @@ class RendezvousServer:
         self._httpd.secret = secret     # type: ignore[attr-defined]
         # /metrics renderer; None = this process's own registry.
         self._httpd.metrics_provider = None  # type: ignore[attr-defined]
+        # POST /serve/<deployment> handler; None = endpoint disabled.
+        self._httpd.serving_provider = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -153,6 +185,17 @@ class RendezvousServer:
         """Install a () -> str renderer for ``GET /metrics`` (the
         elastic driver's fleet-wide merge)."""
         self._httpd.metrics_provider = fn  # type: ignore[attr-defined]
+
+    @property
+    def serving_provider(self):
+        return self._httpd.serving_provider  # type: ignore[attr-defined]
+
+    @serving_provider.setter
+    def serving_provider(self, fn):
+        """Install a (deployment: str, body: bytes) -> bytes handler
+        for ``POST /serve/<deployment>`` (the serving router's HTTP
+        front door, serving/router.py ``install_http_frontend``)."""
+        self._httpd.serving_provider = fn  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:

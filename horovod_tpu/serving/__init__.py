@@ -1,0 +1,27 @@
+"""Continuous-batching serving plane (ISSUE 11).
+
+The training stack's coordination core re-aimed at inference traffic:
+requests coalesce into batches the way tensors fuse into buckets
+(``router``), replica groups are process sets under the pod scheduler
+with traffic-driven autoscaling (``replica``), published weights roll
+across replicas with zero dropped requests via the survivor election
+generalized to newest-version-wins, and process-mode replicas pull
+from a durable claim-based work queue (``workqueue``).  docs/serving.md
+has the lifecycle; ``benchmarks/serving_bw.py`` is the headline
+harness.
+"""
+
+from .router import (InferenceRequest, Router, install_http_frontend,
+                     serve_http)
+from .replica import (Autoscaler, DeploymentSpec, ReplicaSet,
+                      VersionStore, admit_deployment, autoscale_decision,
+                      serve_from_queue, swap_to, tenant_autoscaler)
+from .workqueue import Claim, FileWorkQueue
+
+__all__ = [
+    "InferenceRequest", "Router", "install_http_frontend", "serve_http",
+    "Autoscaler", "DeploymentSpec", "ReplicaSet", "VersionStore",
+    "admit_deployment", "autoscale_decision", "serve_from_queue",
+    "swap_to", "tenant_autoscaler",
+    "Claim", "FileWorkQueue",
+]

@@ -1,0 +1,712 @@
+"""Replica groups, weight hot-swap and traffic-driven autoscaling.
+
+Three pieces, each a deliberate reuse of an existing plane:
+
+* **Model version store** (:class:`VersionStore`) — published weights
+  ride the r10 durable-spill format (MAGIC + version-as-commit-id +
+  CRC32, atomic rename, keep-last-K; elastic/spill.py) in their own
+  directory, so a replica "loads a model" through the exact
+  crash-hardened restore path training states use, and a torn publish
+  is skipped loudly instead of half-loading weights.
+
+* **Hot swap** — a new version rolls across replicas with zero
+  dropped requests: each replica swaps BETWEEN batches (queued
+  requests keep queueing; the other replicas keep serving), and
+  the version to converge on is ELECTED, not assumed —
+  ``jax.functions.elect_newest(records, keys=("version",))``:
+  newest model version wins, the r10 survivor election generalized.
+  In a multi-process replica group the same rule rides the elastic
+  sync itself (each swap commits, so the max-commit survivor carries
+  the newest version through ``elect_state_root`` after a death).
+
+* **Autoscaler** — the r13 ``PodScheduler`` becomes the traffic-driven
+  autoscaler: deployments are tenants (SLO class = priority), and a
+  queue-depth series from the r11 metrics plane drives
+  :func:`autoscale_decision` (grow when the per-replica backlog
+  crosses ``HOROVOD_SERVING_AUTOSCALE_UP_QDEPTH``, shrink below
+  ``..._DOWN_QDEPTH`` after a cooldown).  Scale orders land through
+  ``scheduler.resize`` + ``poke`` — applied on the NEXT tick, not a
+  full cadence later — and the order→converged gap is the cold-start
+  window the serving SLO measures (a fresh replica adopts the fleet's
+  r14 tuned plan at init, before taking traffic).
+
+Process-mode replicas (deployment-as-tenant) pull from the durable
+:class:`~.workqueue.FileWorkQueue` via :func:`serve_from_queue`; the
+in-process :class:`ReplicaSet` (threads) is the latency path
+``benchmarks/serving_bw.py`` measures.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common import faultline, metrics
+from ..common.envutil import env_float
+from ..elastic import spill
+from .router import Router, max_batch
+
+LOG = logging.getLogger("horovod_tpu.serving.replica")
+
+
+# -- autoscale knobs (one read point each; graftlint env-drift covers
+#    this module via bootstrap_env_files) -----------------------------------
+
+def autoscale_up_qdepth() -> float:
+    """Per-replica queue depth that triggers a scale-UP
+    (``HOROVOD_SERVING_AUTOSCALE_UP_QDEPTH``, default 4.0, floor
+    0.1): backlog above this means the current replicas are not
+    keeping up."""
+    return env_float("HOROVOD_SERVING_AUTOSCALE_UP_QDEPTH", 4.0,
+                     minimum=0.1)
+
+
+def autoscale_down_qdepth() -> float:
+    """Per-replica queue depth below which one replica is released
+    (``HOROVOD_SERVING_AUTOSCALE_DOWN_QDEPTH``, default 0.5, floor
+    0.0), one step per cooldown window."""
+    return env_float("HOROVOD_SERVING_AUTOSCALE_DOWN_QDEPTH", 0.5,
+                     minimum=0.0)
+
+
+def autoscale_interval_secs() -> float:
+    """Autoscaler evaluation cadence
+    (``HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECS``, default 1.0, floor
+    0.05)."""
+    return env_float("HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECS", 1.0,
+                     minimum=0.05)
+
+
+def autoscale_cooldown_secs() -> float:
+    """Minimum quiet time after any scale change before a SHRINK is
+    allowed (``HOROVOD_SERVING_AUTOSCALE_COOLDOWN_SECS``, default 5.0,
+    floor 0.0).  Scale-UPs are never cooldown-gated: under-provisioning
+    burns the latency SLO immediately, over-provisioning only burns
+    slots."""
+    return env_float("HOROVOD_SERVING_AUTOSCALE_COOLDOWN_SECS", 5.0,
+                     minimum=0.0)
+
+
+# -- fault seams ------------------------------------------------------------
+#
+# Each site has exactly ONE plant (the graftlint fault-site rule);
+# these helpers are that plant, shared by the two execution modes.
+
+
+def _replica_die_seam():
+    """The batch-execution seam: a claimed batch, not yet served —
+    ``die``/``wedge`` here takes a replica down mid-service (the
+    hot-swap e2e's no-request-lost certification).  Fired by the
+    in-process replica loop AND the process-mode ``serve_from_queue``
+    loop."""
+    faultline.site("serving.replica.die")
+
+
+def _swap_stall_seam():
+    """The weight hot-swap seam: inside the swap window, before the
+    new version loads — ``delay``/``wedge`` stalls one replica's load
+    while the others must keep serving.  Fired by :func:`swap_to`
+    (process mode) and the in-process replica's between-batch swap
+    check."""
+    faultline.site("serving.swap.stall")
+
+
+# -- model version store ----------------------------------------------------
+
+
+class VersionStore:
+    """Published model versions as durable spill blobs in ``d``
+    (version = the blob's commit id; monotonically increasing by
+    convention).  ``publish`` is what a deployment pipeline calls;
+    replicas poll :meth:`version` cheaply (filename scan) and
+    :meth:`newest` re-validates CRC at load time."""
+
+    def __init__(self, d: str):
+        self.dir = d
+        # (head_version, min_version) for which load found NO valid
+        # newer blob: a persistently corrupt head would otherwise be
+        # fully re-read + CRC-failed + WARNING-logged on EVERY swap
+        # check (each batch and each ~50 ms idle beat) until a good
+        # version lands.  Reset the moment the head moves.
+        self._exhausted = None
+
+    def publish(self, version: int, weights: Any) -> Optional[str]:
+        if version <= 0:
+            raise ValueError("model versions start at 1 (got %d)"
+                             % version)
+        return spill.write(version, pickle.dumps(weights), tag="model",
+                           d=self.dir)
+
+    def version(self) -> int:
+        """Newest published version by filename (0 = none yet); the
+        load path re-validates the header before trusting it."""
+        scanned = spill.scan(self.dir)
+        return scanned[0][0] if scanned else 0
+
+    def newest(self, min_version: int = 0):
+        """(version, weights) strictly newer than ``min_version``, or
+        None; corrupt blobs are skipped loudly with CRC-failure
+        metrics (the spill restore path) — once, per head version:
+        an exhausted (head, floor) is remembered so a corrupt head is
+        not re-read on every poll."""
+        head = self.version()
+        if self._exhausted is not None:
+            ex_head, ex_min = self._exhausted
+            if head == ex_head and min_version >= ex_min:
+                return None
+        loaded = spill.load_newest(min_commit_id=min_version, d=self.dir)
+        if loaded is None:
+            if head > min_version:
+                self._exhausted = (head, min_version)
+            return None
+        self._exhausted = None
+        return loaded[0], pickle.loads(loaded[1])
+
+
+def swap_to(store: VersionStore, state,
+            version_attr: str = "version") -> bool:
+    """Process-mode hot swap: when the store holds a version newer
+    than ``state.<version_attr>``, load it through the spill restore
+    path into ``state.weights`` (+ bump the version attr) and COMMIT —
+    the commit is what carries the new version into the elastic
+    election evidence, so after a replica death the survivors'
+    max-commit root IS the newest-version root.  Returns True when a
+    swap happened.  The ``serving.swap.stall`` site fires inside the
+    swap window (a stalled replica must not stall the deployment)."""
+    current = int(getattr(state, version_attr, 0) or 0)
+    if store.version() <= current:
+        return False
+    _swap_stall_seam()
+    loaded = store.newest(min_version=current)
+    if loaded is None:
+        return False  # newest blob was corrupt; keep serving current
+    version, weights = loaded
+    setattr(state, version_attr, version)
+    state.weights = weights
+    metrics.event("serving_swap", version=version)
+    LOG.warning("hot-swapped to model version %d", version)
+    state.commit()
+    return True
+
+
+# -- in-process replica set -------------------------------------------------
+
+
+class ReplicaKilled(RuntimeError):
+    """Test-injected abrupt replica death (``ReplicaSet.kill``)."""
+
+
+class _Replica:
+    """One in-process replica: a thread pulling batches from the
+    router, swapping weights between batches."""
+
+    def __init__(self, rset: "ReplicaSet", index: int):
+        self.rset = rset
+        self.index = index
+        self.version = 0
+        self.weights: Any = None
+        self.alive = True
+        self.ready = False
+        self.started_at = time.monotonic()
+        self.first_batch_s: Optional[float] = None
+        self._killed = False
+        self._stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="replica-%s-%d" % (rset.deployment, index))
+
+    def _run(self):
+        try:
+            # Take the fleet's tuned plan (adopted process-wide at
+            # hvd.init via the r14 plan cache) BEFORE taking traffic;
+            # what was adopted is recorded for the bench's levers.
+            self.rset._note_plan()
+            self._load_initial()
+            self.ready = True
+            while not self._stop.is_set():
+                if self._killed:
+                    raise ReplicaKilled("replica %d killed" % self.index)
+                self._maybe_swap()
+                batch = self.rset.router.next_batch(
+                    self.rset.deployment, timeout=0.02)
+                if not batch:
+                    continue
+                _replica_die_seam()
+                if self._killed:
+                    # Abrupt death with a claimed batch: hand it back
+                    # (the no-request-lost seam the units certify).
+                    self.rset.router.requeue(batch)
+                    raise ReplicaKilled("replica %d killed" % self.index)
+                try:
+                    results = self.rset.model_fn(
+                        self.weights, [r.payload for r in batch])
+                except BaseException:
+                    self.rset.router.requeue(batch)
+                    raise
+                self.rset.router.complete(batch, results)
+                if self.first_batch_s is None:
+                    self.first_batch_s = (time.monotonic()
+                                          - self.started_at)
+                self.rset._note_first_token()
+        except ReplicaKilled:
+            LOG.warning("replica %s/%d died", self.rset.deployment,
+                        self.index)
+        except Exception:  # noqa: BLE001 — a replica must die contained
+            LOG.exception("replica %s/%d crashed", self.rset.deployment,
+                          self.index)
+        finally:
+            self.alive = False
+            self.rset._on_death(self)
+
+    def _load_initial(self):
+        store = self.rset.store
+        if store is not None:
+            loaded = store.newest()
+            if loaded is not None:
+                self.version, self.weights = loaded
+                return
+        self.weights = self.rset.initial_weights
+        self.version = self.rset.initial_version
+
+    def _maybe_swap(self):
+        target = self.rset.target_version()
+        if target <= self.version:
+            return
+        _swap_stall_seam()
+        loaded = (self.rset.store.newest(min_version=self.version)
+                  if self.rset.store is not None else None)
+        if loaded is None:
+            return
+        self.version, self.weights = loaded
+        metrics.event("serving_swap", deployment=self.rset.deployment,
+                      replica=self.index, version=self.version)
+        LOG.info("replica %s/%d hot-swapped to version %d",
+                 self.rset.deployment, self.index, self.version)
+
+    def stop(self):
+        self._stop.set()
+
+    def kill(self):
+        self._killed = True
+
+
+class ReplicaSet:
+    """In-process replica group for one deployment: N replica threads
+    pulling coalesced batches from ``router``.  ``model_fn(weights,
+    payloads) -> results`` is the whole model contract.  Grow/shrink
+    via :meth:`scale` (shrinking replicas finish their in-flight batch
+    first — zero-downtime by construction)."""
+
+    def __init__(self, deployment: str,
+                 model_fn: Callable[[Any, List[Any]], List[Any]],
+                 router: Router,
+                 store: Optional[VersionStore] = None,
+                 initial_weights: Any = None,
+                 initial_version: int = 0,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None):
+        self.deployment = deployment
+        self.model_fn = model_fn
+        self.router = router
+        self.store = store
+        self.initial_weights = initial_weights
+        self.initial_version = initial_version
+        self.min_replicas = max(1, min_replicas)
+        self.max_replicas = max_replicas
+        self._lock = threading.Lock()
+        self._replicas: List[_Replica] = []
+        self._next_index = 0
+        self._stopping = False
+        self._started_at: Optional[float] = None
+        self._first_token_s: Optional[float] = None
+        self.plan: Dict[str, Any] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, replicas: Optional[int] = None):
+        with self._lock:
+            if self._started_at is None:
+                self._started_at = time.monotonic()
+        self.scale(replicas if replicas is not None
+                   else self.min_replicas)
+        return self
+
+    def scale(self, n: int):
+        """Converge on ``n`` live replicas (clamped to
+        [min_replicas, max_replicas])."""
+        n = max(self.min_replicas, n)
+        if self.max_replicas is not None:
+            n = min(self.max_replicas, n)
+        to_start: List[_Replica] = []
+        with self._lock:
+            live = [r for r in self._replicas if r.alive]
+            for r in live[n:]:
+                r.stop()  # finishes its in-flight batch, then exits
+            while len(live) + len(to_start) < n:
+                rep = _Replica(self, self._next_index)
+                self._next_index += 1
+                self._replicas.append(rep)
+                to_start.append(rep)
+        for rep in to_start:
+            rep.thread.start()
+        if to_start:
+            metrics.event("serving_scale", deployment=self.deployment,
+                          replicas=n)
+
+    def stop(self, timeout: float = 10.0):
+        # NOT router.close(): the router is shared across deployments
+        # (one HTTP front door mounts one router), so decommissioning
+        # THIS deployment must not wedge the others' next_batch
+        # waiters.  Replicas poll with a short timeout and exit on
+        # their own stop flag.
+        with self._lock:
+            self._stopping = True
+            replicas = list(self._replicas)
+        for r in replicas:
+            r.stop()
+        deadline = time.monotonic() + timeout
+        for r in replicas:
+            r.thread.join(max(0.1, deadline - time.monotonic()))
+
+    def kill(self, index: int):
+        """Abruptly kill one replica (tests/chaos): its claimed batch
+        is requeued and served by survivors."""
+        with self._lock:
+            for r in self._replicas:
+                if r.index == index and r.alive:
+                    r.kill()
+                    return
+        raise KeyError("no live replica %d" % index)
+
+    # -- introspection -----------------------------------------------------
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if r.alive)
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.alive and r.ready)
+
+    def versions(self) -> List[int]:
+        with self._lock:
+            return [r.version for r in self._replicas if r.alive]
+
+    def target_version(self) -> int:
+        """The version this set converges on: ELECTED over every live
+        replica's evidence plus the store's newest — newest version
+        wins (``elect_newest`` with version evidence), so a replica
+        that already swapped pulls the others forward even if the
+        store momentarily vanishes."""
+        from ..jax.functions import elect_newest
+        with self._lock:
+            records = [{"rank": r.index, "version": r.version}
+                       for r in self._replicas if r.alive]
+        if self.store is not None:
+            # The store is the lowest-authority tiebreak: any live
+            # replica already AT a version outranks it on ties.
+            records.append({"rank": 1 << 20,
+                            "version": self.store.version()})
+        if not records:
+            return 0
+        return int(elect_newest(records, keys=("version",))["version"])
+
+    def cold_start_seconds(self) -> Optional[float]:
+        """start() → first completed request, the cold-start-to-first-
+        token SLO ``serving_bw.py`` reports."""
+        return self._first_token_s
+
+    # -- internal ----------------------------------------------------------
+
+    def _note_first_token(self):
+        if self._first_token_s is None and self._started_at is not None:
+            self._first_token_s = time.monotonic() - self._started_at
+
+    def _note_plan(self):
+        if self.plan:
+            return
+        try:
+            from ..utils import plancache
+            d = plancache.describe()
+            self.plan = {"enabled": d.get("enabled"),
+                         "source": d.get("source"),
+                         "hits": d.get("hits")}
+        except Exception:  # noqa: BLE001 — attribution only
+            self.plan = {}
+
+    def _on_death(self, replica: _Replica):
+        live = self.live_count()
+        metrics.event("serving_replica_death",
+                      deployment=self.deployment, replica=replica.index,
+                      live=live)
+        with self._lock:
+            stopping = self._stopping
+        if not stopping and live < self.min_replicas:
+            # Hold the floor: a deployment must never silently drop
+            # below min_replicas — the sole replica crashing on a bad
+            # batch would otherwise strand the queue forever (the
+            # autoscaler only converges worlds that still serve).
+            # Runs on the dying replica's thread; scale() itself only
+            # spawns, so no recursion.
+            LOG.warning("replica %s/%d died below the floor; "
+                        "respawning to min_replicas=%d",
+                        self.deployment, replica.index,
+                        self.min_replicas)
+            self.scale(self.min_replicas)
+
+
+# -- autoscaler -------------------------------------------------------------
+
+
+def autoscale_decision(queue_depth: float, replicas: int,
+                       min_replicas: int,
+                       max_replicas: Optional[int],
+                       up_qdepth: Optional[float] = None,
+                       down_qdepth: Optional[float] = None) -> int:
+    """Pure scale policy (the unit-tested decision table): returns the
+    DESIRED replica count.  Backlog per replica >= up_qdepth → grow to
+    ceil(depth / up_qdepth) (enough replicas that the backlog would sit
+    at the threshold), bounded by max_replicas; backlog per replica <=
+    down_qdepth → release exactly one replica (shrink is deliberately
+    one-step — a drained queue says little about the NEXT second's
+    traffic); otherwise hold."""
+    up = up_qdepth if up_qdepth is not None else autoscale_up_qdepth()
+    down = (down_qdepth if down_qdepth is not None
+            else autoscale_down_qdepth())
+    replicas = max(1, int(replicas))
+    want = replicas
+    per_replica = queue_depth / replicas
+    if per_replica >= up:
+        want = max(replicas, int(math.ceil(queue_depth / up)))
+    elif per_replica <= down:
+        want = replicas - 1
+    want = max(min_replicas, want)
+    if max_replicas is not None:
+        want = min(max_replicas, want)
+    return want
+
+
+class Autoscaler:
+    """Traffic-driven replica autoscaling over the r11 metrics plane:
+    every interval, read the deployment's queue depth (``depth_fn``),
+    run :func:`autoscale_decision` against the live replica count
+    (``current_fn``), and apply changes (``apply_fn(desired)``) —
+    scale-ups immediately, scale-downs only after
+    ``HOROVOD_SERVING_AUTOSCALE_COOLDOWN_SECS`` of quiet.
+
+    ``deployment`` republishes the observed depth into the
+    ``serving_queue_depth`` gauge so process-mode deployments (whose
+    depth lives in the work queue, not this process's registry) still
+    feed the fleet /metrics scrape.  Cold-start accounting: the gap
+    between a scale-up order and ``current_fn`` reaching it is
+    recorded as a ``serving_scale_converged`` event and
+    :attr:`last_scale_up_secs`."""
+
+    def __init__(self, depth_fn: Callable[[], float],
+                 current_fn: Callable[[], int],
+                 apply_fn: Callable[[int], None],
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 deployment: Optional[str] = None,
+                 interval: Optional[float] = None,
+                 cooldown: Optional[float] = None,
+                 up_qdepth: Optional[float] = None,
+                 down_qdepth: Optional[float] = None):
+        self.depth_fn = depth_fn
+        self.current_fn = current_fn
+        self.apply_fn = apply_fn
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.deployment = deployment
+        self.interval = (interval if interval is not None
+                         else autoscale_interval_secs())
+        self.cooldown = (cooldown if cooldown is not None
+                         else autoscale_cooldown_secs())
+        self.up_qdepth = up_qdepth
+        self.down_qdepth = down_qdepth
+        self.decisions: List[Dict[str, Any]] = []
+        self.last_scale_up_secs: Optional[float] = None
+        self._pending_up: Optional[Dict[str, Any]] = None
+        self._last_change = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self):
+        depth = float(self.depth_fn())
+        current = int(self.current_fn())
+        if self.deployment is not None:
+            metrics.gauge("serving_queue_depth",
+                          deployment=self.deployment).set(depth)
+        now = time.monotonic()
+        if self._pending_up is not None \
+                and current >= self._pending_up["to"]:
+            secs = now - self._pending_up["at"]
+            self.last_scale_up_secs = secs
+            metrics.event("serving_scale_converged",
+                          deployment=self.deployment,
+                          replicas=current, secs=round(secs, 3))
+            self._pending_up = None
+        desired = autoscale_decision(
+            depth, current, self.min_replicas, self.max_replicas,
+            self.up_qdepth, self.down_qdepth)
+        if desired == current:
+            return
+        if desired < current and now - self._last_change < self.cooldown:
+            return  # shrink waits out the cooldown; growth never does
+        self.decisions.append({"from": current, "to": desired,
+                               "depth": depth})
+        metrics.event("serving_scale_decision",
+                      deployment=self.deployment, depth=depth,
+                      replicas=current, desired=desired)
+        LOG.info("autoscale %s: %d -> %d replicas (queue depth %.0f)",
+                 self.deployment or "?", current, desired, depth)
+        if desired > current:
+            self._pending_up = {"to": desired, "at": now}
+        self._last_change = now
+        self.apply_fn(desired)
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                LOG.exception("autoscale tick failed; retrying")
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+
+# -- deployment-as-tenant (process-mode replicas) ---------------------------
+
+
+class DeploymentSpec:
+    """One model deployment for the pod scheduler: ``command`` runs a
+    replica process (typically an elastic worker calling
+    :func:`serve_from_queue`), ``slo_class`` maps to scheduler
+    priority (higher = preempts lower SLO classes under contention),
+    replicas scale within [min_replicas, max_replicas]."""
+
+    def __init__(self, name: str, command: List[str],
+                 slo_class: int = 0, min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None):
+        if not name:
+            raise ValueError("deployment name must be non-empty")
+        self.name = name
+        self.command = list(command)
+        self.slo_class = int(slo_class)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = (None if max_replicas is None
+                             else int(max_replicas))
+        self.env = dict(env or {})
+
+
+def admit_deployment(scheduler, spec: DeploymentSpec) -> str:
+    """Admit ``spec`` as a tenant (replica group = process set under
+    its own elastic driver): tenant id ``serve-<name>``, priority =
+    SLO class.  Starts at ``min_replicas`` (``max_np`` pinned there
+    too — growth is the AUTOSCALER's call via ``scheduler.resize``,
+    not free slack absorption).  Returns the tenant id."""
+    from ..elastic.scheduler import TenantSpec
+    tenant_id = "serve-%s" % spec.name
+    env = dict(spec.env)
+    env.setdefault("HOROVOD_SERVING_DEPLOYMENT", spec.name)
+    scheduler.admit(TenantSpec(
+        tenant_id, spec.command, priority=spec.slo_class,
+        min_np=spec.min_replicas, max_np=spec.min_replicas, env=env))
+    return tenant_id
+
+
+def tenant_autoscaler(scheduler, tenant_id: str, spec: DeploymentSpec,
+                      depth_fn: Callable[[], float],
+                      **kwargs) -> Autoscaler:
+    """Wire an :class:`Autoscaler` to a deployment tenant: desired
+    replica counts land as ``scheduler.resize(max_np=desired)`` +
+    ``poke()`` (applied on the next tick — the satellite fix), and the
+    live count comes from the tenant driver's worker census."""
+
+    def current() -> int:
+        driver = scheduler.tenant_driver(tenant_id)
+        return driver.live_worker_count() if driver is not None else 0
+
+    def apply(desired: int):
+        try:
+            scheduler.resize(tenant_id, max_np=desired)
+        except KeyError:
+            # The deployment finished (or was evicted) under us: a
+            # scale order for a gone tenant is a no-op, not an error —
+            # the operator stops the autoscaler, not the other way
+            # around.
+            LOG.info("autoscale order for finished tenant %s skipped",
+                     tenant_id)
+            return
+        scheduler.poke()
+
+    return Autoscaler(depth_fn, current, apply,
+                      min_replicas=spec.min_replicas,
+                      max_replicas=spec.max_replicas,
+                      deployment=spec.name, **kwargs)
+
+
+# -- process-mode replica serve loop ---------------------------------------
+
+
+def serve_from_queue(queue, handler: Callable[[str, Dict], Dict],
+                     state=None, store: Optional[VersionStore] = None,
+                     deployment: str = "default",
+                     total: Optional[int] = None,
+                     batch_n: Optional[int] = None,
+                     idle_sleep: float = 0.05):
+    """One process-mode replica's serve loop over a durable
+    :class:`~.workqueue.FileWorkQueue`: sweep dead claimants' work
+    back to pending, claim up to a batch, serve each request through
+    ``handler(req_id, payload) -> result``, commit.  With ``state`` +
+    ``store`` the loop hot-swaps between batches (:func:`swap_to`:
+    version bump + commit — the election evidence).  Runs until the
+    deployment's done-count reaches ``total`` (None = until the
+    elastic plane stops the worker).  The ``serving.replica.die`` site
+    fires per claimed batch — the e2e kills one replica mid-service
+    and asserts no request is lost."""
+    n = batch_n if batch_n is not None else max_batch()
+    while True:
+        if total is not None and queue.done_count() >= total:
+            return
+        if state is not None and store is not None:
+            swap_to(store, state)
+        queue.sweep_dead_claimants()
+        metrics.gauge("serving_queue_depth",
+                      deployment=deployment).set(queue.depth())
+        batch = queue.claim(n)
+        if not batch:
+            time.sleep(idle_sleep)
+            if state is not None:
+                # Idle beats still commit: host updates and drain
+                # notices are consumed at the commit seam.
+                state.commit()
+            continue
+        _replica_die_seam()
+        metrics.histogram("serving_batch_size").observe(len(batch))
+        for claim in batch:
+            result = handler(claim.req_id, claim.payload)
+            queue.complete(claim, result)
+            metrics.counter("serving_requests_total",
+                            deployment=deployment, outcome="ok").inc()
+        if state is not None:
+            state.commit()

@@ -1,0 +1,307 @@
+"""Continuous-batching request router: the serving front door.
+
+The serving analog of ``ops/engine.py``'s tensor fusion: individual
+inference requests are worth little alone (a one-request forward pass
+wastes the accelerator exactly the way a lone small allreduce wastes
+the wire), so the router coalesces them into batches under a
+two-knob admission policy — close a batch when it reaches
+``HOROVOD_SERVING_MAX_BATCH`` requests OR when the OLDEST queued
+request has waited ``HOROVOD_SERVING_MAX_WAIT_MICROS`` (the fusion
+buffer-size / cycle-time pair, renamed for the request plane; Orca,
+OSDI '22 calls the same lever iteration-level batching).
+
+Data flow is **pull-based**: replicas (serving/replica.py) call
+:meth:`Router.next_batch` when they free up, so a slow replica never
+backs up the queue for the others, and a dying replica's in-flight
+batch is handed back via :meth:`Router.requeue` — requests are only
+ever terminal as ``ok``, ``deadline`` (expired waiting) or ``dropped``
+(admission refused / injected ``serving.request.drop``).  A requeued
+batch re-enters AT THE FRONT, preserving arrival order, so "no request
+lost" across a replica death is a router invariant, not a client
+retry.
+
+Exposure: :func:`install_http_frontend` mounts the router at
+``POST /serve/<deployment>`` on the rendezvous KV server
+(runner/http_server.py) — the same plumbing workers already bootstrap
+through, HMAC-authed with the launcher secret.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from ..common import faultline, metrics
+from ..common.envutil import env_int
+
+LOG = logging.getLogger("horovod_tpu.serving.router")
+
+
+def max_batch() -> int:
+    """Requests coalesced into one dispatched batch at most
+    (``HOROVOD_SERVING_MAX_BATCH``, default 8, floor 1) — the serving
+    twin of the fusion buffer threshold."""
+    return env_int("HOROVOD_SERVING_MAX_BATCH", 8, minimum=1)
+
+
+def max_wait_micros() -> int:
+    """Longest the oldest queued request waits for companions before
+    its batch closes anyway (``HOROVOD_SERVING_MAX_WAIT_MICROS``,
+    default 2000, floor 0) — the serving twin of the fusion cycle
+    time.  0 = dispatch immediately, batch only what is already
+    queued."""
+    return env_int("HOROVOD_SERVING_MAX_WAIT_MICROS", 2000, minimum=0)
+
+
+class InferenceRequest:
+    """One queued inference request.  ``payload`` is opaque to the
+    router; ``deadline`` (monotonic, absolute) bounds queue wait —
+    an expired request resolves ``deadline`` without ever dispatching.
+    ``wait()`` blocks the submitting client until a terminal outcome.
+    """
+
+    __slots__ = ("id", "deployment", "payload", "arrival", "deadline",
+                 "result", "outcome", "attempts", "_done")
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, deployment: str, payload: Any,
+                 timeout_s: Optional[float] = None):
+        with InferenceRequest._seq_lock:
+            InferenceRequest._seq += 1
+            self.id = InferenceRequest._seq
+        self.deployment = deployment
+        self.payload = payload
+        self.arrival = time.monotonic()
+        self.deadline = (self.arrival + timeout_s
+                         if timeout_s is not None else None)
+        self.result: Any = None
+        self.outcome: Optional[str] = None  # ok | deadline | dropped
+        self.attempts = 0
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class _Queue:
+    """One deployment's pending-request queue + its condition var."""
+
+    __slots__ = ("cond", "items")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.items: Deque[InferenceRequest] = deque()
+
+
+class Router:
+    """Per-deployment continuous-batching queues (module docstring has
+    the policy).  ``max_batch``/``max_wait_micros`` default to the env
+    knobs; explicit arguments win (benches A/B them)."""
+
+    def __init__(self, max_batch_size: Optional[int] = None,
+                 max_wait_us: Optional[int] = None):
+        self.max_batch = (max_batch_size if max_batch_size is not None
+                          else max_batch())
+        self.max_wait_s = (max_wait_us if max_wait_us is not None
+                           else max_wait_micros()) / 1e6
+        self._queues: Dict[str, _Queue] = {}
+        self._queues_lock = threading.Lock()
+        self._closed = False
+
+    def _queue(self, deployment: str) -> _Queue:
+        with self._queues_lock:
+            q = self._queues.get(deployment)
+            if q is None:
+                q = self._queues[deployment] = _Queue()
+            return q
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, deployment: str, payload: Any,
+               timeout_s: Optional[float] = None) -> InferenceRequest:
+        """Enqueue one request; returns immediately (``wait()`` for the
+        outcome).  The ``serving.request.drop`` site fires here: a
+        dropped request resolves terminally as ``dropped`` and never
+        queues."""
+        req = InferenceRequest(deployment, payload, timeout_s)
+        if faultline.site("serving.request.drop"):
+            self._finish(req, "dropped", None)
+            LOG.warning("request %d for %s dropped at admission "
+                        "(faultline serving.request.drop)",
+                        req.id, deployment)
+            return req
+        q = self._queue(deployment)
+        with q.cond:
+            q.items.append(req)
+            metrics.gauge("serving_queue_depth",
+                          deployment=deployment).set(len(q.items))
+            q.cond.notify_all()
+        return req
+
+    def serve(self, deployment: str, payload: Any,
+              timeout_s: Optional[float] = None) -> InferenceRequest:
+        """Blocking submit: returns the request after it resolved (or
+        after ``timeout_s`` of waiting; the request may still resolve
+        later — check ``done``)."""
+        req = self.submit(deployment, payload, timeout_s)
+        req.wait(timeout_s)
+        return req
+
+    def depth(self, deployment: str) -> int:
+        q = self._queue(deployment)
+        with q.cond:
+            return len(q.items)
+
+    def close(self):
+        """Unblock every ``next_batch`` waiter (replica shutdown)."""
+        self._closed = True
+        with self._queues_lock:
+            queues = list(self._queues.values())
+        for q in queues:
+            with q.cond:
+                q.cond.notify_all()
+
+    # -- replica side ------------------------------------------------------
+
+    def _expire_locked(self, deployment: str, q: _Queue,
+                       now: float) -> Optional[float]:
+        """Resolve expired requests (caller holds ``q.cond``); returns
+        the nearest future deadline among survivors, or None."""
+        nearest: Optional[float] = None
+        keep: Deque[InferenceRequest] = deque()
+        changed = False
+        for req in q.items:
+            if req.deadline is not None and now >= req.deadline:
+                changed = True
+                self._finish(req, "deadline", None)
+            else:
+                if req.deadline is not None:
+                    nearest = (req.deadline if nearest is None
+                               else min(nearest, req.deadline))
+                keep.append(req)
+        if changed:
+            q.items = keep
+            metrics.gauge("serving_queue_depth",
+                          deployment=deployment).set(len(keep))
+        return nearest
+
+    def next_batch(self, deployment: str,
+                   timeout: Optional[float] = None
+                   ) -> List[InferenceRequest]:
+        """Block until a batch is ready under the admission policy
+        (full, or the oldest request aged past max-wait), then claim
+        it.  Returns [] on ``timeout`` (replicas use the idle beat for
+        swap checks) or when the router is closed."""
+        q = self._queue(deployment)
+        give_up = (time.monotonic() + timeout
+                   if timeout is not None else None)
+        with q.cond:
+            while not self._closed:
+                now = time.monotonic()
+                nearest_deadline = self._expire_locked(deployment, q, now)
+                if q.items:
+                    close_at = q.items[0].arrival + self.max_wait_s
+                    if len(q.items) >= self.max_batch or now >= close_at:
+                        batch = [q.items.popleft() for _ in
+                                 range(min(self.max_batch,
+                                           len(q.items)))]
+                        metrics.gauge(
+                            "serving_queue_depth",
+                            deployment=deployment).set(len(q.items))
+                        metrics.histogram("serving_batch_size").observe(
+                            len(batch))
+                        for req in batch:
+                            req.attempts += 1
+                        return batch
+                    wake_at = close_at
+                else:
+                    wake_at = None
+                if give_up is not None and now >= give_up:
+                    return []
+                for t in (give_up, nearest_deadline):
+                    if t is not None:
+                        wake_at = t if wake_at is None \
+                            else min(wake_at, t)
+                q.cond.wait(None if wake_at is None
+                            else max(0.0, wake_at - now))
+        return []
+
+    def complete(self, batch: List[InferenceRequest],
+                 results: List[Any]):
+        """Resolve a dispatched batch ``ok`` with its results
+        (positional)."""
+        for req, result in zip(batch, results):
+            self._finish(req, "ok", result)
+
+    def requeue(self, batch: List[InferenceRequest]):
+        """Hand a failed dispatch back (replica died / backend raised):
+        surviving requests re-enter AT THE FRONT in arrival order;
+        already-expired ones resolve ``deadline``.  This is the
+        no-request-lost seam the hot-swap certification leans on."""
+        if not batch:
+            return
+        deployment = batch[0].deployment
+        q = self._queue(deployment)
+        now = time.monotonic()
+        with q.cond:
+            for req in reversed(batch):
+                if req.deadline is not None and now >= req.deadline:
+                    self._finish(req, "deadline", None)
+                else:
+                    q.items.appendleft(req)
+            metrics.gauge("serving_queue_depth",
+                          deployment=deployment).set(len(q.items))
+            q.cond.notify_all()
+        metrics.event("serving_requeue", deployment=deployment,
+                      requests=len(batch))
+        LOG.warning("requeued %d request(s) for %s after a failed "
+                    "dispatch", len(batch), deployment)
+
+    def _finish(self, req: InferenceRequest, outcome: str, result: Any):
+        req.outcome = outcome
+        req.result = result
+        metrics.counter("serving_requests_total",
+                        deployment=req.deployment, outcome=outcome).inc()
+        if outcome == "ok":
+            metrics.histogram(
+                "serving_request_seconds",
+                deployment=req.deployment).observe(
+                    time.monotonic() - req.arrival)
+        req._done.set()
+
+
+# -- HTTP front door --------------------------------------------------------
+
+
+def serve_http(router: Router, deployment: str, body: bytes,
+               timeout_s: float = 30.0) -> bytes:
+    """One ``POST /serve/<deployment>`` request through the router:
+    JSON body in, JSON ``{id, outcome, result}`` out.  A non-ok
+    outcome travels IN the JSON (the HTTP layer reserves 5xx for
+    handler crashes, which clients classify as transient)."""
+    payload = json.loads(body.decode()) if body else {}
+    timeout = float(payload.get("timeout_s", timeout_s))
+    req = router.serve(deployment, payload, timeout_s=timeout)
+    return json.dumps({
+        "id": req.id,
+        "outcome": req.outcome if req.done else "deadline",
+        "result": req.result,
+    }).encode()
+
+
+def install_http_frontend(server, router: Router,
+                          timeout_s: float = 30.0):
+    """Mount ``router`` at ``POST /serve/<deployment>`` on a
+    :class:`~..runner.http_server.RendezvousServer`."""
+    server.serving_provider = (
+        lambda deployment, body: serve_http(router, deployment, body,
+                                            timeout_s))

@@ -1,0 +1,234 @@
+"""Durable request queue for process-mode replica groups.
+
+The in-process :class:`~.router.Router` holds requests in memory — the
+right latency path for one serving frontend, the wrong durability
+story for replicas that are REAL processes the pod scheduler can
+spawn, preempt and lose.  This queue is the process-mode transport:
+one directory tree on shared storage, with atomic-rename claim
+semantics so every request is served despite replica death —
+
+    <root>/pending/req-<id>.json      submitted, unclaimed
+    <root>/claimed/req-<id>.<pid>.json  claimed by live process <pid>
+    <root>/done/<id>.json             result (atomic tmp+replace)
+
+* **Claim** — ``os.rename`` of the pending file into ``claimed/``
+  stamped with the claimant's pid: atomic on POSIX, so two replicas
+  racing the same request resolve to exactly one winner (the loser's
+  rename raises and it moves on).
+* **Requeue on death** — ``sweep_dead_claimants`` renames claims whose
+  pid is no longer alive back to ``pending/`` (pid liveness via
+  ``os.kill(pid, 0)`` — HOST-LOCAL by design; multi-host deployments
+  back this with a lease age, see ``stale_claim_secs``).  A replica
+  that died mid-batch therefore loses its claim, not the request.
+* **At-least-once, idempotent** — a replica that died after writing
+  ``done/`` but before releasing its claim gets its work re-done by a
+  survivor; ``done/<id>.json`` is keyed by request id and atomically
+  replaced, so duplicates collapse and ``done_count`` never
+  double-counts.
+
+No request is EVER deleted from the tree before its result exists —
+"no request lost" is a filesystem invariant here, certified by the
+hot-swap e2e under ``serving.replica.die`` injection.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger("horovod_tpu.serving.workqueue")
+
+_PENDING, _CLAIMED, _DONE = "pending", "claimed", "done"
+
+# Generated request ids must sort in ARRIVAL order (claim() walks the
+# pending dir lexicographically): fixed-width nanosecond timestamp,
+# a per-process sequence for same-tick ties, a random suffix for
+# cross-process uniqueness.  A bare uuid here would make claim order
+# random and let a high-hex request starve under sustained load.
+_id_seq = itertools.count()
+
+
+def _generated_id() -> str:
+    return "%016x-%08x-%s" % (time.time_ns(), next(_id_seq),
+                              uuid.uuid4().hex[:8])
+
+
+class Claim:
+    """One claimed request: serve it, then ``complete(claim, result)``."""
+
+    __slots__ = ("req_id", "payload", "path")
+
+    def __init__(self, req_id: str, payload: Dict, path: str):
+        self.req_id = req_id
+        self.payload = payload
+        self.path = path
+
+
+def _atomic_write(path: str, data: bytes):
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(prefix=".tmp-req-", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class FileWorkQueue:
+    """See module docstring.  ``stale_claim_secs`` (default 120)
+    additionally requeues claims older than the window even when their
+    pid LOOKS alive — the wedged-replica backstop, and the correctness
+    net when claimants run on another host (where pid liveness is
+    meaningless and every claim looks alive)."""
+
+    def __init__(self, root: str, stale_claim_secs: float = 120.0):
+        self.root = root
+        self.stale_claim_secs = stale_claim_secs
+        for sub in (_PENDING, _CLAIMED, _DONE):
+            os.makedirs(os.path.join(root, sub), exist_ok=True)
+
+    def _dir(self, sub: str) -> str:
+        return os.path.join(self.root, sub)
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, payload: Dict,
+               req_id: Optional[str] = None) -> str:
+        """Enqueue one request; ids must not contain ``.`` (the claim
+        filename separator).  Generated ids sort in arrival order;
+        caller-provided ids are claimed in THEIR lexicographic order.
+        """
+        req_id = req_id if req_id is not None else _generated_id()
+        if "." in req_id or "/" in req_id:
+            raise ValueError("request id %r may not contain '.' or '/'"
+                             % req_id)
+        _atomic_write(os.path.join(self._dir(_PENDING),
+                                   "req-%s.json" % req_id),
+                      json.dumps(payload).encode())
+        return req_id
+
+    def result(self, req_id: str) -> Optional[Dict]:
+        try:
+            with open(os.path.join(self._dir(_DONE),
+                                   "%s.json" % req_id), "rb") as f:
+                return json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None
+
+    def depth(self) -> int:
+        return len([n for n in os.listdir(self._dir(_PENDING))
+                    if n.startswith("req-")])
+
+    def done_count(self) -> int:
+        return len([n for n in os.listdir(self._dir(_DONE))
+                    if n.endswith(".json")])
+
+    # -- replica side ------------------------------------------------------
+
+    def claim(self, n: int) -> List[Claim]:
+        """Claim up to ``n`` pending requests (oldest id first); a
+        rename lost to a racing replica is simply skipped."""
+        out: List[Claim] = []
+        for name in sorted(os.listdir(self._dir(_PENDING))):
+            if len(out) >= n:
+                break
+            if not (name.startswith("req-") and name.endswith(".json")):
+                continue
+            req_id = name[len("req-"):-len(".json")]
+            src = os.path.join(self._dir(_PENDING), name)
+            dst = os.path.join(self._dir(_CLAIMED),
+                               "req-%s.%d.json" % (req_id, os.getpid()))
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue  # another replica won the claim race
+            try:
+                # rename preserves the SUBMIT-time mtime; the stale
+                # window must run from CLAIM time, or any backlog older
+                # than the window would be instantly "stale" and
+                # double-served the moment it was claimed.
+                os.utime(dst)
+            except OSError:
+                pass  # worst case: the submit-age heuristic applies
+            try:
+                with open(dst, "rb") as f:
+                    payload = json.loads(f.read().decode())
+            except (OSError, ValueError) as exc:
+                LOG.warning("claimed request %s is unreadable (%s); "
+                            "leaving the claim for the sweeper", req_id,
+                            exc)
+                continue
+            out.append(Claim(req_id, payload, dst))
+        return out
+
+    def complete(self, claim: Claim, result: Dict):
+        """Write the result atomically, THEN release the claim — a
+        crash between the two re-serves the request, never loses it."""
+        _atomic_write(os.path.join(self._dir(_DONE),
+                                   "%s.json" % claim.req_id),
+                      json.dumps(result).encode())
+        try:
+            os.unlink(claim.path)
+        except OSError:
+            pass  # sweeper may have requeued a slow serve; done wins
+
+    def sweep_dead_claimants(self) -> int:
+        """Requeue claims held by dead pids (or older than the stale
+        window); returns how many were handed back.  Already-completed
+        requests are released instead of requeued."""
+        requeued = 0
+        now = time.time()
+        for name in list(os.listdir(self._dir(_CLAIMED))):
+            if not (name.startswith("req-") and name.endswith(".json")):
+                continue
+            stem = name[len("req-"):-len(".json")]
+            req_id, _, pid_text = stem.rpartition(".")
+            path = os.path.join(self._dir(_CLAIMED), name)
+            try:
+                pid = int(pid_text)
+            except ValueError:
+                continue
+            alive = True
+            if pid != os.getpid():
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    alive = False
+                except OSError:
+                    pass  # EPERM etc: alive but not ours
+            if alive:
+                try:
+                    stale = now - os.path.getmtime(path) \
+                        > self.stale_claim_secs
+                except OSError:
+                    continue  # completed/requeued under us
+                if not stale:
+                    continue
+            if self.result(req_id) is not None:
+                # Served before the claimant died: release, don't redo.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            dst = os.path.join(self._dir(_PENDING),
+                               "req-%s.json" % req_id)
+            try:
+                os.rename(path, dst)
+                requeued += 1
+                LOG.warning("requeued request %s from dead claimant "
+                            "pid %d", req_id, pid)
+            except OSError:
+                continue  # raced another sweeper
+        return requeued

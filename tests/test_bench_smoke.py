@@ -50,6 +50,18 @@ def test_bench_emits_one_valid_json_line():
     assert "enabled" in plan and "schema" in plan
     assert set(plan["apply"]) == {"cache", "kv", "tuned", "default"}
     assert "hits" in plan and "misses" in plan
+    # r16 serving-plane attribution: the continuous-batching knobs +
+    # autoscale policy + plan-cache warm-start a deployment would run
+    # with (additive key; headline comes from serving_bw.py).
+    serving = lev["serving"]
+    assert serving["max_batch"] >= 1
+    assert serving["max_wait_micros"] >= 0
+    assert set(serving["autoscale"]) == {
+        "up_qdepth", "down_qdepth", "interval_s", "cooldown_s"}
+    assert serving["autoscale"]["up_qdepth"] > \
+        serving["autoscale"]["down_qdepth"]
+    assert set(serving["plan_warm_start"]) == {
+        "enabled", "source", "hits"}
 
 
 def test_allreduce_bw_amortization_math():

@@ -1,0 +1,833 @@
+"""Continuous-batching serving plane tests (ISSUE 11).
+
+Fast units exercise the router's admission policy (coalescing under
+max-wait, full-batch dispatch, deadline expiry, queue-depth
+accounting, requeue-at-front ordering), the ``serving.request.drop``
+injection seam, the autoscale decision table and the Autoscaler's
+grow-now/shrink-after-cooldown asymmetry, the durable work queue's
+claim/sweep/idempotence invariants, the VersionStore's corrupt-blob
+fallback, the newest-version election, the in-process replica set's
+kill-with-requeue (no request lost) + hot-swap convergence, the HTTP
+front door, and the ``PodScheduler.resize``/``poke`` satellite fix.
+
+The 2-proc real-process e2es — hot swap certified under
+``serving.replica.die`` injection (no request lost, survivors elect
+the newest version) and the traffic-driven tenant autoscaler — are
+``slow``-marked per the 870 s tier-1 cap; CI's `serving-smoke` job
+runs them by node id.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu.common import faultline, metrics
+from horovod_tpu.elastic.discovery import FixedHosts
+from horovod_tpu.elastic.scheduler import (DONE, RUNNING, PodScheduler,
+                                           TenantSpec)
+from horovod_tpu.jax.functions import elect_newest
+from horovod_tpu.serving import (Autoscaler, DeploymentSpec,
+                                 FileWorkQueue, ReplicaSet, Router,
+                                 VersionStore, admit_deployment,
+                                 autoscale_decision,
+                                 install_http_frontend, swap_to,
+                                 tenant_autoscaler)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_FAULT", raising=False)
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+def _wait_for(cond, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- router admission policy -----------------------------------------------
+
+def test_router_full_batch_dispatches_without_waiting():
+    router = Router(max_batch_size=4, max_wait_us=5_000_000)
+    for i in range(4):
+        router.submit("d", i)
+    t0 = time.monotonic()
+    batch = router.next_batch("d", timeout=1.0)
+    # A FULL batch must not wait out the max-wait window.
+    assert time.monotonic() - t0 < 1.0
+    assert [r.payload for r in batch] == [0, 1, 2, 3]
+    assert all(r.attempts == 1 for r in batch)
+
+
+def test_router_max_wait_closes_partial_batch():
+    router = Router(max_batch_size=64, max_wait_us=80_000)
+    router.submit("d", "a")
+    router.submit("d", "b")
+    t0 = time.monotonic()
+    batch = router.next_batch("d", timeout=2.0)
+    elapsed = time.monotonic() - t0
+    assert [r.payload for r in batch] == ["a", "b"]
+    # The batch closed because the OLDEST request aged past max-wait —
+    # not instantly, not at the 2 s poll timeout.
+    assert 0.04 <= elapsed < 1.0
+
+
+def test_router_deadline_expiry_resolves_without_dispatch():
+    metrics.reset()
+    router = Router(max_batch_size=8, max_wait_us=5_000_000)
+    req = router.submit("d", "x", timeout_s=0.03)
+    assert router.next_batch("d", timeout=0.3) == []
+    assert req.done and req.outcome == "deadline"
+    assert metrics.series_sum("serving_requests_total",
+                              deployment="d", outcome="deadline") == 1
+    assert router.depth("d") == 0
+
+
+def test_router_queue_depth_accounting():
+    metrics.reset()
+    router = Router(max_batch_size=2, max_wait_us=0)
+    for i in range(3):
+        router.submit("d", i)
+    assert metrics.series_sum("serving_queue_depth", deployment="d") == 3
+    batch = router.next_batch("d", timeout=1.0)
+    assert len(batch) == 2
+    assert metrics.series_sum("serving_queue_depth", deployment="d") == 1
+    router.complete(batch, ["r0", "r1"])
+    assert metrics.series_sum("serving_requests_total",
+                              deployment="d", outcome="ok") == 2
+
+
+def test_router_requeue_reenters_at_front_in_arrival_order():
+    router = Router(max_batch_size=2, max_wait_us=0)
+    for i in range(4):
+        router.submit("d", i)
+    first = router.next_batch("d", timeout=1.0)
+    assert [r.payload for r in first] == [0, 1]
+    router.requeue(first)          # failed dispatch hands them back
+    again = router.next_batch("d", timeout=1.0)
+    # Arrival order preserved: the requeued pair outranks 2, 3.
+    assert [r.payload for r in again] == [0, 1]
+    assert [r.attempts for r in again] == [2, 2]
+
+
+def test_router_requeue_expires_dead_requests():
+    router = Router(max_batch_size=2, max_wait_us=0)
+    req = router.submit("d", "x", timeout_s=0.01)
+    batch = router.next_batch("d", timeout=1.0)
+    assert [r.payload for r in batch] == ["x"]
+    time.sleep(0.03)
+    router.requeue(batch)
+    assert req.done and req.outcome == "deadline"
+    assert router.depth("d") == 0
+
+
+def test_request_drop_injection_never_queues(monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("HVD_TPU_FAULT",
+                       "serving.request.drop:drop@times=1")
+    faultline.reset()
+    router = Router(max_batch_size=8, max_wait_us=0)
+    dropped = router.submit("d", "a")
+    assert dropped.done and dropped.outcome == "dropped"
+    assert router.depth("d") == 0
+    assert metrics.series_sum("serving_requests_total",
+                              deployment="d", outcome="dropped") == 1
+    # Refused admissions never disturb queued traffic: the next
+    # submit (injection exhausted) queues and serves normally.
+    ok = router.submit("d", "b")
+    batch = router.next_batch("d", timeout=1.0)
+    router.complete(batch, ["r"])
+    assert ok.outcome == "ok" and ok.result == "r"
+
+
+# -- autoscale policy -------------------------------------------------------
+
+def test_autoscale_decision_table():
+    cases = [
+        # (depth, replicas, min, max, want) at up=4, down=0.5
+        (0, 1, 1, 8, 1),     # idle at the floor: hold
+        (3, 1, 1, 8, 1),     # below up-threshold: hold
+        (4, 1, 1, 8, 1),     # exactly at threshold: ceil(4/4) = 1
+        (9, 1, 1, 8, 3),     # backlog 9 -> ceil(9/4) replicas
+        (64, 1, 1, 4, 4),    # growth bounded by max
+        (16, 4, 1, 8, 4),    # per-replica 4 -> ceil(16/4) = 4: hold
+        (1, 4, 1, 8, 3),     # drained: release exactly ONE
+        (0, 4, 3, 8, 3),     # shrink respects the min floor
+        (0, 1, 1, None, 1),  # unbounded max, floor holds
+        (100, 2, 1, None, 25),
+    ]
+    for depth, replicas, mn, mx, want in cases:
+        got = autoscale_decision(depth, replicas, mn, mx,
+                                 up_qdepth=4.0, down_qdepth=0.5)
+        assert got == want, (depth, replicas, mn, mx, got, want)
+
+
+def test_autoscaler_grows_immediately_shrinks_after_cooldown():
+    metrics.reset()
+    depth = [12.0]
+    current = [1]
+    applied = []
+
+    scaler = Autoscaler(lambda: depth[0], lambda: current[0],
+                        applied.append, min_replicas=1, max_replicas=8,
+                        deployment="d", interval=60, cooldown=0.1,
+                        up_qdepth=4.0, down_qdepth=0.5)
+    scaler.tick()
+    assert applied == [3]          # growth is never cooldown-gated
+    current[0] = 3
+    depth[0] = 0.0
+    scaler.tick()
+    assert applied == [3]          # shrink inside the cooldown: held
+    time.sleep(0.12)
+    scaler.tick()
+    assert applied == [3, 2]       # cooldown passed: release one
+    # The observed depth is republished for the fleet scrape.
+    assert metrics.series_sum("serving_queue_depth", deployment="d") == 0
+
+
+def test_autoscaler_records_scale_up_convergence():
+    current = [1]
+    scaler = Autoscaler(lambda: 8.0, lambda: current[0],
+                        lambda n: None, min_replicas=1, max_replicas=4,
+                        interval=60, cooldown=0.0,
+                        up_qdepth=4.0, down_qdepth=0.5)
+    scaler.tick()                  # orders 1 -> 2
+    assert scaler.decisions[-1] == {"from": 1, "to": 2, "depth": 8.0}
+    assert scaler.last_scale_up_secs is None
+    current[0] = 2                 # the order lands (replica spawned)
+    scaler.tick()
+    assert scaler.last_scale_up_secs is not None
+    assert scaler.last_scale_up_secs >= 0.0
+
+
+# -- durable work queue -----------------------------------------------------
+
+def test_workqueue_claim_complete_and_idempotent_done(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"))
+    rid = q.submit({"x": 1})
+    assert q.depth() == 1
+    claims = q.claim(8)
+    assert len(claims) == 1 and claims[0].payload == {"x": 1}
+    assert q.depth() == 0
+    q.complete(claims[0], {"y": 2})
+    assert q.result(rid) == {"y": 2}
+    assert q.done_count() == 1
+    # A duplicate complete (at-least-once redo) collapses by req id.
+    q.complete(claims[0], {"y": 2})
+    assert q.done_count() == 1
+
+
+def test_workqueue_rejects_separator_in_request_id(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"))
+    with pytest.raises(ValueError):
+        q.submit({}, req_id="a.b")
+    with pytest.raises(ValueError):
+        q.submit({}, req_id="a/b")
+
+
+def test_workqueue_sweep_requeues_dead_claimants_work(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"))
+    rid = q.submit({"x": 7})
+    # Simulate a replica that claimed and then died: move the pending
+    # file into claimed/ stamped with a pid that is REALLY dead.
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    os.rename(os.path.join(str(tmp_path / "q"), "pending",
+                           "req-%s.json" % rid),
+              os.path.join(str(tmp_path / "q"), "claimed",
+                           "req-%s.%d.json" % (rid, proc.pid)))
+    assert q.depth() == 0
+    assert q.sweep_dead_claimants() == 1
+    assert q.depth() == 1          # the request, not the claim, survived
+    claims = q.claim(1)
+    assert claims and claims[0].req_id == rid
+
+
+def test_workqueue_sweep_releases_already_completed_claim(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"))
+    rid = q.submit({"x": 7})
+    claims = q.claim(1)
+    q.complete(claims[0], {"ok": True})
+    # Re-create the claim as a dead pid would have left it (died after
+    # writing done/, before releasing): sweep must RELEASE, not redo.
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    path = os.path.join(str(tmp_path / "q"), "claimed",
+                        "req-%s.%d.json" % (rid, proc.pid))
+    with open(path, "w") as f:
+        f.write(json.dumps({"x": 7}))
+    assert q.sweep_dead_claimants() == 0
+    assert q.depth() == 0 and q.done_count() == 1
+    assert not os.path.exists(path)
+
+
+def test_workqueue_stale_claim_requeued_even_with_live_pid(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"), stale_claim_secs=0.01)
+    rid = q.submit({"x": 7})
+    q.claim(1)                     # claimed by THIS live process
+    time.sleep(0.05)
+    assert q.sweep_dead_claimants() == 1   # wedged-replica backstop
+    assert q.depth() == 1
+    assert q.result(rid) is None
+
+
+def test_workqueue_stale_window_runs_from_claim_not_submit(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"), stale_claim_secs=0.2)
+    q.submit({"x": 7})
+    # Backlog older than the stale window: the CLAIM must still be
+    # fresh (rename preserves the submit mtime; claim re-stamps it),
+    # or every old request would be double-served the moment it was
+    # claimed.
+    time.sleep(0.3)
+    assert len(q.claim(1)) == 1
+    assert q.sweep_dead_claimants() == 0
+    assert q.depth() == 0
+
+
+def test_workqueue_generated_ids_claim_in_arrival_order(tmp_path):
+    q = FileWorkQueue(str(tmp_path / "q"))
+    rids = [q.submit({"i": i}) for i in range(6)]
+    claims = q.claim(6)
+    assert [c.req_id for c in claims] == rids
+
+
+# -- version store + hot swap ----------------------------------------------
+
+def test_version_store_publish_scan_and_corrupt_fallback(tmp_path):
+    store = VersionStore(str(tmp_path))
+    assert store.version() == 0 and store.newest() is None
+    p1 = store.publish(1, {"w": 1})
+    p2 = store.publish(2, {"w": 2})
+    assert store.version() == 2
+    assert store.newest() == (2, {"w": 2})
+    assert store.newest(min_version=2) is None
+    # Corrupt the newest blob: the load path re-validates and falls
+    # back to the previous version instead of half-loading weights.
+    with open(p2, "wb") as f:
+        f.write(b"torn publish garbage")
+    assert store.newest() == (1, {"w": 1})
+    assert p1  # both publishes returned real paths
+    with pytest.raises(ValueError):
+        store.publish(0, {})
+
+
+def test_swap_to_loads_newest_and_commits(tmp_path):
+    store = VersionStore(str(tmp_path))
+    store.publish(3, {"w": 3})
+
+    class _State:
+        version = 0
+        weights = None
+
+        def __init__(self):
+            self.commits = 0
+
+        def commit(self):
+            self.commits += 1
+
+    state = _State()
+    assert swap_to(store, state) is True
+    assert (state.version, state.weights) == (3, {"w": 3})
+    assert state.commits == 1      # the commit IS the election evidence
+    assert swap_to(store, state) is False  # idempotent at the newest
+    # A corrupt newest blob keeps the replica serving its current
+    # version rather than swapping to garbage.
+    path = store.publish(4, {"w": 4})
+    with open(path, "wb") as f:
+        f.write(b"bad")
+    assert swap_to(store, state) is False
+    assert state.version == 3 and state.commits == 1
+
+
+def test_version_store_corrupt_head_read_once_until_new_publish(
+        tmp_path):
+    metrics.reset()
+    store = VersionStore(str(tmp_path))
+    path = store.publish(1, {"w": 1})
+    with open(path, "wb") as f:
+        f.write(b"torn")
+    assert store.newest() is None
+    failures = metrics.series_sum("spill_crc_failures_total")
+    assert failures >= 1
+    # Polling again must NOT re-read the known-corrupt head.
+    assert store.newest() is None
+    assert metrics.series_sum("spill_crc_failures_total") == failures
+    # A new publish moves the head and re-enables the load path.
+    store.publish(2, {"w": 2})
+    assert store.newest() == (2, {"w": 2})
+
+
+def test_elect_newest_version_wins_ties_to_lowest_rank():
+    records = [{"rank": 0, "version": 1}, {"rank": 1, "version": 3},
+               {"rank": 2, "version": 3}]
+    assert elect_newest(records, keys=("version",))["rank"] == 1
+    # No evidence anywhere degenerates to rank 0 (the reference's
+    # rank-0 broadcast) — same rule elastic.state relies on.
+    fresh = [{"rank": r} for r in (2, 0, 1)]
+    assert elect_newest(fresh)["rank"] == 0
+    # The hot-swap election: version outranks progress, progress
+    # breaks version ties.
+    mixed = [{"rank": 0, "version": 2, "commit_id": 9},
+             {"rank": 1, "version": 3, "commit_id": 1},
+             {"rank": 2, "version": 3, "commit_id": 4}]
+    win = elect_newest(mixed, keys=("version", "commit_id"))
+    assert win["rank"] == 2
+
+
+# -- in-process replica set -------------------------------------------------
+
+def test_replicaset_kill_requeues_and_survivors_elect_newest(tmp_path):
+    metrics.reset()
+    store = VersionStore(str(tmp_path))
+    store.publish(1, {"version": 1})
+    router = Router(max_batch_size=4, max_wait_us=1000)
+    served_versions = []
+
+    def model_fn(weights, payloads):
+        served_versions.append(int(weights["version"]))
+        time.sleep(0.01)
+        return [p * 2 for p in payloads]
+
+    rset = ReplicaSet("d", model_fn, router, store=store,
+                      min_replicas=1, max_replicas=4).start(2)
+    try:
+        assert _wait_for(lambda: rset.ready_count() == 2)
+        reqs = [router.submit("d", i) for i in range(8)]
+        for r in reqs:
+            assert r.wait(10.0)
+        assert [r.outcome for r in reqs] == ["ok"] * 8
+        assert rset.cold_start_seconds() is not None
+        # Kill one replica mid-service and roll a new version: zero
+        # requests lost, survivors converge on the NEWEST version.
+        rset.kill(0)
+        store.publish(2, {"version": 2})
+        more = [router.submit("d", i) for i in range(8)]
+        for r in more:
+            assert r.wait(10.0)
+        assert [r.outcome for r in more] == ["ok"] * 8
+        assert [r.result for r in more] == [i * 2 for i in range(8)]
+        assert _wait_for(lambda: rset.live_count() == 1)
+        assert _wait_for(lambda: set(rset.versions()) == {2})
+        assert rset.target_version() == 2
+        ok = metrics.series_sum("serving_requests_total",
+                                deployment="d", outcome="ok")
+        assert ok == 16            # every request exactly once
+    finally:
+        rset.stop()
+
+
+def test_replicaset_respawns_to_min_replicas_after_death():
+    router = Router(max_batch_size=4, max_wait_us=1000)
+    rset = ReplicaSet("d", lambda w, ps: [p + 1 for p in ps], router,
+                      min_replicas=1, max_replicas=4).start(1)
+    try:
+        assert _wait_for(lambda: rset.ready_count() == 1)
+        rset.kill(0)
+        router.submit("d", 0)      # wakes the doomed replica
+        # The sole replica died: the floor respawns a replacement and
+        # the queue keeps draining instead of stranding forever.
+        assert _wait_for(lambda: rset.ready_count() == 1, timeout=10)
+        req = router.submit("d", 41)
+        assert req.wait(10.0) and req.outcome == "ok"
+        assert req.result == 42
+    finally:
+        rset.stop()
+
+
+def test_replicaset_stop_leaves_shared_router_serving():
+    router = Router(max_batch_size=4, max_wait_us=1000)
+    rset_a = ReplicaSet("a", lambda w, ps: ps, router).start(1)
+    rset_b = ReplicaSet("b", lambda w, ps: ps, router).start(1)
+    try:
+        assert _wait_for(lambda: rset_b.ready_count() == 1)
+        rset_a.stop()
+        # Decommissioning deployment A must not wedge deployment B's
+        # pull loop on the SHARED router (one HTTP front door mounts
+        # one router for every deployment).
+        req = router.submit("b", "still-served")
+        assert req.wait(10.0) and req.outcome == "ok"
+    finally:
+        rset_b.stop()
+
+
+def test_replicaset_scale_down_finishes_in_flight_batch():
+    router = Router(max_batch_size=2, max_wait_us=1000)
+    rset = ReplicaSet("d", lambda w, ps: ps, router,
+                      min_replicas=1, max_replicas=4).start(3)
+    try:
+        assert _wait_for(lambda: rset.ready_count() == 3)
+        rset.scale(1)
+        assert _wait_for(lambda: rset.live_count() == 1)
+        req = router.submit("d", "x")
+        assert req.wait(5.0) and req.outcome == "ok"
+    finally:
+        rset.stop()
+
+
+# -- HTTP front door --------------------------------------------------------
+
+def test_http_front_door_serves_authed_requests():
+    from horovod_tpu.runner.http_server import (RendezvousServer,
+                                                SECRET_HEADER,
+                                                compute_digest)
+    secret = "s3cret"
+    server = RendezvousServer(host="127.0.0.1", secret=secret)
+    port = server.start()
+    router = Router(max_batch_size=4, max_wait_us=1000)
+    rset = ReplicaSet("m", lambda w, ps: [p["x"] + 1 for p in ps],
+                      router).start(1)
+    try:
+        url = "http://127.0.0.1:%d/serve/m" % port
+        body = json.dumps({"x": 41, "timeout_s": 10}).encode()
+
+        def post(payload, digest):
+            req = urllib.request.Request(
+                url, data=payload, method="POST",
+                headers={SECRET_HEADER: digest})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as err:
+                return err.code, b""
+
+        # No provider installed: this server is a rendezvous KV first.
+        status, _ = post(body, compute_digest(secret, body))
+        assert status == 404
+        install_http_frontend(server, router)
+        status, out = post(body, compute_digest(secret, body))
+        assert status == 200
+        reply = json.loads(out.decode())
+        assert reply["outcome"] == "ok" and reply["result"] == 42
+        # Same HMAC auth as the KV paths: a bad digest never reaches
+        # the router.
+        status, _ = post(body, "bogus")
+        assert status == 403
+        assert router.depth("m") == 0
+    finally:
+        rset.stop()
+        server.stop()
+
+
+# -- deployment-as-tenant + the resize/poke satellite fix -------------------
+
+class _StubDriver:
+    def __init__(self, tenant):
+        self.tenant = tenant
+        self.np_bounds = None
+        self._stop = threading.Event()
+
+    def run(self):
+        self._stop.wait()
+        return 0
+
+    def set_np_bounds(self, min_np, max_np):
+        self.np_bounds = (min_np, max_np)
+
+    def scheduler_preempt(self, reason):
+        pass
+
+    def scheduler_resume(self):
+        pass
+
+    def request_stop(self):
+        self._stop.set()
+
+    def finish(self):
+        self._stop.set()
+
+
+def test_admit_deployment_maps_slo_to_priority():
+    captured = {}
+
+    class _Sched:
+        def admit(self, spec):
+            captured["spec"] = spec
+            return RUNNING
+
+    spec = DeploymentSpec("chat", ["serve"], slo_class=7,
+                          min_replicas=2, max_replicas=6)
+    tenant_id = admit_deployment(_Sched(), spec)
+    assert tenant_id == "serve-chat"
+    admitted = captured["spec"]
+    assert admitted.tenant_id == "serve-chat"
+    assert admitted.priority == 7
+    # Start at the floor: growth is the autoscaler's call, not free
+    # slack absorption.
+    assert (admitted.min_np, admitted.max_np) == (2, 2)
+    assert admitted.env["HOROVOD_SERVING_DEPLOYMENT"] == "chat"
+    with pytest.raises(ValueError):
+        DeploymentSpec("", ["serve"])
+
+
+def test_scheduler_resize_validation():
+    sched = PodScheduler(FixedHosts({"h1": 2}),
+                         driver_factory=_StubDriver, tick_secs=30)
+    with pytest.raises(KeyError):
+        sched.resize("nope", max_np=2)
+    try:
+        sched.start()
+        assert sched.admit(TenantSpec("A", ["true"], min_np=1,
+                                      max_np=1)) == RUNNING
+        with pytest.raises(ValueError):
+            sched.resize("A", min_np=0)
+        with pytest.raises(ValueError):
+            sched.resize("A", min_np=3, max_np=2)
+    finally:
+        sched.stop(timeout=10)
+
+
+def test_scheduler_poke_applies_resize_without_waiting_tick(tmp_path):
+    """The satellite fix: with a LONG tick cadence, a resize alone
+    waits for the next scheduled tick, but resize + poke() lands on an
+    immediate replan."""
+    sched = PodScheduler(FixedHosts({"h1": 2}),
+                         driver_factory=_StubDriver, tick_secs=30)
+    try:
+        sched.start()
+        assert sched.admit(TenantSpec("A", ["true"], min_np=1,
+                                      max_np=1)) == RUNNING
+        assert _wait_for(
+            lambda: sched._tenants["A"].allocated() == 1)
+        time.sleep(0.5)   # drain admit()'s own wake-up of the loop
+        sched.resize("A", max_np=2)
+        time.sleep(0.5)
+        # No poke: the 30 s cadence hasn't replanned yet.
+        assert sched._tenants["A"].allocated() == 1
+        sched.poke()
+        assert _wait_for(
+            lambda: sched._tenants["A"].allocated() == 2,
+            timeout=5.0), "poke() must trigger an immediate replan"
+        # The live driver's own np bounds moved too — without this a
+        # real tenant would keep truncating its world at the
+        # admission-time max_np and the widened view could never be
+        # taken up (the serving scale-up's convergence bug).
+        assert sched._tenants["A"].driver.np_bounds == (1, 2)
+    finally:
+        sched.stop(timeout=10)
+
+
+def test_tenant_autoscaler_orders_land_via_resize_and_poke():
+    calls = []
+
+    class _Sched:
+        def tenant_driver(self, tid):
+            calls.append(("driver", tid))
+            return None
+
+        def resize(self, tid, min_np=None, max_np=None):
+            calls.append(("resize", tid, max_np))
+
+        def poke(self):
+            calls.append(("poke",))
+
+    spec = DeploymentSpec("m", ["serve"], min_replicas=1,
+                          max_replicas=4)
+    scaler = tenant_autoscaler(_Sched(), "serve-m", spec,
+                               depth_fn=lambda: 12.0, interval=60,
+                               cooldown=0.0, up_qdepth=4.0,
+                               down_qdepth=0.5)
+    scaler.tick()
+    assert ("resize", "serve-m", 3) in calls
+    assert calls[-1] == ("poke",)  # applied next tick, not next cadence
+
+
+# -- real-process e2es (slow; CI `serving-smoke` runs them by node id) ------
+
+SERVING_WORKER = """
+import os, sys, time
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.serving import FileWorkQueue, VersionStore, \
+    serve_from_queue
+
+hvd.init()
+state = elastic.ObjectState(version=0, weights=None)
+queue = FileWorkQueue(os.environ["SERVE_QUEUE_DIR"])
+store = VersionStore(os.environ["SERVE_STORE_DIR"])
+
+def note(line):
+    with open(os.environ["SERVE_LOG"], "a") as f:
+        f.write(line + "\\n")
+
+def handler(req_id, payload):
+    time.sleep(float(os.environ.get("SERVE_STEP_SECS", "0.05")))
+    return {"y": payload["x"] * 2, "version": state.version}
+
+@elastic.run
+def serve(state):
+    note("ENTER version=%d commit=%d" % (state.version,
+                                         state._commit_id))
+    serve_from_queue(queue, handler, state=state, store=store,
+                     deployment=os.environ.get(
+                         "HOROVOD_SERVING_DEPLOYMENT", "m"),
+                     total=int(os.environ["SERVE_TOTAL"]))
+    note("DONE version=%d" % state.version)
+
+serve(state)
+"""
+
+
+def _serving_env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_RANK", None)
+    env.pop("HOROVOD_ELASTIC_DRIVER_ADDR", None)
+    env.update(extra or {})
+    return env
+
+
+def _lines(path):
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+@pytest.mark.slow
+def test_serving_hot_swap_under_replica_die_e2e(tmp_path):
+    """ISSUE 11 acceptance: a 2-replica process deployment serves a
+    stream while a new model version rolls across it AND one replica
+    is killed mid-service (``serving.replica.die`` injection).  Zero
+    requests lost — the dead replica's claims are swept back and
+    served by the survivor — and the re-formed group converges on the
+    NEWEST version (the swap's commit is the election evidence)."""
+    total = 24
+    queue = FileWorkQueue(str(tmp_path / "q"))
+    store = VersionStore(str(tmp_path / "versions"))
+    store.publish(1, {"version": 1})
+    log = tmp_path / "serve.log"
+    base = _serving_env({
+        # Die on the slot-1 replica's SECOND claimed batch, epoch 1
+        # only (the respawn runs in epoch 2 and serves on).
+        "HVD_TPU_FAULT":
+            "serving.replica.die:die:43@slot=1@epoch=1@after=1",
+        "HOROVOD_SERVING_MAX_BATCH": "2",
+    })
+    sched = PodScheduler(FixedHosts({"127.0.0.1": 2}), env=base,
+                         tick_secs=0.2, failure_threshold=10,
+                         start_timeout=60)
+    script = tmp_path / "serve.py"
+    script.write_text(SERVING_WORKER)
+    spec = DeploymentSpec(
+        "m", [sys.executable, str(script)], slo_class=5, min_replicas=2,
+        env={"SERVE_QUEUE_DIR": str(tmp_path / "q"),
+             "SERVE_STORE_DIR": str(tmp_path / "versions"),
+             "SERVE_LOG": str(log), "SERVE_TOTAL": str(total)})
+    try:
+        sched.start()
+        tenant_id = admit_deployment(sched, spec)
+        assert tenant_id == "serve-m"
+        assert sched.tenant_state(tenant_id) == RUNNING
+        ids = []
+        for i in range(total):
+            ids.append(queue.submit({"x": i}, req_id="r%03d" % i))
+            time.sleep(0.02)
+            if i == total // 3:
+                # Roll the new version mid-stream.
+                store.publish(2, {"version": 2})
+        assert _wait_for(lambda: sched.tenant_state(tenant_id) == DONE,
+                         timeout=240, interval=0.25), (
+            "tenant=%s log=%r" % (sched.tenant_state(tenant_id),
+                                  _lines(log)))
+        # Zero requests lost, every answer exact, each served once.
+        assert queue.done_count() == total
+        for i, rid in enumerate(ids):
+            result = queue.result(rid)
+            assert result is not None and result["y"] == i * 2, (
+                rid, result)
+        lines = _lines(log)
+        # The injection really fired: the killed replica's world
+        # re-formed (>= 3 ENTERs: 2 initial + >= 1 re-rendezvous).
+        assert len([l for l in lines if l.startswith("ENTER")]) >= 3, \
+            lines
+        assert metrics.series_sum("elastic_worker_failures_total",
+                                  tenant=tenant_id) >= 1
+        # Survivors elected the newest version: every replica finished
+        # AT version 2, and post-roll traffic was served by v2.
+        dones = [l for l in lines if l.startswith("DONE")]
+        assert dones and all(l == "DONE version=2" for l in dones), \
+            lines
+        assert queue.result(ids[-1])["version"] == 2
+    finally:
+        sched.stop(timeout=30)
+
+
+@pytest.mark.slow
+def test_serving_tenant_autoscale_e2e(tmp_path):
+    """Traffic-driven autoscaling through the REAL planes: a burst
+    builds queue depth, the autoscaler orders a grow, the order lands
+    via ``scheduler.resize`` + ``poke`` (next tick, not next cadence),
+    the elastic driver spawns the second replica, and the deployment
+    drains the backlog with zero lost requests."""
+    total = 40
+    queue = FileWorkQueue(str(tmp_path / "q"))
+    store = VersionStore(str(tmp_path / "versions"))
+    store.publish(1, {"version": 1})
+    log = tmp_path / "serve.log"
+    base = _serving_env({"HOROVOD_SERVING_MAX_BATCH": "2"})
+    sched = PodScheduler(FixedHosts({"127.0.0.1": 2}), env=base,
+                         tick_secs=0.2, start_timeout=60)
+    script = tmp_path / "serve.py"
+    script.write_text(SERVING_WORKER)
+    spec = DeploymentSpec(
+        "m", [sys.executable, str(script)], min_replicas=1,
+        max_replicas=2,
+        env={"SERVE_QUEUE_DIR": str(tmp_path / "q"),
+             "SERVE_STORE_DIR": str(tmp_path / "versions"),
+             "SERVE_LOG": str(log), "SERVE_TOTAL": str(total),
+             # Slow enough that the backlog outlasts replica 2's cold
+             # start — the grow order must land and CONVERGE mid-run.
+             "SERVE_STEP_SECS": "0.3"})
+    scaler = None
+    try:
+        sched.start()
+        tenant_id = admit_deployment(sched, spec)
+        assert sched.tenant_state(tenant_id) == RUNNING
+        scaler = tenant_autoscaler(
+            sched, tenant_id, spec, depth_fn=queue.depth,
+            interval=0.2, cooldown=600,   # no shrink mid-run
+            up_qdepth=4.0, down_qdepth=0.5)
+        ids = [queue.submit({"x": i}, req_id="r%03d" % i)
+               for i in range(total)]
+        scaler.start()
+        driver = sched.tenant_driver(tenant_id)
+        assert driver is not None
+        # The burst drives a grow order and the order CONVERGES: a
+        # second real worker process comes up and takes traffic.
+        assert _wait_for(lambda: driver.live_worker_count() == 2,
+                         timeout=120, interval=0.25), (
+            scaler.decisions, _lines(log))
+        assert any(d["to"] == 2 for d in scaler.decisions)
+        assert _wait_for(lambda: scaler.last_scale_up_secs is not None,
+                         timeout=30)
+        assert _wait_for(lambda: sched.tenant_state(tenant_id) == DONE,
+                         timeout=240, interval=0.25), (
+            "tenant=%s log=%r" % (sched.tenant_state(tenant_id),
+                                  _lines(log)))
+        assert queue.done_count() == total
+        for i, rid in enumerate(ids):
+            result = queue.result(rid)
+            assert result is not None and result["y"] == i * 2
+        # Both replicas really served (two ENTER lines, two DONEs).
+        lines = _lines(log)
+        assert len([l for l in lines if l.startswith("ENTER")]) >= 2
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        sched.stop(timeout=30)
