@@ -44,15 +44,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _percentile(sorted_vals, frac):
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(frac * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
-
-
 def run(args):
+    from horovod_tpu.common import metrics
     from horovod_tpu.serving import (Autoscaler, ReplicaSet, Router,
                                      VersionStore)
 
@@ -82,7 +75,6 @@ def run(args):
         deployment=args.deployment,
         interval=0.05, cooldown=0.5)
 
-    latencies = []
     lat_lock = threading.Lock()
     outcomes = {"ok": 0, "deadline": 0, "dropped": 0}
     per_request = args.requests // args.clients
@@ -93,13 +85,10 @@ def run(args):
         for i in range(n):
             req = router.serve(args.deployment, {"i": i},
                                timeout_s=args.timeout_s)
-            outcome = req.outcome if req.done else "deadline"
-            mine.append((outcome, time.monotonic() - req.arrival))
+            mine.append(req.outcome if req.done else "deadline")
         with lat_lock:
-            for outcome, lat in mine:
+            for outcome in mine:
                 outcomes[outcome] = outcomes.get(outcome, 0) + 1
-                if outcome == "ok":
-                    latencies.append(lat)
 
     t0 = time.monotonic()
     rset.start(1)           # cold start: 1 replica, autoscaler grows it
@@ -122,7 +111,12 @@ def run(args):
     scaler.stop()
     rset.stop()
 
-    latencies.sort()
+    # p50/p99 from the router's own serving_request_seconds histogram
+    # via the shared log2-bucket estimator (common/metrics.py
+    # approx_quantile) — the same series an operator scrapes, instead
+    # of bench-local percentile math over a private latency list.
+    snap = metrics.snapshot()
+    lat_labels = {"deployment": args.deployment}
     ok = outcomes.get("ok", 0)
     summary = {
         "metric": "serving_tokens_per_sec",
@@ -132,8 +126,10 @@ def run(args):
         "ok": ok,
         "deadline": outcomes.get("deadline", 0),
         "dropped": outcomes.get("dropped", 0),
-        "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 3),
-        "p99_ms": round(_percentile(latencies, 0.99) * 1e3, 3),
+        "p50_ms": round(metrics.approx_quantile(
+            snap, "serving_request_seconds", 0.50, lat_labels) * 1e3, 3),
+        "p99_ms": round(metrics.approx_quantile(
+            snap, "serving_request_seconds", 0.99, lat_labels) * 1e3, 3),
         "cold_start_s": round(rset.cold_start_seconds() or 0.0, 4),
         "wall_s": round(wall, 3),
         "replica_versions": versions,

@@ -1,0 +1,382 @@
+"""Straggler-mitigation A/B + plan-staleness retune harness (ISSUE 12).
+
+The observe→decide→act certification: faultline ``delay`` injections at
+the multihost dispatch seam (``mh.drain.record`` — the delayed rank
+dispatches its negotiated program late, so every peer's
+``mh_collective_seconds`` window inflates by the wait while the
+straggler's own stays the fleet minimum: the arrival-lag signature the
+skew observatory scores) drive two measured scenarios:
+
+* **Straggler A/B** — a real 2-proc elastic multihost world with one
+  host delayed 150 ms per collective.  Arm A (unmitigated,
+  ``HOROVOD_STRAGGLER_THRESHOLD=0``): every step crawls at the
+  straggler's pace for the whole run.  Arm B (mitigated,
+  ``HOROVOD_STRAGGLER_ACTION=drain``): the driver's observatory
+  detects the sustained skew and drains the straggler through the r10
+  planned-removal path (commit + spill + drain exit code, no
+  blacklist); the injection is conditioned ``@epoch=1``, so the
+  FRESH process that respawns into the re-formed world is healthy and
+  throughput recovers to the uninjected rate.  The headline is the
+  tail steps/s ratio (mitigated >= 1.3x unmitigated is the acceptance
+  floor; in practice the recovery is the full delay multiple).
+
+* **Plan staleness** — a 2-proc elastic multihost world with a plan
+  entry pinned for the probe class; the delay arms ``@after=N`` so the
+  class records a healthy baseline first, then drifts.  Every rank
+  calls ``plancache.check_plan_staleness()`` each step: rank 0's
+  tracker trips, the verdict rides the rendezvous KV, and BOTH ranks
+  invalidate the class at the same check index (printed and compared
+  here — the SPMD-identical requirement), bump
+  ``plan_staleness_total`` exactly once, and re-arm the tuner
+  (``retune_pending``); the re-armed class is then actually re-swept
+  through ``tune_collective_plans``.
+
+Reports one JSON summary line (bench idiom) with a self-attributing
+``levers.straggler`` block.  CPU smoke (the CI fault-smoke leg):
+
+    JAX_PLATFORMS=cpu python benchmarks/straggler_ab.py --quick
+"""
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEP_RE = re.compile(r"<stdout>STEP (\d+) ([0-9.]+)")
+
+AB_WORKER = """
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(batch=0)
+
+@elastic.run
+def train(state):
+    while state.batch < %(steps)d:
+        hvd.allreduce(np.ones(256, np.float32), op=hvd.Sum,
+                      name="b%%d" %% state.batch)
+        state.batch += 1
+        print("STEP %%d %%.6f" %% (state.batch, time.monotonic()),
+              flush=True)
+        state.commit()
+    print("DONE rank=%%d size=%%d" %% (hvd.rank(), hvd.size()),
+          flush=True)
+
+train(state)
+"""
+
+STALE_WORKER = """
+import json, os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.common import metrics
+from horovod_tpu.utils import plancache
+
+hvd.init()
+state = elastic.ObjectState(batch=0)
+
+@elastic.run
+def train(state):
+    ctl = plancache._plane.controller
+    assert ctl is not None, "plan controller missing (no KV world?)"
+    # A "cached tuned plan" for the probe class: route it, pin it, and
+    # let drift invalidate exactly this entry.
+    ctl.pin("allreduce", "%(cls)s", {"path": "flat", "codec": "none"})
+    verdicts = []
+    while state.batch < %(steps)d:
+        hvd.allreduce(np.ones(%(elems)d, np.float32), op=hvd.Sum,
+                      name="probe")
+        state.batch += 1
+        v = plancache.check_plan_staleness(timeout=120)
+        if v is not None:
+            verdicts.append(dict(v, batch=state.batch))
+            print("STALE_VERDICT %%s" %% json.dumps(
+                {"op": v["op"], "size_class": v["size_class"],
+                 "apply_at": v["apply_at"]}, sort_keys=True), flush=True)
+        state.commit()
+    trips = metrics.series_sum("plan_staleness_total")
+    assert trips == 1.0, "expected exactly one staleness trip, got %%s" %% trips
+    assert len(verdicts) == 1, verdicts
+    pending = plancache.retune_pending()
+    assert pending == [("allreduce", "%(cls)s")], pending
+    # Re-arm is real: sweep the stale class and prove the tuner
+    # actually re-sampled it (plan_tune_samples_total moves).
+    retune = plancache.consume_retune()
+    plancache.tune_collective_plans(
+        sizes_bytes=[%(nbytes)d], ops=[op for op, _cls in retune],
+        iters=1, samples_per_class=1)
+    samples = metrics.series_sum("plan_tune_samples_total")
+    assert samples > 0, "re-armed class was never re-swept"
+    print("STALE_OK rank=%%d trips=%%d samples=%%d"
+          %% (hvd.rank(), int(trips), int(samples)), flush=True)
+
+train(state)
+"""
+
+
+def _env(extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_RANK", None)
+    env.pop("HOROVOD_ELASTIC_DRIVER_ADDR", None)
+    env.pop("HVD_TPU_FAULT", None)
+    env.update(extra)
+    return env
+
+
+def _killpg(proc, sig):
+    try:
+        os.killpg(os.getpgid(proc.pid), sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+
+
+def _run_world(script_text, env, timeout, min_np, max_np=2):
+    """One elastic multihost world under the runner; on timeout the
+    WHOLE tree is torn down (SIGTERM the runner's group so its driver
+    can terminate the workers, then SIGKILL stragglers) — a leaked
+    2-proc jax world would poison every later arm's timing on a small
+    box."""
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as f:
+        f.write(script_text)
+        script = f.name
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner", "--multihost",
+         "-H", "127.0.0.1:1,127.0.0.2:1",
+         "--min-np", str(min_np), "--max-np", str(max_np),
+         sys.executable, script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _killpg(proc, signal.SIGTERM)  # let the driver reap its world
+        try:
+            out, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            _killpg(proc, signal.SIGKILL)
+            proc.kill()
+            out, err = proc.communicate()
+        dump = tempfile.mkstemp(prefix="straggler-ab-timeout-",
+                                suffix=".log")[1]
+        with open(dump, "w") as f:
+            f.write(out + "\n=== stderr ===\n" + err)
+        raise SystemExit(
+            "straggler_ab: world timed out after %gs (full log: %s)"
+            "\n%s\n%s" % (timeout, dump, out[-4000:], err[-4000:]))
+    finally:
+        os.unlink(script)
+    return types.SimpleNamespace(returncode=proc.returncode,
+                                 stdout=out, stderr=err)
+
+
+def _tail_rate(out, host="127.0.0.1", tail=8):
+    """Steps/s over the newest ``tail`` STEP stamps of one host's
+    worker — the recovered-state rate for the mitigated arm, the
+    steady injected rate for the unmitigated one."""
+    stamps = [float(m.group(2)) for line in out.splitlines()
+              if line.startswith("[%s:0]" % host)
+              for m in [STEP_RE.search(line)] if m]
+    if len(stamps) < max(tail, 2):
+        return 0.0, len(stamps)
+    window = stamps[-tail:]
+    span = window[-1] - window[0]
+    return (len(window) - 1) / max(span, 1e-9), len(stamps)
+
+
+def run_straggler_ab(args):
+    from horovod_tpu.common import metrics
+
+    arms = {}
+    events_dirs = {}
+    for arm, mitigated in (("unmitigated", False), ("mitigated", True)):
+        events_dir = tempfile.mkdtemp(prefix="straggler-%s-" % arm)
+        events_dirs[arm] = events_dir
+        env = _env({
+            # The dispatch-seam delay on one host, epoch 1 only: the
+            # mitigated arm's respawned (epoch 2) process is healthy,
+            # so the A/B measures recovery, not mere removal.
+            "HVD_TPU_FAULT":
+                "mh.drain.record:delay:%g@host=127.0.0.2@epoch=1"
+                % args.delay_s,
+            "HOROVOD_METRICS_DIR": events_dir,
+            "HOROVOD_STRAGGLER_WINDOW_SECS": str(args.window_secs),
+            "HOROVOD_STRAGGLER_THRESHOLD":
+                str(args.threshold) if mitigated else "0",
+            "HOROVOD_STRAGGLER_ACTION": "drain" if mitigated
+                                        else "observe",
+            # A real drain window: without it ManagedProcess's default
+            # 5 s SIGTERM->SIGKILL escalation can beat the straggler's
+            # commit+notice teardown and turn the planned removal into
+            # a messy kill.
+            "HOROVOD_PREEMPT_GRACE_SECS": "20",
+        })
+        t0 = time.monotonic()
+        proc = _run_world(AB_WORKER % {"steps": args.steps}, env,
+                          args.arm_timeout, min_np=1)
+        wall = time.monotonic() - t0
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            raise SystemExit("straggler_ab: %s arm failed (rc=%d)"
+                             % (arm, proc.returncode))
+        rate, steps_seen = _tail_rate(proc.stdout, tail=args.tail)
+        arms[arm] = {"tail_steps_per_sec": round(rate, 2),
+                     "steps_seen": steps_seen,
+                     "wall_s": round(wall, 2)}
+
+    # The mitigated arm must have actually closed the loop: a
+    # straggler_detected journal event (the driver's observatory) and
+    # a drained planned removal, correlated through the merged reader.
+    kinds = {}
+    detection = None
+    for rec in metrics.iter_events(events_dirs["mitigated"],
+                                   merged=True):
+        kinds[rec.get("kind")] = kinds.get(rec.get("kind"), 0) + 1
+        if rec.get("kind") == "straggler_detected" and detection is None:
+            detection = rec
+    if detection is None or not kinds.get("drained"):
+        raise SystemExit(
+            "straggler_ab: mitigated arm closed no loop (events seen: "
+            "%s)" % kinds)
+    speedup = (arms["mitigated"]["tail_steps_per_sec"]
+               / max(arms["unmitigated"]["tail_steps_per_sec"], 1e-9))
+    return {
+        "unmitigated_steps_per_sec":
+            arms["unmitigated"]["tail_steps_per_sec"],
+        "mitigated_steps_per_sec":
+            arms["mitigated"]["tail_steps_per_sec"],
+        "speedup": round(speedup, 2),
+        "arms": arms,
+        "detection": {
+            "rank": detection.get("rank"),
+            "score": detection.get("score"),
+            "action": detection.get("action"),
+            "sustained_s": detection.get("sustained_s"),
+            "group": detection.get("group"),
+        },
+        "events": kinds,
+    }
+
+
+def run_staleness(args):
+    elems = 16384                       # 64 KiB f32 -> class "65536"
+    nbytes = elems * 4
+    cls = "65536"
+    env = _env({
+        "HVD_TPU_FAULT":
+            "mh.drain.record:delay:%g@host=127.0.0.2@after=%d"
+            % (args.stale_delay_s, args.stale_after),
+        "HOROVOD_PLAN_CACHE": "1",
+        "HOROVOD_PLAN_AUTOTUNE": "1",
+        # Headroom over this box's natural CPU-collective jitter: the
+        # injected delay is ~10-30x the healthy mean, noise is ~2-3x.
+        "HOROVOD_PLAN_STALENESS_RATIO": str(args.stale_ratio),
+    })
+    proc = _run_world(
+        STALE_WORKER % {"steps": args.stale_steps, "elems": elems,
+                        "nbytes": nbytes, "cls": cls},
+        env, args.arm_timeout, min_np=2)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit("straggler_ab: staleness leg failed (rc=%d)"
+                         % proc.returncode)
+    verdicts = {}
+    for line in proc.stdout.splitlines():
+        m = re.search(r"\[(127\.0\.0\.\d+):0\]<stdout>STALE_VERDICT (.*)",
+                      line)
+        if m:
+            verdicts[m.group(1)] = m.group(2).strip()
+    oks = len(re.findall(r"STALE_OK rank=\d+", proc.stdout))
+    if len(verdicts) != 2 or len(set(verdicts.values())) != 1:
+        sys.stderr.write(proc.stdout)
+        raise SystemExit(
+            "straggler_ab: staleness verdict not SPMD-identical "
+            "across ranks: %s" % verdicts)
+    if oks != 2:
+        sys.stderr.write(proc.stdout)
+        raise SystemExit("straggler_ab: %d/2 ranks passed the "
+                         "staleness assertions" % oks)
+    return {
+        "verdict": json.loads(next(iter(verdicts.values()))),
+        "spmd_identical": True,
+        "ranks_ok": oks,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--steps", type=int, default=60,
+                   help="batches per A/B arm")
+    p.add_argument("--delay-s", type=float, default=0.15,
+                   help="injected per-collective dispatch delay")
+    p.add_argument("--threshold", type=float, default=2.0)
+    p.add_argument("--window-secs", type=float, default=2.0,
+                   help="sustained-skew window (small: the harness "
+                        "wants detection in seconds, not minutes)")
+    p.add_argument("--tail", type=int, default=8,
+                   help="STEP stamps in the tail-rate window")
+    p.add_argument("--stale-steps", type=int, default=26)
+    p.add_argument("--stale-after", type=int, default=14,
+                   help="healthy groups before the drift injection "
+                        "arms (init-time collectives consume a few "
+                        "fires too; the rest is the baseline window)")
+    p.add_argument("--stale-delay-s", type=float, default=0.3)
+    p.add_argument("--stale-ratio", type=float, default=3.5)
+    p.add_argument("--arm-timeout", type=float, default=420.0)
+    p.add_argument("--skip-ab", action="store_true")
+    p.add_argument("--skip-staleness", action="store_true")
+    p.add_argument("--quick", action="store_true",
+                   help="CI smoke: fewer steps, shorter windows")
+    args = p.parse_args()
+    if args.quick:
+        args.steps = min(args.steps, 40)
+        args.delay_s = min(args.delay_s, 0.12)
+        args.window_secs = min(args.window_secs, 1.5)
+        args.stale_steps = min(args.stale_steps, 24)
+        args.stale_after = min(args.stale_after, 12)
+
+    summary = {
+        "metric": "straggler_mitigation_speedup",
+        "unit": "x",
+        "levers": {"straggler": {
+            "site": "mh.drain.record",
+            "delay_s": args.delay_s,
+            "threshold": args.threshold,
+            "window_secs": args.window_secs,
+            "action": "drain",
+            "staleness_ratio": args.stale_ratio,
+            "stale_delay_s": args.stale_delay_s,
+        }},
+    }
+    if not args.skip_ab:
+        ab = run_straggler_ab(args)
+        summary.update(ab)
+        summary["value"] = ab["speedup"]
+    if not args.skip_staleness:
+        summary["plan_staleness"] = run_staleness(args)
+    print(json.dumps(summary))
+    if not args.skip_ab and summary["value"] < 1.3:
+        raise SystemExit(
+            "straggler_ab: mitigated %.2f steps/s is not >= 1.3x the "
+            "unmitigated %.2f steps/s"
+            % (summary["mitigated_steps_per_sec"],
+               summary["unmitigated_steps_per_sec"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -451,6 +451,10 @@ class LintConfig:
     # the metrics/spill/rpc knobs are consumed before hvd.init().
     bootstrap_env_files: Sequence[str] = (
         "horovod_tpu/common/metrics.py",
+        # Skew observatory (ISSUE 12): the straggler-detection knobs
+        # and the plan-staleness ratio are read by the elastic
+        # driver's observe loop, pre-Config by design.
+        "horovod_tpu/common/skew.py",
         "horovod_tpu/utils/timeline.py",
         "horovod_tpu/elastic/spill.py",
         "horovod_tpu/elastic/scheduler.py",

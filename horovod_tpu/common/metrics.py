@@ -186,6 +186,26 @@ NAMES: Dict[str, Tuple[str, str]] = {
     "serving_request_seconds": (
         "histogram", "arrival-to-completion latency of one inference "
                      "request, labeled deployment (p50/p99 SLO series)"),
+    # -- skew observatory (online straggler detection + plan staleness,
+    #    common/skew.py; the elastic driver feeds it from the fleet
+    #    /metrics pull and serves GET /skew from it) --
+    "straggler_score": (
+        "gauge", "per-rank arrival-lag skew vs the fleet median, "
+                 "labeled rank (1.0 = at the median; in a synchronous "
+                 "collective the straggler is the member everyone "
+                 "waits FOR, so its own dispatch-to-completion is the "
+                 "fleet minimum and its score = median/own spikes)"),
+    "straggler_detections_total": (
+        "counter", "sustained-skew straggler detections, labeled rank "
+                   "+ action (observe|shrink|drain — the response the "
+                   "observatory actually took)"),
+    "plan_staleness_total": (
+        "counter", "cached-plan entries declared STALE because the "
+                   "observed per-class latency drifted past "
+                   "HOROVOD_PLAN_STALENESS_RATIO x the recorded "
+                   "baseline, labeled op + size_class (each trip "
+                   "invalidates the class's routing entry and re-arms "
+                   "the plan tuner exactly once)"),
     # -- cross-cutting --
     "stall_detected_total": (
         "counter", "stall-inspector warnings (a collective outlived "
@@ -337,6 +357,19 @@ class Registry:
                     fam.series[key] = series
             return _Handle(self, series, kind)
 
+    def remove(self, name: str, labels: Dict[str, Any]) -> bool:
+        """Drop one series (exact label match) from a family — for
+        gauges keyed by a MEMBER identity (``straggler_score{rank=}``)
+        whose subject left the fleet: a departed rank's last value
+        must not be scraped forever.  Counters/histograms are
+        cumulative by contract and should not normally be removed."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return False
+            return fam.series.pop(key, None) is not None
+
     def counter(self, name: str, **labels) -> _Handle:
         return self._get("counter", name, labels)
 
@@ -392,6 +425,10 @@ def histogram(name: str, **labels) -> _Handle:
     return _registry.histogram(name, **labels)
 
 
+def remove_series(name: str, **labels) -> bool:
+    return _registry.remove(name, labels)
+
+
 def snapshot() -> Dict[str, Any]:
     return _registry.snapshot()
 
@@ -414,6 +451,53 @@ def series_sum(name: str, **labels) -> float:
     return sum(row.get("value", 0.0) for row in fam.get("series", ())
                if all(row.get("labels", {}).get(k) == v
                       for k, v in labels.items()))
+
+
+def approx_quantile(model: Dict[str, Any], name: str, q: float,
+                    labels: Optional[Dict[str, str]] = None) -> float:
+    """Quantile estimate from one log2-bucket histogram family in a
+    snapshot ``model``: aggregates every series whose labels contain
+    ``labels`` (subset match, like :func:`series_sum`), walks the
+    cumulative bucket counts to the ``q``-th observation, and linearly
+    interpolates inside the landing bucket — the one percentile
+    estimator every bench shares instead of re-deriving its own
+    (``serving_bw.py`` p50/p99, ``straggler_ab.py`` latency tails).
+
+    Accuracy is bounded by the bucket geometry: a value is pinned to
+    its power-of-two bucket, so the estimate is within 2x of the true
+    quantile.  Observations past the top finite bucket (they count
+    toward ``count`` but land in no bucket) clamp to the top edge.
+    Returns 0.0 when the family is absent or empty."""
+    fam = (model or {}).get(name)
+    if not fam or fam.get("kind") != "histogram":
+        return 0.0
+    labels = labels or {}
+    buckets: Dict[int, int] = {}
+    total = 0
+    for row in fam.get("series", ()):
+        if not all(row.get("labels", {}).get(k) == str(v)
+                   for k, v in labels.items()):
+            continue
+        total += int(row.get("count", 0))
+        for e, n in (row.get("buckets") or {}).items():
+            e = int(e)
+            buckets[e] = buckets.get(e, 0) + int(n)
+    if total <= 0:
+        return 0.0
+    q = min(max(float(q), 0.0), 1.0)
+    target = q * total
+    cum = 0.0
+    for e in sorted(buckets):
+        n = buckets[e]
+        if cum + n >= target:
+            hi = 2.0 ** e
+            lo = 0.0 if e <= _HIST_EXP_MIN else 2.0 ** (e - 1)
+            frac = (target - cum) / n if n else 1.0
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += n
+    # The target rank lives in the +Inf overflow: every finite edge is
+    # below it, so the top finite edge is the least-wrong answer.
+    return 2.0 ** _HIST_EXP_MAX
 
 
 # -- Prometheus text rendering --------------------------------------------
@@ -442,7 +526,14 @@ def _render_family(lines: List[str], name: str, fam: Dict[str, Any],
     for row in fam["series"]:
         labels = dict(row.get("labels") or {})
         if extra:
-            labels.update(extra)
+            for k, v in extra.items():
+                # The merge's source label must never CLOBBER a label
+                # the series already carries: straggler_score{rank=}
+                # is keyed by the SCORED rank — overwriting it with
+                # the source tag would collapse every rank's score
+                # into duplicate {rank="driver"} series (invalid
+                # exposition, meaningless data).
+                labels.setdefault(k, v)
         if fam["kind"] == "histogram":
             cum = 0
             for e, n in sorted((int(k), v) for k, v in
@@ -573,25 +664,53 @@ def event(kind: str, **fields):
                             "events count but are not journaled", exc)
 
 
-def iter_events(d: Optional[str] = None):
+def iter_events(d: Optional[str] = None, merged: bool = False):
     """Yield every journal record under ``d`` (default: the configured
-    journal dir) as dicts, across all writers, in (file, line) order —
-    the read half of the round trip, for tests and tooling."""
+    journal dir) as dicts, across all writers — the read half of the
+    round trip, for tests and tooling.
+
+    Default order is (file, line): one writer's stream at a time.
+    ``merged=True`` interleaves ALL writers into one stream sorted by
+    ``(ts, writer, seq)`` and stamps each record with its ``writer``
+    tag (the ``events-<writer>.jsonl`` filename segment), so cross-rank
+    event correlation — a drain notice against the straggler detection
+    that caused it, a fault fire against the drift it produced — needs
+    no ad-hoc per-file stitching in every consumer.  ``seq`` is only
+    per-process monotonic, so it breaks ties within a writer; across
+    writers the wall clock (and then the writer tag, for determinism)
+    orders the merge."""
     d = d if d is not None else journal_dir()
     if d is None or not os.path.isdir(d):
         return
-    for name in sorted(os.listdir(d)):
-        if not name.startswith("events-") or not name.endswith(".jsonl"):
-            continue
-        with open(os.path.join(d, name), "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except ValueError:
-                    continue  # torn final line of a killed writer
+
+    def _records():
+        for name in sorted(os.listdir(d)):
+            if not name.startswith("events-") \
+                    or not name.endswith(".jsonl"):
+                continue
+            writer = name[len("events-"):-len(".jsonl")]
+            with open(os.path.join(d, name), "r",
+                      encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield writer, json.loads(line)
+                    except ValueError:
+                        continue  # torn final line of a killed writer
+
+    if not merged:
+        for _writer, record in _records():
+            yield record
+        return
+    rows = [(record.get("ts", 0.0), writer, record.get("seq", 0), record)
+            for writer, record in _records()]
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    for ts, writer, _seq, record in rows:
+        out = dict(record)
+        out["writer"] = writer
+        yield out
 
 
 def reset():

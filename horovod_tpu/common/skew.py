@@ -1,0 +1,567 @@
+"""Skew observatory: online straggler detection + plan-staleness drift.
+
+The r11 metrics plane measures everything and the r14 plan cache
+actuates tuned operating points, but nothing connected them *online*
+(ROADMAP item 5): a wedged-but-alive host stalls every synchronous
+collective with no Horovod-level response, and a cached plan keeps
+routing long after the workload mix that tuned it has shifted.  This
+module is the observe half of the observe→decide→act loop; the elastic
+driver drives it from the same fleet snapshot pull that already feeds
+the merged ``GET /metrics`` scrape, and serves its state as
+``GET /skew`` JSON.
+
+**The arrival-lag inversion.**  In a synchronous collective the
+straggler is the member everyone waits FOR.  Each rank's
+``mh_collective_seconds`` clock starts at its OWN dispatch
+(ops/multihost.py stamps ``_metrics_t0`` when the executor pops the
+negotiated record), so the delayed rank dispatches late and completes
+with its peers — its measured latency is the fleet MINIMUM, while every
+prompt rank's window inflates by the wait.  The per-rank skew score is
+therefore ``fleet_median(window_mean) / own_window_mean``: ~1.0 at the
+median, spiking for the rank the fleet is waiting on.  (A rank that is
+slow *symmetrically* — its program leg takes longer — completes
+together with its peers and is indistinguishable by construction; the
+per-rank signal only exists for arrival lag, which is exactly the
+wedged-host failure mode.)
+
+**Detection → action.**  A score above ``HOROVOD_STRAGGLER_THRESHOLD``
+sustained for ``HOROVOD_STRAGGLER_WINDOW_SECS`` is a detection: one
+``straggler_detections_total{rank,action}`` bump, one
+``straggler_detected`` journal event (carrying the straggler's last
+collective group id for timeline correlation), and the configured
+``HOROVOD_STRAGGLER_ACTION``:
+
+* ``observe`` (default) — record only.
+* ``shrink``  — shrink the straggler's tenant share via the r13
+  ``PodScheduler.resize``+``poke`` (the driver's scheduler hook).
+* ``drain``   — remove the straggler through the r10 planned-removal
+  path (SIGTERM → commit + spill + drain exit code; no blacklist, no
+  failure count) BEFORE it stalls the world.
+
+A detection stays latched until the rank's score falls back under the
+threshold (or the rank leaves the fleet), so one sustained episode is
+one detection, not one per tick.
+
+**Plan staleness.**  :class:`ClassLatencyTracker` watches per-
+``(op, size_class)`` latency against the first stable window it saw
+(the baseline — the latency the plan's operating point was delivering
+when this world formed).  Drift past ``HOROVOD_PLAN_STALENESS_RATIO``
+bumps ``plan_staleness_total{op,size_class}`` and journals
+``plan_stale``; one class trips per pass (re-tuning is serialized by
+design), and a tripped class re-baselines so it re-arms only on
+FURTHER drift.  The observatory's tracker is the driver-side fleet
+view (observability); the worker-side actuation — invalidate the
+cached entry, re-arm the tuner, SPMD-uniform through the rendezvous
+KV — lives in ``utils/plancache.check_plan_staleness``.
+
+Analysis here is pure (snapshot models in, scores/detections out, an
+injectable clock): the elastic driver owns the pull loop and the
+actuation callbacks, tests drive synthetic fleets through it directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import metrics
+from .envutil import env_float
+
+LOG = logging.getLogger("horovod_tpu.skew")
+
+ACTIONS = ("observe", "shrink", "drain")
+
+# A window mean over fewer completions than this says more about noise
+# than about the rank; such ranks get no score this pass.
+MIN_WINDOW_COUNT = 3
+
+# Floor on a window-mean divisor: a rank whose measured latency is
+# essentially zero must produce a large-but-finite score.
+_EPS = 1e-6
+
+
+def straggler_threshold() -> float:
+    """Skew score past which a rank is straggler-suspect
+    (``HOROVOD_STRAGGLER_THRESHOLD``, default 2.0 — twice the fleet
+    median; 0 disables detection, scores still publish)."""
+    return env_float("HOROVOD_STRAGGLER_THRESHOLD", 2.0, minimum=0.0)
+
+
+def straggler_window_secs() -> float:
+    """Seconds a rank must stay past the threshold before the response
+    fires (``HOROVOD_STRAGGLER_WINDOW_SECS``, default 30 — a cold
+    compile or one slow step must not shrink a world; floor 0.5).  The
+    same window sizes the sliding statistics."""
+    return env_float("HOROVOD_STRAGGLER_WINDOW_SECS", 30.0, minimum=0.5)
+
+
+def straggler_action() -> str:
+    """Configured response to a sustained detection
+    (``HOROVOD_STRAGGLER_ACTION``: observe | shrink | drain, default
+    observe).  Strict: a typo'd action raises at first read — a
+    mitigation plane that silently observes when asked to drain is the
+    vacuous-test shape the fault plane exists to forbid."""
+    raw = (os.environ.get("HOROVOD_STRAGGLER_ACTION") or "observe")
+    action = raw.strip().lower()
+    if action not in ACTIONS:
+        raise ValueError(
+            "HOROVOD_STRAGGLER_ACTION=%r is not one of %s"
+            % (raw, list(ACTIONS)))
+    return action
+
+
+def plan_staleness_ratio() -> float:
+    """Observed-over-baseline per-class latency ratio past which a
+    cached plan entry is declared stale
+    (``HOROVOD_PLAN_STALENESS_RATIO``, default 2.0; 0 disables
+    staleness tracking)."""
+    return env_float("HOROVOD_PLAN_STALENESS_RATIO", 2.0, minimum=0.0)
+
+
+# -- snapshot readers --------------------------------------------------------
+
+def _hist_totals(model: Dict[str, Any], name: str) -> Tuple[float, float]:
+    """(sum, count) aggregated over every series of one histogram
+    family in a snapshot model."""
+    fam = (model or {}).get(name)
+    total = count = 0.0
+    if fam:
+        for row in fam.get("series", ()):
+            total += float(row.get("sum", 0.0))
+            count += float(row.get("count", 0.0))
+    return total, count
+
+
+def _gauge_value(model: Dict[str, Any], name: str) -> Optional[float]:
+    fam = (model or {}).get(name)
+    if not fam:
+        return None
+    for row in fam.get("series", ()):
+        return float(row.get("value", 0.0))
+    return None
+
+
+def _class_totals(model: Dict[str, Any]
+                  ) -> Dict[Tuple[str, str], Tuple[float, float]]:
+    """{(op, size_class): (sum, count)} from one model's
+    ``mh_collective_seconds`` family."""
+    out: Dict[Tuple[str, str], Tuple[float, float]] = {}
+    fam = (model or {}).get("mh_collective_seconds")
+    if not fam:
+        return out
+    for row in fam.get("series", ()):
+        labels = row.get("labels", {})
+        key = (labels.get("op", "?"), labels.get("size_class", "0"))
+        s, c = out.get(key, (0.0, 0.0))
+        out[key] = (s + float(row.get("sum", 0.0)),
+                    c + float(row.get("count", 0.0)))
+    return out
+
+
+def _median(values: List[float]) -> float:
+    vals = sorted(values)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+# -- per-rank sliding windows ------------------------------------------------
+
+class _RankWindow:
+    """Cumulative (ts, sum, count) samples for one rank, pruned to the
+    sliding window; the window mean is the delta between the newest
+    sample and the oldest still inside the window."""
+
+    __slots__ = ("samples", "meta", "queue_depth", "last_group_id",
+                 "above_since", "latched")
+
+    def __init__(self):
+        self.samples: List[Tuple[float, float, float]] = []
+        self.meta: Any = None
+        self.queue_depth: Optional[float] = None
+        self.last_group_id: Optional[float] = None
+        self.above_since: Optional[float] = None
+        self.latched = False
+
+    def add(self, now: float, total: float, count: float,
+            window: float):
+        self.samples.append((now, total, count))
+        cutoff = now - window
+        # Keep ONE sample at/past the cutoff so the delta spans the
+        # full window, not just its interior.
+        while len(self.samples) > 2 and self.samples[1][0] <= cutoff:
+            self.samples.pop(0)
+
+    def window_stats(self) -> Tuple[Optional[float], float]:
+        """(mean_seconds, completions) across the retained window."""
+        if len(self.samples) < 2:
+            return None, 0.0
+        t0, s0, c0 = self.samples[0]
+        t1, s1, c1 = self.samples[-1]
+        n = c1 - c0
+        if n < MIN_WINDOW_COUNT:
+            return None, n
+        return max(s1 - s0, 0.0) / n, n
+
+
+class SkewAnalyzer:
+    """Per-rank arrival-lag scores from a stream of fleet snapshot
+    pulls.  Latency source: ``mh_collective_seconds`` when any rank
+    reports completions (the multihost payload plane), else
+    ``engine_cycle_seconds`` (the in-process engine's cycle clock —
+    same inversion: the cycle that waits is the prompt rank's)."""
+
+    def __init__(self, window_secs: Optional[float] = None):
+        self.window_secs = (window_secs if window_secs is not None
+                            else straggler_window_secs())
+        self._ranks: Dict[str, _RankWindow] = {}
+        self.source = "mh_collective_seconds"
+
+    def observe(self, models: List[Tuple[str, Any, Dict[str, Any]]],
+                now: Optional[float] = None) -> Dict[str, dict]:
+        """Feed one fleet pull: ``models`` is
+        ``[(rank_label, meta, snapshot_model)]`` (``meta`` is opaque
+        actuation context — the driver passes the slot).  Returns
+        ``{rank_label: {score, window_mean_s, window_count,
+        queue_depth, last_group_id}}`` for every rank with enough
+        window data."""
+        now = time.monotonic() if now is None else now
+        # One latency family for the whole fleet: mixing families
+        # across ranks would compare clocks that measure different
+        # things.
+        use_mh = any(_hist_totals(m, "mh_collective_seconds")[1] > 0
+                     for _label, _meta, m in models)
+        source = ("mh_collective_seconds" if use_mh
+                  else "engine_cycle_seconds")
+        if source != self.source:
+            # Switching families invalidates accumulated deltas.
+            self._ranks.clear()
+            self.source = source
+        seen = set()
+        for label, meta, model in models:
+            label = str(label)
+            seen.add(label)
+            rw = self._ranks.get(label)
+            if rw is None:
+                rw = self._ranks[label] = _RankWindow()
+            total, count = _hist_totals(model, source)
+            rw.add(now, total, count, self.window_secs)
+            rw.meta = meta
+            rw.queue_depth = _gauge_value(model, "engine_queue_depth")
+            rw.last_group_id = _gauge_value(model, "engine_last_group_id")
+        # A rank that left the fleet (drained, died, resized away)
+        # drops its window — a respawn starts a fresh episode.
+        for label in [l for l in self._ranks if l not in seen]:
+            del self._ranks[label]
+
+        stats = {}
+        for label, rw in self._ranks.items():
+            mean, n = rw.window_stats()
+            if mean is not None:
+                stats[label] = (mean, n)
+        out: Dict[str, dict] = {}
+        if len(stats) >= 2:
+            med = _median([mean for mean, _n in stats.values()])
+            for label, (mean, n) in stats.items():
+                score = med / max(mean, _EPS)
+                rw = self._ranks[label]
+                out[label] = {
+                    "score": score,
+                    "window_mean_s": mean,
+                    "window_count": n,
+                    "queue_depth": rw.queue_depth,
+                    "last_group_id": rw.last_group_id,
+                }
+        return out
+
+    def rank_window(self, label: str) -> Optional[_RankWindow]:
+        return self._ranks.get(str(label))
+
+    def rank_labels(self):
+        """Labels of every rank currently IN the fleet (scored or
+        not) — the gauge-cleanup set difference runs against this."""
+        return set(self._ranks)
+
+
+# -- plan-staleness tracking -------------------------------------------------
+
+class ClassLatencyTracker:
+    """Per-``(op, size_class)`` observed-vs-expected latency drift.
+
+    The baseline ("expected") is the first window mean a class
+    delivers with at least ``min_count`` completions — the latency the
+    active plan's operating point was producing when tracking began.
+    A later window mean past ``ratio`` x baseline is a STALE trip;
+    one class trips per :meth:`update` (the worst offender).  After a
+    trip the class holds evaluation for ``settle_windows`` windows,
+    re-baselining each one, so a drift whose TRANSITION straddles a
+    window boundary (the partial window trips first, the full shift
+    lands a window later) still counts as ONE shift — "re-arms exactly
+    once"; only drift past the settled level trips again."""
+
+    def __init__(self, ratio: Optional[float] = None,
+                 min_count: int = MIN_WINDOW_COUNT,
+                 settle_windows: int = 1):
+        self.ratio = ratio if ratio is not None else plan_staleness_ratio()
+        self.min_count = max(1, int(min_count))
+        self.settle_windows = max(0, int(settle_windows))
+        # (op, cls) -> {"last": (sum, count), "baseline": float|None,
+        #               "mean": float|None, "trips": int, "hold": int}
+        self._classes: Dict[Tuple[str, str], dict] = {}
+
+    def update(self, totals: Dict[Tuple[str, str], Tuple[float, float]]
+               ) -> Optional[dict]:
+        """Feed cumulative per-class (sum, count) totals; returns the
+        single worst stale verdict
+        ``{op, size_class, baseline_s, observed_s, ratio}`` or None."""
+        if self.ratio <= 0:
+            return None
+        worst: Optional[dict] = None
+        for key, (total, count) in totals.items():
+            rec = self._classes.get(key)
+            if rec is None:
+                rec = self._classes[key] = {
+                    "last": (total, count), "baseline": None,
+                    "mean": None, "trips": 0, "hold": 0}
+                continue
+            s0, c0 = rec["last"]
+            if count < c0 or total < s0 - 1e-12:
+                # Cumulative totals REGRESSED: the population behind
+                # them changed (a rank drained/died and its lifetime
+                # sums left the fleet aggregate, or a process
+                # restarted).  Deltas against the old totals are
+                # meaningless — and freezing until counts regrow past
+                # the old level (or clamping a negative delta to a
+                # 0-mean window) would poison the baseline.  Start the
+                # class over from a fresh baseline; its trip history
+                # survives.
+                rec["last"] = (total, count)
+                rec["baseline"] = None
+                rec["mean"] = None
+                rec["hold"] = 0
+                continue
+            dn = count - c0
+            if dn < self.min_count:
+                continue  # window too thin; keep accumulating
+            mean = max(total - s0, 0.0) / dn
+            rec["last"] = (total, count)
+            rec["mean"] = mean
+            if rec["baseline"] is None:
+                rec["baseline"] = mean
+                continue
+            if rec["hold"] > 0:
+                # Settling after a trip: the shift is still landing —
+                # track it as the new expectation instead of
+                # re-tripping on its own tail.
+                rec["hold"] -= 1
+                rec["baseline"] = mean
+                continue
+            observed_ratio = mean / max(rec["baseline"], _EPS)
+            if observed_ratio > self.ratio and (
+                    worst is None or observed_ratio > worst["ratio"]):
+                worst = {"op": key[0], "size_class": key[1],
+                         "baseline_s": rec["baseline"],
+                         "observed_s": mean, "ratio": observed_ratio}
+        if worst is not None:
+            rec = self._classes[(worst["op"], worst["size_class"])]
+            rec["trips"] += 1
+            # Re-baseline at the drifted level and hold evaluation
+            # while the shift settles: the SAME shift must never
+            # re-trip; only drift past the settled level re-arms.
+            rec["baseline"] = worst["observed_s"]
+            rec["hold"] = self.settle_windows
+        return worst
+
+    def describe(self) -> Dict[str, dict]:
+        out = {}
+        for (op, cls), rec in sorted(self._classes.items()):
+            out["%s/%s" % (op, cls)] = {
+                "baseline_s": rec["baseline"],
+                "window_mean_s": rec["mean"],
+                "stale_trips": rec["trips"]}
+        return out
+
+
+# -- the observatory ---------------------------------------------------------
+
+class SkewObservatory:
+    """Detection + actuation state over a :class:`SkewAnalyzer` and a
+    :class:`ClassLatencyTracker`; the elastic driver feeds it from the
+    fleet snapshot pull and installs its :meth:`describe` as the
+    ``GET /skew`` provider.
+
+    ``drain_fn(meta)`` / ``shrink_fn(meta)`` are the actuation
+    callbacks (``meta`` is whatever the feeder attached per rank — the
+    driver passes the slot); both return truthy on an accepted order.
+    Thread-safe: the driver's skew loop writes, the HTTP handler
+    reads."""
+
+    def __init__(self, threshold: Optional[float] = None,
+                 window_secs: Optional[float] = None,
+                 action: Optional[str] = None,
+                 drain_fn: Optional[Callable[[Any], bool]] = None,
+                 shrink_fn: Optional[Callable[[Any], bool]] = None,
+                 staleness_ratio: Optional[float] = None):
+        self.threshold = (threshold if threshold is not None
+                          else straggler_threshold())
+        self.window_secs = (window_secs if window_secs is not None
+                            else straggler_window_secs())
+        self.action = action if action is not None else straggler_action()
+        self._drain_fn = drain_fn
+        self._shrink_fn = shrink_fn
+        self._lock = threading.Lock()
+        self.analyzer = SkewAnalyzer(self.window_secs)
+        self.plan = ClassLatencyTracker(staleness_ratio)
+        self._scores: Dict[str, dict] = {}
+        self._detections: List[dict] = []
+        self._published: set = set()  # ranks with a live score gauge
+        self._shrink_warned = False
+
+    # -- one observation pass ----------------------------------------
+
+    def observe(self, models: List[Tuple[str, Any, Dict[str, Any]]],
+                now: Optional[float] = None) -> List[dict]:
+        """Feed one fleet pull; publishes ``straggler_score{rank}``,
+        runs sustained-threshold detection, fires the configured
+        action, and updates the plan-staleness tracker.  Returns the
+        detections fired THIS pass."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            scores = self.analyzer.observe(models, now)
+            # A departed rank's last score must not be scraped
+            # forever: drop its gauge series when it leaves the fleet
+            # (mirrors /skew, which only lists live ranks).
+            for label in self._published - self.analyzer.rank_labels():
+                metrics.remove_series("straggler_score", rank=label)
+                self._published.discard(label)
+            fired = []
+            for label, stat in scores.items():
+                metrics.gauge("straggler_score",
+                              rank=label).set(stat["score"])
+                self._published.add(label)
+                rw = self.analyzer.rank_window(label)
+                if self.threshold <= 0 or rw is None:
+                    continue
+                if stat["score"] < self.threshold:
+                    rw.above_since = None
+                    rw.latched = False
+                    continue
+                if rw.latched:
+                    continue  # one detection per sustained episode
+                if rw.above_since is None:
+                    rw.above_since = now
+                if now - rw.above_since < self.window_secs:
+                    continue
+                rw.latched = True
+                detection = dict(stat, rank=label, action=self.action,
+                                 ts=time.time(),
+                                 sustained_s=now - rw.above_since)
+                fired.append((detection, rw.meta))
+            self._scores = scores
+            self._observe_plan(models)
+        for detection, meta in fired:
+            self._fire(detection, meta)
+        return [d for d, _meta in fired]
+
+    def _observe_plan(self, models) -> Optional[dict]:
+        """Fleet per-class latency into the staleness tracker; a trip
+        journals ``plan_stale{scope=fleet}`` and shows in ``/skew``.
+        It deliberately does NOT bump ``plan_staleness_total``: that
+        counter means "a cached entry was invalidated and re-armed"
+        and is owned by the worker-side actuation
+        (``plancache.check_plan_staleness``) — a driver-side bump
+        would double-count one shift against a trip that invalidates
+        nothing."""
+        totals: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        for _label, _meta, model in models:
+            for key, (s, c) in _class_totals(model).items():
+                s0, c0 = totals.get(key, (0.0, 0.0))
+                totals[key] = (s0 + s, c0 + c)
+        verdict = self.plan.update(totals)
+        if verdict is not None:
+            metrics.event("plan_stale", scope="fleet", **verdict)
+            LOG.warning(
+                "plan staleness: %s/%s latency drifted %.1fx past its "
+                "baseline (%.6fs -> %.6fs); cached plan entry is stale",
+                verdict["op"], verdict["size_class"], verdict["ratio"],
+                verdict["baseline_s"], verdict["observed_s"])
+        return verdict
+
+    def _fire(self, detection: dict, meta):
+        label = detection["rank"]
+        metrics.counter("straggler_detections_total", rank=label,
+                        action=self.action).inc()
+        metrics.event("straggler_detected", rank=label,
+                      score=detection["score"], action=self.action,
+                      sustained_s=detection["sustained_s"],
+                      group=detection.get("last_group_id"),
+                      meta=str(meta) if meta is not None else None)
+        LOG.warning(
+            "straggler detected: rank %s score %.1fx the fleet median "
+            "for %.1fs (window mean %.6fs); action=%s", label,
+            detection["score"], detection["sustained_s"],
+            detection["window_mean_s"], self.action)
+        outcome = "observed"
+        try:
+            if self.action == "drain" and self._drain_fn is not None:
+                outcome = ("drained" if self._drain_fn(meta)
+                           else "drain_refused")
+            elif self.action == "shrink":
+                if self._shrink_fn is not None:
+                    outcome = ("shrunk" if self._shrink_fn(meta)
+                               else "shrink_refused")
+                elif not self._shrink_warned:
+                    self._shrink_warned = True
+                    LOG.warning(
+                        "HOROVOD_STRAGGLER_ACTION=shrink with no pod "
+                        "scheduler attached: shrink needs the r13 "
+                        "PodScheduler (deployments-as-tenants); "
+                        "observing only")
+        except Exception:  # noqa: BLE001 — actuation must not kill the loop
+            LOG.exception("straggler %s actuation failed", self.action)
+            outcome = "error"
+        detection["outcome"] = outcome
+        with self._lock:
+            if outcome == "shrunk":
+                # A shed is a preference, not a guarantee — if the
+                # wedged rank survived the placement change, the
+                # observatory must be able to escalate: re-arm the
+                # episode so ANOTHER full sustained window can shed
+                # again (converging to the tenant's min_np floor,
+                # where shrink refuses and the refusal is recorded).
+                rw = self.analyzer.rank_window(label)
+                if rw is not None:
+                    rw.latched = False
+                    rw.above_since = None
+            self._detections.append(detection)
+            del self._detections[:-32]  # bound the history
+
+    # -- exposition ---------------------------------------------------
+
+    def describe(self) -> dict:
+        """The ``GET /skew`` JSON model."""
+        with self._lock:
+            ranks = {}
+            for label, stat in self._scores.items():
+                rw = self.analyzer.rank_window(label)
+                ranks[label] = dict(
+                    stat,
+                    above_threshold=(self.threshold > 0
+                                     and stat["score"] >= self.threshold),
+                    latched=bool(rw is not None and rw.latched))
+            return {
+                "ts": time.time(),
+                "threshold": self.threshold,
+                "window_secs": self.window_secs,
+                "action": self.action,
+                "source": self.analyzer.source,
+                "ranks": ranks,
+                "detections": list(self._detections),
+                "plan": {
+                    "staleness_ratio": self.plan.ratio,
+                    "classes": self.plan.describe(),
+                },
+            }

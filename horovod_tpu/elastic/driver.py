@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..common import faultline, metrics
+from ..common import faultline, metrics, skew
 from ..common.envutil import env_int
 from ..runner import safe_shell_exec, util
 from ..runner.http_server import RendezvousServer
@@ -104,6 +104,18 @@ class ElasticDriver:
         # merges this driver's registry with every live worker's
         # snapshot (one rank label per source).
         self._kv.metrics_provider = self._metrics_text
+        # Skew observatory (common/skew.py): the observe half of the
+        # telemetry control loop.  The skew loop feeds it the same
+        # worker snapshots the /metrics merge pulls; a sustained
+        # straggler triggers the configured action — drain rides the
+        # r10 planned-removal path, shrink goes through the pod
+        # scheduler's hook (set by PodScheduler._make_driver on
+        # tenant drivers).  GET /skew serves its state as JSON.
+        self.scheduler_shrink = None  # set by the pod scheduler
+        self._observatory = skew.SkewObservatory(
+            drain_fn=self._straggler_drain,
+            shrink_fn=self._straggler_shrink)
+        self._kv.skew_provider = self._skew_text
 
         # World state below is shared between the run() reap loop
         # ("caller"), the discovery thread, and the message-server
@@ -724,10 +736,20 @@ class ElasticDriver:
             for slot in self._target:
                 wait = self._spawn_backoff.get(
                     slot, self.respawn_backoff_base)
+                # A slot that drained THIS pass must wait out the epoch
+                # bump below (the failure path already does, via the
+                # failed_hosts exclusion): a same-pass respawn can
+                # rendezvous into the still-PUBLISHED stale epoch,
+                # resolve the old world's coordinator, and its
+                # new-incarnation connect FATALs the surviving members
+                # mid-recovery (seen live under the straggler-drain
+                # e2e).  The next reap pass respawns it into the
+                # re-formed world.
                 if slot not in self._procs and slot not in self._stopped \
                         and slot not in self._succeeded \
                         and slot not in self._pending_spawns \
                         and slot[0] not in failed_hosts \
+                        and slot not in drained_slots \
                         and now - self._spawn_attempts.get(slot, 0) >= wait:
                     self._spawn_attempts[slot] = now
                     self._spawn_backoff[slot] = min(
@@ -779,14 +801,14 @@ class ElasticDriver:
 
     # -- entry -------------------------------------------------------------
 
-    def _metrics_text(self) -> str:
-        """Fleet-wide Prometheus scrape: this driver's registry merged
-        with every registered worker's snapshot (pulled over the
-        notification service; a dead or mid-respawn worker is skipped —
-        a scrape must never block on the control plane's health)."""
-        models = [("driver", metrics.snapshot())]
+    def _pull_worker_snapshots(self):
+        """Every live worker's metrics snapshot over the notification
+        service: ``[(rank_label, slot, model)]``.  A dead or
+        mid-respawn worker is skipped — neither the /metrics scrape
+        nor the skew tick may block on the control plane's health."""
         with self._lock:
             addrs = list(self._worker_addrs.items())
+            live = set(self._procs)
 
         def pull(slot, addr):
             try:
@@ -801,20 +823,105 @@ class ElasticDriver:
         # the scrape would exceed Prometheus' own timeout exactly
         # during the failure event it exists to observe.
         from concurrent.futures import ThreadPoolExecutor
+        addrs = [(s, a) for s, a in addrs if not live or s in live]
         if addrs:
             with ThreadPoolExecutor(
                     max_workers=min(len(addrs), 16)) as pool:
                 results = list(pool.map(lambda sa: pull(*sa), addrs))
         else:
             results = []
+        models = []
         for slot, resp in results:
             if not isinstance(resp, dict) or not resp.get("snapshot"):
                 continue
             rank = resp.get("rank")
             label = str(rank) if rank is not None \
                 else "%s:%d" % (slot[0], slot[1])
-            models.append((label, resp["snapshot"]))
+            models.append((label, slot, resp["snapshot"]))
+        return models
+
+    def _metrics_text(self) -> str:
+        """Fleet-wide Prometheus scrape: this driver's registry merged
+        with every registered worker's snapshot."""
+        models = [("driver", metrics.snapshot())]
+        models.extend((label, model) for label, _slot, model
+                      in self._pull_worker_snapshots())
         return metrics.render_merged(models)
+
+    # -- skew observatory (straggler detection / plan staleness) -----------
+
+    def _skew_text(self) -> str:
+        """``GET /skew``: the observatory's latest fleet view as JSON
+        (the skew loop keeps it fresh; the handler never pulls — a
+        scrape must not trigger actuation or block on workers)."""
+        import json
+        return json.dumps(self._observatory.describe(), default=str)
+
+    def _skew_tick(self):
+        """One observe pass: pull worker snapshots, feed the
+        observatory (scores + sustained-detection + the configured
+        action + plan-staleness tracking)."""
+        models = self._pull_worker_snapshots()
+        if models:
+            self._observatory.observe(models)
+
+    def _skew_loop(self):
+        # Cadence: a few samples per detection window, bounded so a
+        # tiny test window cannot spin the control plane and a huge
+        # one still refreshes /skew.
+        cadence = min(max(self._observatory.window_secs / 4.0, 0.5), 5.0)
+        while not self._shutdown.is_set():
+            self._shutdown.wait(cadence)
+            if self._shutdown.is_set():
+                return
+            try:
+                self._skew_tick()
+            except Exception:  # noqa: BLE001 — observing must not kill
+                LOG.exception("skew tick failed; retrying next tick")
+
+    def _straggler_drain(self, slot) -> bool:
+        """Actuate a straggler detection through the r10 planned-
+        removal path: mark the slot draining, then SIGTERM it — the
+        worker finishes its in-flight step, commits (+spills) and
+        exits with the drain code inside the grace window; the reap
+        books a drain (no blacklist, no failure count) and the epoch
+        bump re-forms the world without the straggler.  Its host stays
+        discovered, so a FRESH process respawns into the next epoch —
+        mitigation removes the wedged incarnation, not the capacity."""
+        if not isinstance(slot, tuple):
+            return False
+        with self._lock:
+            mp = self._procs.get(slot)
+            if mp is None or slot in self._draining \
+                    or slot in self._stopped:
+                return False
+            self._draining.add(slot)
+            # A straggler drain is not a spawn failure: the slot's
+            # next spawn starts from the base interval.
+            self._spawn_backoff.pop(slot, None)
+        metrics.event("straggler_drain_order", host=slot[0],
+                      slot=slot[1], tenant=self.tenant_id)
+        LOG.warning("draining straggler %s:%d (planned removal — the "
+                    "world re-forms without it before it stalls a "
+                    "collective)", slot[0], slot[1])
+        # Off-lock: terminate waits out the shared grace window.
+        if mp.poll() is None:
+            safe_shell_exec.terminate_all([mp])
+        return True
+
+    def _straggler_shrink(self, slot) -> bool:
+        """Actuate via the pod scheduler: shrink this tenant's share
+        by one slot (resize + poke, wired by
+        ``PodScheduler._make_driver``), naming the straggler's HOST so
+        the packer sheds from it rather than from an arbitrary healthy
+        slot.  Standalone drivers have no scheduler to shrink through
+        — the observatory warns and keeps observing."""
+        if self.scheduler_shrink is None:
+            return False
+        host, idx = slot if isinstance(slot, tuple) else (None, -1)
+        metrics.event("straggler_shrink_order", tenant=self.tenant_id,
+                      host=host, slot=idx)
+        return bool(self.scheduler_shrink(host=host))
 
     def run(self) -> int:
         if self.tenant_id is None:
@@ -847,6 +954,11 @@ class ElasticDriver:
             disc = threading.Thread(target=self._discovery_loop,
                                     daemon=True)
             disc.start()
+            # The observatory's pull loop: always on (scores + /skew
+            # stay live even with detection disabled); detection and
+            # actuation are governed by the HOROVOD_STRAGGLER_* knobs.
+            threading.Thread(target=self._skew_loop, daemon=True,
+                             name="skew-observatory").start()
             # The shutdown event doubles as the scheduler's stop
             # request (request_stop): a managed tenant driver must be
             # stoppable without its world ever reaching "done".

@@ -142,6 +142,10 @@ class _Tenant:
         # preemption→resume, both observed into tenant_wait_seconds.
         self.wait_since: Optional[float] = time.monotonic()
         self.preemptions = 0
+        # Straggler-shrink preference (shrink_tenant(host=...)): the
+        # packer fills this tenant from every OTHER host first, so a
+        # tightened max_np sheds the wedged host's slot.
+        self.avoid_host: Optional[str] = None
 
     @property
     def tenant_id(self) -> str:
@@ -265,6 +269,48 @@ class PodScheduler:
         LOG.info("tenant %s resized to np=[%d, %s]", tenant_id, new_min,
                  new_max if new_max is not None else "inf")
 
+    def shrink_tenant(self, tenant_id: str, host: Optional[str] = None,
+                      reason: str = "straggler") -> bool:
+        """Shed ONE slot from an active tenant's share (the skew
+        observatory's ``shrink`` actuation: the straggler host keeps
+        less of the pod instead of stalling all of it).  Implemented as
+        :meth:`resize` of ``max_np`` to one below the current
+        allocation plus :meth:`poke`, so the order lands on the next
+        tick through the normal elastic machinery — the shed slot
+        leaves via the drain path of the driver's SIGTERM.
+
+        ``host`` names the STRAGGLER's host: the packer fills this
+        tenant from every other host first from then on (the
+        ``avoid_host`` preference), so the tightened ``max_np`` sheds
+        the wedged host's slot rather than an arbitrary healthy one
+        (a preference, not a guarantee — contention with other
+        tenants' claims can still shift placement).  Refused (False)
+        when the tenant is already at its ``min_np`` floor: shrinking
+        below the SLO floor would just preempt it."""
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is None or t.state not in _ACTIVE:
+                return False
+            if host is not None:
+                t.avoid_host = str(host)
+            allocated = t.allocated()
+            if allocated <= t.spec.min_np:
+                LOG.warning(
+                    "shrink order for tenant %s refused: already at "
+                    "its min_np floor (%d slot(s))", tenant_id,
+                    allocated)
+                return False
+            new_max = allocated - 1
+        metrics.event("tenant_shrink_order", tenant=tenant_id,
+                      reason=reason, max_np=new_max, host=host)
+        LOG.warning("shrinking tenant %s to max_np=%d (%s)",
+                    tenant_id, new_max, reason)
+        # resize takes the lock itself and propagates the bound to the
+        # live driver; poke applies the plan on the next tick.
+        self.resize(tenant_id, max_np=new_max)
+        self.poke()
+        return True
+
     # -- introspection -----------------------------------------------------
 
     def tenant_state(self, tenant_id: str) -> str:
@@ -291,11 +337,19 @@ class PodScheduler:
     # -- planning ----------------------------------------------------------
 
     @staticmethod
-    def _take(free: Dict[str, int], want: int) -> Dict[str, int]:
+    def _take(free: Dict[str, int], want: int,
+              last: Optional[str] = None) -> Dict[str, int]:
         """Take up to ``want`` slots from ``free`` (mutated), host
-        order preserved — deterministic packing."""
+        order preserved — deterministic packing.  ``last`` defers one
+        host to the end of the fill order (the straggler-shrink
+        ``avoid_host`` preference): its slots are claimed only when
+        every other host is exhausted."""
         got: Dict[str, int] = {}
-        for host in list(free):
+        hosts = list(free)
+        if last is not None and last in hosts:
+            hosts.remove(last)
+            hosts.append(last)
+        for host in hosts:
             if want <= 0:
                 break
             n = min(free[host], want)
@@ -313,7 +367,8 @@ class PodScheduler:
         free = {h: int(n) for h, n in pod.items() if n > 0}
         alloc: Dict[str, Dict[str, int]] = {}
         for t in order:
-            got = self._take(free, t.spec.min_np)
+            got = self._take(free, t.spec.min_np,
+                              last=getattr(t, "avoid_host", None))
             if sum(got.values()) < t.spec.min_np:
                 for h, n in got.items():  # give the partial fill back
                     free[h] += n
@@ -327,7 +382,9 @@ class PodScheduler:
             have = sum(cur.values())
             room = (sum(free.values()) if t.spec.max_np is None
                     else t.spec.max_np - have)
-            for h, n in self._take(free, room).items():
+            for h, n in self._take(
+                    free, room,
+                    last=getattr(t, "avoid_host", None)).items():
                 cur[h] = cur.get(h, 0) + n
         return alloc
 
@@ -480,12 +537,20 @@ class PodScheduler:
         spec = tenant.spec
         env = dict(self._base_env)
         env.update(spec.env)
-        return ElasticDriver(
+        driver = ElasticDriver(
             spec.command, tenant.view,
             min_np=spec.min_np, max_np=spec.max_np, env=env,
             elastic_timeout=self._elastic_timeout,
             tenant_id=spec.tenant_id, tenant_priority=spec.priority,
             **self._driver_kwargs)
+        # The skew observatory's shrink actuation routes through the
+        # pod scheduler: a sustained straggler on this tenant sheds one
+        # slot of its share (resize + poke), preferentially from the
+        # straggler's own host, instead of stalling it.
+        driver.scheduler_shrink = (
+            lambda host=None, tid=spec.tenant_id:
+                self.shrink_tenant(tid, host=host))
+        return driver
 
     def _start_tenant(self, tenant: _Tenant):
         with self._lock:

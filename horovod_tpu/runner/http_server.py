@@ -119,10 +119,32 @@ class _KvHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _serve_skew(self):
+        """``GET /skew`` — the skew observatory's fleet JSON (per-rank
+        straggler scores, detections, plan-staleness classes).  Same
+        auth stance as ``/metrics``: read-only operational telemetry
+        with no payload data, served unauthenticated so fleet tooling
+        that cannot compute the launcher HMAC can still watch it.  No
+        provider installed (non-elastic servers) = 404."""
+        provider = getattr(self.server, "skew_provider", None)
+        if provider is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = provider().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         try:
             if self.path == "/metrics":
                 self._serve_metrics()
+                return
+            if self.path == "/skew":
+                self._serve_skew()
                 return
             if not self._authorized(self.path.encode()):
                 self.send_response(403)
@@ -174,6 +196,8 @@ class RendezvousServer:
         self._httpd.metrics_provider = None  # type: ignore[attr-defined]
         # POST /serve/<deployment> handler; None = endpoint disabled.
         self._httpd.serving_provider = None  # type: ignore[attr-defined]
+        # GET /skew renderer; None = endpoint disabled (404).
+        self._httpd.skew_provider = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -196,6 +220,16 @@ class RendezvousServer:
         for ``POST /serve/<deployment>`` (the serving router's HTTP
         front door, serving/router.py ``install_http_frontend``)."""
         self._httpd.serving_provider = fn  # type: ignore[attr-defined]
+
+    @property
+    def skew_provider(self):
+        return self._httpd.skew_provider  # type: ignore[attr-defined]
+
+    @skew_provider.setter
+    def skew_provider(self, fn):
+        """Install a () -> str JSON renderer for ``GET /skew`` (the
+        elastic driver's skew observatory, common/skew.py)."""
+        self._httpd.skew_provider = fn  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:

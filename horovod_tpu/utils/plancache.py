@@ -360,6 +360,25 @@ class PlanController:
         with self._lock:
             return self._last_cls.get(op)
 
+    def invalidate(self, op: str, cls: str) -> bool:
+        """Drop one class's plan entry (the staleness verdict's
+        actuation): cached and pinned entries both go, the route memo
+        clears, and the class's counted/seen marks reset so the next
+        dispatch re-resolves from scratch (by the default gate, counted
+        as a fresh ``plan_apply_total{source="default"}`` — provenance
+        stays honest about the fallback).  Returns whether any entry
+        was actually dropped.  SPMD contract: called on every member at
+        the same point (``check_plan_staleness`` routes the verdict
+        through the rendezvous KV), never from rank-local judgement."""
+        key = (op, str(cls))
+        with self._lock:
+            had = self._cached.pop(key, None) is not None
+            had = (self._pinned.pop(key, None) is not None) or had
+            self._seen.pop(key, None)
+            self._counted = {k for k in self._counted if k[0] != key}
+            self._memo.clear()  # the drop changes future resolutions
+        return had
+
     def pin(self, op: str, cls: str, entry: dict) -> bool:
         """Pin a tuned winner for one class; refused (False) when env
         pins suppress planning — an explicit operator A/B must stay
@@ -410,6 +429,11 @@ class _PlanPlane:
         self.tuned_runtime: Optional[dict] = None
         self.kv = None  # live RendezvousClient for republish, or None
         self.rank: Optional[int] = None
+        self.size: Optional[int] = None
+        # Staleness-check state (lazy: built at the first
+        # check_plan_staleness call so the ratio env is read when the
+        # check runs, not at plane reset).
+        self.staleness = None
 
 
 _plane = _PlanPlane()
@@ -537,6 +561,7 @@ def bootstrap(config, topology, mode: str) -> Optional[dict]:
     Returns the active plan (may be empty) or None when disabled."""
     plane = _plane
     plane.rank = topology.rank if topology is not None else None
+    plane.size = topology.size if topology is not None else 1
     plane.enabled = bool(getattr(config, "plan_cache", True))
     plane.tune_enabled = (config.plan_autotune
                           if getattr(config, "plan_autotune", None)
@@ -746,6 +771,151 @@ def describe() -> dict:
         if plane.tuned_runtime is not None:
             out["tuned"] = dict(plane.tuned_runtime)
     return out
+
+
+# -- plan staleness: observed-vs-expected drift, SPMD-uniform ---------------
+
+# One record per fingerprint on the rendezvous KV: rank 0 overwrites it
+# every check with {"seq": N, "stale": [trip history]}; members gate on
+# seq and apply trips by their ``apply_at`` seq — never on local
+# judgement.
+_STALE_KEY = "plan/stale/v%d/%s"
+
+
+class _StalenessState:
+    def __init__(self):
+        from ..common import skew
+        self.seq = 0                 # checks this process has run
+        self.tracker = skew.ClassLatencyTracker()  # rank 0 only
+        self.entries: List[dict] = []  # rank 0's trip history
+        self.applied = 0             # trips applied locally
+        self.rearmed: List[Tuple[str, str]] = []  # awaiting re-tune
+        self.warned_no_kv = False
+
+
+def _staleness_state() -> _StalenessState:
+    plane = _plane
+    with plane.lock:
+        if plane.staleness is None:
+            plane.staleness = _StalenessState()
+        return plane.staleness
+
+
+def retune_pending() -> List[Tuple[str, str]]:
+    """Classes whose cached plan entry went stale and now await
+    re-tuning — appended exactly once per trip by
+    :func:`check_plan_staleness`, consumed by the caller that re-runs
+    :func:`tune_collective_plans` for them (every member sees the
+    identical list: trips only ever arrive through the KV verdict)."""
+    st = _plane.staleness
+    return list(st.rearmed) if st is not None else []
+
+
+def consume_retune() -> List[Tuple[str, str]]:
+    """Pop the pending re-tune classes (call right before sweeping
+    them, on every member — the SPMD calling contract)."""
+    st = _plane.staleness
+    if st is None:
+        return []
+    out, st.rearmed = list(st.rearmed), []
+    return out
+
+
+def _apply_stale(plane, entry: dict):
+    op, cls = entry["op"], entry["size_class"]
+    if plane.controller is not None:
+        plane.controller.invalidate(op, cls)
+    metrics.counter("plan_staleness_total", op=op,
+                    size_class=cls).inc()
+    metrics.event("plan_stale", scope="member", rank=plane.rank,
+                  **entry)
+    st = plane.staleness
+    st.rearmed.append((op, cls))
+    LOG.warning(
+        "plan entry (%s, %s) invalidated as STALE (observed %.6fs vs "
+        "baseline %.6fs, %.1fx drift): routing falls back to the "
+        "default gate and the class is re-armed for tuning",
+        op, cls, entry.get("observed_s", 0.0),
+        entry.get("baseline_s", 0.0), entry.get("ratio", 0.0))
+
+
+def check_plan_staleness(timeout: float = 60.0) -> Optional[dict]:  # graftlint: spmd-uniform -- rank-0-decide -> KV-adopt: only rank 0's ClassLatencyTracker ever produces a trip; the trip history is published under the fingerprint key with an apply_at seq, every member blocks for a record covering ITS OWN seq and applies exactly the trips with apply_at <= that seq, so all members invalidate the same classes at the same check index (in between, routing is untouched everywhere).  KV-less multi-member worlds return None before any state mutates.
+    """Observed-vs-expected plan drift check — the decide half of the
+    staleness loop.  EVERY member calls this at the same point in its
+    step sequence (the ``tune_collective_plans`` SPMD contract; pick a
+    cadence you can afford — each check is one KV round-trip).
+
+    Rank 0 feeds its live ``mh_collective_seconds`` per-class totals
+    into a :class:`~horovod_tpu.common.skew.ClassLatencyTracker`:
+    a class whose window mean drifts past
+    ``HOROVOD_PLAN_STALENESS_RATIO`` x its recorded baseline (the
+    latency the active plan delivered when tracking began) is STALE —
+    one class per check, worst first.  The verdict is routed through
+    the rendezvous KV (rank 0 publishes its trip history stamped with
+    the check seq; members block for a record covering their own seq)
+    so the invalidation lands at the SAME check index on every member
+    — per-class routing must never diverge (the r14 hang class).  On
+    a trip every member drops the class from its controller
+    (:meth:`PlanController.invalidate`), bumps
+    ``plan_staleness_total{op,size_class}``, journals ``plan_stale``,
+    and re-arms the class for tuning exactly once
+    (:func:`retune_pending`).
+
+    Returns the trip applied this check (or None).  Multi-member
+    worlds without a rendezvous KV cannot agree and observe nothing
+    (warned once); a member that cannot reach rank 0's record raises
+    rather than guess."""
+    plane = _plane
+    if not plane.enabled or plane.fingerprint is None:
+        return None
+    from ..common import skew
+    if skew.plan_staleness_ratio() <= 0:
+        return None
+    st = _staleness_state()
+    multi = (plane.size or 1) > 1
+    if multi and plane.kv is None:
+        if not st.warned_no_kv:
+            st.warned_no_kv = True
+            LOG.warning(
+                "plan staleness check skipped: multi-member world "
+                "with no rendezvous KV to agree through (set "
+                "HOROVOD_RENDEZVOUS_ADDR) — rank-local invalidation "
+                "would diverge per-class routing")
+        return None
+    st.seq += 1
+    key = _STALE_KEY % (SCHEMA_VERSION, plane.fingerprint)
+    if plane.rank in (None, 0):
+        verdict = st.tracker.update(
+            skew._class_totals(metrics.snapshot()))
+        if verdict is not None:
+            st.entries.append(dict(verdict, apply_at=st.seq))
+        if multi:
+            plane.kv.put_json(key, {"seq": st.seq,
+                                    "stale": st.entries})
+        visible = st.entries
+    else:
+        deadline = time.monotonic() + timeout
+        rec = None
+        while True:
+            rec = plane.kv.get_json(key)
+            if isinstance(rec, dict) and rec.get("seq", 0) >= st.seq:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "plan staleness check: rank 0 never published "
+                    "check #%d for %s — members must adopt rank 0's "
+                    "verdict or not at all (the divergent-routing "
+                    "hang class)" % (st.seq, plane.fingerprint))
+            time.sleep(0.05)
+        # Only trips rank 0 decided AT OR BEFORE this member's own
+        # check index apply now; later ones apply at their own index.
+        visible = [e for e in rec.get("stale", ())
+                   if e.get("apply_at", 0) <= st.seq]
+    fresh = visible[st.applied:]
+    for entry in fresh:
+        _apply_stale(plane, entry)
+    st.applied = len(visible)
+    return dict(fresh[-1]) if fresh else None
 
 
 # -- the tuning sweep -------------------------------------------------------

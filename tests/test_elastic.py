@@ -533,6 +533,40 @@ def test_drained_worker_is_planned_removal_not_failure():
         _close_driver(driver)
 
 
+def test_drained_slot_not_respawned_in_same_reap_pass():
+    """Regression (found live by the straggler-drain e2e): the reap
+    pass that books a drain runs its spawn list BEFORE the epoch-bump
+    recompute, so a same-pass respawn of the drained slot could
+    rendezvous into the still-PUBLISHED stale epoch, resolve the OLD
+    world's jax coordinator, and FATAL the survivors mid-recovery
+    (new-incarnation connect propagated by error polling).  The
+    drained slot must sit out its own reap pass — the failure path
+    already does, via failed_hosts — and respawn only after the world
+    recompute, where the fresh worker parks on "wait" until the new
+    epoch publishes."""
+    from horovod_tpu.elastic.worker import DRAIN_EXIT_CODE
+    driver = _make_driver(FixedHosts({"h": 1}))
+    slot = ("h", 0)
+    spawned = []
+    driver._spawn_workers = lambda slots: spawned.extend(slots)
+    recomputes = []
+    driver._recompute_world = recomputes.append
+    try:
+        driver._target = [slot]
+        driver._published = True
+        driver._procs[slot] = _FakeProc(DRAIN_EXIT_CODE)
+        # No spawn-attempt stamp: without the drained-slot exclusion
+        # the throttle alone would happily respawn in this very pass.
+        assert driver._check_procs() is False
+        assert spawned == []                      # sat out its pass
+        assert recomputes == ["worker drained"]   # epoch bump booked
+        # The NEXT pass (post-recompute world) respawns it normally.
+        assert driver._check_procs() is False
+        assert spawned == [slot]
+    finally:
+        _close_driver(driver)
+
+
 def test_drain_ack_drop_falls_back_to_exit_code(monkeypatch):
     """driver.drain.ack drop: the notice is lost at the driver; the
     slot is NOT marked draining, but the drain exit code still lands

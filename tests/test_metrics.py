@@ -185,6 +185,96 @@ def test_journal_roundtrip(tmp_path, monkeypatch):
     assert metrics.counter("events_total", kind="stall").value == 1
 
 
+def test_render_merged_keeps_series_own_rank_label():
+    # The skew observatory's straggler_score is keyed by the SCORED
+    # rank; the fleet merge's source label must not clobber it (it
+    # would collapse every score into duplicate {rank="driver"}
+    # series — invalid exposition).  Labels the series does NOT carry
+    # still gain the source tag.
+    metrics.gauge("straggler_score", rank="0").set(0.5)
+    metrics.gauge("straggler_score", rank="1").set(12.0)
+    metrics.counter("elastic_spawn_total").inc()
+    text = metrics.render_merged([("driver", metrics.snapshot())])
+    assert 'straggler_score{rank="0"} 0.5' in text
+    assert 'straggler_score{rank="1"} 12' in text
+    assert 'rank="driver"' not in \
+        [l for l in text.splitlines()
+         if l.startswith("straggler_score")][0]
+    assert 'elastic_spawn_total{rank="driver"} 1' in text
+
+
+def test_iter_events_merged_across_writers(tmp_path):
+    # ISSUE 12 satellite: the merged reader interleaves ALL writers by
+    # (ts, writer, seq) and stamps each record with its writer tag, so
+    # cross-rank correlation needs no per-file stitching.  Two writers
+    # with interleaved timestamps, including a same-ts tie broken by
+    # writer then seq.
+    def write(writer, records):
+        with open(os.path.join(str(tmp_path),
+                               "events-%s.jsonl" % writer), "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+    write("driver", [
+        {"ts": 1.0, "seq": 1, "kind": "epoch_published"},
+        {"ts": 3.0, "seq": 2, "kind": "drained"},
+        {"ts": 5.0, "seq": 3, "kind": "straggler_detected"},
+    ])
+    write("r1", [
+        {"ts": 2.0, "seq": 1, "kind": "spawn_seen"},
+        {"ts": 3.0, "seq": 2, "kind": "drain_request"},
+        {"ts": 4.0, "seq": 3, "kind": "fault_fire"},
+    ])
+    merged = list(metrics.iter_events(str(tmp_path), merged=True))
+    assert [(r["ts"], r["writer"], r["seq"]) for r in merged] == [
+        (1.0, "driver", 1), (2.0, "r1", 1), (3.0, "driver", 2),
+        (3.0, "r1", 2), (4.0, "r1", 3), (5.0, "driver", 3)]
+    assert [r["kind"] for r in merged] == [
+        "epoch_published", "spawn_seen", "drained", "drain_request",
+        "fault_fire", "straggler_detected"]
+    # Default (unmerged) behavior is unchanged: file order, no writer
+    # stamp.
+    flat = list(metrics.iter_events(str(tmp_path)))
+    assert [r["kind"] for r in flat[:3]] == [
+        "epoch_published", "drained", "straggler_detected"]
+    assert "writer" not in flat[0]
+
+
+def test_approx_quantile_log2_estimator():
+    # 100 fast observations and 10 slow ones: the shared estimator
+    # must put p50 inside the fast bucket, p99 near its top, and the
+    # extreme tail inside the slow bucket — within the log2 bucket
+    # geometry's 2x bound, labels filtered by subset match.
+    h = metrics.histogram("mh_collective_seconds", op="allreduce",
+                          size_class="65536")
+    for _ in range(100):
+        h.observe(0.01)
+    for _ in range(10):
+        h.observe(1.0)
+    other = metrics.histogram("mh_collective_seconds", op="allgather",
+                              size_class="1024")
+    other.observe(100.0)  # wrong labels: must not pollute
+    snap = metrics.snapshot()
+    p50 = metrics.approx_quantile(snap, "mh_collective_seconds", 0.50,
+                                  {"op": "allreduce"})
+    assert 0.0078125 <= p50 <= 0.015625, p50  # 0.01's bucket
+    tail = metrics.approx_quantile(snap, "mh_collective_seconds",
+                                   0.999, {"op": "allreduce"})
+    assert 0.5 <= tail <= 1.024, tail  # 1.0's bucket
+    # Aggregation across series (no label filter) covers both ops.
+    assert metrics.approx_quantile(snap, "mh_collective_seconds",
+                                   1.0) >= 64.0
+    # Absent family / empty labels-match degrade to 0.
+    assert metrics.approx_quantile(snap, "nope", 0.5) == 0.0
+    assert metrics.approx_quantile(
+        snap, "mh_collective_seconds", 0.5, {"op": "bcast"}) == 0.0
+    # Beyond-top-bucket overflow clamps to the top finite edge.
+    big = metrics.histogram("engine_cycle_seconds")
+    big.observe(1000.0)
+    assert metrics.approx_quantile(metrics.snapshot(),
+                                   "engine_cycle_seconds", 1.0) == 64.0
+
+
 def test_journal_disabled_without_dir(tmp_path, monkeypatch):
     monkeypatch.delenv("HOROVOD_METRICS_DIR", raising=False)
     metrics.event("stall", tensor="x")

@@ -203,6 +203,62 @@ def test_enqueue_fixed_order_delay_is_harmless():
     _assert_ok(outs, marker="FAULT_OK")
 
 
+def _skew_totals(outs):
+    """{rank: (lat_sum, count)} from the delay_skew scenario's
+    SKEW_TOTALS report lines."""
+    totals = {}
+    for rank, (_rc, out, _err) in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith("SKEW_TOTALS "):
+                _tag, r, total, count = line.split()
+                totals[int(r)] = (float(total), int(count))
+    return totals
+
+
+@pytest.mark.slow
+def test_drain_record_delay_completes_and_skews():
+    # ISSUE 12 satellite: the `delay` action at the multihost DRAIN
+    # seam (mh.drain.record — a negotiated record popped, dispatch
+    # stalled; until now only die/drop/wedge paths were asserted
+    # here).  A delayed-but-alive rank must COMPLETE every group with
+    # correct values, not error it — and the delay must show up as
+    # mh_collective_seconds skew: the t0 stamp sits AFTER this seam,
+    # so the delayed rank's own window stays the exec-only fleet
+    # minimum while the PROMPT rank's inflates by the wait (the
+    # arrival-lag inversion the skew observatory scores).
+    outs = _spawn_multihost(2, local_devices=1, extra_env={
+        "HVD_TPU_FAULT": "mh.drain.record:delay:0.2@rank=1",
+        "TEST_SCENARIO": "delay_skew",
+    }, worker=FAULT_WORKER)
+    _assert_ok(outs, marker="FAULT_OK")
+    totals = _skew_totals(outs)
+    assert set(totals) == {0, 1}, totals
+    # Every group completed on both ranks (delayed != dropped).
+    assert totals[0][1] >= 12 and totals[1][1] >= 12, totals
+    # The prompt rank absorbed most of 12 x 0.2 s of waiting; the
+    # delayed rank's own latency is a small fraction of it.
+    assert totals[0][0] > 12 * 0.2 * 0.5, totals
+    assert totals[0][0] > 3 * totals[1][0], totals
+
+
+@pytest.mark.slow
+def test_enqueue_delay_completes_without_skew():
+    # The ENQUEUE seam's delay (mh.enqueue.pre_register): the payload
+    # registers late, so NEGOTIATION stalls — but once negotiated,
+    # both executors dispatch together, so the world completes
+    # correctly with no per-rank latency skew (dispatch-to-completion
+    # windows stay symmetric; the cost shows up as throughput, which
+    # is exactly why the observatory keys on the dispatch seam's
+    # signature rather than enqueue lag).
+    outs = _spawn_multihost(2, local_devices=1, extra_env={
+        "HVD_TPU_FAULT": "mh.enqueue.pre_register:delay:0.2@rank=1",
+        "TEST_SCENARIO": "delay_skew",
+    }, worker=FAULT_WORKER)
+    _assert_ok(outs, marker="FAULT_OK")
+    totals = _skew_totals(outs)
+    assert totals[0][1] >= 12 and totals[1][1] >= 12, totals
+
+
 def test_drain_drop_injection_trips_watchdog():
     # mh.drain.record:drop on rank 1 = a member that negotiates but
     # never dispatches (the alive-but-absent failure the execution

@@ -7,7 +7,17 @@ FAULT_LOUD).  Any other outcome — a silently wrong reduction above all
 The spawning test arms HVD_TPU_FAULT (e.g. core.enqueue.legacy_order,
 the pre-fix enqueue ordering) and asserts the world never completes
 with a corrupted value: loud errors are the acceptable failure mode,
-wrong numbers never are."""
+wrong numbers never are.
+
+``TEST_SCENARIO=delay_skew`` runs the delayed-but-alive leg instead:
+a burst of verified allreduces under an armed ``delay`` action at a
+multihost dispatch seam, followed by a ``SKEW_TOTALS <rank> <sum>
+<count>`` report of this rank's ``mh_collective_seconds`` totals —
+the spawning test asserts the delayed rank completed every group
+(values correct, no error path) AND that the delay is visible as
+latency skew (the PROMPT rank's window inflates by the wait; the
+delayed rank's own dispatch→completion stays the fleet minimum — the
+arrival-lag inversion common/skew.py scores)."""
 
 import os
 import sys
@@ -30,7 +40,28 @@ import horovod_tpu as hvd
 from horovod_tpu.ops.engine import HorovodInternalError
 
 
+def run_delay_skew():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    expected = float(sum(range(1, n + 1)))
+    for i in range(12):
+        out = hvd.allreduce(np.full((64,), float(r + 1), np.float32),
+                            op=hvd.Sum, name="skew%d" % i)
+        np.testing.assert_allclose(np.asarray(out), expected)
+    from horovod_tpu.common import skew
+    from horovod_tpu.common.metrics import snapshot
+    total, count = skew._hist_totals(snapshot(),
+                                     "mh_collective_seconds")
+    print("SKEW_TOTALS %d %.6f %d" % (r, total, int(count)),
+          flush=True)
+    hvd.shutdown()
+    print("FAULT_OK %d" % r, flush=True)
+
+
 def main():
+    if os.environ.get("TEST_SCENARIO") == "delay_skew":
+        run_delay_skew()
+        return
     hvd.init(controller="multihost")
     r, n = hvd.rank(), hvd.size()
     try:
