@@ -457,6 +457,14 @@ class LintConfig:
         "horovod_tpu/common/skew.py",
         "horovod_tpu/utils/timeline.py",
         "horovod_tpu/elastic/spill.py",
+        # Sharded durable commits (ISSUE 15): the shard-spill gate and
+        # replica count are read at commit time, pre-Config by design
+        # (the spill plane must work before/without hvd.init()).
+        "horovod_tpu/elastic/shardspill.py",
+        # ZeRO step builders (ISSUE 15): stage selection and the wire
+        # codec are resolved at step-build time, which may precede
+        # Config (the builders only need a mesh, not the engine).
+        "horovod_tpu/jax/zero.py",
         "horovod_tpu/elastic/scheduler.py",
         "horovod_tpu/runner/http_client.py",
         # Serving plane (r16): the router's admission knobs and the

@@ -134,6 +134,12 @@ SITES: Dict[str, str] = {
         "elastic spill, write: one durable commit spill for one rank "
         "(drop = the write is torn mid-blob, leaving a truncated file "
         "the CRC-checked restore must detect and skip)",
+    "elastic.state.shard":
+        "sharded spill, shardspill.write_commit: one shard blob of one "
+        "sharded durable commit (drop = that shard's copy lands torn "
+        "mid-payload; target one shard index with @shard= — the "
+        "per-shard CRC fallback must adopt a buddy copy of the SAME "
+        "commit instead of discarding it)",
     "scheduler.admit":
         "pod scheduler, PodScheduler.admit entry: one tenant admission "
         "request (drop = the admission is refused as if the pod had no "
@@ -183,6 +189,7 @@ DROP_SITES = frozenset({
     "worker.preempt.sigterm",
     "driver.drain.ack",
     "elastic.state.spill",
+    "elastic.state.shard",
     "scheduler.admit",
     "scheduler.preempt.notice",
     "serving.request.drop",
@@ -198,6 +205,12 @@ _COND_ENV = {
     # exports HOROVOD_TENANT_ID per tenant) so isolation tests can
     # kill tenant A while asserting tenant B's progress.
     "tenant": "HOROVOD_TENANT_ID",
+    # Sharded spills: the writer stamps HVD_TPU_SHARD_INDEX just
+    # before each shard blob write (elastic/shardspill.py), so
+    # @shard=<idx> tears exactly one shard of a multi-shard commit —
+    # the per-shard-fallback certification needs the buddy copy of the
+    # SAME shard index to survive.
+    "shard": "HVD_TPU_SHARD_INDEX",
 }
 
 _DEFAULT_ARG = {"delay": 0.25, "die": 43.0, "wedge": 3600.0}

@@ -155,6 +155,14 @@ NAMES: Dict[str, Tuple[str, str]] = {
     "spill_crc_failures_total": (
         "counter", "spill/replica blobs rejected by CRC/length "
                    "validation (torn writes, bit flips)"),
+    "shardspill_restore_bytes_total": (
+        "counter", "bytes this process streamed from durable storage "
+                   "during sharded-commit restore (the N→M resharding "
+                   "claim: stays well under full-state size per host)"),
+    "shardspill_shard_fallbacks_total": (
+        "counter", "sharded-restore reads that fell back to a buddy "
+                   "copy of the same shard after a corrupt first copy "
+                   "(per-shard fallback, commit preserved)"),
     # -- multi-tenant pod scheduler --
     "tenant_slots": (
         "gauge", "pod-scheduler slot bookkeeping per tenant, labeled "

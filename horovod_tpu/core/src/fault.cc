@@ -32,6 +32,12 @@ const char* CondEnv(const std::string& key) {
   if (key == "host") return "HOROVOD_HOSTNAME";
   if (key == "epoch") return "HOROVOD_ELASTIC_EPOCH";
   if (key == "tenant") return "HOROVOD_TENANT_ID";
+  // Sharded-spill targeting: the Python writer stamps the shard index
+  // just before each shard blob write (elastic/shardspill.py).  The
+  // native core plants no shard-indexed sites, but it parses the same
+  // env — knowing the key keeps a shard-targeted spec from logging a
+  // bad-condition warning at every core init.
+  if (key == "shard") return "HVD_TPU_SHARD_INDEX";
   return nullptr;
 }
 

@@ -1,0 +1,512 @@
+"""Sharded durable commits: one manifest + K shard blobs per commit,
+with N→M resharding restore.
+
+The r10 spill format (spill.py) writes ONE whole-state blob per rank
+per commit — N identical copies of the full state, and a restarting
+rank must read all of it.  For large models that is the refactor that
+blocks elastic restart: the write amplifies N-fold and the read cannot
+start until a full-state blob lands on one host.  This module shards
+the durable plane instead:
+
+* **One flat byte stream** per commit: the spill payload (pickled
+  scalar attrs + every tree leaf's raw array bytes) serializes into a
+  deterministic flat layout recorded in the manifest — each leaf at
+  (offset, nbytes) with dtype/shape, so any byte range of the stream
+  is independently meaningful.
+* **K shard blobs**: writer k of a K-member world writes bytes
+  [k·ceil(total/K), (k+1)·ceil(total/K)) as ``shard-<commit>-<k>of<K>-
+  <tag>.shard`` — the r10 wire format per blob (MAGIC + commit id +
+  length + CRC32, atomic tmp + ``os.replace``) so every shard is
+  independently validated.  Each writer additionally mirrors the next
+  ``HOROVOD_SHARD_REPLICAS`` (default 1) shards ((k+1)%K, ...) so a
+  single torn/lost shard falls back **per shard** to a buddy copy of
+  the SAME commit instead of discarding the commit.
+* **One manifest** (``state-<commit>-<tag>.manifest``, same CRC'd
+  format, JSON payload): (commit_id, n_shards = writer world size,
+  total_bytes, flat-layout descriptor).  Every writer writes its tagged
+  copy; any valid copy serves (they are byte-identical by
+  construction — states are identical across ranks at a commit id).
+* **N→M resharding restore**: a reader world of M ranks restores by
+  each rank streaming ONLY the source-shard ranges overlapping its own
+  1/M slice of the byte stream (whole source shards are read for CRC
+  validation — still ≤ ~1/M + one shard of slop, never the full
+  state), then reassembling over the collective plane
+  (``elastic/state.py`` allgathers the slices).  2→1, 2→3, any N→M.
+  A shard whose every copy is corrupt fails that COMMIT down the
+  keep-last-K chain — the same fallback the r10 plane has — but a
+  torn copy with a surviving buddy costs one warning, not the commit.
+
+Requires a SHARED spill directory (``HOROVOD_STATE_SPILL_DIR`` on
+common storage): resharding reads ranges other ranks wrote.  Enabled
+by ``HOROVOD_STATE_SHARD_SPILL=1`` (default off — the r10 whole-blob
+path remains the default for per-host-disk deployments).
+
+Fault site ``elastic.state.shard`` (drop = one shard blob lands torn
+mid-payload) targets a single shard with the ``@shard=<idx>`` cond
+key, proving the per-shard fallback without discarding the commit.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common import faultline, metrics
+from ..common.envutil import env_int
+from . import spill
+
+LOG = logging.getLogger("horovod_tpu.elastic.shardspill")
+
+_MANIFEST_SUFFIX = ".manifest"
+_SHARD_SUFFIX = ".shard"
+
+
+class ShardUnavailable(RuntimeError):
+    """No valid copy of a needed shard exists for this commit (every
+    tagged blob torn/corrupt/missing): the commit itself must fall
+    back down the keep-last-K chain."""
+
+
+def enabled() -> bool:
+    """``HOROVOD_STATE_SHARD_SPILL`` (default 0): commits spill as
+    manifest + shard blobs instead of whole-state blobs.  Needs a
+    SHARED spill directory (see module docstring)."""
+    return env_int("HOROVOD_STATE_SHARD_SPILL", 0, minimum=0) > 0
+
+
+def shard_replicas() -> int:
+    """Extra buddy copies of each shard per commit
+    (``HOROVOD_SHARD_REPLICAS``, default 1): writer k also writes
+    shards (k+1)%K .. (k+r)%K, so a torn shard falls back per shard
+    within the commit.  0 disables redundancy (a torn shard then costs
+    the commit)."""
+    return env_int("HOROVOD_SHARD_REPLICAS", 1, minimum=0)
+
+
+# -- flat layout ------------------------------------------------------------
+
+def flatten_state(payload: Dict[str, Any]) -> Tuple[bytes, List[dict]]:
+    """Serialize a spill payload ({"attrs": ..., "trees": {...}}) into
+    (flat bytes, layout).  Scalar attrs and the tree SKELETONS pickle
+    into one leading section; every tree leaf's raw array bytes follow
+    at recorded (offset, nbytes) with dtype/shape — so any byte range
+    of the stream maps back to (parts of) named tensors."""
+    import jax
+    import numpy as np
+    trees = payload.get("trees", {})
+    leaf_entries = []
+    leaf_parts = []
+    skeletons: Dict[str, Any] = {}
+    counts: Dict[str, int] = {}
+    for attr in sorted(trees):
+        leaves, treedef = jax.tree_util.tree_flatten(trees[attr])
+        skeletons[attr] = jax.tree_util.tree_unflatten(
+            treedef, [None] * len(leaves))
+        counts[attr] = len(leaves)
+        for i, leaf in enumerate(leaves):
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            leaf_entries.append({
+                "key": "t:%s:%d" % (attr, i),
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+                "nbytes": int(arr.nbytes),
+            })
+            leaf_parts.append(arr.tobytes())
+    head = pickle.dumps({
+        "meta": {k: v for k, v in payload.items() if k != "trees"},
+        "skeletons": skeletons,
+        "counts": counts,
+    })
+    layout = [{"key": "__head__", "dtype": "pickle", "shape": [],
+               "nbytes": len(head), "offset": 0}]
+    off = len(head)
+    for e in leaf_entries:
+        e["offset"] = off
+        off += e["nbytes"]
+        layout.append(e)
+    return head + b"".join(leaf_parts), layout
+
+
+def unflatten_state(buf: bytes, layout: List[dict]) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_state`."""
+    import jax
+    import numpy as np
+    head_entry = layout[0]
+    assert head_entry["key"] == "__head__", layout[:1]
+    head = pickle.loads(
+        bytes(buf[head_entry["offset"]:
+                  head_entry["offset"] + head_entry["nbytes"]]))
+    leaves_by_attr: Dict[str, List] = {a: [] for a in head["counts"]}
+    for e in layout[1:]:
+        _, attr, _idx = e["key"].split(":", 2)
+        dtype = np.dtype(e["dtype"])
+        count = e["nbytes"] // max(dtype.itemsize, 1)
+        arr = np.frombuffer(buf, dtype=dtype, count=count,
+                            offset=e["offset"]) \
+            .reshape(e["shape"]).copy()
+        leaves_by_attr[attr].append(arr)
+    payload = dict(head["meta"])
+    trees = {}
+    for attr, skeleton in head["skeletons"].items():
+        structure = jax.tree_util.tree_structure(
+            skeleton, is_leaf=lambda x: x is None)
+        trees[attr] = jax.tree_util.tree_unflatten(
+            structure, leaves_by_attr[attr])
+    payload["trees"] = trees
+    return payload
+
+
+def shard_range(total: int, n: int, idx: int) -> Tuple[int, int]:
+    """Byte range [lo, hi) that member ``idx`` of an ``n``-member world
+    owns (last shard absorbs the remainder)."""
+    per = -(-total // max(n, 1))
+    return min(idx * per, total), min((idx + 1) * per, total)
+
+
+# -- write path -------------------------------------------------------------
+
+def _manifest_name(commit_id: int, tag: str) -> str:
+    return "state-%020d-%s%s" % (commit_id, tag, _MANIFEST_SUFFIX)
+
+
+def _shard_name(commit_id: int, idx: int, n: int, tag: str) -> str:
+    return "shard-%020d-%dof%d-%s%s" % (commit_id, idx, n, tag,
+                                        _SHARD_SUFFIX)
+
+
+def write_commit(commit_id: int, buf: bytes, layout: List[dict],
+                 shard_index: int, n_shards: int, tag: str,
+                 d: Optional[str] = None) -> bool:
+    """Spill this member's piece of one commit: its own shard, the
+    buddy replicas, and its tagged manifest copy.  Never raises into
+    the commit path (a full disk degrades durability, not training);
+    returns True when everything landed."""
+    d = d if d is not None else spill.spill_dir()
+    if d is None:
+        return False
+    t0 = time.monotonic()
+    manifest = {
+        "commit_id": int(commit_id),
+        "n_shards": int(n_shards),
+        "total_bytes": len(buf),
+        "layout": layout,
+    }
+    try:
+        os.makedirs(d, exist_ok=True)
+        replicas = 0 if n_shards <= 1 else min(shard_replicas(),
+                                               n_shards - 1)
+        try:
+            for r in range(replicas + 1):
+                idx = (shard_index + r) % n_shards
+                lo, hi = shard_range(len(buf), n_shards, idx)
+                blob = spill.encode(commit_id, bytes(buf[lo:hi]))
+                # The @shard= cond key compares against this env at
+                # fire time, so one spec can tear exactly one shard
+                # index of a multi-shard commit.
+                os.environ["HVD_TPU_SHARD_INDEX"] = str(idx)
+                if faultline.site("elastic.state.shard"):
+                    # Injected torn shard: truncated mid-payload, past
+                    # the header — the host-lost-power-mid-commit
+                    # shape.  The rename still lands, so only
+                    # CRC/length catches it.
+                    head = len(spill.MAGIC) + spill._HEADER.size
+                    blob = blob[:head + max(1, (hi - lo) // 2)]
+                    LOG.warning("shard %d of commit %d torn (faultline "
+                                "elastic.state.shard)", idx, commit_id)
+                spill.write_atomic(
+                    d, _shard_name(commit_id, idx, n_shards, tag), blob)
+        finally:
+            # Scoped to the shard writes: a stale index would make a
+            # @shard= condition on ANY other site compare against
+            # whatever this process wrote last.
+            os.environ.pop("HVD_TPU_SHARD_INDEX", None)
+        mblob = spill.encode(
+            commit_id, json.dumps(manifest, sort_keys=True).encode())
+        spill.write_atomic(d, _manifest_name(commit_id, tag), mblob)
+        _prune(d, tag)
+        metrics.counter("spill_commits_total").inc()
+        metrics.histogram("spill_commit_seconds").observe(
+            time.monotonic() - t0)
+        return True
+    except OSError as exc:
+        LOG.warning("sharded spill for commit %d failed (%s); "
+                    "continuing without durability for this commit",
+                    commit_id, exc)
+        return False
+
+
+def _prune(d: str, tag: str):
+    """Keep the newest ``spill.keep_last()`` commits carrying this
+    writer's tag (manifests AND shard blobs; only own files — pruning
+    a peer's would race its writes), and sweep crash-orphaned temp
+    files past the shared age guard."""
+    keep = spill.keep_last()
+    mine = sorted(n for n in os.listdir(d)
+                  if n.startswith("state-")
+                  and n.endswith("-%s%s" % (tag, _MANIFEST_SUFFIX)))
+    kept_commits = set()
+    for name in mine[-keep:]:
+        try:
+            kept_commits.add(int(name[len("state-"):].split("-", 1)[0]))
+        except ValueError:
+            continue
+    for name in mine[:-keep]:
+        try:
+            os.unlink(os.path.join(d, name))
+        except OSError:
+            pass
+    shard_tail = "-%s%s" % (tag, _SHARD_SUFFIX)
+    for name in os.listdir(d):
+        if not name.startswith("shard-") or not name.endswith(shard_tail):
+            continue
+        try:
+            commit = int(name[len("shard-"):].split("-", 1)[0])
+        except ValueError:
+            continue
+        if commit not in kept_commits:
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+    spill.sweep_tmp(d)
+
+
+# -- read path --------------------------------------------------------------
+
+def scan_manifests(d: Optional[str] = None) -> List[Tuple[int, str]]:
+    """(commit_id, path) for every manifest copy, newest commit first
+    (multiple tags per commit appear consecutively)."""
+    d = d if d is not None else spill.spill_dir()
+    if d is None or not os.path.isdir(d):
+        return []
+    out = []
+    for name in os.listdir(d):
+        if not name.startswith("state-") \
+                or not name.endswith(_MANIFEST_SUFFIX):
+            continue
+        parts = name[len("state-"):-len(_MANIFEST_SUFFIX)].split("-", 1)
+        if len(parts) < 2 or not parts[1]:
+            continue
+        try:
+            out.append((int(parts[0]), os.path.join(d, name)))
+        except ValueError:
+            continue
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
+
+
+def have_evidence(d: Optional[str] = None) -> bool:
+    """True when the directory holds ANY sharded-commit file, valid or
+    not — committed state existed, so restore must not silently
+    reinitialize."""
+    d = d if d is not None else spill.spill_dir()
+    if d is None or not os.path.isdir(d):
+        return False
+    for name in os.listdir(d):
+        if (name.startswith("state-")
+                and name.endswith(_MANIFEST_SUFFIX)) \
+                or (name.startswith("shard-")
+                    and name.endswith(_SHARD_SUFFIX)):
+            return True
+    return False
+
+
+def newest_manifest_commit(d: Optional[str] = None) -> int:
+    """Newest manifest commit id on disk (0 = none): election evidence
+    (the survivor-election record carries it so a world that must
+    refuse a blank restart can name the commit it refused over)."""
+    manifests = scan_manifests(d)
+    return manifests[0][0] if manifests else 0
+
+
+# Parsed-manifest memo keyed by (dir, commit, file signature): the
+# restore protocol consults the same manifest from candidate listing,
+# range reads AND the collective agree loop — for a real model its
+# layout descriptor is one JSON entry per tree leaf, so each re-parse
+# is the cost state.py's min_commit fast path exists to avoid.  The
+# signature (path, size, mtime) invalidates on any rewrite.
+_manifest_cache: Dict[tuple, tuple] = {}
+_MANIFEST_CACHE_MAX = 16
+
+
+def _file_sig(paths):
+    sig = []
+    for p in paths:
+        try:
+            st = os.stat(p)
+            sig.append((p, st.st_size, st.st_mtime_ns))
+        except OSError:
+            sig.append((p, -1, -1))
+    return tuple(sig)
+
+
+def load_manifest(commit_id: int,
+                  d: Optional[str] = None) -> Optional[dict]:
+    """Parse any valid manifest copy for ``commit_id`` (copies are
+    byte-identical by construction; corrupt ones are skipped with a
+    warning).  Memoized on the copies' file signatures."""
+    d_key = d if d is not None else spill.spill_dir()
+    copies = [p for cid, p in scan_manifests(d) if cid == commit_id]
+    sig = _file_sig(copies)
+    hit = _manifest_cache.get((d_key, commit_id))
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    for cid, path in scan_manifests(d):
+        if cid != commit_id:
+            continue
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            file_cid, payload = spill.decode(blob)
+            if file_cid != commit_id:
+                raise spill.SpillCorrupt(
+                    "manifest name claims commit %d, header %d"
+                    % (commit_id, file_cid))
+            m = json.loads(payload.decode())
+            if int(m.get("commit_id", -1)) != commit_id:
+                raise spill.SpillCorrupt("manifest body commit mismatch")
+            if len(_manifest_cache) >= _MANIFEST_CACHE_MAX:
+                _manifest_cache.clear()
+            _manifest_cache[(d_key, commit_id)] = (sig, m)
+            return m
+        except (OSError, ValueError, spill.SpillCorrupt) as exc:
+            metrics.counter("spill_crc_failures_total").inc()
+            metrics.event("spill_corrupt", path=path, error=str(exc))
+            LOG.warning("skipping corrupt manifest %s (%s)", path, exc)
+            continue
+    return None
+
+
+def _shard_copies(d: str, commit_id: int, idx: int, n: int) -> List[str]:
+    """Every tagged blob of shard ``idx`` for this commit (own copy +
+    buddies), deterministic order."""
+    prefix = "shard-%020d-%dof%d-" % (commit_id, idx, n)
+    return sorted(os.path.join(d, name) for name in os.listdir(d)
+                  if name.startswith(prefix)
+                  and name.endswith(_SHARD_SUFFIX))
+
+
+def _read_shard(d: str, commit_id: int, idx: int, n: int,
+                expect: int) -> bytes:
+    """One shard's payload from the first VALID copy; corrupt copies
+    fall back per shard (warned + counted), exhaustion raises
+    :class:`ShardUnavailable` — the caller then falls back per
+    COMMIT."""
+    copies = _shard_copies(d, commit_id, idx, n)
+    for i, path in enumerate(copies):
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            # Counted at the read, not the validation: a corrupt copy
+            # still cost the host its bytes, and the N→M I/O claim is
+            # about what actually crossed the storage link.
+            metrics.counter("shardspill_restore_bytes_total").inc(
+                len(blob))
+            cid, payload = spill.decode(blob)
+            if cid != commit_id:
+                raise spill.SpillCorrupt(
+                    "shard name claims commit %d, header %d"
+                    % (commit_id, cid))
+            if len(payload) != expect:
+                raise spill.SpillCorrupt(
+                    "shard %d holds %d bytes, manifest promises %d"
+                    % (idx, len(payload), expect))
+            if i > 0:
+                metrics.counter("shardspill_shard_fallbacks_total").inc()
+            return payload
+        except (OSError, spill.SpillCorrupt) as exc:
+            metrics.counter("spill_crc_failures_total").inc()
+            metrics.event("spill_corrupt", path=path, error=str(exc))
+            LOG.warning("skipping corrupt shard copy %s (%s); falling "
+                        "back to the next copy of shard %d", path, exc,
+                        idx)
+            continue
+    raise ShardUnavailable(
+        "no valid copy of shard %d/%d for commit %d (%d candidate "
+        "blob(s))" % (idx, n, commit_id, len(copies)))
+
+
+def read_range(manifest: dict, lo: int, hi: int,
+               d: Optional[str] = None) -> bytes:
+    """Bytes [lo, hi) of the commit's flat stream, streamed from only
+    the source shards that overlap — per-host restore I/O stays
+    ~ (hi-lo) + one shard of CRC-validation slop, never the full
+    state."""
+    d = d if d is not None else spill.spill_dir()
+    if d is None:
+        raise ShardUnavailable("no spill directory")
+    n = int(manifest["n_shards"])
+    total = int(manifest["total_bytes"])
+    commit_id = int(manifest["commit_id"])
+    out = []
+    for idx in range(n):
+        slo, shi = shard_range(total, n, idx)
+        if shi <= lo or slo >= hi or slo == shi:
+            continue
+        payload = _read_shard(d, commit_id, idx, n, shi - slo)
+        out.append(payload[max(lo - slo, 0):hi - slo])
+    return b"".join(out)
+
+
+def read_shards(manifest: dict, indices, d: Optional[str] = None
+                ) -> Dict[int, bytes]:
+    """Whole source shards by index (the N→M collective restore's
+    unit of ownership: reader j of M owns source shards s with
+    s % M == j, so per-host restore I/O is ≤ ⌈N/M⌉ shards — strictly
+    under full-state size whenever M ≥ 2).  Per-shard buddy fallback
+    inside; :class:`ShardUnavailable` when a needed shard has no valid
+    copy."""
+    d = d if d is not None else spill.spill_dir()
+    if d is None:
+        raise ShardUnavailable("no spill directory")
+    n = int(manifest["n_shards"])
+    total = int(manifest["total_bytes"])
+    commit_id = int(manifest["commit_id"])
+    out: Dict[int, bytes] = {}
+    for idx in indices:
+        slo, shi = shard_range(total, n, idx)
+        out[idx] = b"" if slo == shi else _read_shard(
+            d, commit_id, idx, n, shi - slo)
+    return out
+
+
+def restore_candidates(min_commit: int = 0,
+                       d: Optional[str] = None,
+                       limit: int = 8) -> List[int]:
+    """Commit ids (newest first, > ``min_commit``) with at least one
+    parseable manifest — the per-commit fallback chain the reader
+    world walks until every member can stream its ranges."""
+    seen: List[int] = []
+    for cid, _path in scan_manifests(d):
+        if cid <= min_commit or cid in seen:
+            continue
+        if load_manifest(cid, d) is not None:
+            seen.append(cid)
+        if len(seen) >= limit:
+            break
+    return seen
+
+
+def restore_local(min_commit: int = 0, d: Optional[str] = None
+                  ) -> Optional[Tuple[int, Dict[str, Any]]]:
+    """Whole-state restore on ONE host (the M=1 reader world, and the
+    uninitialized-world path): newest commit whose every shard has a
+    valid copy; per-shard fallback inside a commit, per-commit
+    fallback down the chain."""
+    for cid in restore_candidates(min_commit, d):
+        manifest = load_manifest(cid, d)
+        if manifest is None:
+            continue
+        try:
+            buf = read_range(manifest, 0,
+                             int(manifest["total_bytes"]), d)
+        except ShardUnavailable as exc:
+            LOG.warning("commit %d not restorable (%s); falling back "
+                        "to the previous commit", cid, exc)
+            continue
+        return cid, unflatten_state(buf, manifest["layout"])
+    return None

@@ -142,19 +142,7 @@ def write(commit_id: int, payload: bytes, tag: str,
                     "elastic.state.spill)", commit_id)
     try:
         os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(prefix=".tmp-spill-", dir=d)
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, os.path.join(d, _filename(commit_id, tag)))
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        write_atomic(d, _filename(commit_id, tag), blob)
         _prune(d, tag)
         metrics.counter("spill_commits_total").inc()
         metrics.histogram("spill_commit_seconds").observe(
@@ -173,18 +161,31 @@ def write(commit_id: int, payload: bytes, tag: str,
 _TMP_SWEEP_AGE_S = 300.0
 
 
-def _prune(d: str, tag: str):
-    """Keep the newest ``keep_last()`` blobs with this writer's tag
-    (only own files: pruning a peer's history would race its writes),
-    and sweep crash-orphaned temp files past the age guard."""
-    mine = sorted(n for n in os.listdir(d)
-                  if n.endswith("-%s%s" % (tag, _SUFFIX))
-                  and n.startswith("state-"))
-    for name in mine[:-keep_last()]:
+def write_atomic(d: str, name: str, blob: bytes):
+    """Atomic same-directory write (temp + fsync + ``os.replace``): a
+    reader never observes a half-written NAMED file; a crash mid-write
+    leaves only a temp :func:`sweep_tmp` reaps.  The ONE write
+    protocol for every durable plane (whole-blob spills, sharded
+    manifests/shards, the serving version store) — a protocol fix
+    lands once."""
+    fd, tmp = tempfile.mkstemp(prefix=".tmp-spill-", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, name))
+    except BaseException:
         try:
-            os.unlink(os.path.join(d, name))
+            os.unlink(tmp)
         except OSError:
             pass
+        raise
+
+
+def sweep_tmp(d: str):
+    """Unlink crash-orphaned ``.tmp-spill-*`` files past the age
+    guard (shared by every durable plane's pruner)."""
     now = time.time()
     for name in os.listdir(d):
         if not name.startswith(".tmp-spill-"):
@@ -197,10 +198,35 @@ def _prune(d: str, tag: str):
             pass
 
 
+def _prune(d: str, tag: str):
+    """Keep the newest ``keep_last()`` blobs with this writer's tag
+    (only own files: pruning a peer's history would race its writes),
+    and sweep crash-orphaned temp files past the age guard."""
+    mine = sorted(n for n in os.listdir(d)
+                  if n.endswith("-%s%s" % (tag, _SUFFIX))
+                  and n.startswith("state-"))
+    for name in mine[:-keep_last()]:
+        try:
+            os.unlink(os.path.join(d, name))
+        except OSError:
+            pass
+    sweep_tmp(d)
+
+
+# Filenames already warned about by scan(): the restore path polls,
+# and one hand-renamed file must not spam a warning per poll.
+_scan_warned = set()
+
+
 def scan(d: Optional[str] = None) -> List[Tuple[int, str]]:
     """(commit_id, path) for every named spill file, newest first.
     Commit ids come from the filename here; :func:`load_newest`
-    re-validates them against the header at read time."""
+    re-validates them against the header at read time.  Files whose
+    commit-id field parses but whose tag segment is EMPTY (a
+    hand-renamed ``state-<id>-.spill``) are skipped — the writer never
+    produces them, so an untagged blob entering the restore chain
+    would dodge the per-writer keep-last-K pruning — with one warning
+    per filename, not one per poll."""
     d = d if d is not None else spill_dir()
     if d is None or not os.path.isdir(d):
         return []
@@ -210,9 +236,20 @@ def scan(d: Optional[str] = None) -> List[Tuple[int, str]]:
             continue
         parts = name[len("state-"):-len(_SUFFIX)].split("-", 1)
         try:
-            out.append((int(parts[0]), os.path.join(d, name)))
+            commit_id = int(parts[0])
         except ValueError:
             continue
+        if len(parts) < 2 or not parts[1]:
+            key = os.path.join(d, name)
+            if key not in _scan_warned:
+                _scan_warned.add(key)
+                LOG.warning(
+                    "ignoring spill file %s: commit id parses but the "
+                    "writer-tag segment is empty (hand-renamed?); "
+                    "untagged blobs are excluded from the restore "
+                    "chain", key)
+            continue
+        out.append((commit_id, os.path.join(d, name)))
     out.sort(key=lambda t: (-t[0], t[1]))
     return out
 
@@ -220,8 +257,16 @@ def scan(d: Optional[str] = None) -> List[Tuple[int, str]]:
 def have_evidence(d: Optional[str] = None) -> bool:
     """True when the spill directory holds ANY spill file, valid or
     not: committed state existed, so a restore that finds no valid
-    blob must fail loudly rather than silently restart from zeros."""
-    return bool(scan(d))
+    blob must fail loudly rather than silently restart from zeros.
+    Checked against the RAW directory, not :func:`scan`: a hand-
+    renamed empty-tag blob is excluded from the restore chain but
+    still proves state existed — dropping it from evidence would let
+    a blank restart slide past the guard."""
+    d = d if d is not None else spill_dir()
+    if d is None or not os.path.isdir(d):
+        return False
+    return any(n.startswith("state-") and n.endswith(_SUFFIX)
+               for n in os.listdir(d))
 
 
 def load_newest(min_commit_id: int = 0,

@@ -41,7 +41,7 @@ import numpy as np
 from ..common import basics, faultline, metrics
 from ..ops.engine import HorovodInternalError
 from ..utils.stall_inspector import StallError
-from . import spill
+from . import shardspill, spill
 from .worker import (HostsUpdatedInterrupt, WorkerDrained, WorkerStopped,
                      arm_last_resort_exit, elastic_timeout,
                      install_assignment, install_preemption_handler,
@@ -164,8 +164,28 @@ class ObjectState(State):
         self._saved = payload.get("attrs", {})
         self.restore()
 
+    def _sharded_world(self) -> bool:
+        """Sharded spill engages only where it helps: a real
+        multi-process world (each member writes its 1/K byte range to
+        the SHARED directory).  In-process and single-rank worlds keep
+        the whole-blob path — there is no second writer to shard
+        across."""
+        return (shardspill.enabled()
+                and basics.is_initialized() and basics.size() > 1
+                and not basics._controller_is_spmd())
+
     def _persist(self):
         if spill.spill_dir() is None and spill.replica_count() <= 0:
+            return
+        if self._sharded_world() and spill.spill_dir() is not None:
+            buf, layout = shardspill.flatten_state(self._spill_payload())
+            shardspill.write_commit(
+                self._commit_id, buf, layout,
+                shard_index=basics.rank(), n_shards=basics.size(),
+                tag="r%d" % basics.rank())
+            # Shard buddy copies replace the whole-blob buddy
+            # mirroring: one commit's bytes land ~(1+replicas)/K per
+            # writer instead of whole-state per writer.
             return
         payload = pickle.dumps(self._spill_payload())
         tag = "r%d" % (basics.rank() if basics.is_initialized() else 0)
@@ -178,6 +198,7 @@ class ObjectState(State):
 
     def _durable_evidence(self) -> bool:
         return (spill.have_evidence()
+                or shardspill.have_evidence()
                 or notification_manager().replica_blob() is not None)
 
     def _adopt_durable_state(self) -> bool:
@@ -205,6 +226,26 @@ class ObjectState(State):
                               error=str(exc))
                 LOG.warning("buddy replica blob is corrupt (%s); "
                             "ignoring it", exc)
+        # Sharded commits, local path: when the collective streaming
+        # path will not run (fresh single process, the N→1 resize,
+        # in-process worlds — or HOROVOD_STATE_SHARD_SPILL rolled back
+        # while sharded files remain), the newest fully-readable
+        # sharded commit competes as a whole.  Gated on the FILES, not
+        # the env flag: sharded blobs count as durable evidence
+        # whatever the flag says, so restore must be reachable for
+        # them too — otherwise a flag rollback turns valid commits
+        # into a permanently refused restart.
+        if shardspill.have_evidence() and not self._sharded_world():
+            floor = max(self._commit_id,
+                        best[0] if best is not None else 0)
+            loaded = shardspill.restore_local(min_commit=floor)
+            if loaded is not None:
+                self._load_payload(loaded[1])
+                self._commit_id = loaded[0]
+                self.save()
+                LOG.info("restored sharded durable state at commit %d "
+                         "(local whole-state read)", self._commit_id)
+                return True
         if best is None:
             return False
         self._load_payload(pickle.loads(best[1]))
@@ -213,6 +254,85 @@ class ObjectState(State):
         LOG.info("restored durable state at commit %d from %s",
                  self._commit_id, best[2])
         return True
+
+    def _adopt_sharded_collective(self) -> bool:
+        """N→M resharding restore: the reader world agrees on the
+        newest commit EVERY member can stream its own 1/M byte range
+        for (per-shard buddy fallback inside a commit, per-commit
+        fallback down the chain), then assembles the full state over
+        the collective plane — no member reads more than its ranges
+        (plus CRC-validation slop) from durable storage.  Symmetric:
+        every rank makes the same calls, so it is collectively safe
+        inside sync()."""
+        if not self._sharded_world():
+            return False
+        from ..jax.functions import allgather_object
+        n, r = basics.size(), basics.rank()
+        # min_commit = own commit: nothing at or below ANY member's
+        # commit can win (the c > max_commit gate below), so mid-job
+        # syncs skip the manifest parsing entirely instead of
+        # re-reading up to keep-K full layout descriptors per
+        # re-rendezvous.
+        cands = shardspill.restore_candidates(
+            min_commit=self._commit_id) \
+            if spill.spill_dir() is not None else []
+        recs = allgather_object(
+            {"rank": r, "commit": self._commit_id, "cands": cands},
+            name="elastic.shardspill.plan")
+        max_commit = max(int(x.get("commit", 0)) for x in recs)
+        shared = set(recs[0].get("cands", []))
+        for x in recs[1:]:
+            shared &= set(x.get("cands", []))
+        # Adopt only past EVERY member's in-memory progress: if any
+        # survivor is at/val beyond the disk commit, its memory state
+        # wins the election instead (disk is never newer than a live
+        # member's memory within one job incarnation).
+        for cid in sorted((c for c in shared if c > max_commit),
+                          reverse=True):
+            manifest = shardspill.load_manifest(cid)
+            ok, mine = manifest is not None, {}
+            if ok:
+                n_src = int(manifest["n_shards"])
+                # Round-robin whole-shard ownership: reader j streams
+                # source shards s % M == j — ≤ ⌈N/M⌉ shards per host,
+                # strictly under full-state size for M ≥ 2 (whole
+                # shards, so each read CRC-validates exactly what it
+                # streams, no overlap slop).
+                try:
+                    mine = shardspill.read_shards(
+                        manifest, [s for s in range(n_src)
+                                   if s % n == r])
+                except shardspill.ShardUnavailable as exc:
+                    LOG.warning(
+                        "sharded commit %d not streamable on rank %d "
+                        "(%s); world falls back to the previous "
+                        "commit", cid, r, exc)
+                    ok = False
+            gathered = allgather_object(
+                {"rank": r, "ok": ok, "shards": mine},
+                name="elastic.shardspill.range")
+            if not all(g.get("ok") for g in gathered):
+                continue
+            merged: dict = {}
+            for g in gathered:
+                merged.update(g.get("shards") or {})
+            n_src = int(manifest["n_shards"])
+            if set(merged) != set(range(n_src)):
+                LOG.warning("sharded commit %d reassembly is missing "
+                            "shards %s; falling back", cid,
+                            sorted(set(range(n_src)) - set(merged)))
+                continue
+            buf = b"".join(merged[s] for s in range(n_src))
+            self._load_payload(shardspill.unflatten_state(
+                buf, manifest["layout"]))
+            self._commit_id = cid
+            self.save()
+            LOG.info("restored sharded durable state at commit %d "
+                     "(N=%d writers -> M=%d readers; this rank "
+                     "streamed %d source shard(s))", cid, n_src, n,
+                     len(mine))
+            return True
+        return False
 
     # -- sync with survivor-elected root -----------------------------------
 
@@ -224,7 +344,14 @@ class ObjectState(State):
         from ..jax.functions import elect_state_root
         record = {"rank": basics.rank(),
                   "commit_id": self._commit_id,
-                  "evidence": self._durable_evidence()}
+                  "evidence": self._durable_evidence(),
+                  # The newest sharded-commit manifest this rank can
+                  # see: election evidence carries the manifest, so a
+                  # refused blank restart can name the durable commit
+                  # it refused over (and operators can see which rank
+                  # sees which durable history).
+                  "manifest_commit": shardspill.newest_manifest_commit()
+                  if shardspill.enabled() else 0}
         root, records = elect_state_root(record)
         root_commit = int(root.get("commit_id", 0))
         if any(int(r.get("commit_id", 0)) > root_commit
@@ -252,6 +379,11 @@ class ObjectState(State):
     def sync(self):
         self._sync_root = None
         adopted = self._adopt_durable_state()
+        # Sharded commits in a live multi-rank world stream N→M over
+        # the collective plane (symmetric on every rank) — this must
+        # run before the evidence guard: manifest+shard files ARE the
+        # evidence a fresh reader world restores from.
+        adopted = self._adopt_sharded_collective() or adopted
         if (not adopted and self._commit_id == 0
                 and self._durable_evidence()):
             raise StateSyncError(

@@ -40,7 +40,8 @@ from .sync_batch_norm import (SyncBatchNorm, sync_batch_norm_stats,
 from .data_parallel import (fetch,
                             make_data_parallel_step, make_sharded_jit_step,
                             shard_batch, replicate, metric_average)
-from .zero import make_zero1_step
+from .zero import (make_zero1_step, make_zero2_step, make_zero3_step,
+                   make_zero_step, zero_stage_from_env)
 from .mesh import create_mesh, create_hybrid_mesh
 from . import spmd
 from . import callbacks

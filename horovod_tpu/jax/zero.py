@@ -1,50 +1,103 @@
-"""ZeRO-1: optimizer-state sharding over the data-parallel axis.
+"""ZeRO-1/2/3: sharded training state over the data-parallel axis.
 
 Beyond-reference extension (SURVEY.md §2.5 lists ZeRO as absent
 upstream, with ``reducescatter``/``allgather`` as the primitives users
-would build it from — this module builds it).  Memory per device for
-optimizer state (and the fp32 work the update does) drops by the DP
-world size:
+would build it from — this module builds all three stages of
+Rajbhandari et al., arXiv:1910.02054, on them).  The three
+training-state units (params Ψ, gradients Ψ, optimizer state ~2Ψ for
+Adam) shard progressively across the DP world of size n:
 
-    grads --reducescatter--> my 1/n shard (mean-reduced)
-    optimizer.update on the shard (1/n of the state)
-    params --allgather-- updated shards
+    stage 1   optimizer state sharded             →  2Ψ + 2Ψ/n
+    stage 2   + persistent gradient shards        →   Ψ + 3Ψ/n
+    stage 3   + parameter shards (gather-on-use)  →        4Ψ/n
 
-With Adam the optimizer state (mu+nu = 2 of the 3 training-state
-units) shards n ways: total training-state HBM drops by (2 - 2/n)/3 —
-50% at n=4, approaching 2/3 as n grows.  XLA overlaps the
-reduce-scatter with backward compute like any collective.
+(The gradient unit is persistent whenever gradient accumulation is on
+— the normal large-model regime; ``benchmarks/zero_mem.py`` measures
+exactly these rows.)
 
-ONLY ELEMENTWISE optimizers are exact under ZeRO-1 sharding (adam,
-sgd, rmsprop, adagrad, ...): each rank updates its flat shard
+Communication shapes::
+
+    zero-1  grads --reducescatter--> shard, update, params --allgather
+            (accum_steps > 1: full-grad accumulator stays REPLICATED —
+            the paper's stage-1 gradient layout)
+    zero-2  grads --reducescatter--> SHARD accumulator (the persistent
+            gradient state is 1/n; the full gradient tree is transient
+            inside one backward), update at the boundary, allgather
+    zero-3  params --allgather-on-demand--> forward/backward, grads
+            --reducescatter--> shard, update shards, NO param allgather
+            (the next step re-gathers; the master copy is the shard)
+
+**Quantized DCN leg** (multihost worlds): the new cross-host
+reducescatter/allgather volume routes through the r12 wire codecs
+(``HOROVOD_CROSS_HOST_COMPRESSION`` = fp16/bf16/int8/fp8, or the
+``wire=`` build argument).  Over the proc×local mesh the in-host leg
+(ICI) stays full precision; only the cross-host exchange carries the
+narrow wire.  int8/fp8 gradient reduce-scatter runs with per-tensor-name
+error-feedback residuals carried in the step state (donated each step),
+so the quantization error telescopes instead of biasing the optimizer;
+zero-3's parameter gather-on-demand quantizes the *transient* gathered
+copy only — the full-precision master is the shard, so gather noise
+never accumulates.  Zero-2's parameter allgather updates the replicated
+MASTER copy and therefore stays full precision (quantizing it would
+integrate wire noise into the weights with no residual to correct it).
+Per-(op, size_class) engagement rides the r14 ``PlanController`` when
+the plan plane is active, so routing stays SPMD-identical across
+members by construction.
+
+ONLY ELEMENTWISE optimizers are exact under ZeRO sharding (adam, sgd,
+rmsprop, adagrad, ...): each rank updates its flat shard
 independently.  Optimizers that couple elements across the whole tree
 — ``clip_by_global_norm``, LAMB/LARS trust ratios, Adafactor's
-factored second moment — would compute their norms over 1/n of the
-data and silently diverge; do not use them here.
+factored second moment — would compute their statistics over 1/n of
+the data and silently diverge; the builders detect the known optax
+offenders at build time and refuse loudly (see
+:func:`_assert_elementwise`).
 
 Usage (mirrors ``make_data_parallel_step``)::
 
-    step, init = make_zero1_step(loss_fn, optax.adam(3e-4))
+    step, init = make_zero2_step(loss_fn, optax.adam(3e-4))
     params = hvd.replicate(params)
-    opt_state = init(params)              # sharded along the world axis
-    params, opt_state, loss = step(params, opt_state,
-                                   hvd.shard_batch(batch))
+    carry = init(params)
+    params, carry, loss = step(params, carry, hvd.shard_batch(batch))
+
+    step3, init3, gather3 = make_zero3_step(loss_fn, optax.adam(3e-4))
+    state = init3(hvd.replicate(params))     # params now live sharded
+    state, loss = step3(state, hvd.shard_batch(batch))
+    full = gather3(state)                    # eval/export only
+
+Model-parallel composition: pass your own ``mesh`` (e.g. a
+``create_hybrid_mesh``) plus the DP ``axes`` tuple; the loss_fn may
+use ``jax/spmd.py`` collectives over the remaining model axes — ZeRO
+shards along ``axes`` only.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+import logging
+import os
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
-from jax.sharding import PartitionSpec as P
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
 
 from . import spmd
-from .data_parallel import _world_mesh
+from .data_parallel import _multihost, _world_mesh
 from ..ops.xla_ops import AVERAGE
 
-__all__ = ["make_zero1_step"]
+LOG = logging.getLogger("horovod_tpu.jax.zero")
+
+__all__ = ["make_zero1_step", "make_zero2_step", "make_zero3_step",
+           "make_zero_step", "zero_stage_from_env"]
+
+#: Axis names of the proc×local mesh the multihost builders construct
+#: (the DCN leg runs over PROC_AXIS, the in-host ICI leg over
+#: LOCAL_AXIS).
+PROC_AXIS = "hvd_proc"
+LOCAL_AXIS = "hvd_local"
 
 
 def _pad_to(n: int, mult: int) -> int:
@@ -60,8 +113,361 @@ def _flat_pad(x, n):
     return flat
 
 
+def _shard_leaf(x, n, idx):
+    """Member ``idx``'s flat 1/n shard of one leaf — THE canonical
+    shard slice (chunk ``idx`` of the padded flat vector) every stage
+    and every wire path shares, so a stage change or codec toggle
+    never reinterprets persisted state."""
+    flat = _flat_pad(x, n)
+    per = flat.shape[0] // n
+    return lax.dynamic_slice(flat, (idx * per,), (per,))
+
+
+def _shard_tree(params, n, idx):
+    return jax.tree.map(lambda x: _shard_leaf(x, n, idx), params)
+
+
+# -- elementwise guard ------------------------------------------------------
+
+# Known non-elementwise optax transforms: the update-fn qualnames that
+# appear in the closure graph of any optimizer built from them, mapped
+# to WHY each one silently diverges under a flat 1/n shard.
+_NON_ELEMENTWISE = {
+    "clip_by_global_norm":
+        "clip_by_global_norm computes the GLOBAL gradient norm over "
+        "the whole tree; each rank would clip by the norm of its 1/n "
+        "shard and the updates silently diverge across ranks",
+    "scale_by_trust_ratio":
+        "LAMB/LARS trust ratios divide per-layer parameter and update "
+        "norms; a flat 1/n shard mixes and truncates layers, so the "
+        "ratio is computed over the wrong span — silent divergence",
+    "scale_by_factored_rms":
+        "Adafactor's factored second moment needs each leaf's full "
+        "matrix shape for its row/column statistics; a flat shard "
+        "destroys the factorization — silent divergence",
+}
+
+
+def _closure_qualnames(roots, limit: int = 512):
+    """Qualnames of every function reachable from ``roots`` through
+    closures, __wrapped__ chains, and GradientTransformation-shaped
+    members (``optax.chain`` holds its stages in closure cells)."""
+    seen, out, stack = set(), [], list(roots)
+    while stack and len(seen) < limit:
+        obj = stack.pop()
+        if id(obj) in seen:
+            continue
+        seen.add(id(obj))
+        if callable(obj) and hasattr(obj, "__qualname__"):
+            out.append(obj.__qualname__)
+            stack.extend(getattr(obj, "__closure__", None) and
+                         [c.cell_contents for c in obj.__closure__
+                          if _cell_ok(c)] or [])
+            wrapped = getattr(obj, "__wrapped__", None)
+            if wrapped is not None:
+                stack.append(wrapped)
+        elif isinstance(obj, (tuple, list)):
+            stack.extend(obj)
+        elif hasattr(obj, "init") and hasattr(obj, "update"):
+            stack.extend([obj.init, obj.update])
+    return out
+
+
+def _cell_ok(cell) -> bool:
+    try:
+        cell.cell_contents
+    except ValueError:  # empty cell
+        return False
+    return True
+
+
+def _assert_elementwise(optimizer, where: str):
+    """Refuse the known non-elementwise optax transforms LOUDLY at
+    build time: under ZeRO sharding they would compute tree-coupled
+    statistics over 1/n of the elements and diverge silently — the
+    exact failure mode the module docstring warns about."""
+    for qn in _closure_qualnames((optimizer.init, optimizer.update)):
+        for marker, why in _NON_ELEMENTWISE.items():
+            if marker in qn:
+                raise ValueError(
+                    "%s: optimizer contains the non-elementwise optax "
+                    "transform %r, which is NOT exact under ZeRO "
+                    "sharding: %s.  Use an elementwise optimizer "
+                    "(adam, sgd, rmsprop, adagrad, ...) or apply the "
+                    "coupled transform outside the sharded step."
+                    % (where, marker, why))
+
+
+# -- mesh / axes resolution -------------------------------------------------
+
+_zero_mesh_cache = {}
+
+
+def _zero_mesh_and_axes(axis_name, mesh, axes):
+    """(mesh, axes) for a ZeRO step: a caller-provided mesh wins
+    (model-parallel composition — ``axes`` names its DP dims);
+    multihost worlds get the proc×local 2-D mesh (the DCN leg is
+    addressable as PROC_AXIS); in-process worlds use the engine's
+    flat mesh."""
+    if mesh is not None:
+        use = tuple(axes) if axes else (axis_name,)
+        for a in use:
+            if a not in mesh.shape:
+                raise ValueError("axis %r not in mesh axes %s"
+                                 % (a, tuple(mesh.shape)))
+        return mesh, use
+    if axes:
+        raise ValueError("axes= requires an explicit mesh=")
+    if _multihost():
+        flat = _world_mesh()  # validates per-process homogeneity
+        devs = flat.devices.reshape(-1)
+        nproc = jax.process_count()
+        local = devs.size // nproc
+        key = tuple((d.process_index, d.id) for d in devs)
+        cached = _zero_mesh_cache.get(key)
+        if cached is None:
+            _zero_mesh_cache.clear()
+            cached = Mesh(devs.reshape(nproc, local),
+                          (PROC_AXIS, LOCAL_AXIS))
+            _zero_mesh_cache[key] = cached
+        return cached, (PROC_AXIS, LOCAL_AXIS)
+    return _world_mesh(), (axis_name,)
+
+
+def _axes_arg(axes):
+    """The axis_name argument shape lax collectives want."""
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _linear_index(axes, sizes):
+    """This shard's linearized index over ``axes`` (row-major, usable
+    inside jit)."""
+    idx = lax.axis_index(axes[0])
+    for a, s in zip(axes[1:], sizes[1:]):
+        idx = idx * s + lax.axis_index(a)
+    return idx
+
+
+# -- cross-host wire codec --------------------------------------------------
+
+def _resolve_wire(wire: Optional[str]):
+    """The DCN-leg codec: (kind, impl, label) or None.  ``wire=None``
+    reads ``HOROVOD_CROSS_HOST_COMPRESSION`` (the r12 env — one knob
+    governs the eager hier legs AND the ZeRO in-program legs).  Name
+    validation, the accepted codec set, and the loud fp8→bf16
+    fallback all live in the ENGINE's resolver — one parser, so the
+    two planes can never drift on what the knob means."""
+    name = wire if wire is not None else os.environ.get(
+        "HOROVOD_CROSS_HOST_COMPRESSION", "none")
+    from ..ops.multihost import _resolve_codec
+    codec = _resolve_codec((name or "none").strip().lower())
+    if codec is None:
+        return None
+    if codec.kind == "cast":
+        return ("cast", codec.wire, codec.name)
+    if codec.wire == np.dtype(np.int8):
+        from .compression import Int8Quantizer
+        return ("quant", Int8Quantizer, codec.name)
+    from .compression import ScaledFP8Quantizer
+    return ("quant", ScaledFP8Quantizer, codec.name)
+
+
+def _leg_engages(op: str, nbytes: int, n_procs: int, n_local: int) -> bool:
+    """Per-(op, size_class) codec engagement under the r14
+    PlanController when the plan plane is active for this topology —
+    SPMD-identical on every member because the plan itself is (shared
+    cache blob / KV adoption).  No controller = engage (the env codec
+    asked for it)."""
+    try:
+        from ..utils import plancache
+        kind = jax.devices()[0].device_kind
+        ctl = plancache.controller_for(n_procs, n_local, kind)
+    except Exception:  # noqa: BLE001 — plan plane absent/uninitialized
+        return True
+    if ctl is None:
+        return True
+    from ..ops.multihost import _pow2_class
+    return ctl.route(op, _pow2_class(nbytes), True)[1]
+
+
+def _leg_codec(wire: Optional[str], axes, sizes):
+    """The builder's resolved DCN codec, honest about engagement: on a
+    mesh with no cross-host leg (flat axis, or a 1-proc 2-level mesh)
+    an EXPLICIT ``wire=`` is refused loudly — the caller asked for
+    compression that can never engage, and silently training full
+    precision poisons any comparison (zero_mem refuses the same way) —
+    while an env-derived codec merely warns, matching the engine's
+    behavior when the hier plane is unavailable."""
+    if len(axes) == 2 and sizes[0] > 1:
+        return _resolve_wire(wire)
+    explicit = wire is not None and \
+        (wire or "none").strip().lower() not in ("", "none")
+    if explicit:
+        raise ValueError(
+            "wire=%r needs a 2-level proc x local mesh with >1 "
+            "process-level groups (got axes=%s sizes=%s): there is no "
+            "cross-host leg for the codec to ride, and silently "
+            "training full precision would misattribute the results"
+            % (wire, tuple(axes), tuple(sizes)))
+    env = os.environ.get("HOROVOD_CROSS_HOST_COMPRESSION", "none")
+    if (env or "none").strip().lower() not in ("", "none"):
+        LOG.warning(
+            "HOROVOD_CROSS_HOST_COMPRESSION=%s is set but this ZeRO "
+            "mesh has no cross-host leg (axes=%s sizes=%s); the "
+            "in-program legs stay full precision", env, tuple(axes),
+            tuple(sizes))
+    return None
+
+
+# -- hierarchical collectives (traced) --------------------------------------
+#
+# Canonical shard order shared by EVERY path (plain and wire): the flat
+# padded vector cuts into n = P*L chunks and device (p, l) owns chunk
+# p*L + l — identical to lax.psum_scatter/all_gather tiled over the
+# (PROC_AXIS, LOCAL_AXIS) tuple, so optimizer-state shards mean the
+# same thing whether or not the codec engages (a codec toggle or a
+# restore never reinterprets state).
+
+def _rs_world(flat, axes, n):
+    """Full-precision mean reduce-scatter over all of ``axes``."""
+    s = lax.psum_scatter(flat, _axes_arg(axes), scatter_dimension=0,
+                         tiled=True)
+    return (s / n).astype(flat.dtype)
+
+
+def _ag_world(shard, axes):
+    """Full-precision allgather over all of ``axes``."""
+    return lax.all_gather(shard, _axes_arg(axes), tiled=True)
+
+
+def _rs_hier_wire(flat, paxis, laxis, pn, ln, codec, residual):
+    """Mean reduce-scatter with the cross-host leg on the narrow wire:
+    in-host psum_scatter full precision (ICI), then the host-partial
+    chunks quantize/cast and cross DCN as an all_to_all exchange of
+    [pn, S] wire rows + per-row f32 scales (the 1-bit-Adam compressed
+    reduce-scatter shape), dequant-summed far side.  Returns
+    (shard, new_residual) — the residual is this member's
+    error-feedback state for this tensor (quant codecs only)."""
+    s = flat.shape[0] // (pn * ln)
+    # View [P, L, S] → local-major rows so the in-host scatter hands
+    # local device l the [P, S] partial of every chunk (·, l).
+    g2 = flat.reshape(pn, ln, s).transpose(1, 0, 2).reshape(ln, pn * s)
+    chunk = lax.psum_scatter(g2, laxis, scatter_dimension=0, tiled=True)
+    rows = chunk.reshape(pn, s)
+    kind = codec[0]
+    new_res = None
+    if kind == "cast":
+        wx = lax.all_to_all(rows.astype(codec[1]), paxis, 0, 0,
+                            tiled=True)
+        deq = wx.astype(jnp.float32)
+    else:
+        quantizer = codec[1]
+        if residual is not None:
+            rows = rows + residual.astype(rows.dtype)
+        wire, ctx = quantizer.compress(rows)
+        if residual is not None:
+            sent = quantizer.decompress(wire, ctx)
+            new_res = (rows - sent.astype(rows.dtype))
+        wx = lax.all_to_all(wire, paxis, 0, 0, tiled=True)
+        sx = lax.all_to_all(ctx[0], paxis, 0, 0, tiled=True)
+        deq = wx.astype(jnp.float32) * sx
+    shard = jnp.sum(deq, axis=0) / (pn * ln)
+    return shard.astype(flat.dtype), new_res
+
+
+def _ag_hier_wire(shard, paxis, laxis, codec):
+    """Allgather with the cross-host leg on the narrow wire: my [S]
+    chunk quantizes/casts, crosses DCN once (1/L of the bytes per
+    chip), dequants far side, and the in-host all_gather reassembles
+    full precision in canonical (p·L + l) order."""
+    if codec[0] == "cast":
+        wg = lax.all_gather(shard.astype(codec[1]), paxis, tiled=False)
+        deq = wg.astype(jnp.float32)
+    else:
+        wire, ctx = codec[1].compress(shard)
+        wg = lax.all_gather(wire, paxis, tiled=False)
+        sg = lax.all_gather(jnp.reshape(ctx[0], (1,)), paxis,
+                            tiled=False)
+        deq = wg.astype(jnp.float32) * sg
+    full = lax.all_gather(deq, laxis, tiled=False)  # [L, P, S]
+    return full.transpose(1, 0, 2).reshape(-1).astype(shard.dtype)
+
+
+# -- per-leaf build-time metadata -------------------------------------------
+
+class _Leaf:
+    __slots__ = ("name", "shape", "dtype", "size", "padded",
+                 "rs_codec", "ag_codec")
+
+    def __init__(self, name, shape, dtype, size, padded):
+        self.name, self.shape, self.dtype = name, shape, dtype
+        self.size, self.padded = size, padded
+        self.rs_codec = None
+        self.ag_codec = None
+
+
+def _leaf_meta(params, n, codec, sizes, ops=("reducescatter",)):
+    """Static per-leaf records (tree order): flat/padded sizes plus the
+    codec engagement decision per op, resolved once at build time
+    through the plan plane.  Returns (treedef, [_Leaf...])."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+    paths, treedef = tree_flatten_with_path(
+        jax.eval_shape(lambda p: p, params))
+    metas = []
+    two_level = len(sizes) == 2 and sizes[0] > 1
+    for path, leaf in paths:
+        name = keystr(path) or "/"
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        m = _Leaf(name, tuple(leaf.shape), leaf.dtype, size,
+                  _pad_to(size, n))
+        if codec is not None and two_level \
+                and jnp.issubdtype(leaf.dtype, jnp.floating):
+            nbytes = m.padded * np.dtype(leaf.dtype).itemsize
+            if "reducescatter" in ops and _leg_engages(
+                    "reducescatter", nbytes, sizes[0], sizes[1]):
+                m.rs_codec = codec
+            if "allgather" in ops and _leg_engages(
+                    "allgather", nbytes, sizes[0], sizes[1]):
+                m.ag_codec = codec
+        metas.append(m)
+    return treedef, metas
+
+
+def _rs_leaf(meta, grad, axes, sizes, n, ef):
+    """Reduce-scatter one gradient leaf into this member's shard,
+    through the wire leg when engaged.  Returns (shard, new_residual
+    or None)."""
+    flat = _flat_pad(grad, n)
+    if meta.rs_codec is not None:
+        res = ef.get(meta.name)
+        shard, new_res = _rs_hier_wire(
+            flat, axes[0], axes[1], sizes[0], sizes[1],
+            meta.rs_codec, res[0] if res is not None else None)
+        return shard, (None if new_res is None else new_res[None])
+    return _rs_world(flat, axes, n), None
+
+
+def _ef_spec_and_init(metas, axes, sizes, n):
+    """(spec, local_shapes) for the error-feedback residual dict: one
+    global [n, P, S] f32 leaf per quant-engaged tensor name, dim 0
+    sharded across the world — each member carries its own [1, P, S]
+    residual block, donated through the step."""
+    spec, local_shapes = {}, {}
+    if len(sizes) != 2:
+        return spec, local_shapes
+    pn = sizes[0]
+    for m in metas:
+        if m.rs_codec is not None and m.rs_codec[0] == "quant":
+            spec[m.name] = P(tuple(axes))
+            local_shapes[m.name] = (1, pn, m.padded // n)
+    return spec, local_shapes
+
+
+# -- stage 1 ----------------------------------------------------------------
+
 def make_zero1_step(loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
+                    accum_steps: int = 1,
                     axis_name: str = spmd.DEFAULT_AXIS):
     """Build ``(step, init)`` with ZeRO-1 sharded optimizer state.
 
@@ -72,33 +478,35 @@ def make_zero1_step(loss_fn: Callable,
     params stay replicated, optimizer state lives sharded.  Params and
     opt state are donated each step: keep using the returned values.
 
-    ``optimizer`` must be elementwise (see module docstring).
+    ``accum_steps > 1`` adds the paper-faithful stage-1 gradient
+    accumulator: FULL and replicated (stage 1 does not shard
+    gradients), filled by a pmean allreduce each microbatch; the
+    optimizer applies every ``accum_steps``-th call.  The opt_state
+    argument becomes ``(opt_state, acc_tree, micro)`` — treat it as
+    opaque carry.
+
+    ``optimizer`` must be elementwise (see module docstring); the
+    known optax offenders are refused loudly at build time.
     """
+    _assert_elementwise(optimizer, "make_zero1_step")
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
     mesh = _world_mesh()
     n = mesh.shape[axis_name]
 
     def shard_params_local(params, idx):
-        def leaf(x):
-            flat = _flat_pad(x, n)
-            per = flat.shape[0] // n
-            return jax.lax.dynamic_slice(flat, (idx * per,), (per,))
-        return jax.tree.map(leaf, params)
+        return _shard_tree(params, n, idx)
 
     def local_init(params):
         idx = jax.lax.axis_index(axis_name)
-        return optimizer.init(shard_params_local(params, idx))
+        opt = optimizer.init(shard_params_local(params, idx))
+        if accum_steps == 1:
+            return opt
+        acc = jax.tree.map(jnp.zeros_like, params)
+        return (opt, acc, jnp.zeros((), jnp.int32))
 
-    def local_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        loss = jax.lax.pmean(loss, axis_name)
+    def _apply(params, opt_state, grad_shards):
         idx = jax.lax.axis_index(axis_name)
-
-        def rs(g):
-            # mean-reduce + scatter my 1/n of every gradient
-            return spmd.reducescatter(_flat_pad(g, n), op=AVERAGE,
-                                      axis_name=axis_name)
-
-        grad_shards = jax.tree.map(rs, grads)
         param_shards = shard_params_local(params, idx)
         updates, opt_state = optimizer.update(grad_shards, opt_state,
                                               param_shards)
@@ -109,8 +517,43 @@ def make_zero1_step(loss_fn: Callable,
             return full[:like.size].reshape(like.shape) \
                 .astype(like.dtype)
 
-        params = jax.tree.map(ag, new_shards, params)
-        return params, opt_state, loss
+        return jax.tree.map(ag, new_shards, params), opt_state
+
+    def local_step(params, carry, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss, axis_name)
+
+        if accum_steps == 1:
+            def rs(g):
+                return spmd.reducescatter(_flat_pad(g, n), op=AVERAGE,
+                                          axis_name=axis_name)
+            params, opt_state = _apply(params, carry,
+                                       jax.tree.map(rs, grads))
+            return params, opt_state, loss
+
+        opt_state, acc, micro = carry
+        # Stage-1 gradient layout: the accumulator is FULL and
+        # replicated (pmean allreduce per microbatch) — sharding it is
+        # stage 2's move.
+        acc = jax.tree.map(
+            lambda a, g: a + jax.lax.pmean(g, axis_name) / accum_steps,
+            acc, grads)
+        micro = micro + 1
+
+        def boundary(args):
+            params, opt_state, acc = args
+            idx = jax.lax.axis_index(axis_name)
+
+            params, opt_state = _apply(
+                params, opt_state,
+                jax.tree.map(lambda a: _shard_leaf(a, n, idx), acc))
+            return params, opt_state, jax.tree.map(jnp.zeros_like, acc)
+
+        params, opt_state, acc = jax.lax.cond(
+            micro >= accum_steps, boundary, lambda a: a,
+            (params, opt_state, acc))
+        micro = jnp.where(micro >= accum_steps, 0, micro)
+        return params, (opt_state, acc, micro), loss
 
     compiled = {}
 
@@ -120,16 +563,21 @@ def make_zero1_step(loss_fn: Callable,
         # are replicated
         state_shapes = jax.eval_shape(
             lambda p: optimizer.init(shard_params_local(p, 0)), params)
-        state_spec = jax.tree.map(
+        opt_spec = jax.tree.map(
             lambda s: P(axis_name) if getattr(s, "ndim", 0) >= 1
             else P(), state_shapes)
+        if accum_steps == 1:
+            carry_spec = opt_spec
+        else:
+            acc_spec = jax.tree.map(lambda _: P(), params)
+            carry_spec = (opt_spec, acc_spec, P())
         mapped_init = jax.shard_map(
             local_init, mesh=mesh, in_specs=(P(),),
-            out_specs=state_spec, check_vma=False)
+            out_specs=carry_spec, check_vma=False)
         mapped_step = jax.shard_map(
             local_step, mesh=mesh,
-            in_specs=(P(), state_spec, P(axis_name)),
-            out_specs=(P(), state_spec, P()), check_vma=False)
+            in_specs=(P(), carry_spec, P(axis_name)),
+            out_specs=(P(), carry_spec, P()), check_vma=False)
         compiled["step"] = jax.jit(mapped_step, donate_argnums=(0, 1))
         return jax.jit(mapped_init)(params)
 
@@ -139,3 +587,372 @@ def make_zero1_step(loss_fn: Callable,
         return compiled["step"](params, opt_state, batch)
 
     return step, init
+
+
+# -- stage 2 ----------------------------------------------------------------
+
+def make_zero2_step(loss_fn: Callable,
+                    optimizer: optax.GradientTransformation,
+                    accum_steps: int = 1,
+                    axis_name: str = spmd.DEFAULT_AXIS,
+                    mesh: Optional[Mesh] = None,
+                    axes: Optional[Sequence[str]] = None,
+                    wire: Optional[str] = None):
+    """Build ``(step, init)`` with ZeRO-2 sharding: optimizer state AND
+    the persistent gradient state live as 1/n shards.
+
+    Gradients are reduce-scattered straight into this member's shard —
+    the full gradient tree is transient inside one backward, and with
+    ``accum_steps > 1`` the accumulator holds SHARDS (1/n of stage 1's
+    replicated buffer).  Params stay replicated; the boundary update
+    runs on shards and allgathers the new params (full precision — the
+    replicated copy is the master, see module docstring).
+
+    ``init(params) -> carry`` (opaque: opt state, shard accumulator,
+    micro counter, EF residuals); ``step(params, carry, batch) ->
+    (params, carry, loss)`` with params and carry donated.
+
+    Multihost worlds run over the proc×local mesh and the gradient
+    reduce-scatter's DCN leg rides the configured wire codec with
+    per-tensor-name error feedback (``wire=`` overrides the env).
+    """
+    _assert_elementwise(optimizer, "make_zero2_step")
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
+    mesh, axes = _zero_mesh_and_axes(axis_name, mesh, axes)
+    sizes = tuple(mesh.shape[a] for a in axes)
+    n = int(np.prod(sizes))
+    codec = _leg_codec(wire, axes, sizes)
+    axes_arg = _axes_arg(axes)
+    shard_spec = P(axes_arg if len(axes) > 1 else axes[0])
+
+    def shard_params_local(params, idx):
+        return _shard_tree(params, n, idx)
+
+    build = {}
+
+    def local_init(params):
+        idx = _linear_index(axes, sizes)
+        metas = build["metas"]
+        pshards = jax.tree.leaves(shard_params_local(params, idx))
+        pshards = {m.name: s for m, s in zip(metas, pshards)}
+        carry = {"opt": optimizer.init(pshards),
+                 "ef": {k: jnp.zeros(shape, jnp.float32)
+                        for k, shape in build["ef_shapes"].items()}}
+        if accum_steps > 1:
+            carry["acc"] = {m.name: jnp.zeros((m.padded // n,), m.dtype)
+                            for m in metas}
+            carry["micro"] = jnp.zeros((), jnp.int32)
+        return carry
+
+    def _grad_shards(grads, ef):
+        metas = build["metas"]
+        leaves = jax.tree.leaves(grads)
+        shards, new_ef = {}, dict(ef)
+        for m, g in zip(metas, leaves):
+            shard, res = _rs_leaf(m, g, axes, sizes, n, ef)
+            shards[m.name] = shard
+            if res is not None:
+                new_ef[m.name] = res
+        return shards, new_ef
+
+    def _apply(params, opt_state, gshards):
+        metas = build["metas"]
+        idx = _linear_index(axes, sizes)
+        pshards = jax.tree.leaves(shard_params_local(params, idx))
+        pshards = {m.name: s for m, s in zip(metas, pshards)}
+        updates, opt_state = optimizer.update(gshards, opt_state,
+                                              pshards)
+        new_shards = optax.apply_updates(pshards, updates)
+        pleaves = jax.tree.leaves(params)
+        out = []
+        for m, like in zip(metas, pleaves):
+            full = _ag_world(new_shards[m.name], axes)
+            out.append(full[:m.size].reshape(m.shape)
+                       .astype(like.dtype))
+        return (jax.tree.unflatten(build["treedef"], out), opt_state)
+
+    def local_step(params, carry, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss, axes_arg)
+        gshards, new_ef = _grad_shards(grads, carry["ef"])
+
+        if accum_steps == 1:
+            params, opt = _apply(params, carry["opt"], gshards)
+            return params, {"opt": opt, "ef": new_ef}, loss
+
+        acc = {k: a + gshards[k].astype(a.dtype) / accum_steps
+               for k, a in carry["acc"].items()}
+        micro = carry["micro"] + 1
+
+        def boundary(args):
+            params, opt, acc = args
+            params, opt = _apply(params, opt, acc)
+            return params, opt, {k: jnp.zeros_like(a)
+                                 for k, a in acc.items()}
+
+        params, opt, acc = jax.lax.cond(
+            micro >= accum_steps, boundary, lambda a: a,
+            (params, carry["opt"], acc))
+        micro = jnp.where(micro >= accum_steps, 0, micro)
+        return params, {"opt": opt, "acc": acc, "micro": micro,
+                        "ef": new_ef}, loss
+
+    compiled = {}
+
+    def init(params):
+        treedef, metas = _leaf_meta(params, n, codec, sizes,
+                                    ops=("reducescatter",))
+        ef_spec, ef_shapes = _ef_spec_and_init(metas, axes, sizes, n)
+        build.update(treedef=treedef, metas=metas, ef_shapes=ef_shapes)
+        opt_shapes = jax.eval_shape(
+            lambda p: optimizer.init(
+                {m.name: s for m, s in zip(
+                    metas, jax.tree.leaves(shard_params_local(p, 0)))}),
+            params)
+        opt_spec = jax.tree.map(
+            lambda s: shard_spec if getattr(s, "ndim", 0) >= 1
+            else P(), opt_shapes)
+        carry_spec = {"opt": opt_spec, "ef": ef_spec}
+        if accum_steps > 1:
+            carry_spec["acc"] = {m.name: shard_spec for m in metas}
+            carry_spec["micro"] = P()
+        mapped_init = jax.shard_map(
+            local_init, mesh=mesh, in_specs=(P(),),
+            out_specs=carry_spec, check_vma=False)
+        mapped_step = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(), carry_spec, shard_spec),
+            out_specs=(P(), carry_spec, P()), check_vma=False)
+        compiled["step"] = jax.jit(mapped_step, donate_argnums=(0, 1))
+        return jax.jit(mapped_init)(params)
+
+    def step(params, carry, batch):
+        if "step" not in compiled:
+            raise RuntimeError("call init(params) before step(...)")
+        return compiled["step"](params, carry, batch)
+
+    return step, init
+
+
+# -- stage 3 ----------------------------------------------------------------
+
+def make_zero3_step(loss_fn: Callable,
+                    optimizer: optax.GradientTransformation,
+                    accum_steps: int = 1,
+                    axis_name: str = spmd.DEFAULT_AXIS,
+                    mesh: Optional[Mesh] = None,
+                    axes: Optional[Sequence[str]] = None,
+                    wire: Optional[str] = None):
+    """Build ``(step, init, gather)`` with ZeRO-3 sharding: params,
+    gradients AND optimizer state all live as 1/n shards — total
+    persistent training state is ~4Ψ/n per device.
+
+    ``init(params)`` consumes a replicated param tree ONCE and returns
+    the sharded ``state`` dict (param shards, opt shards, accumulator,
+    EF residuals); ``step(state, batch) -> (state, loss)`` gathers
+    each parameter leaf on demand (allgather before use; XLA frees the
+    gathered copy after its last use — nothing full-size persists),
+    reduce-scatters gradients into shards, and updates shards in
+    place.  There is NO trailing parameter allgather: the next step
+    re-gathers, and the full-precision master copy is the shard — so
+    a quantized gather (DCN leg on the wire codec) perturbs only the
+    transient per-step copy, never the master.  ``gather(state)``
+    materializes the replicated params for eval/export.
+    """
+    _assert_elementwise(optimizer, "make_zero3_step")
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
+    mesh, axes = _zero_mesh_and_axes(axis_name, mesh, axes)
+    sizes = tuple(mesh.shape[a] for a in axes)
+    n = int(np.prod(sizes))
+    codec = _leg_codec(wire, axes, sizes)
+    axes_arg = _axes_arg(axes)
+    shard_spec = P(axes_arg if len(axes) > 1 else axes[0])
+
+    build = {}
+
+    def _gather_full(shards):
+        """Gathered (transient) replicated params from shard dict."""
+        metas = build["metas"]
+        out = []
+        for m in metas:
+            s = shards[m.name]
+            if m.ag_codec is not None:
+                full = _ag_hier_wire(s, axes[0], axes[1], m.ag_codec)
+            else:
+                full = _ag_world(s, axes)
+            out.append(full[:m.size].reshape(m.shape).astype(m.dtype))
+        return jax.tree.unflatten(build["treedef"], out)
+
+    def local_init(params):
+        idx = _linear_index(axes, sizes)
+        metas = build["metas"]
+        leaves = jax.tree.leaves(params)
+        shards = {m.name: _shard_leaf(x, n, idx)
+                  for m, x in zip(metas, leaves)}
+        state = {"shards": shards,
+                 "opt": optimizer.init(shards),
+                 "ef": {k: jnp.zeros(shape, jnp.float32)
+                        for k, shape in build["ef_shapes"].items()}}
+        if accum_steps > 1:
+            state["acc"] = {k: jnp.zeros_like(v)
+                            for k, v in shards.items()}
+            state["micro"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def local_step(state, batch):
+        metas = build["metas"]
+        params = _gather_full(state["shards"])
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss, axes_arg)
+        gleaves = jax.tree.leaves(grads)
+        gshards, new_ef = {}, dict(state["ef"])
+        for m, g in zip(metas, gleaves):
+            shard, res = _rs_leaf(m, g, axes, sizes, n, state["ef"])
+            gshards[m.name] = shard
+            if res is not None:
+                new_ef[m.name] = res
+
+        def update(shards, opt, g):
+            updates, opt = optimizer.update(g, opt, shards)
+            return optax.apply_updates(shards, updates), opt
+
+        if accum_steps == 1:
+            shards, opt = update(state["shards"], state["opt"], gshards)
+            return {"shards": shards, "opt": opt, "ef": new_ef}, loss
+
+        acc = {k: a + gshards[k].astype(a.dtype) / accum_steps
+               for k, a in state["acc"].items()}
+        micro = state["micro"] + 1
+
+        def boundary(args):
+            shards, opt, acc = args
+            shards, opt = update(shards, opt, acc)
+            return shards, opt, {k: jnp.zeros_like(a)
+                                 for k, a in acc.items()}
+
+        shards, opt, acc = jax.lax.cond(
+            micro >= accum_steps, boundary, lambda a: a,
+            (state["shards"], state["opt"], acc))
+        micro = jnp.where(micro >= accum_steps, 0, micro)
+        return {"shards": shards, "opt": opt, "acc": acc,
+                "micro": micro, "ef": new_ef}, loss
+
+    def local_gather(state):
+        # Full-precision gather for eval/export: the wire codec is a
+        # step-time lever, not an export-time one.
+        metas = build["metas"]
+        out = []
+        for m in metas:
+            full = _ag_world(state["shards"][m.name], axes)
+            out.append(full[:m.size].reshape(m.shape).astype(m.dtype))
+        return jax.tree.unflatten(build["treedef"], out)
+
+    compiled = {}
+
+    def init(params):
+        treedef, metas = _leaf_meta(params, n, codec, sizes,
+                                    ops=("reducescatter", "allgather"))
+        ef_spec, ef_shapes = _ef_spec_and_init(metas, axes, sizes, n)
+        build.update(treedef=treedef, metas=metas, ef_shapes=ef_shapes)
+        shards_spec = {m.name: shard_spec for m in metas}
+        opt_shapes = jax.eval_shape(
+            lambda p: optimizer.init(
+                {m.name: _flat_pad(x, n)[:m.padded // n]
+                 for m, x in zip(metas, jax.tree.leaves(p))}),
+            params)
+        opt_spec = jax.tree.map(
+            lambda s: shard_spec if getattr(s, "ndim", 0) >= 1
+            else P(), opt_shapes)
+        state_spec = {"shards": shards_spec, "opt": opt_spec,
+                      "ef": ef_spec}
+        if accum_steps > 1:
+            state_spec["acc"] = dict(shards_spec)
+            state_spec["micro"] = P()
+        mapped_init = jax.shard_map(
+            local_init, mesh=mesh, in_specs=(P(),),
+            out_specs=state_spec, check_vma=False)
+        mapped_step = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(state_spec, shard_spec),
+            out_specs=(state_spec, P()), check_vma=False)
+        mapped_gather = jax.shard_map(
+            local_gather, mesh=mesh, in_specs=(state_spec,),
+            out_specs=P(), check_vma=False)
+        compiled["step"] = jax.jit(mapped_step, donate_argnums=(0,))
+        compiled["gather"] = jax.jit(mapped_gather)
+        return jax.jit(mapped_init)(params)
+
+    def step(state, batch):
+        if "step" not in compiled:
+            raise RuntimeError("call init(params) before step(...)")
+        return compiled["step"](state, batch)
+
+    def gather(state):
+        if "gather" not in compiled:
+            raise RuntimeError("call init(params) before gather(...)")
+        return compiled["gather"](state)
+
+    return step, init, gather
+
+
+# -- stage dispatch ---------------------------------------------------------
+
+def zero_stage_from_env() -> int:
+    """``HOROVOD_ZERO_STAGE`` (0-3, default 0 = plain data parallel);
+    malformed or out-of-range values are refused loudly — a typo'd
+    stage silently training plain DP is exactly the drift this env
+    exists to prevent."""
+    raw = os.environ.get("HOROVOD_ZERO_STAGE")
+    if raw is None or not raw.strip():
+        return 0
+    try:
+        stage = int(raw)
+    except ValueError:
+        raise ValueError(
+            "HOROVOD_ZERO_STAGE=%r is not an integer (known stages: "
+            "0 (off), 1, 2, 3)" % raw)
+    if not 0 <= stage <= 3:
+        raise ValueError(
+            "HOROVOD_ZERO_STAGE=%d: known stages are 0 (off), 1, 2, 3"
+            % stage)
+    return stage
+
+
+def make_zero_step(loss_fn: Callable,
+                   optimizer: optax.GradientTransformation,
+                   stage: Optional[int] = None, **kwargs):
+    """Stage-dispatched builder: ``stage=None`` reads
+    ``HOROVOD_ZERO_STAGE`` (default 0 = ``make_data_parallel_step``).
+    Returns each stage's native tuple — ``(step, init)`` for stages
+    0-2, ``(step, init, gather)`` for stage 3; the carry argument is
+    stage-opaque by design."""
+    stage = zero_stage_from_env() if stage is None else int(stage)
+    if stage < 2:
+        # Stages 0/1 have no mesh/axes/wire surface; dropping an
+        # explicit argument silently would change training semantics
+        # under an env flip, so refuse instead.
+        for k in ("mesh", "axes", "wire"):
+            if kwargs.pop(k, None) is not None:
+                raise ValueError(
+                    "make_zero_step: %s= is a stage-2/3 argument but "
+                    "the resolved stage is %d (HOROVOD_ZERO_STAGE?)"
+                    % (k, stage))
+    if stage == 0:
+        from .data_parallel import make_data_parallel_step
+        kwargs.pop("axis_name", None)
+        accum = int(kwargs.pop("accum_steps", 1) or 1)
+        if accum > 1:
+            # Same one-update-per-accum semantics the sharded stages
+            # give: accumulate through optax.MultiSteps rather than
+            # silently applying every microbatch.
+            optimizer = optax.MultiSteps(optimizer, accum)
+        return make_data_parallel_step(loss_fn, optimizer, **kwargs)
+    if stage == 1:
+        return make_zero1_step(loss_fn, optimizer, **kwargs)
+    if stage == 2:
+        return make_zero2_step(loss_fn, optimizer, **kwargs)
+    if stage == 3:
+        return make_zero3_step(loss_fn, optimizer, **kwargs)
+    raise ValueError("unknown ZeRO stage %r" % stage)

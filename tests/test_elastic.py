@@ -1444,3 +1444,138 @@ train(state)
         except OSError:
             pass
         proc.wait(timeout=30)
+
+
+SHARD_SPILL_WORKER = """
+import hashlib, os, sys
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.common import metrics
+
+hvd.init()
+rng = np.random.RandomState(7)
+state = elastic.JaxState(
+    params={"w": rng.randn(64, 8).astype(np.float32),
+            "b": rng.randn(64).astype(np.float64)},
+    batch=0)
+
+
+def state_hash(state):
+    h = hashlib.sha256()
+    for k in sorted(state.params):
+        h.update(np.ascontiguousarray(
+            np.asarray(state.params[k])).tobytes())
+    return h.hexdigest()[:16]
+
+
+@elastic.run
+def train(state):
+    print("ENTER rank=%d size=%d batch=%d commit=%d hash=%s"
+          % (hvd.rank(), hvd.size(), state.batch, state._commit_id,
+             state_hash(state)), flush=True)
+    print("RESTORE_BYTES rank=%d bytes=%d"
+          % (hvd.rank(),
+             int(metrics.series_sum("shardspill_restore_bytes_total"))),
+          flush=True)
+    while state.batch < 6:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="b%d" % state.batch)
+        state.params["w"] = state.params["w"] + float(np.asarray(out)[0])
+        state.batch += 1
+        state.commit()
+        print("COMMIT rank=%d commit=%d hash=%s"
+              % (hvd.rank(), state._commit_id, state_hash(state)),
+              flush=True)
+    print("DONE rank=%d size=%d batch=%d hash=%s"
+          % (hvd.rank(), hvd.size(), state.batch, state_hash(state)),
+          flush=True)
+
+
+train(state)
+"""
+
+
+@pytest.mark.slow
+def test_shard_spill_n_to_m_restore(tmp_path):
+    """ISSUE 15 acceptance: a 2-proc world's SHARDED commit restores
+    bitwise-identical state into a 1-proc world (2→1) AND a 3-proc
+    world (2→3), per-host restore I/O < full-state size in the 3-proc
+    world, and a torn shard (elastic.state.shard@shard=1@rank=0 —
+    rank 0's buddy copy, the one the reader tries FIRST) falls back
+    per shard to the surviving copy without discarding the commit."""
+    import shutil
+
+    spill_dir = tmp_path / "spills"
+    script = tmp_path / "train.py"
+    script.write_text(SHARD_SPILL_WORKER)
+    env = _env()
+    env["HOROVOD_STATE_SPILL_DIR"] = str(spill_dir)
+    env["HOROVOD_STATE_SHARD_SPILL"] = "1"
+
+    # Run 1: 2 writers, commits 1..5 land sharded (rank 0's copy of
+    # shard 1 torn every commit), every worker dies at commit 6.
+    env1 = dict(env)
+    env1["HVD_TPU_FAULT"] = ("elastic.state.shard:drop@shard=1@rank=0,"
+                             "elastic.state.commit:die:21@after=5")
+    env1["HOROVOD_ELASTIC_EXIT_GRACE"] = "5"
+    proc1 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         "--elastic-timeout", "6",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env1, cwd=REPO)
+    assert proc1.returncode != 0, proc1.stdout + proc1.stderr
+    assert "torn (faultline elastic.state.shard)" in proc1.stderr, \
+        proc1.stderr
+    import re as _re
+    h5 = set(_re.findall(r"COMMIT rank=\d+ commit=5 hash=(\w+)",
+                         proc1.stdout))
+    assert len(h5) == 1, proc1.stdout  # ranks agree at commit 5
+    h5 = h5.pop()
+    from horovod_tpu.elastic import shardspill
+    manifest = shardspill.load_manifest(5, d=str(spill_dir))
+    assert manifest is not None and manifest["n_shards"] == 2
+    total = int(manifest["total_bytes"])
+
+    # Freeze the durable state for the second reader world: each run
+    # appends its own commits.
+    dir_b = tmp_path / "spills_b"
+    shutil.copytree(spill_dir, dir_b)
+
+    # Run 2a: 2 -> 1 resharding restore (whole stream, one reader).
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1", "--min-np", "1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env, cwd=REPO)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "ENTER rank=0 size=1 batch=5 commit=5 hash=%s" % h5 \
+        in proc2.stdout, proc2.stdout + proc2.stderr
+    assert "falling back to the next copy of shard 1" in proc2.stderr, \
+        proc2.stderr
+
+    # Run 2b: 2 -> 3 resharding restore (streamed ranges + collective
+    # reassembly; per-host restore I/O asserted < full state).
+    env_b = dict(env)
+    env_b["HOROVOD_STATE_SPILL_DIR"] = str(dir_b)
+    proc3 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1,127.0.0.3:1", "--min-np", "3",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(300),
+        env=env_b, cwd=REPO)
+    assert proc3.returncode == 0, proc3.stdout + proc3.stderr
+    for r in range(3):
+        assert "ENTER rank=%d size=3 batch=5 commit=5 hash=%s" \
+            % (r, h5) in proc3.stdout, proc3.stdout + proc3.stderr
+    streamed = {m.group(1): int(m.group(2)) for m in _re.finditer(
+        r"RESTORE_BYTES rank=(\d+) bytes=(\d+)", proc3.stdout)}
+    assert len(streamed) == 3, proc3.stdout
+    # Per-host peak restore I/O strictly under full-state size; the
+    # union still covers the whole stream (readers 0/1 own one source
+    # shard each, reader 2 owns none in the 2→3 case).
+    assert all(v < total for v in streamed.values()), (streamed, total)
+    assert sum(streamed.values()) >= total, (streamed, total)

@@ -307,3 +307,24 @@ def test_init_detects_preinitialized_runtime(monkeypatch):
                                             "before|process_count"):
         mh.init_jax_distributed(cfg, rank=0, size=2)
     mh.init_jax_distributed._done = False
+
+
+ZERO_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "utils",
+    "zero_mh_worker.py")
+
+
+@pytest.mark.slow
+def test_multihost_zero23_quantized_e2e():
+    # ISSUE 15: ZeRO-2/3 step builders over the REAL proc x local mesh
+    # (2 procs x 2 local devices) with the int8 DCN leg armed —
+    # position-dependent payloads vs a single-device reference within
+    # the EF bounds, per-tensor EF residuals present, and (via
+    # HVD_TPU_DUMP_HLO) the lowered programs spanning all
+    # n_procs x n_local partitions with reduce-scatter/all-gather HLO
+    # and an s8 wire.
+    _assert_ok(_spawn_multihost(2, local_devices=2, worker=ZERO_WORKER,
+                                extra_env={
+        "HOROVOD_CROSS_HOST_COMPRESSION": "int8",
+        "HVD_TPU_DUMP_HLO": "1",
+    }))

@@ -1,0 +1,302 @@
+"""Sharded durable commits (ISSUE 15): flat layout, manifest + shard
+blobs, N→M range streaming, per-shard/per-commit fallback, the
+``elastic.state.shard`` injection, and the state.py wiring — fast
+units (no spawned processes; the 2-proc e2es live in
+test_elastic.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import faultline, metrics
+from horovod_tpu.elastic import shardspill, spill
+from horovod_tpu.elastic.state import JaxState
+
+
+def _payload(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "attrs": {"epoch": 3, "batch": 7},
+        "trees": {
+            "params": {"w": rng.randn(16, 8).astype(np.float32),
+                       "b": rng.randn(8).astype(np.float64)},
+            "opt": (np.int32(4),
+                    {"mu": rng.randn(2, 3).astype(np.float32)}),
+        },
+    }
+
+
+def _assert_payload_equal(a, b):
+    assert a["attrs"] == b["attrs"]
+    np.testing.assert_array_equal(a["trees"]["params"]["w"],
+                                  b["trees"]["params"]["w"])
+    np.testing.assert_array_equal(a["trees"]["params"]["b"],
+                                  b["trees"]["params"]["b"])
+    np.testing.assert_array_equal(a["trees"]["opt"][1]["mu"],
+                                  b["trees"]["opt"][1]["mu"])
+
+
+def _write_world(commit, buf, layout, d, n=2):
+    for r in range(n):
+        assert shardspill.write_commit(commit, buf, layout,
+                                       shard_index=r, n_shards=n,
+                                       tag="r%d" % r, d=str(d))
+
+
+def _tear(path, keep_frac=0.5):
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:int(len(blob) * keep_frac)])
+
+
+def test_flatten_unflatten_roundtrip_mixed_trees():
+    payload = _payload()
+    buf, layout = shardspill.flatten_state(payload)
+    assert layout[0]["key"] == "__head__"
+    # every tree leaf appears at a recorded range with dtype/shape
+    keys = [e["key"] for e in layout[1:]]
+    assert len(keys) == 4 and all(k.startswith("t:") for k in keys)
+    assert layout[-1]["offset"] + layout[-1]["nbytes"] == len(buf)
+    _assert_payload_equal(shardspill.unflatten_state(buf, layout),
+                          payload)
+
+
+def test_shard_range_partitions_exactly():
+    for total in (0, 1, 7, 100):
+        for n in (1, 2, 3, 7):
+            ranges = [shardspill.shard_range(total, n, i)
+                      for i in range(n)]
+            assert ranges[0][0] == 0 and ranges[-1][1] == total
+            for (a, b), (c, _d) in zip(ranges, ranges[1:]):
+                assert b == c and a <= b
+
+
+def test_write_scan_restore_roundtrip_and_replicas(tmp_path):
+    buf, layout = shardspill.flatten_state(_payload())
+    _write_world(9, buf, layout, tmp_path)
+    names = sorted(os.listdir(tmp_path))
+    # 2 manifests + each shard index has its own copy AND one buddy
+    assert sum(n.endswith(".manifest") for n in names) == 2
+    assert sum(n.endswith(".shard") for n in names) == 4
+    assert shardspill.have_evidence(str(tmp_path))
+    assert shardspill.newest_manifest_commit(str(tmp_path)) == 9
+    cid, restored = shardspill.restore_local(d=str(tmp_path))
+    assert cid == 9
+    _assert_payload_equal(restored, _payload())
+
+
+def test_n_to_m_range_streaming_bitwise(tmp_path):
+    buf, layout = shardspill.flatten_state(_payload(1))
+    _write_world(5, buf, layout, tmp_path, n=2)
+    manifest = shardspill.load_manifest(5, d=str(tmp_path))
+    assert manifest["n_shards"] == 2
+    for m in (1, 3, 5):
+        chunks = [shardspill.read_range(
+            manifest, *shardspill.shard_range(len(buf), m, j),
+            d=str(tmp_path)) for j in range(m)]
+        assert b"".join(chunks) == buf, "M=%d reassembly differs" % m
+
+
+def test_reader_streams_less_than_full_state(tmp_path):
+    """The N→M claim at unit level: one reader of an M=3 world reads
+    only the source shards overlapping its range — strictly less than
+    the full stream."""
+    buf, layout = shardspill.flatten_state(_payload(2))
+    _write_world(5, buf, layout, tmp_path, n=2)
+    manifest = shardspill.load_manifest(5, d=str(tmp_path))
+    before = metrics.series_sum("shardspill_restore_bytes_total")
+    lo, hi = shardspill.shard_range(len(buf), 3, 0)
+    shardspill.read_range(manifest, lo, hi, d=str(tmp_path))
+    streamed = metrics.series_sum("shardspill_restore_bytes_total") \
+        - before
+    assert 0 < streamed < len(buf), (streamed, len(buf))
+
+
+def test_corrupt_copy_falls_back_per_shard_not_per_commit(tmp_path):
+    buf, layout = shardspill.flatten_state(_payload(3))
+    _write_world(9, buf, layout, tmp_path)
+    _tear(tmp_path / ("shard-%020d-0of2-r0.shard" % 9))
+    before = metrics.series_sum("shardspill_shard_fallbacks_total")
+    cid, restored = shardspill.restore_local(d=str(tmp_path))
+    assert cid == 9  # the commit survives the torn copy
+    _assert_payload_equal(restored, _payload(3))
+    assert metrics.series_sum("shardspill_shard_fallbacks_total") \
+        == before + 1
+
+
+def test_all_copies_corrupt_falls_back_per_commit(tmp_path):
+    buf, layout = shardspill.flatten_state(_payload(4))
+    _write_world(8, buf, layout, tmp_path)
+    _write_world(9, buf, layout, tmp_path)
+    for r in range(2):
+        _tear(tmp_path / ("shard-%020d-0of2-r%d.shard" % (9, r)))
+    cid, _restored = shardspill.restore_local(d=str(tmp_path))
+    assert cid == 8  # every copy of commit 9's shard 0 is bad
+
+
+def test_prune_keeps_last_k_commits(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_STATE_KEEP", "2")
+    buf, layout = shardspill.flatten_state(_payload())
+    for commit in (1, 2, 3, 4):
+        _write_world(commit, buf, layout, tmp_path)
+    names = os.listdir(tmp_path)
+    assert not any("%020d" % 1 in n for n in names), names
+    assert not any("%020d" % 2 in n for n in names), names
+    cid, _ = shardspill.restore_local(d=str(tmp_path))
+    assert cid == 4
+
+
+def test_shard_cond_key_parses_and_targets_one_index():
+    specs = faultline.parse("elastic.state.shard:drop@shard=1")
+    spec = specs["elastic.state.shard"]
+    assert spec.action == "drop" and spec.conds == (("shard", "1"),)
+
+
+def test_torn_shard_injection_buddy_survives(tmp_path, monkeypatch):
+    """elastic.state.shard@shard=1@times=1 tears exactly the FIRST
+    copy of shard 1 this process writes; the buddy copy lands intact
+    and restore stays at the commit (per-shard fallback, commit not
+    discarded)."""
+    monkeypatch.setenv("HVD_TPU_FAULT",
+                       "elastic.state.shard:drop@shard=1@times=1")
+    faultline.reset()
+    buf, layout = shardspill.flatten_state(_payload(5))
+    try:
+        _write_world(7, buf, layout, tmp_path)
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        faultline.reset()
+    cid, restored = shardspill.restore_local(d=str(tmp_path))
+    assert cid == 7
+    _assert_payload_equal(restored, _payload(5))
+
+
+def test_torn_all_copies_discards_commit(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", "elastic.state.shard:drop@shard=1")
+    faultline.reset()
+    buf, layout = shardspill.flatten_state(_payload(6))
+    try:
+        _write_world(7, buf, layout, tmp_path)
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT")
+        faultline.reset()
+    assert shardspill.restore_local(d=str(tmp_path)) is None
+    assert shardspill.have_evidence(str(tmp_path))
+
+
+# -- state.py wiring --------------------------------------------------------
+
+def _fake_world(monkeypatch, rank, size):
+    from horovod_tpu.common import basics
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "rank", lambda: rank)
+    monkeypatch.setattr(basics, "size", lambda: size)
+    monkeypatch.setattr(basics, "_controller_is_spmd", lambda: False)
+
+
+def test_jax_state_sharded_commit_and_local_restore(tmp_path,
+                                                    monkeypatch):
+    """JaxState with HOROVOD_STATE_SHARD_SPILL=1 in a (faked) 2-rank
+    world spills manifest + shard blobs; a later single-rank world
+    (the 2→1 resize) restores the exact trees through the sharded
+    local path."""
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_STATE_SHARD_SPILL", "1")
+    params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    for rank in range(2):
+        _fake_world(monkeypatch, rank, 2)
+        state = JaxState(params={k: v.copy() for k, v in params.items()},
+                         batch=5)
+        state._commit_id = 3
+        state.save()
+        state._persist()
+    names = os.listdir(tmp_path)
+    assert any(n.endswith(".manifest") for n in names), names
+    assert any(n.endswith(".shard") for n in names), names
+    assert not any(n.endswith(".spill") for n in names), names
+
+    from horovod_tpu.common import basics
+    monkeypatch.setattr(basics, "is_initialized", lambda: False)
+    fresh = JaxState(params={k: np.zeros_like(v)
+                             for k, v in params.items()}, batch=0)
+    fresh.sync()
+    assert fresh._commit_id == 3 and fresh.batch == 5
+    np.testing.assert_array_equal(np.asarray(fresh.params["w"]),
+                                  params["w"])
+
+
+def test_sharded_evidence_refuses_blank_restart(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_STATE_SHARD_SPILL", "1")
+    buf, layout = shardspill.flatten_state(_payload())
+    _write_world(4, buf, layout, tmp_path)
+    for name in os.listdir(tmp_path):
+        if name.endswith(".shard"):
+            _tear(tmp_path / name, 0.3)
+    from horovod_tpu.elastic.state import StateSyncError
+    state = JaxState(params={"w": np.zeros(3, np.float32)}, batch=0)
+    with pytest.raises(StateSyncError):
+        state.sync()
+
+
+# -- spill.scan satellite ---------------------------------------------------
+
+def test_scan_skips_empty_tag_filenames_with_one_warning(tmp_path,
+                                                         caplog):
+    good = spill.encode(5, b"payload")
+    (tmp_path / ("state-%020d-r0.spill" % 5)).write_bytes(good)
+    # Hand-renamed: commit id parses, tag segment empty.
+    (tmp_path / ("state-%020d-.spill" % 7)).write_bytes(
+        spill.encode(7, b"rogue"))
+    spill._scan_warned.clear()
+    with caplog.at_level("WARNING",
+                         logger="horovod_tpu.elastic.spill"):
+        out = spill.scan(str(tmp_path))
+        out2 = spill.scan(str(tmp_path))
+    assert [c for c, _ in out] == [5] and out == out2
+    warned = [r for r in caplog.records
+              if "writer-tag segment is empty" in r.getMessage()]
+    assert len(warned) == 1, "one warning per filename, not per poll"
+
+
+def test_read_shards_round_robin_reassembles(tmp_path):
+    """The collective restore's ownership unit: readers j of M own
+    source shards s % M == j; the union reassembles the stream and no
+    reader touches more than ceil(N/M) shards."""
+    buf, layout = shardspill.flatten_state(_payload(8))
+    _write_world(5, buf, layout, tmp_path, n=2)
+    manifest = shardspill.load_manifest(5, d=str(tmp_path))
+    for m in (1, 2, 3):
+        merged = {}
+        for j in range(m):
+            mine = [s for s in range(2) if s % m == j]
+            assert len(mine) <= -(-2 // m)
+            merged.update(shardspill.read_shards(manifest, mine,
+                                                 d=str(tmp_path)))
+        assert b"".join(merged[s] for s in range(2)) == buf, m
+
+
+def test_flag_rollback_still_restores_sharded_files(tmp_path,
+                                                    monkeypatch):
+    """Review regression: sharded files count as durable evidence
+    regardless of HOROVOD_STATE_SHARD_SPILL, so restore must be
+    reachable for them with the flag OFF too — a flag rollback must
+    not turn valid commits into a permanently refused restart."""
+    monkeypatch.setenv("HOROVOD_STATE_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_STATE_SHARD_SPILL", "1")
+    params = {"w": np.arange(6, dtype=np.float32)}
+    for rank in range(2):
+        _fake_world(monkeypatch, rank, 2)
+        state = JaxState(params={k: v.copy() for k, v in params.items()},
+                         batch=2)
+        state._commit_id = 4
+        state.save()
+        state._persist()
+    monkeypatch.delenv("HOROVOD_STATE_SHARD_SPILL")
+    from horovod_tpu.common import basics
+    monkeypatch.setattr(basics, "is_initialized", lambda: False)
+    fresh = JaxState(params={"w": np.zeros(6, np.float32)}, batch=0)
+    fresh.sync()
+    assert fresh._commit_id == 4 and fresh.batch == 2
+    np.testing.assert_array_equal(np.asarray(fresh.params["w"]),
+                                  params["w"])
