@@ -31,6 +31,13 @@ def main(argv=None) -> int:
                              "editor tooling); exit code unchanged")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the summary line")
+    parser.add_argument("--emit-schedule-cert", metavar="PATH",
+                        nargs="?", const="-", default=None,
+                        help="write the per-plane schedule-determinism"
+                             " certificate (JSON) to PATH after the "
+                             "run ('-' or no value: stdout); the cert "
+                             "is a pure function of the sources and "
+                             "byte-identical across runs")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -41,6 +48,16 @@ def main(argv=None) -> int:
     cfg = LintConfig()
     paths = args.paths or [cfg.resolve("horovod_tpu")]
     findings = run_paths(paths, cfg)
+    if args.emit_schedule_cert is not None:
+        from .rules import collective_schedule
+        cert = collective_schedule.build_certificate(cfg)
+        blob = json.dumps(cert, indent=2, sort_keys=True) + "\n"
+        if args.emit_schedule_cert == "-":
+            sys.stdout.write(blob)
+        else:
+            with open(args.emit_schedule_cert, "w",
+                      encoding="utf-8") as fh:
+                fh.write(blob)
     if args.json:
         print(json.dumps({
             "root": cfg.repo_root,

@@ -39,8 +39,9 @@ MARKER = "graftlint:"
 # silently disables whatever rule it was meant to drive, so it must be
 # a finding wherever it appears.
 KNOWN_KEYS = frozenset({"owned-by", "guarded-by", "thread",
-                        "requires-lock"})
-KNOWN_FLAGS = frozenset({"hot-path", "spmd-uniform"})
+                        "requires-lock", "schedule-entry"})
+KNOWN_FLAGS = frozenset({"hot-path", "spmd-uniform",
+                         "collective-order-exempt"})
 
 # Matches the issue citation inside a suppression: issue=<ref> where the
 # ref names a tracker entry (ISSUE-1, GH-123, ROADMAP:multistream, ...).
@@ -287,6 +288,140 @@ class CallGraph:
         return sweeps
 
 
+# -- schedule-expression layer ----------------------------------------------
+#
+# The collective-schedule pass summarizes every function as a regular
+# expression over collective issue events: SEQ (statement order), ALT
+# (branch arms, in source order — order matters, arms are NOT sorted),
+# LOOP (zero-or-more applications).  The nodes live here rather than in
+# the rule module because the certificate emitter (__main__'s
+# --emit-schedule-cert) renders the same trees, and fixtures/tests
+# build them directly.  All nodes are frozen/hashable so signatures
+# can be compared structurally and memoized summaries stay immutable.
+
+@dataclasses.dataclass(frozen=True)
+class SchedOp:
+    """One collective issue event: op kind + the call site it came
+    from.  ``detail`` carries schedule-relevant constants (process-set,
+    axis name) — two ops of the same kind on different process-sets
+    are different schedule entries."""
+
+    op: str
+    path: str
+    line: int
+    detail: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedSeq:
+    items: Tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedAlt:
+    arms: Tuple = ()
+    line: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedLoop:
+    body: object = None
+
+
+SCHED_EMPTY = SchedSeq(())
+
+
+def sched_seq(items) -> object:
+    """Normalized sequence: child SEQs flattened, empty items dropped,
+    a single survivor returned bare."""
+    flat: List[object] = []
+    for it in items:
+        if it is None:
+            continue
+        if isinstance(it, SchedSeq):
+            flat.extend(it.items)
+        else:
+            flat.append(it)
+    if not flat:
+        return SCHED_EMPTY
+    if len(flat) == 1:
+        return flat[0]
+    return SchedSeq(tuple(flat))
+
+
+def sched_alt(arms, line: int = 0) -> object:
+    """Normalized alternation: if every arm issues the identical
+    schedule the branch is schedule-transparent and collapses."""
+    arms = tuple(arms)
+    if not arms:
+        return SCHED_EMPTY
+    sigs = {sched_signature(a) for a in arms}
+    if len(sigs) == 1:
+        return arms[0]
+    return SchedAlt(arms, line)
+
+
+def sched_loop(body) -> object:
+    if body is None or body == SCHED_EMPTY:
+        return SCHED_EMPTY
+    if isinstance(body, SchedLoop):
+        return body
+    return SchedLoop(body)
+
+
+def sched_signature(node) -> str:
+    """Canonical textual signature of a schedule expression — the
+    string two ranks must agree on.  Sites are deliberately excluded
+    (a refactor moving a call is schedule-neutral); op kinds, details,
+    order, branching and looping structure are all included."""
+    if node is None:
+        return ""
+    if isinstance(node, SchedOp):
+        return node.op + ("[%s]" % node.detail if node.detail else "")
+    if isinstance(node, SchedSeq):
+        return ";".join(sched_signature(i) for i in node.items)
+    if isinstance(node, SchedAlt):
+        return "{%s}" % "|".join(sched_signature(a) for a in node.arms)
+    if isinstance(node, SchedLoop):
+        return "(%s)*" % sched_signature(node.body)
+    return ""
+
+
+def sched_ops(node) -> List[SchedOp]:
+    """Every collective event in the expression, in traversal order."""
+    out: List[SchedOp] = []
+    if isinstance(node, SchedOp):
+        out.append(node)
+    elif isinstance(node, SchedSeq):
+        for i in node.items:
+            out.extend(sched_ops(i))
+    elif isinstance(node, SchedAlt):
+        for a in node.arms:
+            out.extend(sched_ops(a))
+    elif isinstance(node, SchedLoop):
+        out.extend(sched_ops(node.body))
+    return out
+
+
+def sched_to_json(node):
+    """JSON-serializable structural rendering for the certificate:
+    sites kept (the cert is evidence, not just a signature)."""
+    if node is None:
+        return {"seq": []}
+    if isinstance(node, SchedOp):
+        out = {"op": node.op, "site": "%s:%d" % (node.path, node.line)}
+        if node.detail:
+            out["detail"] = node.detail
+        return out
+    if isinstance(node, SchedSeq):
+        return {"seq": [sched_to_json(i) for i in node.items]}
+    if isinstance(node, SchedAlt):
+        return {"alt": [sched_to_json(a) for a in node.arms]}
+    if isinstance(node, SchedLoop):
+        return {"loop": sched_to_json(node.body)}
+    return {"seq": []}
+
+
 # -- C++ source model --------------------------------------------------------
 
 _CC_COMMENT_RE = re.compile(r"//\s*" + re.escape(MARKER) + r"\s*(.*)$")
@@ -366,6 +501,149 @@ def _strip_cc_noise(text: str) -> str:
     return "".join(out)
 
 
+# -- lightweight clang-free C++ scanner --------------------------------------
+#
+# Shared structural helpers over CcSource.code (the comment/string-
+# stripped twin): out-of-line method bodies, lexical lock scopes, and
+# named call sites.  cpp_guarded_by's annotation checks, lock_cycles'
+# combined lock graph and the schedule certificate's native-site table
+# all ride the same four primitives, so they live here.
+
+CC_DEF_RE = re.compile(r"\b([A-Za-z_]\w*)::(~?[A-Za-z_]\w*)\s*\(")
+CC_LOCK_RE = re.compile(
+    r"\bstd::(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^;{}<>]*>)?\s*[A-Za-z_]\w*\s*\(\s*"
+    r"(?:this->)?([A-Za-z_][\w.]*)")
+
+
+def cc_line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def cc_match_brace(code: str, open_pos: int) -> int:
+    depth = 0
+    for i in range(open_pos, len(code)):
+        c = code[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return -1
+
+
+def cc_method_bodies(code: str) -> List[Tuple[str, str, int, int]]:
+    """(class, method, body start, body end) for out-of-line
+    ``Class::Method(...) { ... }`` definitions."""
+    out = []
+    for m in CC_DEF_RE.finditer(code):
+        # Find the parameter list's closing paren.
+        i = m.end() - 1  # at the '('
+        depth = 0
+        while i < len(code):
+            c = code[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        if i >= len(code):
+            continue
+        i += 1
+        # Scan to the body '{' or a ';' (declaration / pointer-to-
+        # member expression).  Member-init lists ride here: paren
+        # groups are skipped; `ident{...}` brace-inits are skipped by
+        # the identifier-adjacency heuristic.
+        in_init = False
+        body_start = -1
+        while i < len(code):
+            c = code[i]
+            if c == ";":
+                break
+            if c == ":" and code[i:i + 2] != "::":
+                in_init = True
+                i += 1
+                continue
+            if c == "(":
+                j = i
+                d = 0
+                while j < len(code):
+                    if code[j] == "(":
+                        d += 1
+                    elif code[j] == ")":
+                        d -= 1
+                        if d == 0:
+                            break
+                    j += 1
+                i = j + 1
+                continue
+            if c == "{":
+                prev = code[:i].rstrip()[-1:] if code[:i].rstrip() else ""
+                if in_init and (prev.isalnum() or prev in "_>"):
+                    # Brace-init of a member: skip the group.
+                    end = cc_match_brace(code, i)
+                    if end < 0:
+                        break
+                    i = end + 1
+                    continue
+                body_start = i
+                break
+            i += 1
+        if body_start < 0:
+            continue
+        body_end = cc_match_brace(code, body_start)
+        if body_end > 0:
+            out.append((m.group(1), m.group(2), body_start, body_end))
+    return out
+
+
+def cc_lock_scopes(code: str, start: int,
+                   end: int) -> List[Tuple[str, int, int]]:
+    """(mutex, scope start, scope end) for every lexical lock in the
+    body: from the lock declaration to the close of its enclosing
+    brace block."""
+    scopes = []
+    for m in CC_LOCK_RE.finditer(code, start, end):
+        # Enclosing block: walk back tracking depth.
+        depth = 0
+        open_pos = start
+        for i in range(m.start() - 1, start - 1, -1):
+            c = code[i]
+            if c == "}":
+                depth += 1
+            elif c == "{":
+                if depth == 0:
+                    open_pos = i
+                    break
+                depth -= 1
+        close = cc_match_brace(code, open_pos)
+        if close < 0 or close > end:
+            close = end
+        scopes.append((m.group(1).replace("this->", ""),
+                       m.start(), close))
+    return scopes
+
+
+def cc_call_sites(code: str, name: str, start: int,
+                  end: int) -> List[Tuple[int, str]]:
+    """(position, receiver) for each call of ``name`` in [start, end):
+    receiver is the ``obj`` of ``obj.name(`` / ``obj->name(``, or ""
+    for a bare (implicit-this) call."""
+    out = []
+    for m in re.finditer(r"(?:\b([A-Za-z_]\w*)\s*(?:\.|->)\s*)?"
+                         r"\b%s\s*\(" % re.escape(name), code):
+        if m.start() < start or m.start() >= end:
+            continue
+        before = code[max(m.start() - 2, 0):m.start()]
+        if m.group(1) is None and before.endswith(("::", "&", ".")):
+            continue  # qualified name / address-of / other receiver
+        out.append((m.start(), m.group(1) or ""))
+    return out
+
+
 # -- per-run source cache --------------------------------------------------
 
 _CACHE: Dict[str, Tuple[Optional["SourceFile"], List[Finding]]] = {}
@@ -427,6 +705,10 @@ class LintConfig:
         "horovod_tpu/elastic/discovery.py",
         "horovod_tpu/elastic/registration.py",
         "horovod_tpu/elastic/sampler.py",
+        # r19 drift sweep: scheduler.py carried guarded-by annotations
+        # since r13 but was never scanned — unchecked annotations are
+        # silent documentation, not checked facts.
+        "horovod_tpu/elastic/scheduler.py",
     )
     # env-drift rule: the Config module and the docs that must mention
     # every key it reads.
@@ -514,6 +796,103 @@ class LintConfig:
     # (GUARDED_BY / REQUIRES / EXCLUDES, core/src/common.h) are
     # verified against actual lock scopes in the .cc bodies.
     cpp_lock_roots: Sequence[str] = ("horovod_tpu/core/src",)
+    # collective-schedule rule: the files whose functions issue (or
+    # route to) collectives — the whole-program schedule analysis
+    # summarizes every function here and certifies the entry points
+    # annotated `schedule-entry=<plane>`.
+    schedule_roots: Sequence[str] = (
+        "horovod_tpu/ops/engine.py",
+        "horovod_tpu/ops/multihost.py",
+        "horovod_tpu/ops/api.py",
+        "horovod_tpu/common/multihost.py",
+        "horovod_tpu/jax/spmd.py",
+        "horovod_tpu/jax/functions.py",
+        "horovod_tpu/jax/zero.py",
+        "horovod_tpu/jax/optimizer.py",
+        "horovod_tpu/elastic/state.py",
+    )
+    # Callee names that ARE collective issue points, mapped to the op
+    # kind they issue.  A call matching this table records a schedule
+    # event and is NOT spliced (the wrapper chain api.allreduce ->
+    # enqueue_allreduce must count once, at the outermost issue site).
+    schedule_collectives: Sequence[Tuple[str, str]] = (
+        ("allreduce", "allreduce"),
+        ("allreduce_async", "allreduce"),
+        ("grouped_allreduce", "allreduce"),
+        ("grouped_allreduce_async", "allreduce"),
+        ("fused_allreduce", "allreduce"),
+        ("hierarchical_allreduce", "allreduce"),
+        ("hierarchical_allreduce_pytree", "allreduce"),
+        ("allreduce_pytree", "allreduce"),
+        ("allreduce_gradients", "allreduce"),
+        ("enqueue_allreduce", "allreduce"),
+        ("psum", "allreduce"),
+        ("pmean", "allreduce"),
+        ("pmax", "allreduce"),
+        ("pmin", "allreduce"),
+        ("allgather", "allgather"),
+        ("allgather_async", "allgather"),
+        ("grouped_allgather", "allgather"),
+        ("grouped_allgather_async", "allgather"),
+        ("allgather_object", "allgather"),
+        ("all_gather", "allgather"),
+        ("enqueue_allgather", "allgather"),
+        ("broadcast", "broadcast"),
+        ("broadcast_async", "broadcast"),
+        ("broadcast_object", "broadcast"),
+        ("broadcast_parameters", "broadcast"),
+        ("broadcast_optimizer_state", "broadcast"),
+        ("enqueue_broadcast", "broadcast"),
+        ("alltoall", "alltoall"),
+        ("alltoall_async", "alltoall"),
+        ("all_to_all", "alltoall"),
+        ("enqueue_alltoall", "alltoall"),
+        ("reducescatter", "reducescatter"),
+        ("reducescatter_async", "reducescatter"),
+        ("grouped_reducescatter", "reducescatter"),
+        ("grouped_reducescatter_async", "reducescatter"),
+        ("psum_scatter", "reducescatter"),
+        ("enqueue_reducescatter", "reducescatter"),
+        ("barrier", "barrier"),
+        ("enqueue_barrier", "barrier"),
+        ("ppermute", "ppermute"),
+    )
+    # Native enqueue/dispatch sites listed in the certificate: the C++
+    # methods whose call sites the clang-free scanner enumerates per
+    # out-of-line method of the TCP core.
+    schedule_cc_roots: Sequence[str] = (
+        "horovod_tpu/core/src/operations.cc",
+        "horovod_tpu/core/src/tensor_queue.cc",
+    )
+    schedule_cc_sites: Sequence[Tuple[str, str]] = (
+        ("Enqueue", "enqueue"),
+        ("EnqueueJoin", "enqueue-join"),
+        ("RunCycle", "negotiate"),
+        ("PerformOperation", "execute"),
+        ("CompleteEntry", "complete"),
+    )
+    # lock-cycle rule: the Python modules whose classes/module-level
+    # locks join the combined lock graph (C++ mutexes from
+    # cpp_lock_roots join automatically via GUARDED_BY facts).
+    lock_cycle_roots: Sequence[str] = (
+        "horovod_tpu/ops/engine.py",
+        "horovod_tpu/ops/multihost.py",
+        "horovod_tpu/ops/executable_cache.py",
+        "horovod_tpu/common/metrics.py",
+        "horovod_tpu/common/process_sets.py",
+        "horovod_tpu/common/skew.py",
+        "horovod_tpu/elastic/worker.py",
+        "horovod_tpu/elastic/driver.py",
+        "horovod_tpu/elastic/discovery.py",
+        "horovod_tpu/elastic/registration.py",
+        "horovod_tpu/elastic/scheduler.py",
+        "horovod_tpu/serving/router.py",
+        "horovod_tpu/serving/replica.py",
+        "horovod_tpu/utils/plancache.py",
+        "horovod_tpu/utils/timeline.py",
+        "horovod_tpu/core/client.py",
+    )
+    lock_cycle_cc_roots: Sequence[str] = ("horovod_tpu/core/src",)
 
     def resolve(self, rel: str) -> str:
         return os.path.join(self.repo_root, rel)
@@ -588,6 +967,20 @@ def run_paths(paths: Sequence[str],
     if cpp_roots:
         findings += cpp_guarded_by.check_roots(
             [cfg.resolve(r) for r in cpp_roots])
+    from .rules import collective_schedule, lock_cycles
+    sched_roots = [r for r in cfg.schedule_roots if in_scope(r)]
+    if sched_roots:
+        # Like spmd-uniform: summaries are whole-plane (a narrowed
+        # path still splices every schedule file) but findings are
+        # reported only inside the requested scope.
+        findings += [
+            f for f in collective_schedule.check(cfg)
+            if any(os.path.abspath(f.path) == os.path.abspath(
+                       cfg.resolve(r))
+                   for r in sched_roots)]
+    if any(in_scope(r) for r in cfg.lock_cycle_roots) \
+            or any(in_scope(r) for r in cfg.lock_cycle_cc_roots):
+        findings += lock_cycles.check(cfg)
     for src, errs in _CACHE.values():
         findings += errs
         if src is not None:

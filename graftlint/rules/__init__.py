@@ -5,13 +5,15 @@ pairs — ``python -m graftlint --list-rules`` renders them — plus its
 entry point (``check_files`` / ``check_roots`` / ``check``).
 """
 
-from . import (cpp_guarded_by, env_drift, faultline_sites,  # noqa: F401
-               host_bounce, metric_names, ownership, spmd_uniform)
+from . import (collective_schedule, cpp_guarded_by,  # noqa: F401
+               env_drift, faultline_sites, host_bounce, lock_cycles,
+               metric_names, ownership, spmd_uniform)
 
 ALL_CHECKS = (
     ownership.CHECKS + env_drift.CHECKS + host_bounce.CHECKS
     + faultline_sites.CHECKS + metric_names.CHECKS
-    + spmd_uniform.CHECKS + cpp_guarded_by.CHECKS + (
+    + spmd_uniform.CHECKS + cpp_guarded_by.CHECKS
+    + collective_schedule.CHECKS + lock_cycles.CHECKS + (
         ("bad-suppression",
          "suppression missing disable=/issue= citation or reason"),
         ("unused-suppression",

@@ -1,0 +1,726 @@
+"""Whole-program collective-schedule analysis and certificate.
+
+Horovod's correctness contract (arXiv:1802.05799) is that every rank
+issues the IDENTICAL collective sequence — one conditionally-skipped
+or reordered collective is a distributed hang, not a slowdown — and
+the planned cached-response fast path (ROADMAP item 1: freeze the
+negotiated schedule after K stable cycles) is only safe once that
+sequence is a machine-checked fact.  ``spmd_uniform`` certifies the
+routed *values*; this pass certifies collective issue *order*.
+
+Every function in ``LintConfig.schedule_roots`` is summarized as a
+schedule expression (:mod:`graftlint.core`'s SEQ / ALT / LOOP /
+``SchedOp`` nodes) over the collective table
+(``LintConfig.schedule_collectives``: the ``allreduce`` /
+``allgather`` / ``broadcast`` / ``reducescatter`` / ``barrier`` /
+``alltoall`` surface plus the ``lax.psum``-family primitives they
+lower to).  Summaries are interprocedural: resolvable calls splice the
+callee's summary (lexical scope first, then same-class methods, then
+module-alias bare names when unique), a call matching the collective
+table records ONE event and is not spliced (the wrapper chain
+``api.allreduce -> engine.enqueue_allreduce`` must count once), and a
+function reference passed as an argument to an unresolved call splices
+as a LOOP — the ``jax.tree.map(rs, grads)`` /
+``shard_map(local_step, ...)`` idiom the ZeRO plane is built from.
+
+Checks (both reuse spmd_uniform's taint-source and barrier
+vocabulary; conditions are tainted by rank calls, per-rank envs,
+clock/filesystem/identity/RNG reads and per-member attributes):
+
+* **`collective-tainted-branch`** — a branch (or loop trip count) on a
+  rank-divergent condition where the arms issue DIFFERENT collective
+  multisets: some member skips or adds a collective — the deadlock
+  class.  Cleared by a ``spmd-uniform`` barrier on the condition line
+  (or a vouched barrier def), or a cited suppression.
+* **`collective-order-divergence`** — sibling paths issue the same
+  collectives in different order/structure under a rank-divergent
+  condition, or collectives are issued while iterating a ``set``
+  (per-process iteration order): a frozen schedule desynchronizes
+  even though every op eventually happens.  ``sorted()`` sanitizes
+  set iteration; ``collective-order-exempt`` on the branch line (or
+  def) declares a reviewed exemption.
+
+Entry points carry ``# graftlint: schedule-entry=<plane>`` on the def
+line; ``build_certificate`` renders each entry's schedule signature,
+its structural schedule tree, and the uniformity proof points the
+traversal crossed (barriers and exemptions), plus the native enqueue
+sites scanned clang-free out of ``core/src``.  The certificate is a
+pure function of the ASTs — byte-identical across runs.
+
+Deliberate limits (lint-grade, not a proof system): parameters are
+assumed uniform (negotiated inputs are the common case; per-function
+conditions on raw rank parameters need the caller to pass a source,
+which the return-taint summaries do track), ``except`` handlers are
+walked for findings but excluded from the steady-state sequence
+(exceptional paths are divergent by nature and surface through the
+engine's error protocol instead), and recursion is cut to an empty
+summary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (CallGraph, Finding, LintConfig, SchedAlt, SchedOp,
+                    SCHED_EMPTY, SourceFile, cc_call_sites, cc_line_of,
+                    cc_method_bodies, get_cc_source, get_source,
+                    sched_alt, sched_loop, sched_ops, sched_seq,
+                    sched_signature, sched_to_json)
+from .spmd_uniform import (_SET_ITER, _final_name, _is_set_expr,
+                           source_kinds)
+
+CHECK_TAINT = "collective-tainted-branch"
+CHECK_ORDER = "collective-order-divergence"
+
+CHECKS = (
+    (CHECK_TAINT,
+     "collective issued under a rank-divergent branch/loop whose arms "
+     "disagree on WHICH collectives run (deadlock class)"),
+    (CHECK_ORDER,
+     "sibling paths issue the same collectives in divergent order "
+     "(or via set-iteration order) — desynchronizes a frozen "
+     "schedule"),
+)
+
+_CHECK_IDS = (CHECK_TAINT, CHECK_ORDER)
+
+# Attribute reads that are per-member by construction on this tree:
+# reading one into a branch condition makes the branch rank-divergent.
+_RANK_ATTRS = frozenset({
+    "rank", "local_rank", "cross_rank", "node_rank", "member_index",
+    "process_index", "_rank", "_local_rank", "_member_index",
+})
+
+# Constant kwargs that distinguish schedule entries: the same op on a
+# different process-set/axis is a different collective.
+_DETAIL_KWARGS = ("process_set", "process_set_id", "axis_name",
+                  "inner_axis", "outer_axis", "root_rank")
+
+
+class _Fn:
+    """One function/method node: schedule + taint summaries."""
+
+    __slots__ = ("qualname", "display", "name", "cls", "node", "src",
+                 "rel", "parent", "local_defs", "entry", "barrier",
+                 "exempt", "summary", "proofs", "building",
+                 "var_taint", "ret_taint", "taint_building")
+
+    def __init__(self, qualname: str, display: str, cls: Optional[str],
+                 node, src: SourceFile, rel: str):
+        self.qualname = qualname
+        self.display = display
+        self.name = node.name
+        self.cls = cls
+        self.node = node
+        self.src = src
+        self.rel = rel
+        self.parent: Optional["_Fn"] = None
+        self.local_defs: Dict[str, "_Fn"] = {}
+        ann = src.def_annotation(node)
+        self.entry = ann.pairs.get("schedule-entry") if ann else None
+        self.barrier = ann is not None and "spmd-uniform" in ann.flags
+        self.exempt = ann is not None \
+            and "collective-order-exempt" in ann.flags
+        if ann is not None and (self.entry is not None or self.barrier
+                                or self.exempt):
+            ann.attached = True
+        self.summary = None
+        self.proofs: Set[Tuple[str, int, str, str]] = set()
+        self.building = False
+        self.var_taint: Optional[Dict[str, Set[str]]] = None
+        self.ret_taint: Optional[Set[str]] = None
+        self.taint_building = False
+
+
+def _sub_blocks(st) -> List[list]:
+    """Nested statement lists of a compound statement (same scope)."""
+    out = []
+    for field in ("body", "orelse", "finalbody"):
+        blk = getattr(st, field, None)
+        if blk:
+            out.append(blk)
+    for h in getattr(st, "handlers", ()) or ():
+        if h.body:
+            out.append(h.body)
+    for c in getattr(st, "cases", ()) or ():
+        if c.body:
+            out.append(c.body)
+    return out
+
+
+class _Analysis:
+    """Whole-plane state: name-indexed function registry (the shared
+    CallGraph layer's bare-name index), memoized schedule summaries,
+    per-function taint environments, findings."""
+
+    def __init__(self, cfg: LintConfig, files: List[SourceFile]):
+        self.cfg = cfg
+        self.root = cfg.repo_root
+        self.files = files
+        self.graph = CallGraph()
+        self.order: List[_Fn] = []
+        self.module_defs: Dict[str, Dict[str, _Fn]] = {}
+        self.module_aliases: Dict[str, Set[str]] = {}
+        self.module_stems: Dict[str, str] = {}
+        self.collectives = dict(cfg.schedule_collectives)
+        self.rank_envs = frozenset(cfg.spmd_rank_envs)
+        self.findings: List[Finding] = []
+        self._reported: Set[Tuple[str, int, str]] = set()
+        for src in files:
+            self._collect(src)
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self, src: SourceFile):
+        aliases = self.module_aliases.setdefault(src.path, set())
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+        rel = os.path.relpath(src.path, self.root)
+        modname = rel[:-3].replace(os.sep, ".")
+        stem = os.path.splitext(os.path.basename(src.path))[0]
+        self.module_stems.setdefault(stem, src.path)
+        defs = self.module_defs.setdefault(src.path, {})
+
+        def walk_block(stmts, parts, cls, parent):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    display = ".".join(parts + [node.name])
+                    f = _Fn("%s.%s" % (modname, display), display,
+                            cls, node, src, rel)
+                    f.parent = parent
+                    self.graph.add(f.qualname, f)
+                    self.order.append(f)
+                    if parent is not None:
+                        parent.local_defs.setdefault(node.name, f)
+                    elif cls is None:
+                        defs.setdefault(node.name, f)
+                    walk_block(node.body, parts + [node.name], None, f)
+                elif isinstance(node, ast.ClassDef):
+                    walk_block(node.body, parts + [node.name],
+                               node.name, None)
+                else:
+                    for blk in _sub_blocks(node):
+                        walk_block(blk, parts, cls, parent)
+
+        walk_block(src.tree.body, [], None, None)
+
+    # -- call resolution ----------------------------------------------------
+
+    def _resolve(self, f: _Fn, call: ast.Call) -> Optional[_Fn]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            scope = f
+            while scope is not None:
+                hit = scope.local_defs.get(func.id)
+                if hit is not None:
+                    return hit
+                scope = scope.parent
+            hit = self.module_defs.get(f.src.path, {}).get(func.id)
+            if hit is not None:
+                return hit
+            if func.id in self.module_aliases.get(f.src.path, ()):
+                cands = self.graph.resolve(func.id)
+                return cands[0] if len(cands) == 1 else None
+            return None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and f.cls is not None:
+                cands = [c for c in self.graph.resolve(func.attr)
+                         if c.cls == f.cls and c.src.path == f.src.path]
+                return cands[0] if len(cands) == 1 else None
+            # Module-alias calls resolve ONLY through aliases naming a
+            # scanned module: an unrelated alias (``os.close``,
+            # ``jnp.where``) must not splice a same-named repo
+            # function's schedule into this one.
+            if isinstance(base, ast.Name) and base.id in \
+                    self.module_aliases.get(f.src.path, ()) \
+                    and base.id in self.module_stems:
+                target = self.module_stems[base.id]
+                return self.module_defs.get(target, {}).get(func.attr)
+        return None
+
+    def _resolve_ref(self, f: _Fn, name: str) -> Optional[_Fn]:
+        """Lexical-only resolution of a bare function REFERENCE (a
+        higher-order argument): locals up the closure chain, then
+        same-file module functions.  No cross-file guessing — an
+        arbitrary callback name must not splice an unrelated module's
+        schedule."""
+        scope = f
+        while scope is not None:
+            hit = scope.local_defs.get(name)
+            if hit is not None:
+                return hit
+            scope = scope.parent
+        return self.module_defs.get(f.src.path, {}).get(name)
+
+    # -- taint (spmd_uniform's source vocabulary) ---------------------------
+
+    def _ensure_taint(self, f: _Fn):
+        if f.var_taint is not None:
+            return
+        f.var_taint = {}
+        for _ in range(4):
+            if not self._taint_sweep(f):
+                break
+
+    def _taint_sweep(self, f: _Fn) -> bool:
+        changed = False
+
+        def bind(target, taint):
+            nonlocal changed
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    bind(el, taint)
+                return
+            if isinstance(target, ast.Starred):
+                bind(target.value, taint)
+                return
+            if isinstance(target, ast.Name):
+                cur = f.var_taint.setdefault(target.id, set())
+                if not taint <= cur:
+                    cur |= taint
+                    changed = True
+
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Assign):
+                t = self._taint(f, node.value)
+                if not self._barrier_line(f, node.lineno):
+                    for tgt in node.targets:
+                        bind(tgt, t)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                    and node.value is not None:
+                t = self._taint(f, node.value)
+                if not self._barrier_line(f, node.lineno):
+                    bind(node.target, t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                t = self._taint(f, node.iter)
+                if _is_set_expr(node.iter):
+                    t = t | {_SET_ITER}
+                bind(node.target, t)
+            elif isinstance(node, ast.comprehension):
+                t = self._taint(f, node.iter)
+                if _is_set_expr(node.iter):
+                    t = t | {_SET_ITER}
+                bind(node.target, t)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        bind(item.optional_vars,
+                             self._taint(f, item.context_expr))
+            elif isinstance(node, ast.NamedExpr):
+                bind(node.target, self._taint(f, node.value))
+        return changed
+
+    def _barrier_line(self, f: _Fn, line: int) -> bool:
+        ann = f.src.annotations.get(line)
+        if ann is not None and "spmd-uniform" in ann.flags:
+            ann.attached = True
+            return True
+        return False
+
+    def _taint(self, f: _Fn, node) -> Set[str]:
+        if node is None or isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Name):
+            return set(f.var_taint.get(node.id, ())) \
+                if f.var_taint else set()
+        if isinstance(node, ast.Attribute):
+            if node.attr in _RANK_ATTRS:
+                return {"per-member attribute .%s" % node.attr}
+            return self._taint(f, node.value)
+        if isinstance(node, ast.Call):
+            return self._taint_call(f, node)
+        if isinstance(node, ast.Subscript):
+            return self._taint(f, node.value) | self._taint(f, node.slice)
+        if isinstance(node, ast.IfExp):
+            return self._taint(f, node.body) | self._taint(f, node.orelse)
+        if isinstance(node, ast.Lambda):
+            return set()
+        out: Set[str] = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self._taint(f, child)
+        return out
+
+    def _taint_call(self, f: _Fn, node: ast.Call) -> Set[str]:
+        if self._barrier_line(f, node.lineno):
+            return set()
+        kinds = source_kinds(node, self.rank_envs)
+        if kinds:
+            return set(kinds)
+        name = _final_name(node.func)
+        if name in self.collectives:
+            # A collective's RESULT is uniform by definition — the
+            # reduction/gather itself is the cross-rank agreement.
+            return set()
+        arg_taint: Set[str] = set()
+        for a in node.args:
+            arg_taint |= self._taint(f, a)
+        for kw in node.keywords:
+            arg_taint |= self._taint(f, kw.value)
+        if name == "sorted":
+            return arg_taint - {_SET_ITER}
+        target = self._resolve(f, node)
+        if target is not None:
+            return set(self._ret_taint(target))
+        if isinstance(node.func, ast.Attribute):
+            arg_taint |= self._taint(f, node.func.value)
+        return arg_taint
+
+    def _ret_taint(self, f: _Fn) -> Set[str]:
+        if f.ret_taint is not None:
+            return f.ret_taint
+        if f.taint_building or f.barrier:
+            return set()
+        f.taint_building = True
+        self._ensure_taint(f)
+        out: Set[str] = set()
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Return) and node.value is not None \
+                    and not self._barrier_line(f, node.lineno):
+                out |= self._taint(f, node.value)
+        f.taint_building = False
+        f.ret_taint = out
+        return out
+
+    # -- schedule summaries -------------------------------------------------
+
+    def summary(self, f: _Fn):
+        if f.summary is not None:
+            return f.summary
+        if f.building:
+            return SCHED_EMPTY  # recursion cut
+        f.building = True
+        self._ensure_taint(f)
+        f.summary = self._stmts(f, f.node.body)
+        f.building = False
+        return f.summary
+
+    def _stmts(self, f: _Fn, stmts):
+        return sched_seq([self._stmt(f, st) for st in stmts])
+
+    def _stmt(self, f: _Fn, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return SCHED_EMPTY  # summarized at its own node
+        if isinstance(st, ast.If):
+            cond = self._expr(f, st.test)
+            arms = [self._stmts(f, st.body), self._stmts(f, st.orelse)]
+            return sched_seq([cond,
+                              self._branch(f, st.lineno, st.test, arms)])
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            head = self._expr(f, st.iter)
+            body = self._stmts(f, st.body)
+            tail = self._stmts(f, st.orelse)
+            return sched_seq([head,
+                              self._loop(f, st.lineno, st.iter, body,
+                                         _is_set_expr(st.iter)),
+                              tail])
+        if isinstance(st, ast.While):
+            head = self._expr(f, st.test)
+            body = self._stmts(f, st.body)
+            tail = self._stmts(f, st.orelse)
+            return sched_seq([head,
+                              self._loop(f, st.lineno, st.test, body,
+                                         False),
+                              tail])
+        if isinstance(st, ast.Try):
+            # Handlers are walked (their findings are real) but kept
+            # out of the steady-state sequence: exceptional paths are
+            # divergent by nature and ride the engine's error
+            # protocol, not the frozen schedule.
+            for h in st.handlers:
+                self._stmts(f, h.body)
+            return sched_seq([self._stmts(f, st.body),
+                              self._stmts(f, st.orelse),
+                              self._stmts(f, st.finalbody)])
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            items = [self._expr(f, it.context_expr) for it in st.items]
+            return sched_seq(items + [self._stmts(f, st.body)])
+        if isinstance(st, ast.Match):
+            subj = self._expr(f, st.subject)
+            arms = [self._stmts(f, c.body) for c in st.cases]
+            return sched_seq([subj,
+                              self._branch(f, st.lineno, st.subject,
+                                           arms)])
+        if isinstance(st, ast.Return):
+            return self._expr(f, st.value)
+        if isinstance(st, ast.Expr):
+            return self._expr(f, st.value)
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            return self._expr(f, st.value)
+        if isinstance(st, ast.Assert):
+            return sched_seq([self._expr(f, st.test),
+                              self._expr(f, st.msg)])
+        if isinstance(st, ast.Raise):
+            return sched_seq([self._expr(f, st.exc),
+                              self._expr(f, st.cause)])
+        if isinstance(st, ast.Delete):
+            return SCHED_EMPTY
+        return SCHED_EMPTY
+
+    def _expr(self, f: _Fn, node):
+        if node is None or isinstance(node, (ast.Constant, ast.Name,
+                                             ast.Lambda)):
+            return SCHED_EMPTY
+        if isinstance(node, ast.Call):
+            return self._call(f, node)
+        if isinstance(node, ast.IfExp):
+            test = self._expr(f, node.test)
+            arms = [self._expr(f, node.body), self._expr(f, node.orelse)]
+            return sched_seq([test,
+                              self._branch(f, node.lineno, node.test,
+                                           arms)])
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            heads = []
+            per_iter = []
+            for gen in node.generators:
+                heads.append(self._expr(f, gen.iter))
+                per_iter.extend(self._expr(f, c) for c in gen.ifs)
+            if isinstance(node, ast.DictComp):
+                per_iter += [self._expr(f, node.key),
+                             self._expr(f, node.value)]
+            else:
+                per_iter.append(self._expr(f, node.elt))
+            body = sched_seq(per_iter)
+            first = node.generators[0] if node.generators else None
+            loop = self._loop(
+                f, node.lineno,
+                first.iter if first is not None else None, body,
+                first is not None and _is_set_expr(first.iter))
+            return sched_seq(heads + [loop])
+        out = []
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out.append(self._expr(f, child))
+        return sched_seq(out)
+
+    def _call(self, f: _Fn, node: ast.Call):
+        items = []
+        if isinstance(node.func, ast.Attribute):
+            items.append(self._expr(f, node.func.value))
+        for a in node.args:
+            items.append(self._expr(f, a))
+        for kw in node.keywords:
+            items.append(self._expr(f, kw.value))
+        name = _final_name(node.func)
+        op = self.collectives.get(name) if name else None
+        if op is not None:
+            items.append(SchedOp(op, f.rel, node.lineno,
+                                 self._detail(node)))
+            return sched_seq(items)
+        target = self._resolve(f, node)
+        if target is not None:
+            items.append(self._splice(f, target))
+            return sched_seq(items)
+        # Unresolved call: a bare function reference among its
+        # arguments splices as zero-or-more applications — the
+        # tree.map / shard_map / jit higher-order idiom.
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.Name):
+                ref = self._resolve_ref(f, a.id)
+                if ref is not None and ref is not f:
+                    items.append(sched_loop(self._splice(f, ref)))
+        return sched_seq(items)
+
+    def _splice(self, f: _Fn, target: _Fn):
+        s = self.summary(target)
+        f.proofs.update(target.proofs)
+        return s
+
+    def _detail(self, node: ast.Call) -> str:
+        parts = []
+        for kw in node.keywords:
+            if kw.arg in _DETAIL_KWARGS \
+                    and isinstance(kw.value, ast.Constant):
+                parts.append("%s=%s" % (kw.arg, kw.value.value))
+        return ",".join(parts)
+
+    # -- divergence checks --------------------------------------------------
+
+    def _branch(self, f: _Fn, line: int, test, arms):
+        result = sched_alt(arms, line)
+        if not isinstance(result, SchedAlt):
+            return result  # arms schedule-equal: branch is transparent
+        taint = sorted(self._taint(f, test)) if test is not None else []
+        ann = f.src.annotations.get(line)
+        exempt = f.exempt
+        if ann is not None and "collective-order-exempt" in ann.flags:
+            ann.attached = True
+            exempt = True
+            f.proofs.add((f.rel, line, "exempt", ann.raw))
+        if taint and f.barrier:
+            f.proofs.add((f.rel, line, "barrier",
+                          "def-level spmd-uniform on %s" % f.display))
+            taint = []
+        if taint and ann is not None and "spmd-uniform" in ann.flags:
+            ann.attached = True
+            f.proofs.add((f.rel, line, "barrier", ann.raw))
+            taint = []
+        if not taint:
+            return result
+        multisets = []
+        for a in arms:
+            ops = sorted((o.op, o.detail) for o in sched_ops(a))
+            multisets.append(tuple(ops))
+        if len(set(multisets)) > 1:
+            ops_named = sorted({o.op for a in arms for o in sched_ops(a)})
+            self._report(
+                f, line, CHECK_TAINT,
+                "branch on rank-divergent condition (%s) issues "
+                "different collectives per arm (%s) in %s(); a member "
+                "taking the other arm skips/adds a collective — "
+                "distributed hang.  Negotiate the condition or declare "
+                "'# graftlint: spmd-uniform -- <why>' at its "
+                "uniformity point"
+                % (", ".join(taint), ", ".join(ops_named), f.display))
+        elif not exempt:
+            self._report(
+                f, line, CHECK_ORDER,
+                "branch on rank-divergent condition (%s) issues the "
+                "same collectives in divergent order in %s(); a frozen "
+                "schedule desynchronizes.  Make the order unconditional "
+                "or declare '# graftlint: collective-order-exempt -- "
+                "<why>'" % (", ".join(taint), f.display))
+        return result
+
+    def _loop(self, f: _Fn, line: int, head, body, set_iter: bool):
+        ops = sched_ops(body)
+        if ops:
+            taint = sorted(self._taint(f, head)) if head is not None \
+                else []
+            ann = f.src.annotations.get(line)
+            exempt = f.exempt
+            if ann is not None \
+                    and "collective-order-exempt" in ann.flags:
+                ann.attached = True
+                exempt = True
+                f.proofs.add((f.rel, line, "exempt", ann.raw))
+            if taint and (f.barrier or (
+                    ann is not None and "spmd-uniform" in ann.flags)):
+                if ann is not None and "spmd-uniform" in ann.flags:
+                    ann.attached = True
+                f.proofs.add((f.rel, line, "barrier",
+                              ann.raw if ann is not None else
+                              "def-level spmd-uniform on %s"
+                              % f.display))
+                taint = []
+            real = [t for t in taint if t != _SET_ITER]
+            if real:
+                self._report(
+                    f, line, CHECK_TAINT,
+                    "loop issuing collectives (%s) has a rank-divergent "
+                    "trip count (%s) in %s(); members issue different "
+                    "numbers of collectives — distributed hang.  "
+                    "Negotiate the bound or declare '# graftlint: "
+                    "spmd-uniform -- <why>'"
+                    % (", ".join(sorted({o.op for o in ops})),
+                       ", ".join(real), f.display))
+            elif (set_iter or _SET_ITER in taint) and not exempt:
+                self._report(
+                    f, line, CHECK_ORDER,
+                    "collectives issued while iterating a set in %s(); "
+                    "per-process iteration order reorders the schedule "
+                    "— iterate sorted(...) instead" % f.display)
+        return sched_loop(body)
+
+    def _report(self, f: _Fn, line: int, check: str, message: str):
+        if f.src.suppressed(line, check):
+            return
+        key = (f.src.path, line, message)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.findings.append(Finding(f.src.path, line, check,
+                                         message))
+
+    # -- driver -------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for f in self.order:
+            self.summary(f)
+        return self.findings
+
+
+def _analyze(cfg: LintConfig) -> Optional[_Analysis]:
+    files: List[SourceFile] = []
+    for rel in cfg.schedule_roots:
+        path = cfg.resolve(rel)
+        if not os.path.isfile(path):
+            continue  # fixture configs legitimately aim elsewhere
+        src, _errs = get_source(path)
+        if src is None:
+            continue
+        src.checked.update(_CHECK_IDS)
+        files.append(src)
+    if not files:
+        return None
+    an = _Analysis(cfg, files)
+    an.run()
+    return an
+
+
+def check(cfg: LintConfig) -> List[Finding]:
+    an = _analyze(cfg)
+    return an.findings if an is not None else []
+
+
+def build_certificate(cfg: LintConfig) -> dict:
+    """The per-plane schedule-determinism certificate: for every
+    ``schedule-entry=<plane>`` function, its ordered collective
+    signature, structural schedule, and the uniformity proof points
+    crossed; plus the native enqueue/dispatch sites scanned out of the
+    TCP core.  Pure function of the sources — byte-identical across
+    runs."""
+    an = _analyze(cfg)
+    planes: Dict[str, List[dict]] = {}
+    if an is not None:
+        for f in an.order:
+            if not f.entry:
+                continue
+            planes.setdefault(f.entry, []).append({
+                "entry": f.display,
+                "path": f.rel,
+                "line": f.node.lineno,
+                "signature": sched_signature(f.summary),
+                "schedule": sched_to_json(f.summary),
+                "proof_points": [
+                    {"path": p, "line": n, "kind": k, "note": note}
+                    for p, n, k, note in sorted(f.proofs)],
+            })
+    native: Dict[str, List[dict]] = {}
+    for rel in cfg.schedule_cc_roots:
+        path = cfg.resolve(rel)
+        if not os.path.isfile(path):
+            continue
+        src, _errs = get_cc_source(path)
+        if src is None:
+            continue
+        sites = []
+        for cls, method, bs, be in cc_method_bodies(src.code):
+            for name, op in cfg.schedule_cc_sites:
+                for pos, recv in cc_call_sites(src.code, name, bs, be):
+                    sites.append({
+                        "method": "%s::%s" % (cls, method),
+                        "call": ("%s.%s" % (recv, name)) if recv
+                        else name,
+                        "op": op,
+                        "line": cc_line_of(src.code, pos),
+                    })
+        sites.sort(key=lambda s: (s["line"], s["call"]))
+        native[rel] = sites
+    return {
+        "format": "hvd-tpu-schedule-cert/1",
+        "checks": sorted(_CHECK_IDS),
+        "planes": planes,
+        "native_sites": native,
+    }

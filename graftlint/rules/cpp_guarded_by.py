@@ -41,7 +41,9 @@ import os
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import CallGraph, CcSource, Finding, get_cc_source
+from ..core import (CallGraph, CcSource, Finding, cc_line_of,
+                    cc_lock_scopes, cc_match_brace, cc_method_bodies,
+                    get_cc_source)
 
 CHECKS = (
     ("cpp-guarded-by",
@@ -68,11 +70,6 @@ _METHOD_ANN_RE = re.compile(
     r"((?:\b(?:REQUIRES|EXCLUDES)\s*\(\s*[^)]*?\s*\)\s*)+)")
 _ANN_CLAUSE_RE = re.compile(
     r"\b(REQUIRES|EXCLUDES)\s*\(\s*([^)]*?)\s*\)")
-_DEF_RE = re.compile(r"\b([A-Za-z_]\w*)::(~?[A-Za-z_]\w*)\s*\(")
-_LOCK_RE = re.compile(
-    r"\bstd::(?:lock_guard|unique_lock|scoped_lock)\s*"
-    r"(?:<[^;{}<>]*>)?\s*[A-Za-z_]\w*\s*\(\s*"
-    r"(?:this->)?([A-Za-z_][\w.]*)")
 
 
 class _ClassFacts:
@@ -84,10 +81,6 @@ class _ClassFacts:
         # method -> set of mutexes
         self.requires: Dict[str, Set[str]] = {}
         self.excludes: Dict[str, Set[str]] = {}
-
-
-def _line_of(text: str, pos: int) -> int:
-    return text.count("\n", 0, pos) + 1
 
 
 def _class_spans(code: str) -> List[Tuple[str, int, int]]:
@@ -112,23 +105,10 @@ def _class_spans(code: str) -> List[Tuple[str, int, int]]:
             i += 1
         if i < 0 or i >= len(code):
             continue
-        end = _match_brace(code, i)
+        end = cc_match_brace(code, i)
         if end > 0:
             spans.append((m.group(2), i, end))
     return spans
-
-
-def _match_brace(code: str, open_pos: int) -> int:
-    depth = 0
-    for i in range(open_pos, len(code)):
-        c = code[i]
-        if c == "{":
-            depth += 1
-        elif c == "}":
-            depth -= 1
-            if depth == 0:
-                return i
-    return -1
 
 
 def _enclosing_class(spans, pos: int) -> Optional[str]:
@@ -151,7 +131,7 @@ def collect_annotations(sources: List[CcSource]) -> Dict[str, _ClassFacts]:
                 continue
             facts = classes.setdefault(cls, _ClassFacts())
             facts.guarded[m.group(1)] = (
-                m.group(2), src.path, _line_of(src.code, m.start()))
+                m.group(2), src.path, cc_line_of(src.code, m.start()))
         for m in _METHOD_ANN_RE.finditer(src.code):
             cls = _enclosing_class(spans, m.start())
             if cls is None:
@@ -164,100 +144,6 @@ def collect_annotations(sources: List[CcSource]) -> Dict[str, _ClassFacts]:
                          else facts.excludes)
                 table.setdefault(m.group(1), set()).update(mutexes)
     return classes
-
-
-def _method_bodies(code: str) -> List[Tuple[str, str, int, int]]:
-    """(class, method, body start, body end) for out-of-line
-    ``Class::Method(...) { ... }`` definitions."""
-    out = []
-    for m in _DEF_RE.finditer(code):
-        # Find the parameter list's closing paren.
-        i = m.end() - 1  # at the '('
-        depth = 0
-        while i < len(code):
-            c = code[i]
-            if c == "(":
-                depth += 1
-            elif c == ")":
-                depth -= 1
-                if depth == 0:
-                    break
-            i += 1
-        if i >= len(code):
-            continue
-        i += 1
-        # Scan to the body '{' or a ';' (declaration / pointer-to-
-        # member expression).  Member-init lists ride here: paren
-        # groups are skipped; `ident{...}` brace-inits are skipped by
-        # the identifier-adjacency heuristic.
-        in_init = False
-        body_start = -1
-        while i < len(code):
-            c = code[i]
-            if c == ";":
-                break
-            if c == ":" and code[i:i + 2] != "::":
-                in_init = True
-                i += 1
-                continue
-            if c == "(":
-                j = i
-                d = 0
-                while j < len(code):
-                    if code[j] == "(":
-                        d += 1
-                    elif code[j] == ")":
-                        d -= 1
-                        if d == 0:
-                            break
-                    j += 1
-                i = j + 1
-                continue
-            if c == "{":
-                prev = code[:i].rstrip()[-1:] if code[:i].rstrip() else ""
-                if in_init and (prev.isalnum() or prev in "_>"):
-                    # Brace-init of a member: skip the group.
-                    end = _match_brace(code, i)
-                    if end < 0:
-                        break
-                    i = end + 1
-                    continue
-                body_start = i
-                break
-            i += 1
-        if body_start < 0:
-            continue
-        body_end = _match_brace(code, body_start)
-        if body_end > 0:
-            out.append((m.group(1), m.group(2), body_start, body_end))
-    return out
-
-
-def _lock_scopes(code: str, start: int,
-                 end: int) -> List[Tuple[str, int, int]]:
-    """(mutex, scope start, scope end) for every lexical lock in the
-    body: from the lock declaration to the close of its enclosing
-    brace block."""
-    scopes = []
-    for m in _LOCK_RE.finditer(code, start, end):
-        # Enclosing block: walk back tracking depth.
-        depth = 0
-        open_pos = start
-        for i in range(m.start() - 1, start - 1, -1):
-            c = code[i]
-            if c == "}":
-                depth += 1
-            elif c == "{":
-                if depth == 0:
-                    open_pos = i
-                    break
-                depth -= 1
-        close = _match_brace(code, open_pos)
-        if close < 0 or close > end:
-            close = end
-        scopes.append((m.group(1).replace("this->", ""),
-                       m.start(), close))
-    return scopes
 
 
 def _held_at(scopes, requires: Set[str], pos: int) -> Set[str]:
@@ -319,12 +205,12 @@ def check_roots(roots) -> List[Finding]:
         if not src.path.endswith((".cc", ".cpp")):
             continue
         code = src.code
-        for cls, method, bstart, bend in _method_bodies(code):
+        for cls, method, bstart, bend in cc_method_bodies(code):
             facts = classes.get(cls)
             if facts is None:
                 continue
             requires = set(facts.requires.get(method, ()))
-            scopes = _lock_scopes(code, bstart, bend)
+            scopes = cc_lock_scopes(code, bstart, bend)
             # Guarded-field accesses.
             for field, (mutex, _dp, _dl) in sorted(facts.guarded.items()):
                 for m in word_re(field).finditer(code, bstart, bend):
@@ -334,7 +220,7 @@ def check_roots(roots) -> List[Finding]:
                                 " \t")[-6:].endswith("this->"):
                         continue  # member of another object
                     held = _held_at(scopes, requires, m.start())
-                    line = _line_of(code, m.start())
+                    line = cc_line_of(code, m.start())
                     if mutex not in held \
                             and not src.suppressed(line,
                                                    "cpp-guarded-by"):
@@ -359,7 +245,7 @@ def check_roots(roots) -> List[Finding]:
                     if before.endswith(("->", ".", "::", "&")):
                         continue  # another object / address-of
                     held = _held_at(scopes, requires, m.start())
-                    line = _line_of(code, m.start())
+                    line = cc_line_of(code, m.start())
                     for node in graph.resolve(name, cls):
                         req_mx, exc_mx = node
                         missing = sorted(mx for mx in req_mx

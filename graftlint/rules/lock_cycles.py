@@ -1,0 +1,441 @@
+"""Lock-order-inversion cycles across the combined Python/C++ graph.
+
+The threads that ISSUE collectives — the engine cycle, the multihost
+exec/done/watchdog trio, the elastic driver, the serving router — must
+never deadlock around them: a lock-order inversion between any two of
+those threads stalls the negotiation loop, which reads as a collective
+hang on every other member (the stall detector then kills the world).
+``cpp_guarded_by`` checks per-site contracts; nothing checked lock
+*ordering* globally, and the Python and C++ halves of the core were
+checked in isolation even though ctypes calls cross between them.
+
+This pass builds one directed lock graph spanning both languages and
+reports every cycle:
+
+* **Python nodes** — ``Class.attr`` for ``self._lock = threading.Lock()``
+  / ``RLock()`` attributes (``threading.Condition(self._lock)``
+  aliases resolve to the underlying lock), and ``module.py:NAME`` for
+  module-level locks, over ``LintConfig.lock_cycle_roots``.
+* **Python edges** — holding ``A`` while acquiring ``B``: lexically
+  nested ``with`` scopes, ``# graftlint: requires-lock=A`` def
+  annotations (the caller-holds convention), and interprocedurally a
+  call made while holding ``A`` to a function whose transitive
+  acquire set contains ``B`` (same-class ``self.m()``, same-module
+  names, module-alias calls resolving uniquely).
+* **C++ nodes/edges** — mutexes from the ``GUARDED_BY`` / ``REQUIRES``
+  / ``EXCLUDES`` facts (``LintConfig.lock_cycle_cc_roots``): nested
+  ``std::lock_guard`` scopes, ``REQUIRES(m)`` held-on-entry, and
+  calls to ``EXCLUDES(x)`` methods (bare or through a typed member
+  field — the ``tensor_queue_.Push(...)`` cross-object shape) while
+  holding another mutex.
+
+A cycle ``A -> B -> A`` means two threads can each hold one lock and
+wait for the other.  Check id: ``lock-cycle``; suppression on the
+first edge's witness line with the cited-issue hygiene.
+
+Deliberate limits: lexical scoping only (manual ``.acquire()`` /
+``.release()`` pairs and mid-scope ``unlock()`` are not modeled),
+nested closures are not walked (thread bodies on this tree are
+methods), per-instance locks collapse to class-level nodes (two
+instances of the same class are indistinguishable — a self-cycle on
+one node via RLock re-entry is NOT reported, only cross-lock cycles),
+and C++ receiver typing is one level of member-field declarations.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import (Finding, LintConfig, SourceFile, cc_call_sites,
+                    cc_line_of, cc_lock_scopes, cc_method_bodies,
+                    get_cc_source, get_source)
+from .cpp_guarded_by import _class_spans, collect_annotations
+import re
+
+CHECK = "lock-cycle"
+
+CHECKS = (
+    (CHECK,
+     "lock-order-inversion cycle in the combined Python/C++ lock "
+     "graph (two threads can deadlock around the collective path)"),
+)
+
+_LOCK_CTORS = frozenset({"Lock", "RLock"})
+
+# Member-field declarations inside C++ class bodies: `TensorQueue
+# tensor_queue_;` — one level of receiver typing for cross-object
+# EXCLUDES edges.
+_CC_FIELD_RE = re.compile(
+    r"\b([A-Z]\w*)\s+([A-Za-z_]\w*)\s*;")
+
+
+def _lock_ctor(value) -> Optional[str]:
+    """ "lock" for Lock()/RLock() calls, "cond" for Condition(),
+    else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    func = value.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None)
+    if name in _LOCK_CTORS:
+        return "lock"
+    if name == "Condition":
+        return "cond"
+    return None
+
+
+class _PyFn:
+    __slots__ = ("key", "cls", "node", "src", "acquires", "calls",
+                 "requires")
+
+    def __init__(self, key, cls, node, src):
+        self.key = key
+        self.cls = cls
+        self.node = node
+        self.src = src
+        self.acquires: Set[str] = set()        # lock node ids
+        self.calls: List[Tuple[str, Tuple[str, ...], int]] = []
+        self.requires: Tuple[str, ...] = ()
+
+
+class _Graph:
+    def __init__(self):
+        # (a, b) -> (source-ish, line): first witness of "holding a,
+        # acquiring b".  source-ish is whatever carries suppressed().
+        self.edges: Dict[Tuple[str, str], Tuple[object, int]] = {}
+
+    def add(self, a: str, b: str, src, line: int):
+        if a == b:
+            return
+        cur = self.edges.get((a, b))
+        if cur is None or (line, id(src)) < (cur[1], id(cur[0])):
+            self.edges[(a, b)] = (src, line)
+
+    def adjacency(self) -> Dict[str, List[str]]:
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        for v in adj.values():
+            v.sort()
+        return adj
+
+
+def _python_side(cfg: LintConfig, graph: _Graph):
+    files: List[SourceFile] = []
+    for rel in cfg.lock_cycle_roots:
+        path = cfg.resolve(rel)
+        if not os.path.isfile(path):
+            continue
+        src, _errs = get_source(path)
+        if src is None:
+            continue
+        src.checked.add(CHECK)
+        files.append(src)
+
+    fns: Dict[str, _PyFn] = {}
+    by_name: Dict[str, List[str]] = {}
+    module_fns: Dict[str, Dict[str, str]] = {}
+    # Module-alias calls resolve ONLY through aliases naming a scanned
+    # module (`metrics.counter(...)` -> metrics.py's counter): an
+    # unrelated alias (`os.close`, `subprocess.run`) must not smear a
+    # same-named method's acquires into a false lock edge.
+    stem_to_path: Dict[str, str] = {}
+    class_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+    module_locks: Dict[str, Set[str]] = {}
+    aliases: Dict[str, Set[str]] = {}
+    root = cfg.repo_root
+
+    # Pass 1: lock inventory + function registry.
+    for src in files:
+        rel = os.path.relpath(src.path, root)
+        mod_names: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod_names.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    mod_names.add(a.asname or a.name)
+        aliases[src.path] = mod_names
+        stem = os.path.splitext(os.path.basename(src.path))[0]
+        stem_to_path.setdefault(stem, src.path)
+        mlocks = module_locks.setdefault(src.path, set())
+        mfns = module_fns.setdefault(src.path, {})
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and _lock_ctor(node.value) == "lock":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        mlocks.add(tgt.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                key = "%s:%s" % (rel, node.name)
+                fns[key] = _PyFn(key, None, node, src)
+                by_name.setdefault(node.name, []).append(key)
+                mfns[node.name] = key
+            elif isinstance(node, ast.ClassDef):
+                locks: Dict[str, str] = {}
+                conds: Dict[str, Optional[str]] = {}
+                for item in ast.walk(node):
+                    if not isinstance(item, ast.Assign):
+                        continue
+                    kind = _lock_ctor(item.value)
+                    if kind is None:
+                        continue
+                    for tgt in item.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            if kind == "lock":
+                                locks[tgt.attr] = tgt.attr
+                            else:
+                                arg = item.value.args[0] \
+                                    if item.value.args else None
+                                if isinstance(arg, ast.Attribute) \
+                                        and isinstance(arg.value,
+                                                       ast.Name) \
+                                        and arg.value.id == "self":
+                                    conds[tgt.attr] = arg.attr
+                                else:
+                                    conds[tgt.attr] = None
+                for attr, under in conds.items():
+                    locks[attr] = under if under is not None else attr
+                class_locks[(src.path, node.name)] = locks
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        key = "%s:%s.%s" % (rel, node.name, item.name)
+                        fn = _PyFn(key, node.name, item, src)
+                        fns[key] = fn
+                        by_name.setdefault(item.name, []).append(key)
+
+    # Pass 2: per-function lock walk.
+    for fn in fns.values():
+        src = fn.src
+        rel = os.path.relpath(src.path, root)
+        locks = class_locks.get((src.path, fn.cls), {}) \
+            if fn.cls else {}
+        mlocks = module_locks.get(src.path, set())
+
+        def lock_node(expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                under = locks.get(expr.attr)
+                if under is not None:
+                    return "%s.%s" % (fn.cls, under)
+            elif isinstance(expr, ast.Name) and expr.id in mlocks:
+                return "%s:%s" % (rel, expr.id)
+            return None
+
+        def resolve_call(call) -> Optional[str]:
+            func = call.func
+            if isinstance(func, ast.Name):
+                hit = module_fns.get(src.path, {}).get(func.id)
+                if hit is not None:
+                    return hit
+                if func.id in aliases.get(src.path, ()):
+                    cands = by_name.get(func.id, ())
+                    return cands[0] if len(cands) == 1 else None
+                return None
+            if isinstance(func, ast.Attribute):
+                base = func.value
+                if isinstance(base, ast.Name) and base.id == "self" \
+                        and fn.cls is not None:
+                    key = "%s:%s.%s" % (rel, fn.cls, func.attr)
+                    return key if key in fns else None
+                if isinstance(base, ast.Name) \
+                        and base.id in aliases.get(src.path, ()) \
+                        and base.id in stem_to_path:
+                    target = stem_to_path[base.id]
+                    return module_fns.get(target, {}).get(func.attr)
+            return None
+
+        def scan_calls(expr, held: Tuple[str, ...]):
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call):
+                    callee = resolve_call(sub)
+                    if callee is not None:
+                        fn.calls.append((callee, held, sub.lineno))
+
+        def visit(stmts, held: Tuple[str, ...]):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue  # closures not walked (deliberate limit)
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    inner = held
+                    for item in st.items:
+                        scan_calls(item.context_expr, inner)
+                        lk = lock_node(item.context_expr)
+                        if lk is not None:
+                            for h in inner:
+                                graph.add(h, lk, src, st.lineno)
+                            fn.acquires.add(lk)
+                            inner = inner + (lk,)
+                    visit(st.body, inner)
+                    continue
+                for field in ("test", "iter", "value", "exc", "msg",
+                              "cause", "subject"):
+                    expr = getattr(st, field, None)
+                    if isinstance(expr, ast.expr):
+                        scan_calls(expr, held)
+                if isinstance(st, ast.Assign):
+                    for tgt in st.targets:
+                        scan_calls(tgt, held)
+                for blk in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, blk, None)
+                    if sub and isinstance(sub, list) \
+                            and sub and isinstance(sub[0], ast.stmt):
+                        visit(sub, held)
+                for h in getattr(st, "handlers", ()) or ():
+                    visit(h.body, held)
+                for c in getattr(st, "cases", ()) or ():
+                    visit(c.body, held)
+
+        held0: Tuple[str, ...] = ()
+        ann = src.def_annotation(fn.node)
+        if ann is not None and "requires-lock" in ann.pairs \
+                and fn.cls is not None:
+            attr = ann.pairs["requires-lock"]
+            under = locks.get(attr, attr)
+            held0 = ("%s.%s" % (fn.cls, under),)
+            fn.requires = held0
+        visit(fn.node.body, held0)
+
+    # Pass 3: transitive acquire sets + interprocedural edges.
+    trans: Dict[str, Set[str]] = {k: set(f.acquires)
+                                  for k, f in fns.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, fn in fns.items():
+            for callee, _held, _line in fn.calls:
+                add = trans.get(callee, set()) - trans[key]
+                if add:
+                    trans[key] |= add
+                    changed = True
+    for fn in fns.values():
+        for callee, held, line in fn.calls:
+            for h in held:
+                for a in sorted(trans.get(callee, ())):
+                    graph.add(h, a, fn.src, line)
+
+
+def _cpp_side(cfg: LintConfig, graph: _Graph) -> List[object]:
+    sources = []
+    for root in cfg.lock_cycle_cc_roots:
+        rootp = cfg.resolve(root)
+        paths = []
+        if os.path.isfile(rootp):
+            paths = [rootp]
+        elif os.path.isdir(rootp):
+            for dirpath, dirnames, filenames in os.walk(rootp):
+                dirnames[:] = [d for d in dirnames if d != ".git"]
+                for fn in sorted(filenames):
+                    if fn.endswith((".h", ".hpp", ".cc", ".cpp")):
+                        paths.append(os.path.join(dirpath, fn))
+        for path in paths:
+            src, _errs = get_cc_source(path)
+            if src is not None:
+                src.checked.add(CHECK)
+                sources.append(src)
+    if not sources:
+        return sources
+    classes = collect_annotations(sources)
+    # One level of member-field typing for cross-object calls.
+    field_types: Dict[Tuple[str, str], str] = {}
+    for src in sources:
+        spans = _class_spans(src.code)
+        for cls, start, end in spans:
+            for m in _CC_FIELD_RE.finditer(src.code, start, end):
+                if m.group(1) in classes:
+                    field_types[(cls, m.group(2))] = m.group(1)
+
+    for src in sources:
+        if not src.path.endswith((".cc", ".cpp")):
+            continue
+        code = src.code
+        for cls, method, bstart, bend in cc_method_bodies(code):
+            facts = classes.get(cls)
+            requires = set(facts.requires.get(method, ())) \
+                if facts is not None else set()
+            scopes = cc_lock_scopes(code, bstart, bend)
+
+            def held_at(pos) -> Set[str]:
+                held = {"%s.%s" % (cls, r) for r in requires}
+                for mu, s, e in scopes:
+                    if s <= pos <= e:
+                        held.add("%s.%s" % (cls, mu))
+                return held
+
+            for mu, s, e in scopes:
+                node = "%s.%s" % (cls, mu)
+                for h in held_at(s - 1):
+                    graph.add(h, node, src, cc_line_of(code, s))
+            # Calls to EXCLUDES(x) methods: the callee acquires x.
+            for callee_cls, cfacts in sorted(classes.items()):
+                for name, mus in sorted(cfacts.excludes.items()):
+                    if name == method and callee_cls == cls:
+                        continue
+                    for pos, recv in cc_call_sites(code, name,
+                                                   bstart, bend):
+                        if recv:
+                            tcls = field_types.get((cls, recv))
+                            if tcls != callee_cls:
+                                continue
+                        elif callee_cls != cls:
+                            continue
+                        line = cc_line_of(code, pos)
+                        for h in sorted(held_at(pos)):
+                            for mu in sorted(mus):
+                                graph.add(h, "%s.%s"
+                                          % (callee_cls, mu),
+                                          src, line)
+    return sources
+
+
+def _find_cycles(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Enumerate simple cycles, each reported once anchored at its
+    lexicographically-smallest node (Johnson-style restriction: a DFS
+    from ``start`` only visits nodes > ``start``)."""
+    cycles: List[List[str]] = []
+
+    def dfs(start, cur, path, visited):
+        for nxt in adj.get(cur, ()):
+            if nxt == start and len(path) > 1:
+                cycles.append(list(path))
+            elif nxt > start and nxt not in visited:
+                dfs(start, nxt, path + [nxt], visited | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def check(cfg: LintConfig) -> List[Finding]:
+    graph = _Graph()
+    _python_side(cfg, graph)
+    _cpp_side(cfg, graph)
+    findings: List[Finding] = []
+    for cycle in _find_cycles(graph.adjacency()):
+        hops = []
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            src, line = graph.edges[(a, b)]
+            rel = os.path.relpath(src.path, cfg.repo_root)
+            hops.append("%s -> %s (%s:%d)" % (a, b, rel, line))
+        first_src, first_line = graph.edges[(cycle[0], cycle[1])] \
+            if len(cycle) > 1 else graph.edges[(cycle[0], cycle[0])]
+        if first_src.suppressed(first_line, CHECK):
+            continue
+        findings.append(Finding(
+            first_src.path, first_line, CHECK,
+            "lock-order-inversion cycle: %s; two threads can each "
+            "hold one lock and block on the next — impose one global "
+            "order (acquire %s first everywhere) or split the "
+            "critical sections" % ("; ".join(hops), cycle[0])))
+    return findings

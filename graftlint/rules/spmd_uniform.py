@@ -151,6 +151,31 @@ def _is_set_expr(node) -> bool:
             and node.func.id in ("set", "frozenset"))
 
 
+def source_kinds(node: ast.Call, rank_envs) -> Set[str]:
+    """Divergence-source classification of one call — the shared taint
+    vocabulary: rank calls, clock/filesystem/identity/RNG reads, and
+    per-rank env lookups.  collective_schedule reuses this so its
+    branch-uniformity story is exactly spmd-uniform's."""
+    name = _final_name(node.func)
+    owner = _owner_name(node.func)
+    if name in _RANK_CALLS:
+        return {"%s()" % name}
+    if name in _CLOCK_ATTRS and owner in _CLOCK_OWNERS:
+        return {"%s.%s()" % (owner, name)}
+    if name == "open" and isinstance(node.func, ast.Name):
+        return {"filesystem read (open)"}
+    if name in _FS_CALLS and (owner in _FS_OWNERS or owner is None):
+        return {"filesystem read (%s)" % name}
+    if name in _ID_CALLS:
+        return {"per-process identity (%s)" % name}
+    if owner in _RNG_OWNERS:
+        return {"unseeded RNG (%s.%s)" % (owner, name)}
+    key = _env_key(node)
+    if key is not None and key in rank_envs:
+        return {"per-rank env %s" % key}
+    return set()
+
+
 class _Func:
     """One function/method node of the shared call graph, carrying the
     taint summaries the global fixpoint converges."""
@@ -395,24 +420,7 @@ class _Env:
     # -- source classification ----------------------------------------------
 
     def _source_kinds(self, node: ast.Call) -> Set[str]:
-        name = _final_name(node.func)
-        owner = _owner_name(node.func)
-        if name in _RANK_CALLS:
-            return {"%s()" % name}
-        if name in _CLOCK_ATTRS and owner in _CLOCK_OWNERS:
-            return {"%s.%s()" % (owner, name)}
-        if name == "open" and isinstance(node.func, ast.Name):
-            return {"filesystem read (open)"}
-        if name in _FS_CALLS and (owner in _FS_OWNERS or owner is None):
-            return {"filesystem read (%s)" % name}
-        if name in _ID_CALLS:
-            return {"per-process identity (%s)" % name}
-        if owner in _RNG_OWNERS:
-            return {"unseeded RNG (%s.%s)" % (owner, name)}
-        key = _env_key(node)
-        if key is not None and key in self.an.rank_envs:
-            return {"per-rank env %s" % key}
-        return set()
+        return source_kinds(node, self.an.rank_envs)
 
     # -- expression taint ---------------------------------------------------
 

@@ -43,7 +43,21 @@ _build_lock = threading.Lock()
 
 
 def build_library(force: bool = False) -> str:
-    """Compile the core if the .so is missing or stale."""
+    """Compile the core if the .so is missing or stale.
+
+    ``HVD_TPU_CORE_LIB`` overrides the library outright (no build):
+    the sanitizer test nodes compile ``make SANITIZE=thread`` side
+    builds and point every spawned worker here, and ``xla_ops``
+    exports the same variable so the XLA custom-call dlopens the very
+    library the Python runtime initialized.
+    """
+    override = os.environ.get("HVD_TPU_CORE_LIB")
+    if override:
+        if not os.path.exists(override):
+            raise FileNotFoundError(
+                "HVD_TPU_CORE_LIB points at a missing library: %r"
+                % override)
+        return override
     with _build_lock:
         src_dir = os.path.join(_CORE_DIR, "src")
         if not force and os.path.exists(_LIB_PATH):

@@ -156,7 +156,12 @@ class CoreState {
   void WakeLoop() EXCLUDES(wake_mu_);
   std::mutex wake_mu_;
   std::condition_variable wake_cv_;
-  uint64_t enqueue_seq_ GUARDED_BY(wake_mu_) = 0;
+  // Atomic on top of the mutex: wake_mu_ still orders the increment
+  // against the cv wait (a bare atomic bump could slip between the
+  // waiter's predicate check and its sleep — a lost wakeup), but the
+  // counter itself must also be readable from sanitizer interceptors
+  // whose mutex identity tracking breaks under an embedding host.
+  std::atomic<uint64_t> enqueue_seq_ GUARDED_BY(wake_mu_){0};
 };
 
 }  // namespace hvdtpu

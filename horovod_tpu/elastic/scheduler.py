@@ -458,7 +458,7 @@ class PodScheduler:
                         continue
                     t.view.set({})
                     t.state = PREEMPTED
-                    t.wait_since = now
+                    t.wait_since = now  # graftlint: disable=dispatch-scoped issue=ISSUE-16 -- preempt->resume wait-latency bookkeeping under _lock, not per-dispatch scratch; reset marks the observation, not a dispatch end
                     t.preemptions += 1
                     metrics.counter("tenant_preemptions_total",
                                     tenant=t.tenant_id).inc()
@@ -595,7 +595,7 @@ class PodScheduler:
                 LOG.exception("scheduling tick failed; retrying next "
                               "tick")
             self._wake.wait(self._tick_secs)
-            self._wake.clear()
+            self._wake.clear()  # graftlint: disable=ownership-shared issue=ISSUE-16 -- threading.Event is internally synchronized; cross-thread set/wait/clear IS its contract
 
     def stop(self, timeout: float = 30.0):
         """Stop the pod: every live tenant driver is asked to stop (its

@@ -359,7 +359,8 @@ class CollectiveEngine:
                 for e in pending:
                     e.handle._set_error(exc)
 
-    def _run_cycle(self, batch: List[_Entry]):
+    def _run_cycle(  # graftlint: schedule-entry=eager -- per-cycle collective order of the eager TCP-core plane
+            self, batch: List[_Entry]):
         faultline.site("engine.cycle.pre")
         # Group allreduces for fusion: (process set, dtype, red_op, scales).
         fuse_groups: Dict[tuple, List[_Entry]] = {}

@@ -1876,7 +1876,7 @@ class MultihostEngine:
             if py is not None and not py.poll():
                 py._set_error(exc)
 
-    def _execute(self, g: dict):
+    def _execute(self, g: dict):  # graftlint: schedule-entry=hier -- per-group dispatch order of the hierarchical DCN plane
         """Stage and dispatch one negotiated group, then hand the
         blocking tail (device_get for numpy-typed entries, handle
         resolution) to the completion thread — the drain loop is free

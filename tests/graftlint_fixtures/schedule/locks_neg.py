@@ -1,0 +1,37 @@
+"""Negative lock fixtures: nesting under one global order, and the
+requires-lock caller-holds convention."""
+import threading
+
+_journal_lock = threading.Lock()
+
+
+class Ordered:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self._cv = threading.Condition(self._inner)
+
+    def fast(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def slow(self):
+        # Same order as fast(): outer before inner, via the Condition
+        # alias of the SAME underlying lock.
+        with self._outer:
+            with self._cv:
+                pass
+
+    def journal(self):
+        with self._outer:
+            append("x")
+
+
+def append(line):
+    with _journal_lock:
+        _flush(line)
+
+
+def _flush(line):  # graftlint: requires-lock=_journal_lock -- append() is the only caller
+    return line

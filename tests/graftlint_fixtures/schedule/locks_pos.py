@@ -1,0 +1,47 @@
+"""Positive lock fixtures: an A->B / B->A inversion, both lexical and
+through calls made while holding."""
+import threading
+
+_registry_lock = threading.Lock()
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class Caller:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def publish(self):
+        # Holds _mu, and register() transitively acquires the registry
+        # lock: _mu -> _registry_lock.
+        with self._mu:
+            register()
+
+    def on_event(self):
+        # The registry-side callback path takes the locks the other
+        # way around: _registry_lock -> _mu.  Interprocedural cycle.
+        with _registry_lock:
+            self.refresh()
+
+    def refresh(self):
+        with self._mu:
+            pass
+
+
+def register():
+    with _registry_lock:
+        pass

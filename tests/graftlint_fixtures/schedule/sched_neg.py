@@ -1,0 +1,50 @@
+"""Negative schedule fixtures: rank-dependent code that stays
+schedule-safe (and the annotations that prove or waive it)."""
+import horovod_tpu as hvd
+
+
+def data_conditioned(t):  # graftlint: schedule-entry=fixture -- golden-cert entry
+    # Branching on tensor shape: uniform by construction (params are
+    # assumed uniform), and both arms issue the same sequence anyway.
+    if t.shape[0] > 1:
+        hvd.allreduce(t)
+    else:
+        hvd.allreduce(t)
+    hvd.barrier()
+    return sorted_fanout([t])
+
+
+def rank_only_side_effects(path, t):
+    # Rank-dependent branch with NO collectives in either arm: fine.
+    if hvd.rank() == 0:
+        log = open(path, "w")
+        log.write("lead\n")
+        log.close()
+    return hvd.allreduce(t)
+
+
+def proven_uniform(flag, t):
+    # The branch condition was allreduced first: every member computed
+    # the SAME value, so conditioning collectives on it is safe — the
+    # collective result is a taint barrier.
+    joint = hvd.allreduce(flag)
+    if joint > 0:
+        hvd.allgather(t)
+
+
+def declared_uniform(t):
+    me = hvd.rank()
+    lead = me == 0
+    if lead:  # graftlint: spmd-uniform -- fixture: condition vouched uniform at a negotiated commit point
+        hvd.allreduce(t)
+
+
+def waived_order(named):  # graftlint: collective-order-exempt -- names registered via register_group; core matches by name not order
+    for t in set(named):
+        hvd.allreduce(t)
+
+
+def sorted_fanout(named):
+    # sorted() is the blessed determinizer for set iteration.
+    for t in sorted(named):
+        hvd.allreduce(t)

@@ -1,0 +1,55 @@
+"""Positive schedule fixtures: every def here trips a collective
+schedule check (deadlock-shaped or order-divergent)."""
+import horovod_tpu as hvd
+
+
+def tainted_skip(t):
+    # Rank-dependent branch where only one arm issues a collective:
+    # rank 0 blocks in allreduce, every other rank never joins.
+    if hvd.rank() == 0:
+        hvd.allreduce(t)
+
+
+def tainted_order(t, u):
+    # Same collectives, different ORDER per rank: classic cross-rank
+    # schedule mismatch (rank 0 waits in allreduce, rank 1 in
+    # allgather).
+    if hvd.rank() == 0:
+        hvd.allreduce(t)
+        hvd.allgather(u)
+    else:
+        hvd.allgather(u)
+        hvd.allreduce(t)
+
+
+def tainted_trip_count(ts):
+    # Loop trip count derives from the local rank: ranks issue a
+    # different NUMBER of collectives.
+    for _ in range(hvd.rank()):
+        hvd.allreduce(ts)
+
+
+def set_iteration(named):
+    # Collectives issued in set order: hash-seed-dependent, so the
+    # per-rank sequences need not agree.
+    for t in set(named):
+        hvd.allreduce(t)
+
+
+def taint_through_local(t):
+    # The rank read flows through a local before conditioning the
+    # branch; the dataflow pass must carry it.
+    me = hvd.rank()
+    lead = me == 0
+    if lead:
+        hvd.broadcast(t, root_rank=0)
+
+
+def taint_interprocedural(t):
+    # The rank read hides behind a helper's return value.
+    if _is_lead():
+        hvd.barrier()
+
+
+def _is_lead():
+    return hvd.rank() == 0

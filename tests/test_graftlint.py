@@ -517,3 +517,125 @@ def test_cli_json_zero_findings_shape(capsys):
     assert rc == 0
     assert data["count"] == 0 and data["findings"] == []
     assert data["paths"] == ["horovod_tpu"]
+
+
+# -- collective-schedule / lock-cycle ---------------------------------------
+
+def _sched_cfg(sched=(), locks=()):
+    return LintConfig(
+        repo_root=FIX,
+        ownership_files=(), config_file="absent/config.py",
+        doc_files=(), env_scan_root="absent", hot_path_roots=(),
+        faultline_module="absent/faultline.py", faultline_roots=(),
+        faultline_cc_roots=(), metrics_module="absent/metrics.py",
+        metrics_roots=(), bootstrap_env_files=(),
+        harness_env_files=(), spmd_roots=(), cpp_lock_roots=(),
+        schedule_roots=tuple(os.path.join("schedule", n)
+                             for n in sched),
+        schedule_cc_roots=(), lock_cycle_cc_roots=(),
+        lock_cycle_roots=tuple(os.path.join("schedule", n)
+                               for n in locks))
+
+
+def _run_sched(name, **kw):
+    return run_paths([os.path.join(FIX, "schedule", name)],
+                     _sched_cfg(**kw))
+
+
+def test_schedule_flags_every_deadlock_and_divergence_shape():
+    """One finding per seeded hazard: arm-skip, arm-reorder, tainted
+    trip count, set iteration, taint through a local, and taint
+    through a helper's return value."""
+    findings = _run_sched("sched_pos.py", sched=("sched_pos.py",))
+    checks = _checks(findings)
+    assert checks.count("collective-tainted-branch") == 4, _fmt(findings)
+    assert checks.count("collective-order-divergence") == 2, \
+        _fmt(findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "tainted_skip" in msgs and "tainted_order" in msgs
+    assert "tainted_trip_count" in msgs and "set_iteration" in msgs
+    assert "taint_through_local" in msgs
+    assert "taint_interprocedural" in msgs
+
+
+def test_schedule_passes_uniform_barriers_and_exemptions():
+    """Data-conditioned branches, collective-result barriers,
+    spmd-uniform waivers, order exemptions, and sorted() fan-out all
+    lint clean."""
+    findings = _run_sched("sched_neg.py", sched=("sched_neg.py",))
+    assert findings == [], _fmt(findings)
+
+
+def test_lock_cycles_flags_lexical_and_interprocedural_inversion():
+    findings = _run_sched("locks_pos.py", locks=("locks_pos.py",))
+    assert _checks(findings) == ["lock-cycle", "lock-cycle"], \
+        _fmt(findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "Inverted._a -> Inverted._b" in msgs
+    assert "Caller._mu" in msgs and "_registry_lock" in msgs
+
+
+def test_lock_cycles_passes_global_order_and_condition_alias():
+    findings = _run_sched("locks_neg.py", locks=("locks_neg.py",))
+    assert findings == [], _fmt(findings)
+
+
+# -- schedule-determinism certificate ---------------------------------------
+
+def _fixture_cert():
+    from graftlint.core import reset_cache
+    from graftlint.rules import collective_schedule
+    cfg = _sched_cfg(sched=("sched_neg.py",))
+    reset_cache()
+    run_paths([os.path.join(FIX, "schedule", "sched_neg.py")], cfg)
+    return collective_schedule.build_certificate(cfg)
+
+
+def test_certificate_fixture_golden():
+    """The fixture entry's certificate: collapsed branch (both arms
+    issue the same allreduce), the barrier, then the spliced sorted
+    fan-out loop."""
+    cert = _fixture_cert()
+    assert cert["format"] == "hvd-tpu-schedule-cert/1"
+    (entry,) = cert["planes"]["fixture"]
+    assert entry["entry"] == "data_conditioned"
+    assert entry["signature"] == "allreduce;barrier;(allreduce)*"
+    sites = [op["site"] for op in _flat_ops(entry["schedule"])]
+    assert all(s.startswith("schedule/sched_neg.py:") for s in sites)
+
+
+def _flat_ops(node):
+    if "op" in node:
+        return [node]
+    for key in ("seq", "alt"):
+        if key in node:
+            return [o for child in node[key] for o in _flat_ops(child)]
+    return _flat_ops(node["loop"]) if "loop" in node else []
+
+
+def test_certificate_is_deterministic():
+    """Byte-identical certificates across two full runs — the property
+    CI relies on to diff certs between commits."""
+    import json
+    a = json.dumps(_fixture_cert(), sort_keys=True)
+    b = json.dumps(_fixture_cert(), sort_keys=True)
+    assert a == b
+
+
+def test_certificate_real_tree_covers_required_planes():
+    """Acceptance bar from the r19 issue: the live tree's cert lists
+    the per-cycle collective sequence for the eager, hier, and ZeRO
+    planes, plus the native enqueue/negotiate sites."""
+    from graftlint.core import reset_cache
+    from graftlint.rules import collective_schedule
+    cfg = LintConfig(repo_root=REPO)
+    reset_cache()
+    run_paths([os.path.join(REPO, "horovod_tpu")], cfg)
+    cert = collective_schedule.build_certificate(cfg)
+    for plane in ("eager", "hier", "zero1", "zero2", "zero3"):
+        assert plane in cert["planes"], sorted(cert["planes"])
+        (entry,) = cert["planes"][plane]
+        assert entry["signature"], plane
+    ops = [s["op"] for s in
+           cert["native_sites"]["horovod_tpu/core/src/operations.cc"]]
+    assert "negotiate" in ops and "execute" in ops
