@@ -749,6 +749,10 @@ class LintConfig:
         "horovod_tpu/jax/zero.py",
         "horovod_tpu/elastic/scheduler.py",
         "horovod_tpu/runner/http_client.py",
+        # HA control plane (ISSUE 17): the journal dir, lease and
+        # recovery deadline gate KV/driver BOOTSTRAP — read before any
+        # world (or Config) can exist by definition.
+        "horovod_tpu/runner/journal.py",
         # Serving plane (r16): the router's admission knobs and the
         # autoscale policy are read pre-Config by design.
         "horovod_tpu/serving/router.py",

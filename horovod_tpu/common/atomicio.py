@@ -1,0 +1,106 @@
+"""Shared durable-write primitives: atomic renames + CRC-framed records.
+
+Extracted from elastic/spill.py (the r10 durable-commit plane) so the
+control plane's write-ahead journal (runner/journal.py) reuses the SAME
+write protocol instead of copying it: temp + fsync + ``os.replace``
+atomicity, a ``MAGIC | u64 | u64-len | crc32 | payload`` frame whose
+every field is validated before the payload is trusted, and an
+age-guarded sweeper for crash-orphaned temp files.  A protocol fix —
+fsync ordering, tmp-file hygiene, CRC policy — lands once, here.
+
+The frame layout is byte-identical to the spill wire format; only the
+MAGIC differs per plane (``HVDSPILL1\\n`` for state spills,
+``HVDKVWAL1\\n`` for the control journal), so a blob from one plane can
+never be decoded by another's reader.
+"""
+
+from __future__ import annotations
+
+import binascii
+import os
+import struct
+import tempfile
+import time
+from typing import Tuple
+
+# Frame header: one u64 sequence/commit id, one u64 payload length, one
+# u32 CRC of the payload.  Shared by every durable plane.
+HEADER = struct.Struct("!QQI")
+
+TMP_PREFIX = ".tmp-spill-"
+
+# Orphaned temp files older than this are swept by the pruner: far
+# beyond any live write's lifetime, so a crash mid-write (the power
+# loss the atomic rename protects against) cannot leak disk forever,
+# while a concurrent writer's in-flight temp is never touched.
+TMP_SWEEP_AGE_S = 300.0
+
+
+class RecordCorrupt(ValueError):
+    """A framed record failed validation (torn write, bad CRC, bad
+    magic).  Plane-specific corruption errors (spill.SpillCorrupt)
+    subclass this so callers can catch either level."""
+
+
+def frame(magic: bytes, seq: int, payload: bytes) -> bytes:
+    """One self-validating record: MAGIC | seq u64 | len u64 | crc u32
+    | payload."""
+    return (magic
+            + HEADER.pack(seq, len(payload),
+                          binascii.crc32(payload) & 0xFFFFFFFF)
+            + payload)
+
+
+def unframe(magic: bytes, blob: bytes) -> Tuple[int, bytes]:
+    """(seq, payload) or :class:`RecordCorrupt` — every field is
+    validated before the payload is trusted."""
+    head_len = len(magic) + HEADER.size
+    if len(blob) < head_len or not blob.startswith(magic):
+        raise RecordCorrupt("bad magic or truncated header "
+                            "(%d bytes)" % len(blob))
+    seq, payload_len, crc = HEADER.unpack(blob[len(magic):head_len])
+    payload = blob[head_len:]
+    if len(payload) != payload_len:
+        raise RecordCorrupt(
+            "torn payload: header promises %d bytes, file holds %d"
+            % (payload_len, len(payload)))
+    if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+        raise RecordCorrupt("payload CRC mismatch")
+    return seq, payload
+
+
+def write_atomic(d: str, name: str, blob: bytes):
+    """Atomic same-directory write (temp + fsync + ``os.replace``): a
+    reader never observes a half-written NAMED file; a crash mid-write
+    leaves only a temp :func:`sweep_tmp` reaps.  The ONE write
+    protocol for every durable plane (whole-blob spills, sharded
+    manifests/shards, the serving version store, the control-plane
+    journal's snapshots) — a protocol fix lands once."""
+    fd, tmp = tempfile.mkstemp(prefix=TMP_PREFIX, dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, name))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def sweep_tmp(d: str):
+    """Unlink crash-orphaned ``.tmp-spill-*`` files past the age
+    guard (shared by every durable plane's pruner)."""
+    now = time.time()
+    for name in os.listdir(d):
+        if not name.startswith(TMP_PREFIX):
+            continue
+        path = os.path.join(d, name)
+        try:
+            if now - os.path.getmtime(path) > TMP_SWEEP_AGE_S:
+                os.unlink(path)
+        except OSError:
+            pass

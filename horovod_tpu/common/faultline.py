@@ -171,6 +171,22 @@ SITES: Dict[str, str] = {
         "swap check): delay/wedge stalls a replica's version load — "
         "requests must keep queueing (zero downtime) and the other "
         "replicas must keep serving while one swap drags",
+    "kv.server.die":
+        "rendezvous KV server, the per-request seam (every KV verb): "
+        "drop = the request is answered 503 (a transient the client's "
+        "retry layer must absorb); die = the KV server process dies "
+        "mid-service — the HA e2e certifies the warm standby promotes "
+        "within the lease and clients rotate to it",
+    "kv.journal.torn":
+        "control-plane journal, ControlJournal.append: one WAL record "
+        "(drop = the record lands truncated mid-payload, the shape a "
+        "power loss mid-fsync leaves; replay must skip it loudly and "
+        "resync at the next magic boundary)",
+    "kv.standby.partition":
+        "KV standby, the journal-tail poll loop (drop = one "
+        "replication poll is lost; sustained loss past "
+        "HOROVOD_CONTROL_LEASE_SECS promotes the standby, exercising "
+        "the split-brain term fencing when the old leader resurfaces)",
 }
 
 ACTIONS = ("delay", "drop", "die", "wedge")
@@ -193,6 +209,9 @@ DROP_SITES = frozenset({
     "scheduler.admit",
     "scheduler.preempt.notice",
     "serving.request.drop",
+    "kv.server.die",
+    "kv.journal.torn",
+    "kv.standby.partition",
 })
 
 _COND_ENV = {

@@ -130,6 +130,18 @@ NAMES: Dict[str, Tuple[str, str]] = {
     "rpc_giveups_total": (
         "counter", "retried RPCs that exhausted their retry budget or "
                    "deadline and escalated"),
+    # -- HA control plane (journaled KV, warm-standby failover) --
+    "control_leader_term": (
+        "gauge", "this KV server's current leader term (fencing "
+                 "epoch; followers report the leader term they track)"),
+    "control_failovers_total": (
+        "counter", "standby promotions after leader lease expiry"),
+    "kv_journal_bytes_total": (
+        "counter", "bytes appended to the control-plane write-ahead "
+                   "journal"),
+    "kv_journal_skipped_records_total": (
+        "counter", "torn/corrupt journal records (or snapshots) "
+                   "skipped during replay"),
     # -- elastic plane: driver side --
     "elastic_epoch": (
         "gauge", "current published world epoch (driver)"),

@@ -21,11 +21,14 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+import json
+
 from ..common import faultline, metrics, skew
 from ..common.envutil import env_int
+from ..runner import journal as control_journal
 from ..runner import safe_shell_exec, util
 from ..runner.http_server import RendezvousServer
-from ..runner.services import MessageServer, send_message
+from ..runner.services import AddressTable, MessageServer, send_message
 from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
                         HostManager, HostUpdateResult)
 from .registration import WorkerStateRegistry
@@ -60,7 +63,8 @@ class ElasticDriver:
                  respawn_backoff_base: float = 1.0,
                  respawn_backoff_cap: float = 30.0,
                  tenant_id: Optional[str] = None,
-                 tenant_priority: Optional[int] = None):
+                 tenant_priority: Optional[int] = None,
+                 journal_dir: Optional[str] = None):
         self.command = command
         self.min_np = max(1, min_np)  # graftlint: guarded-by=_lock
         self.max_np = max_np  # graftlint: guarded-by=_lock
@@ -97,9 +101,52 @@ class ElasticDriver:
             cooldown_secs=blacklist_cooldown)
         self._extra_handler = None  # platform hook for extra msg kinds
         self._hosts = HostManager(discovery, self._registry.is_blacklisted)
+        # HA control plane (runner/journal.py): with a journal dir the
+        # KV store is write-ahead journaled and the driver journals its
+        # own bookkeeping (the control record), so a restarted driver
+        # can ADOPT the old world — same secret, same ports, same
+        # epoch — instead of re-forming it.  An explicit journal_dir
+        # wins over HOROVOD_CONTROL_JOURNAL_DIR (+ tenant subdir).
+        self._journal_dir = (
+            journal_dir if journal_dir is not None
+            else control_journal.control_journal_dir(tenant_id))
+        self._adopt_rec = control_journal.peek_control_record(
+            self._journal_dir)
         self._secret = util.make_secret()
-        self._server = MessageServer(self._handle, self._secret)
-        self._kv = RendezvousServer(secret=self._secret)
+        msg_port = kv_port = 0
+        if self._adopt_rec is not None:
+            # The journaled secret MUST survive the restart: live
+            # workers still HMAC with it, and the journaled ports are
+            # the addresses baked into their environment.
+            self._secret = self._adopt_rec.get("secret") or self._secret
+            msg_port = int(self._adopt_rec.get("msg_port") or 0)
+            kv_port = int(self._adopt_rec.get("kv_port") or 0)
+        try:
+            self._server = MessageServer(self._handle, self._secret,
+                                         port=msg_port)
+        except OSError as exc:
+            # The old notification port is unavailable: workers hold it
+            # in HOROVOD_ELASTIC_DRIVER_ADDR and could never reach this
+            # incarnation — adoption is off the table.
+            LOG.error("cannot rebind journaled driver port %d (%s): "
+                      "abandoning crash adoption, re-forming the world",
+                      msg_port, exc)
+            self._adopt_rec = None
+            self._secret = util.make_secret()
+            kv_port = 0
+            self._server = MessageServer(self._handle, self._secret)
+        try:
+            self._kv = RendezvousServer(secret=self._secret,
+                                        port=kv_port,
+                                        journal_dir=self._journal_dir)
+        except OSError as exc:
+            # A lost KV port only matters at the NEXT re-rendezvous
+            # (workers learn the new address with their next
+            # assignment); adoption of the live world can proceed.
+            LOG.warning("cannot rebind journaled KV port %d (%s); "
+                        "serving the KV on a fresh port", kv_port, exc)
+            self._kv = RendezvousServer(secret=self._secret,
+                                        journal_dir=self._journal_dir)
         # Fleet-wide scrape: GET /metrics on the rendezvous server
         # merges this driver's registry with every live worker's
         # snapshot (one rank label per source).
@@ -130,7 +177,16 @@ class ElasticDriver:
         self._assignments: Dict[Slot, Dict] = {}  # graftlint: guarded-by=_lock
         self._port_base = 0  # graftlint: guarded-by=_lock
         self._procs: Dict[Slot, safe_shell_exec.ManagedProcess] = {}  # graftlint: guarded-by=_lock
-        self._worker_addrs: Dict[Slot, Tuple[str, int]] = {}  # graftlint: guarded-by=_lock
+        # Generation-tracked so a reattached worker's fresh endpoint
+        # always shadows a journal-restored (or leftover) one, never
+        # the reverse (services.AddressTable; own internal lock).
+        self._worker_addrs = AddressTable()
+        # ADOPTED workers: slots whose live process belongs to a dead
+        # driver incarnation (crash adoption) — no proc handle to
+        # reap, so liveness is ping-based.  Value = consecutive ping
+        # misses.
+        self._external: Dict[Slot, int] = {}  # graftlint: guarded-by=_lock
+        self._external_checked = 0.0  # reap-loop thread only
         # slots told/forced to stop; slots whose proc exited 0;
         # slots that announced a drain (planned removal — preemption,
         # stall abort); per-slot spawn retry throttle; spawn RPCs in
@@ -162,8 +218,34 @@ class ElasticDriver:
         kind = req.get("kind")
         if kind == "register":
             slot = (req["host"], int(req["slot"]))
+            # A live registration evicts any stale entry shadowing it
+            # (same slot re-registering from a new port after failover,
+            # or another slot's leftover claim on this address).
+            self._worker_addrs.register(
+                slot, (req["host"], int(req["port"])))
+            self._journal_control()
+            return {"ok": True}
+        if kind == "finished":
+            # An ADOPTED worker's only "done" signal: no proc handle
+            # exists to reap its rc=0, so the clean return of its
+            # train function reports here (worker.py send_finished).
+            # Harmless duplicate for driver-owned procs — the reap
+            # loop already books their exit.
+            slot = (req["host"], int(req["slot"]))
             with self._lock:
-                self._worker_addrs[slot] = (req["host"], int(req["port"]))
+                was_external = slot in self._external
+                if was_external:
+                    del self._external[slot]
+                    self._succeeded.add(slot)
+                    self._worker_addrs.purge(slot)
+            if was_external:
+                self._registry.record_success(slot[0])
+                metrics.event("external_finished", host=slot[0],
+                              slot=slot[1],
+                              commit_id=req.get("commit_id"))
+                LOG.info("adopted worker %s:%d finished cleanly",
+                         slot[0], slot[1])
+                self._journal_control()
             return {"ok": True}
         if kind == "rendezvous":
             return self._handle_rendezvous(
@@ -239,7 +321,7 @@ class ElasticDriver:
         want = max(0, int(req.get("replicas", 1)))
         with self._lock:
             target = list(self._target)
-            addrs = dict(self._worker_addrs)
+        addrs = self._worker_addrs.snapshot()
         if source not in target or want == 0:
             return {"ok": True, "delivered": 0}
         ring = target[target.index(source) + 1:] + \
@@ -303,6 +385,7 @@ class ElasticDriver:
                       hosts=len(hosts_in_order))
         LOG.info("epoch %d published: %d ranks over %d hosts",
                  self._epoch, len(self._target), len(hosts_in_order))
+        self._journal_control()
 
     def _driver_host(self) -> str:
         if all(h == "localhost" or h.startswith("127.")
@@ -312,6 +395,184 @@ class ElasticDriver:
             return socket.gethostbyname(socket.gethostname())
         except socket.gaierror:
             return "127.0.0.1"
+
+    # -- HA control plane: journaling + crash adoption ---------------------
+
+    def _journal_control(self):
+        """Persist this driver's bookkeeping as the journaled control
+        record (runner/journal.py CONTROL_KEY): epoch, secret, ports,
+        target, assignments, worker addresses, blacklist.  A restarted
+        driver replays it in :meth:`_try_adopt`.  No-op without a
+        journal directory."""
+        if self._journal_dir is None:
+            return
+        with self._lock:
+            rec = {
+                "epoch": self._epoch,
+                "secret": self._secret,
+                "msg_port": self._server.port,
+                "kv_port": self._kv.port,
+                "port_base": self._port_base,
+                "published": self._published,
+                "target": [list(s) for s in self._target],
+                "assignments": [[list(s), a] for s, a
+                                in self._assignments.items()],
+                "worker_addrs": [[list(s), list(a)] for s, a
+                                 in self._worker_addrs.items()],
+                "succeeded": [list(s) for s in self._succeeded],
+                "blacklist": self._registry.blacklisted_hosts(),
+                "tenant": self.tenant_id,
+            }
+            # Same lock order as _publish_epoch's _kv.reset(): driver
+            # lock, then the KV httpd lock inside put_local.
+            self._kv.put_local(control_journal.CONTROL_KEY,
+                               json.dumps(rec, sort_keys=True).encode())
+
+    def _try_adopt(self) -> bool:
+        """Crash adoption: reconstruct the published world from the
+        journaled control record and the live workers themselves.
+        Every unfinished journaled slot must answer a ping within
+        ``HOROVOD_CONTROL_RECOVERY_DEADLINE`` — then the old epoch is
+        re-installed as-is (no epoch bump, no re-rendezvous) and those
+        workers keep training as ADOPTED (external) slots.  Any
+        journaled worker still missing at the deadline fails the
+        adoption LOUDLY and the driver falls back to ordinary world
+        re-formation, where the r2 elastic deadline governs."""
+        rec = self._adopt_rec
+        if not rec or not rec.get("published") or not rec.get("target"):
+            return False
+        budget = control_journal.recovery_deadline()
+        deadline = time.monotonic() + budget
+        target = [tuple(s) for s in rec["target"]]
+        assignments = {tuple(s): a for s, a
+                       in rec.get("assignments") or []}
+        addrs = {tuple(s): tuple(a) for s, a
+                 in rec.get("worker_addrs") or []}
+        succeeded = {tuple(s) for s in rec.get("succeeded") or []}
+        for host in rec.get("blacklist") or []:
+            self._registry.restore_blacklist(host)
+        for slot, addr in addrs.items():
+            # Generation-0 seed: a live re-registration shadows it.
+            self._worker_addrs.restore(slot, addr)
+        want = [s for s in target if s not in succeeded]
+        metrics.event("control_adopt_attempt", epoch=rec.get("epoch"),
+                      slots=len(want), deadline_secs=budget)
+        LOG.warning("journaled control record found (epoch %s, %d "
+                    "unfinished slots): attempting driver crash "
+                    "adoption within %.0fs", rec.get("epoch"),
+                    len(want), budget)
+        live: Dict[Slot, Tuple[str, int]] = {}
+        while not self._shutdown.is_set():
+            for slot in want:
+                if slot in live:
+                    continue
+                addr = self._worker_addrs.get(slot) or addrs.get(slot)
+                if addr is None:
+                    continue
+                try:
+                    pong = send_message(addr, self._secret,
+                                        {"kind": "ping"},
+                                        timeout=2.0, retries=0)
+                    if isinstance(pong, dict) and pong.get("ok"):
+                        live[slot] = addr
+                except Exception:  # noqa: BLE001 — probed again below
+                    pass
+            if len(live) == len(want) or time.monotonic() >= deadline:
+                break
+            time.sleep(0.2)
+        if len(live) != len(want):
+            missing = [s for s in want if s not in live]
+            metrics.event("control_adopt_failed",
+                          missing=len(missing), live=len(live))
+            LOG.error(
+                "driver crash adoption FAILED: %d/%d journaled workers "
+                "unreachable within the %.0fs recovery deadline (%s); "
+                "falling back to world re-formation (the elastic "
+                "deadline governs from here)", len(missing), len(want),
+                budget, ", ".join("%s:%d" % s for s in missing))
+            for slot in missing:
+                self._worker_addrs.purge(slot)
+            return False
+        with self._lock:
+            self._epoch = int(rec["epoch"])
+            self._target = target
+            self._assignments = assignments
+            self._port_base = int(rec.get("port_base") or 0)
+            self._published = True
+            self._ready = set(target)
+            self._succeeded = set(succeeded)
+            self._external = {s: 0 for s in want}
+        metrics.gauge("elastic_epoch", **self._mlabels).set(self._epoch)
+        metrics.event("control_adopted", epoch=self._epoch,
+                      workers=len(live))
+        LOG.warning("adopted epoch %d: all %d live workers reattached; "
+                    "training continues WITHOUT a world re-formation",
+                    self._epoch, len(live))
+        self._journal_control()
+        return True
+
+    # Consecutive ping misses before an adopted worker is booked as
+    # gone (one miss can be a GC pause or a busy accept queue).
+    _EXTERNAL_PING_MISSES = 2
+
+    def _check_external(self):
+        """Liveness for adopted workers (no proc handle to poll):
+        ping each external slot at a throttled cadence; sustained
+        silence books the slot the way a reaped exit would — drained
+        if it was told to stop/drain, a failure otherwise.  Returns
+        (failed_hosts, drained_slots) for :meth:`_check_procs` to fold
+        into its epilogue."""
+        now = time.monotonic()
+        if now - self._external_checked < 2.0:
+            return [], []
+        self._external_checked = now
+        with self._lock:
+            probes = [(s, self._worker_addrs.get(s))
+                      for s in self._external]
+        if not probes:
+            return [], []
+        results = {}
+        for slot, addr in probes:
+            ok = False
+            if addr is not None:
+                try:
+                    pong = send_message(addr, self._secret,
+                                        {"kind": "ping"},
+                                        timeout=2.0, retries=0)
+                    ok = bool(isinstance(pong, dict) and pong.get("ok"))
+                except Exception:  # noqa: BLE001 — that IS the signal
+                    ok = False
+            results[slot] = ok
+        failed_hosts, drained_slots = [], []
+        with self._lock:
+            for slot, ok in results.items():
+                if slot not in self._external:
+                    continue  # finished/re-booked while we pinged
+                if ok:
+                    self._external[slot] = 0
+                    continue
+                self._external[slot] += 1
+                if self._external[slot] < self._EXTERNAL_PING_MISSES:
+                    continue
+                del self._external[slot]
+                self._worker_addrs.purge(slot)
+                if slot in self._draining or slot in self._stopped:
+                    self._draining.discard(slot)
+                    drained_slots.append(slot)
+                    metrics.counter("elastic_drain_total",
+                                    **self._mlabels).inc()
+                    metrics.event("drained", host=slot[0],
+                                  slot=slot[1], rc=-1, external=True)
+                else:
+                    failed_hosts.append(slot[0])
+                    metrics.counter("elastic_worker_failures_total",
+                                    **self._mlabels).inc()
+                    metrics.event("worker_failed", host=slot[0],
+                                  slot=slot[1], rc=-1, external=True)
+                    LOG.warning("adopted worker %s:%d stopped "
+                                "answering pings: booking a failure",
+                                slot[0], slot[1])
+        return failed_hosts, drained_slots
 
     # -- world management --------------------------------------------------
 
@@ -325,6 +586,11 @@ class ElasticDriver:
         polled = {slot: (mp, mp.poll() is None) for slot, mp in snapshot}
         with self._lock:
             def _alive(slot):
+                if slot in self._external:
+                    # Adopted worker: liveness is ping-based
+                    # (_check_external); a slot still in the map is
+                    # live as far as world math is concerned.
+                    return True
                 mp = self._procs.get(slot)
                 if mp is None:
                     return False
@@ -363,6 +629,13 @@ class ElasticDriver:
             for slot in list(self._procs):
                 if slot not in new_target and _alive(slot):
                     self._stopped.add(slot)
+            # An adopted worker whose slot left the world is told to
+            # stop through rendezvous like anyone else; marking it
+            # stopped books its eventual silence as a planned removal
+            # (no blacklist) in _check_external.
+            for slot in list(self._external):
+                if slot not in new_target:
+                    self._stopped.add(slot)
             # Collect target slots without a live process; the spawn
             # RPCs themselves run after the lock is released.  A slot
             # whose spawn is already in flight on the other thread is
@@ -375,7 +648,8 @@ class ElasticDriver:
             for slot in to_spawn:
                 self._pending_spawns.add(slot)
                 self._spawn_attempts[slot] = now
-            addrs = list(self._worker_addrs.items())
+        addrs = self._worker_addrs.items()
+        self._journal_control()
         self._spawn_workers(to_spawn)
         # Notify outside the lock (network).
         for slot, addr in addrs:
@@ -663,18 +937,21 @@ class ElasticDriver:
 
     def _check_procs(self) -> bool:
         """Reap exited workers; returns True when the run is finished."""
-        failed_hosts = []
-        drained_slots = []
+        # Adopted (externally-spawned) workers first: their ping-based
+        # liveness feeds the same failure/drain epilogue as the reap.
+        failed_hosts, drained_slots = self._check_external()
         # Poll OUTSIDE the lock: platform proc proxies (Spark agents)
         # may do blocking RPCs, and the message handler needs the lock.
         with self._lock:
             snapshot = list(self._procs.items())
         polled = [(slot, mp, mp.poll()) for slot, mp in snapshot]
+        reaped = False
         with self._lock:
             for slot, mp, rc in polled:
                 if rc is None or self._procs.get(slot) is not mp:
                     continue  # alive, or replaced while we polled
                 del self._procs[slot]
+                reaped = True
                 if slot in self._stopped:
                     # A stopped slot that was ALSO marked draining (a
                     # scheduler preemption) still counts as a drain —
@@ -748,6 +1025,7 @@ class ElasticDriver:
                 if slot not in self._procs and slot not in self._stopped \
                         and slot not in self._succeeded \
                         and slot not in self._pending_spawns \
+                        and slot not in self._external \
                         and slot[0] not in failed_hosts \
                         and slot not in drained_slots \
                         and now - self._spawn_attempts.get(slot, 0) >= wait:
@@ -761,6 +1039,11 @@ class ElasticDriver:
             done = (bool(target) and self._published
                     and all(s in self._succeeded for s in target))
         self._spawn_workers(to_spawn)
+        if reaped:
+            # Success/failure bookkeeping changed: the journaled
+            # control record must follow (world changes journal inside
+            # _recompute_world below).
+            self._journal_control()
         if done:
             self._rc = 0
             return True
@@ -807,8 +1090,8 @@ class ElasticDriver:
         mid-respawn worker is skipped — neither the /metrics scrape
         nor the skew tick may block on the control plane's health."""
         with self._lock:
-            addrs = list(self._worker_addrs.items())
-            live = set(self._procs)
+            live = set(self._procs) | set(self._external)
+        addrs = self._worker_addrs.items()
 
         def pull(slot, addr):
             try:
@@ -933,24 +1216,37 @@ class ElasticDriver:
         self._server.start()
         self._kv.start()
         try:
-            deadline = time.monotonic() + self.start_timeout
-            while True:
+            # Crash adoption first: if a journaled control record's
+            # workers all reattach, the old world continues at its
+            # published epoch and the startup rendezvous is skipped
+            # (discovery still seeds its view below for elasticity).
+            adopted = (self._adopt_rec is not None
+                       and self._try_adopt())
+            if adopted:
                 try:
                     self._hosts.update_available_hosts()
                 except Exception as exc:  # noqa: BLE001 — flaky script
-                    LOG.warning("startup discovery failed: %s", exc)
-                with self._lock:
-                    lo, hi = self.min_np, self.max_np
-                if len(self._hosts.ordered_slots(hi)) >= lo:
-                    break
-                if self._shutdown.is_set():
-                    return self._rc
-                if time.monotonic() > deadline and not self.held():
-                    LOG.error("discovery never found min_np=%d hosts",
-                              lo)
-                    return 1
-                time.sleep(1.0)
-            self._recompute_world("startup")
+                    LOG.warning("post-adoption discovery failed: %s",
+                                exc)
+            else:
+                deadline = time.monotonic() + self.start_timeout
+                while True:
+                    try:
+                        self._hosts.update_available_hosts()
+                    except Exception as exc:  # noqa: BLE001
+                        LOG.warning("startup discovery failed: %s", exc)
+                    with self._lock:
+                        lo, hi = self.min_np, self.max_np
+                    if len(self._hosts.ordered_slots(hi)) >= lo:
+                        break
+                    if self._shutdown.is_set():
+                        return self._rc
+                    if time.monotonic() > deadline and not self.held():
+                        LOG.error("discovery never found min_np=%d "
+                                  "hosts", lo)
+                        return 1
+                    time.sleep(1.0)
+                self._recompute_world("startup")
             disc = threading.Thread(target=self._discovery_loop,
                                     daemon=True)
             disc.start()

@@ -130,6 +130,21 @@ class WorkerStateRegistry:
                 return False
             return True
 
+    def restore_blacklist(self, host: str):
+        """Crash-adoption seed (elastic/driver.py): re-enter a host the
+        PREVIOUS driver incarnation had blacklisted, per its journaled
+        control record.  The cooldown clock restarts at adoption time
+        (monotonic timestamps do not survive a process) — strictly the
+        conservative direction: the host stays out at least as long as
+        it would have.  Never weakens live bookkeeping: a host this
+        incarnation already blacklisted keeps its own entry."""
+        with self._lock:
+            if host not in self._blacklist:
+                self._blacklist[host] = time.monotonic()
+                self._blacklist_count.setdefault(host, 1)
+            if self._failures.get(host, 0) < self._threshold:
+                self._failures[host] = self._threshold
+
     def blacklisted_hosts(self) -> List[str]:
         with self._lock:
             return sorted(self._blacklist)

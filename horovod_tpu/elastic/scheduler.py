@@ -51,6 +51,7 @@ from typing import Dict, List, Optional
 
 from ..common import faultline, metrics
 from ..common.envutil import env_float
+from ..runner import journal as control_journal
 from .discovery import HostDiscovery, HostManager
 from .driver import ElasticDriver
 
@@ -537,6 +538,17 @@ class PodScheduler:
         spec = tenant.spec
         env = dict(self._base_env)
         env.update(spec.env)
+        # Journaled control plane (HOROVOD_CONTROL_JOURNAL_DIR): each
+        # tenant journals under its own subdirectory, so a pod restart
+        # re-admitting this tenant finds its previous incarnation's
+        # control record and the driver adopts the live world instead
+        # of re-forming it.  Announce the adoption attempt here — the
+        # pod operator should see WHY a tenant skips startup rendezvous.
+        jdir = control_journal.control_journal_dir(spec.tenant_id)
+        if jdir and control_journal.peek_control_record(jdir):
+            LOG.info("tenant %s: journaled control record found in %s; "
+                     "its driver will attempt crash adoption",
+                     spec.tenant_id, jdir)
         driver = ElasticDriver(
             spec.command, tenant.view,
             min_np=spec.min_np, max_np=spec.max_np, env=env,

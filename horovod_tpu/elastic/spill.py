@@ -33,25 +33,29 @@ directory is shared storage.
 
 from __future__ import annotations
 
-import binascii
 import logging
 import os
-import struct
-import tempfile
 import time
 from typing import List, Optional, Tuple
 
-from ..common import faultline, metrics
+from ..common import atomicio, faultline, metrics
+from ..common.atomicio import sweep_tmp, write_atomic  # noqa: F401 — re-export
 from ..common.envutil import env_int
 
 LOG = logging.getLogger("horovod_tpu.elastic.spill")
 
 MAGIC = b"HVDSPILL1\n"
-_HEADER = struct.Struct("!QQI")  # commit_id, payload_len, crc32
+_HEADER = atomicio.HEADER  # commit_id, payload_len, crc32
 _SUFFIX = ".spill"
 
+# Back-compat alias: the write protocol now lives in common/atomicio.py
+# (extracted for the control-plane journal); this module re-exports it
+# so every existing ``spill.write_atomic``/``spill.sweep_tmp`` caller
+# (shardspill.py, serving/replica.py) keeps one import path.
+_TMP_SWEEP_AGE_S = atomicio.TMP_SWEEP_AGE_S
 
-class SpillCorrupt(ValueError):
+
+class SpillCorrupt(atomicio.RecordCorrupt):
     """A spill blob failed validation (torn write, bad CRC, bad magic)."""
 
 
@@ -87,29 +91,18 @@ def replica_count() -> int:
 
 
 def encode(commit_id: int, payload: bytes) -> bytes:
-    return (MAGIC
-            + _HEADER.pack(commit_id, len(payload),
-                           binascii.crc32(payload) & 0xFFFFFFFF)
-            + payload)
+    return atomicio.frame(MAGIC, commit_id, payload)
 
 
 def decode(blob: bytes) -> Tuple[int, bytes]:
     """(commit_id, payload) or :class:`SpillCorrupt` — every field is
     validated before the payload is trusted."""
-    head_len = len(MAGIC) + _HEADER.size
-    if len(blob) < head_len or not blob.startswith(MAGIC):
-        raise SpillCorrupt("bad magic or truncated header "
-                           "(%d bytes)" % len(blob))
-    commit_id, payload_len, crc = _HEADER.unpack(
-        blob[len(MAGIC):head_len])
-    payload = blob[head_len:]
-    if len(payload) != payload_len:
-        raise SpillCorrupt(
-            "torn payload: header promises %d bytes, file holds %d"
-            % (payload_len, len(payload)))
-    if binascii.crc32(payload) & 0xFFFFFFFF != crc:
-        raise SpillCorrupt("payload CRC mismatch")
-    return commit_id, payload
+    try:
+        return atomicio.unframe(MAGIC, blob)
+    except SpillCorrupt:
+        raise
+    except atomicio.RecordCorrupt as exc:
+        raise SpillCorrupt(str(exc)) from None
 
 
 def _filename(commit_id: int, tag: str) -> str:
@@ -152,50 +145,6 @@ def write(commit_id: int, payload: bytes, tag: str,
         LOG.warning("state spill for commit %d failed (%s); continuing "
                     "without durability for this commit", commit_id, exc)
         return None
-
-
-# Orphaned temp files older than this are swept by the pruner: far
-# beyond any live write's lifetime, so a crash mid-write (the power
-# loss the atomic rename protects against) cannot leak disk forever,
-# while a concurrent writer's in-flight temp is never touched.
-_TMP_SWEEP_AGE_S = 300.0
-
-
-def write_atomic(d: str, name: str, blob: bytes):
-    """Atomic same-directory write (temp + fsync + ``os.replace``): a
-    reader never observes a half-written NAMED file; a crash mid-write
-    leaves only a temp :func:`sweep_tmp` reaps.  The ONE write
-    protocol for every durable plane (whole-blob spills, sharded
-    manifests/shards, the serving version store) — a protocol fix
-    lands once."""
-    fd, tmp = tempfile.mkstemp(prefix=".tmp-spill-", dir=d)
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(d, name))
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-
-
-def sweep_tmp(d: str):
-    """Unlink crash-orphaned ``.tmp-spill-*`` files past the age
-    guard (shared by every durable plane's pruner)."""
-    now = time.time()
-    for name in os.listdir(d):
-        if not name.startswith(".tmp-spill-"):
-            continue
-        path = os.path.join(d, name)
-        try:
-            if now - os.path.getmtime(path) > _TMP_SWEEP_AGE_S:
-                os.unlink(path)
-        except OSError:
-            pass
 
 
 def _prune(d: str, tag: str):

@@ -559,7 +559,13 @@ def run(func):
             try:
                 if not skip_sync:
                     state.sync()
-                return func(state, *args, **kwargs)
+                result = func(state, *args, **kwargs)
+                # A crash-adopted driver holds no proc handle for this
+                # worker, so a clean return must announce itself — the
+                # reaped exit code 0 only exists for owned processes.
+                nm.send_finished(
+                    commit_id=getattr(state, "_commit_id", 0))
+                return result
             except StallError as exc:
                 _stall_abort(state, exc)
             except HorovodInternalError as exc:

@@ -307,6 +307,28 @@ class WorkerNotificationManager:
             LOG.warning("drain notice failed (%s); relying on the "
                         "drain exit code", exc)
 
+    def send_finished(self, commit_id: int = 0):
+        """Tell the driver this worker's train function returned
+        cleanly.  For a driver-OWNED process this is redundant (the
+        reaped exit code 0 says the same thing), but a crash-ADOPTED
+        worker has no proc handle on the new driver — this notice is
+        its only completion signal (elastic/driver.py ``finished``
+        handler).  Best-effort: a lost notice degrades to the external
+        liveness probe noticing the exit, never to a hang."""
+        if not self.active or self._server is None:
+            return
+        secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+        try:
+            services.send_message(
+                _driver_addr(), secret,
+                {"kind": "finished", "host": self.host,
+                 "slot": self.slot, "commit_id": commit_id},
+                timeout=5.0, retries=1, deadline=8.0)
+            LOG.debug("finished notice sent (commit id %d)", commit_id)
+        except Exception as exc:  # noqa: BLE001 — exit code is fallback
+            LOG.debug("finished notice failed (%s); the driver's "
+                      "liveness probe will observe the exit", exc)
+
     def mirror_commit(self, blob: bytes, commit_id: int, replicas: int):
         """Mirror one durable commit blob to ``replicas`` buddy ranks
         via the driver (it owns the slot→address table).  Best-effort:

@@ -19,14 +19,15 @@ import logging
 import os
 import random
 import socket
+import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Callable, Optional, TypeVar
+from typing import Callable, List, Optional, TypeVar
 
 from ..common import faultline, metrics
 from ..common.envutil import env_float, env_int
-from .http_server import SECRET_HEADER, compute_digest
+from .http_server import SECRET_HEADER, TERM_HEADER, compute_digest
 
 LOG = logging.getLogger("horovod_tpu.runner.rpc")
 
@@ -145,12 +146,40 @@ def request_with_retry(attempt: Callable[[], T], what: str = "rpc",
             time.sleep(sleep)
 
 
+def rendezvous_endpoints() -> List[str]:
+    """Ordered KV endpoint candidates from
+    ``HOROVOD_RENDEZVOUS_ENDPOINTS`` (comma-separated ``host:port``
+    list, leader first) — the ONE read point for the HA endpoint list.
+    Re-read on every call on purpose: a mid-run env update (or a
+    client constructed before failover config landed) is picked up by
+    the next request, not only by the next client."""
+    raw = os.environ.get("HOROVOD_RENDEZVOUS_ENDPOINTS", "")
+    return [e.strip() for e in raw.split(",") if e.strip()]
+
+
 class RendezvousClient:
-    def __init__(self, addr: str, secret: Optional[str] = None,
-                 namespace: Optional[str] = None):
-        # addr: "host:port"
-        self.base = "http://" + addr
+    """KV client with HA endpoint failover: requests walk an ordered
+    endpoint list (explicit ``addr`` first, then
+    ``HOROVOD_RENDEZVOUS_ENDPOINTS``), rotating to the next candidate
+    when one endpoint exhausts its transient-retry budget (the r8
+    classification: refused/reset/timeout/5xx) or answers 409
+    (fenced/stale leader).  The client carries the highest leader term
+    it has seen in ``X-Hvd-Term``, so a paused-and-resumed old leader
+    learns it was superseded and fences itself instead of accepting a
+    write the new leader never sees."""
+
+    def __init__(self, addr: Optional[str] = None,
+                 secret: Optional[str] = None,
+                 namespace: Optional[str] = None,
+                 endpoints: Optional[List[str]] = None):
+        # addr: "host:port" (optional once the env lists endpoints)
+        self._addr = addr
+        self._explicit = list(endpoints) if endpoints is not None \
+            else None
         self.secret = secret
+        self._active = 0       # index of the endpoint that last worked
+        self._term = 0         # highest leader term seen
+        self._rot_lock = threading.Lock()
         # Tenant-scoped key namespace: on a multi-tenant pod every
         # client prefixes its keys with the tenant id (the scheduler
         # exports HOROVOD_TENANT_ID per tenant), so one tenant's
@@ -166,41 +195,146 @@ class RendezvousClient:
         return self._prefix + "/" + key.lstrip("/")
 
     def _headers(self, payload: bytes) -> dict:
-        if not self.secret:
-            return {}
-        return {SECRET_HEADER: compute_digest(self.secret, payload)}
+        headers = {}
+        if self.secret:
+            headers[SECRET_HEADER] = compute_digest(self.secret, payload)
+        with self._rot_lock:
+            if self._term > 0:
+                headers[TERM_HEADER] = str(self._term)
+        return headers
+
+    def _endpoints(self) -> List[str]:
+        """The current ordered candidate list: the explicitly-passed
+        address first (the world this client was bootstrapped into),
+        then every configured failover endpoint not already listed."""
+        eps: List[str] = []
+        if self._addr:
+            eps.append(self._addr)
+        extra = (self._explicit if self._explicit is not None
+                 else rendezvous_endpoints())
+        for e in extra:
+            if e not in eps:
+                eps.append(e)
+        if not eps:
+            raise ValueError(
+                "no rendezvous endpoint: pass addr= or set "
+                "HOROVOD_RENDEZVOUS_ENDPOINTS")
+        return eps
+
+    @property
+    def base(self) -> str:
+        """Back-compat: the currently-active endpoint's URL base."""
+        eps = self._endpoints()
+        with self._rot_lock:
+            return "http://" + eps[min(self._active, len(eps) - 1)]
+
+    def _note_term(self, headers) -> None:
+        try:
+            seen = int((headers or {}).get(TERM_HEADER) or 0)
+        except (TypeError, ValueError):
+            return
+        with self._rot_lock:
+            if seen > self._term:
+                self._term = seen
+
+    def _request(self, build_attempt, what: str):
+        """Run one logical KV operation with endpoint failover:
+        ``build_attempt(base_url)`` returns the single-attempt closure
+        for one endpoint; each endpoint gets the full retry/backoff
+        budget, and the client rotates to the next candidate on
+        transient-exhaustion or a 409 fence.  Non-transient answers
+        (auth 403, other 4xx) are definitive and raise immediately.
+
+        A cycle where EVERY endpoint failed transiently or answered
+        409 is the leaderless failover window — the old leader is dead
+        and the standby's lease has not yet expired, so *nobody* can
+        answer.  The whole list is retried (with backoff) under the
+        shared ``HOROVOD_RPC_DEADLINE`` wall budget; only its
+        exhaustion escalates the last error."""
+        _retries, backoff, budget = rpc_retry_config()
+        give_up_at = time.monotonic() + budget
+        while True:
+            eps = self._endpoints()
+            with self._rot_lock:
+                start = min(self._active, len(eps) - 1)
+            last_exc: Optional[BaseException] = None
+            for k in range(len(eps)):
+                i = (start + k) % len(eps)
+                if k > 0:
+                    metrics.event("kv_endpoint_rotate", what=what,
+                                  frm=eps[(i - 1) % len(eps)], to=eps[i])
+                    LOG.warning("%s: rotating rendezvous endpoint to %s "
+                                "(%s)", what, eps[i], last_exc)
+                try:
+                    out = request_with_retry(
+                        build_attempt("http://" + eps[i]), what=what)
+                    with self._rot_lock:
+                        self._active = i
+                    return out
+                except urllib.error.HTTPError as exc:
+                    self._note_term(getattr(exc, "headers", None))
+                    if exc.code == 409:
+                        # Fenced or stale leader: a definitive "not
+                        # me" — try the next endpoint with the
+                        # adopted term.
+                        last_exc = exc
+                        continue
+                    raise
+                except Exception as exc:  # noqa: BLE001 — classified
+                    if is_transient(exc):
+                        last_exc = exc
+                        continue
+                    raise
+            assert last_exc is not None
+            now = time.monotonic()
+            if now >= give_up_at:
+                raise last_exc
+            sleep = min(jittered(max(0.05, backoff)),
+                        max(0.0, give_up_at - now))
+            LOG.warning("%s: no rendezvous endpoint answered this "
+                        "cycle (%s); failover may be in flight, "
+                        "retrying the list in %.2fs", what, last_exc,
+                        sleep)
+            time.sleep(sleep)
 
     def put(self, key: str, value: str):
         path = self._path(key)
         body = value.encode()
 
-        def attempt():
-            req = urllib.request.Request(self.base + path, data=body,
-                                         method="PUT",
-                                         headers=self._headers(body))
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                if resp.status != 200:
-                    raise RuntimeError(
-                        "rendezvous PUT failed: %d" % resp.status)
+        def build(base):
+            def attempt():
+                req = urllib.request.Request(base + path, data=body,
+                                             method="PUT",
+                                             headers=self._headers(body))
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    self._note_term(resp.headers)
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            "rendezvous PUT failed: %d" % resp.status)
+            return attempt
 
-        request_with_retry(attempt, what="rendezvous PUT %s" % key)
+        self._request(build, what="rendezvous PUT %s" % key)
 
     def get(self, key: str) -> Optional[str]:
         path = self._path(key)
 
-        def attempt():
-            req = urllib.request.Request(self.base + path, method="GET",
-                                         headers=self._headers(
-                                             path.encode()))
-            try:
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    return resp.read().decode()
-            except urllib.error.HTTPError as e:
-                if e.code == 404:
-                    return None  # a missing key is an answer, not a fault
-                raise
+        def build(base):
+            def attempt():
+                req = urllib.request.Request(base + path, method="GET",
+                                             headers=self._headers(
+                                                 path.encode()))
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        self._note_term(resp.headers)
+                        return resp.read().decode()
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        self._note_term(getattr(e, "headers", None))
+                        return None  # a missing key is an answer
+                    raise
+            return attempt
 
-        return request_with_retry(attempt, what="rendezvous GET %s" % key)
+        return self._request(build, what="rendezvous GET %s" % key)
 
     def put_json(self, key: str, obj):
         """PUT one JSON document (the collective-plan plane publishes
@@ -218,6 +352,11 @@ class RendezvousClient:
                      interval: float = 0.1) -> str:
         deadline = time.monotonic() + timeout
         while True:
+            # Each poll goes through self.get, which re-resolves the
+            # endpoint list (explicit addr + env) and the active index
+            # PER ITERATION: a failover that lands mid-poll is picked
+            # up on the next loop instead of the client spinning
+            # against the dead leader it resolved at entry.
             v = self.get(key)
             if v is not None:
                 return v
@@ -231,11 +370,14 @@ class RendezvousClient:
     def delete(self, key: str):
         path = self._path(key)
 
-        def attempt():
-            req = urllib.request.Request(self.base + path,
-                                         method="DELETE",
-                                         headers=self._headers(
-                                             path.encode()))
-            urllib.request.urlopen(req, timeout=10)
+        def build(base):
+            def attempt():
+                req = urllib.request.Request(base + path,
+                                             method="DELETE",
+                                             headers=self._headers(
+                                                 path.encode()))
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    self._note_term(resp.headers)
+            return attempt
 
-        request_with_retry(attempt, what="rendezvous DELETE %s" % key)
+        self._request(build, what="rendezvous DELETE %s" % key)

@@ -15,20 +15,45 @@ payload data, and scrapers cannot compute the launcher HMAC).  By
 default it renders this process's registry; the elastic driver installs
 a provider that merges every worker's snapshot into a fleet-wide scrape
 (``metrics_provider``).
+
+HA control plane: the server is no longer a SPOF.  With a journal
+directory (runner/journal.py) every mutation is write-ahead journaled
+and snapshotted, so a restarted server replays its store.  Leadership
+carries a **monotonic term** (Raft-style fencing): every response
+advertises the server's term in ``X-Hvd-Term``; clients echo the
+highest term they have seen, and a server that receives proof of a
+newer term **fences itself** — every subsequent KV request is answered
+409 until (if ever) it is re-promoted.  A :class:`StandbyServer` tails
+the leader's journal stream over ``GET /control/journal`` and promotes
+itself with a bumped term when the leader's lease
+(``HOROVOD_CONTROL_LEASE_SECS``) expires, so a paused-and-resumed old
+leader's writes are rejected instead of forking the store
+(split-brain-proof, test-asserted).
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import hmac
+import json
 import logging
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+
+from ..common import faultline, metrics
 
 LOG = logging.getLogger("horovod_tpu.runner.rendezvous")
 
 SECRET_HEADER = "X-Hvd-Secret"
+# Leader-term fencing header: servers advertise their term on every
+# response; clients echo the highest term seen so a stale leader
+# learns it has been superseded and fences itself.
+TERM_HEADER = "X-Hvd-Term"
+SEQ_HEADER = "X-Hvd-Seq"
 
 
 def compute_digest(secret: Optional[str], payload: bytes) -> str:
@@ -55,10 +80,62 @@ class _KvHandler(BaseHTTPRequestHandler):
         LOG.warning("rendezvous handler failed on %s %s: %s",
                     self.command, self.path, exc)
         try:
-            self.send_response(500)
-            self.end_headers()
+            self._respond(500)
         except Exception:  # noqa: BLE001 — socket already gone
             pass
+
+    def _respond(self, code: int, body: Optional[bytes] = None,
+                 ctype: Optional[str] = None,
+                 extra: Optional[Dict[str, str]] = None):
+        """Send one response; every response carries the server's
+        current term so clients track leadership passively."""
+        self.send_response(code)
+        self.send_header(TERM_HEADER,
+                         str(self.server.term))  # type: ignore
+        if ctype:
+            self.send_header("Content-Type", ctype)
+        if extra:
+            for k, v in extra.items():
+                self.send_header(k, v)
+        if body is not None:
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body is not None:
+            self.wfile.write(body)
+
+    def _kv_gate(self) -> bool:
+        """Per-request HA gate for KV verbs; True = request handled
+        (caller returns).  Order: injected server death first (the
+        ``kv.server.die`` seam — drop answers 503, a transient the
+        client retry layer absorbs; die kills the process), then term
+        fencing: a client that has seen a newer term fences this
+        server; a fenced server or a follower answers 409 so the
+        client rotates to the real leader."""
+        if faultline.site("kv.server.die"):
+            self._respond(503)
+            return True
+        srv = self.server
+        client_term = self.headers.get(TERM_HEADER)
+        with srv.lock:  # type: ignore[attr-defined]
+            if client_term is not None:
+                try:
+                    ct = int(client_term)
+                except ValueError:
+                    ct = 0
+                if ct > srv.term and not srv.fenced:  # type: ignore
+                    LOG.warning(
+                        "KV server (term %d) saw proof of newer term "
+                        "%d: fencing self — every mutating request is "
+                        "now rejected", srv.term, ct)  # type: ignore
+                    metrics.event("control_leader_fenced",
+                                  own_term=srv.term,  # type: ignore
+                                  seen_term=ct)
+                    srv.fenced = True  # type: ignore[attr-defined]
+            rejected = srv.fenced or srv.follower  # type: ignore
+        if rejected:
+            self._respond(409)
+            return True
+        return False
 
     def do_POST(self):
         """``POST /serve/<deployment>`` — the serving plane's request
@@ -71,53 +148,48 @@ class _KvHandler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length)
             if not self._authorized(body):
-                self.send_response(403)
-                self.end_headers()
+                self._respond(403)
                 return
             provider = getattr(self.server, "serving_provider", None)
             if provider is None or not self.path.startswith("/serve/"):
-                self.send_response(404)
-                self.end_headers()
+                self._respond(404)
                 return
             deployment = self.path[len("/serve/"):]
             out = provider(deployment, body)
         except Exception as exc:  # noqa: BLE001 — report as 5xx
             self._server_error(exc)
             return
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(out)))
-        self.end_headers()
-        self.wfile.write(out)
+        self._respond(200, out, "application/json")
 
     def do_PUT(self):
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length)
             if not self._authorized(body):
-                self.send_response(403)
-                self.end_headers()
+                self._respond(403)
+                return
+            if self._kv_gate():
                 return
             with self.server.lock:  # type: ignore[attr-defined]
-                self.server.store[self.path] = body  # type: ignore
+                jnl = self.server.journal  # type: ignore[attr-defined]
+                if jnl is not None:
+                    # store IS journal.state: the record append applies
+                    # the mutation, so don't double-apply here.
+                    jnl.record_put(self.path, body)
+                else:
+                    self.server.store[self.path] = body  # type: ignore
         except Exception as exc:  # noqa: BLE001 — report as 5xx
             self._server_error(exc)
             return
-        self.send_response(200)
-        self.end_headers()
+        self._respond(200)
 
     def _serve_metrics(self):
         provider = getattr(self.server, "metrics_provider", None)
         from ..common import metrics as _metrics
         text = provider() if provider is not None \
             else _metrics.render_prometheus()
-        body = text.encode()
-        self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._respond(200, text.encode(),
+                      "text/plain; version=0.0.4; charset=utf-8")
 
     def _serve_skew(self):
         """``GET /skew`` — the skew observatory's fleet JSON (per-rank
@@ -128,15 +200,58 @@ class _KvHandler(BaseHTTPRequestHandler):
         provider installed (non-elastic servers) = 404."""
         provider = getattr(self.server, "skew_provider", None)
         if provider is None:
-            self.send_response(404)
-            self.end_headers()
+            self._respond(404)
             return
-        body = provider().encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._respond(200, provider().encode(), "application/json")
+
+    def _serve_control(self):
+        """``GET /control/...`` — the HA replication/introspection
+        endpoints (HMAC over the full path, query string included):
+
+        * ``/control/status`` — ``{term, seq, fenced, role}``.
+        * ``/control/journal?since=N`` — concatenated journal frames
+          newer than N (the standby's replication feed), with the
+          current term and sequence in response headers.
+        * ``/control/dump`` — the full store (values base64) + term +
+          seq, for standby bootstrap and bitwise recovery assertions.
+        """
+        srv = self.server
+        parsed = urllib.parse.urlparse(self.path)
+        with srv.lock:  # type: ignore[attr-defined]
+            term = srv.term  # type: ignore[attr-defined]
+            jnl = srv.journal  # type: ignore[attr-defined]
+            seq = jnl.seq if jnl is not None else 0
+            if parsed.path == "/control/status":
+                body = json.dumps({
+                    "term": term, "seq": seq,
+                    "fenced": bool(srv.fenced),  # type: ignore
+                    "role": ("follower" if srv.follower  # type: ignore
+                             else "leader"),
+                }, sort_keys=True).encode()
+                self._respond(200, body, "application/json")
+                return
+            if parsed.path == "/control/dump":
+                body = json.dumps({
+                    "term": term, "seq": seq,
+                    "kv": {k: base64.b64encode(v).decode("ascii")
+                           for k, v in srv.store.items()},  # type: ignore
+                }, sort_keys=True).encode()
+                self._respond(200, body, "application/json")
+                return
+            if parsed.path == "/control/journal":
+                if jnl is None:
+                    self._respond(404)
+                    return
+                qs = urllib.parse.parse_qs(parsed.query)
+                try:
+                    since = int(qs.get("since", ["0"])[0])
+                except ValueError:
+                    since = 0
+                tail = jnl.tail_since(since)
+                self._respond(200, tail, "application/octet-stream",
+                              extra={SEQ_HEADER: str(seq)})
+                return
+        self._respond(404)
 
     def do_GET(self):
         try:
@@ -147,8 +262,12 @@ class _KvHandler(BaseHTTPRequestHandler):
                 self._serve_skew()
                 return
             if not self._authorized(self.path.encode()):
-                self.send_response(403)
-                self.end_headers()
+                self._respond(403)
+                return
+            if self.path.startswith("/control/"):
+                self._serve_control()
+                return
+            if self._kv_gate():
                 return
             with self.server.lock:  # type: ignore[attr-defined]
                 value = self.server.store.get(self.path)  # type: ignore
@@ -156,27 +275,28 @@ class _KvHandler(BaseHTTPRequestHandler):
             self._server_error(exc)
             return
         if value is None:
-            self.send_response(404)
-            self.end_headers()
+            self._respond(404)
             return
-        self.send_response(200)
-        self.send_header("Content-Length", str(len(value)))
-        self.end_headers()
-        self.wfile.write(value)
+        self._respond(200, value)
 
     def do_DELETE(self):
         try:
             if not self._authorized(self.path.encode()):
-                self.send_response(403)
-                self.end_headers()
+                self._respond(403)
+                return
+            if self._kv_gate():
                 return
             with self.server.lock:  # type: ignore[attr-defined]
-                self.server.store.pop(self.path, None)  # type: ignore
+                jnl = self.server.journal  # type: ignore[attr-defined]
+                if jnl is not None:
+                    if self.path in self.server.store:  # type: ignore
+                        jnl.record_delete(self.path)
+                else:
+                    self.server.store.pop(self.path, None)  # type: ignore
         except Exception as exc:  # noqa: BLE001 — report as 5xx
             self._server_error(exc)
             return
-        self.send_response(200)
-        self.end_headers()
+        self._respond(200)
 
     def log_message(self, *args):  # quiet
         pass
@@ -184,14 +304,32 @@ class _KvHandler(BaseHTTPRequestHandler):
 
 class RendezvousServer:
     """In-memory KV over HTTP; scope keys like /global/addr/0
-    (reference scopes: global/local/cross)."""
+    (reference scopes: global/local/cross).
+
+    With ``journal_dir`` the store is durably journaled (replayed on
+    construction) and the server participates in term-fenced
+    leadership; ``follower=True`` starts it fenced-for-writes as a
+    warm standby (see :class:`StandbyServer`) until :meth:`promote`."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 journal_dir: Optional[str] = None,
+                 follower: bool = False):
+        from . import journal as _journal
         self._httpd = ThreadingHTTPServer((host, port), _KvHandler)
-        self._httpd.store = {}          # type: ignore[attr-defined]
+        jnl = (_journal.ControlJournal(journal_dir)
+               if journal_dir else None)
+        # With a journal the store IS the journal's replayed state
+        # (one dict object): mutations flow through record_* appends,
+        # which apply to it — the handler never double-writes.
+        self._httpd.store = (jnl.state if jnl is not None  # type: ignore
+                             else {})
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.secret = secret     # type: ignore[attr-defined]
+        self._httpd.journal = jnl       # type: ignore[attr-defined]
+        self._httpd.term = max(1, jnl.term if jnl else 1)  # type: ignore
+        self._httpd.fenced = False      # type: ignore[attr-defined]
+        self._httpd.follower = follower  # type: ignore[attr-defined]
         # /metrics renderer; None = this process's own registry.
         self._httpd.metrics_provider = None  # type: ignore[attr-defined]
         # POST /serve/<deployment> handler; None = endpoint disabled.
@@ -199,6 +337,8 @@ class RendezvousServer:
         # GET /skew renderer; None = endpoint disabled (404).
         self._httpd.skew_provider = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        if not follower:
+            metrics.gauge("control_leader_term").set(self.term)
 
     @property
     def metrics_provider(self):
@@ -235,6 +375,23 @@ class RendezvousServer:
     def port(self) -> int:
         return self._httpd.server_address[1]
 
+    @property
+    def term(self) -> int:
+        return self._httpd.term  # type: ignore[attr-defined]
+
+    @property
+    def fenced(self) -> bool:
+        return self._httpd.fenced  # type: ignore[attr-defined]
+
+    @property
+    def follower(self) -> bool:
+        return self._httpd.follower  # type: ignore[attr-defined]
+
+    @property
+    def seq(self) -> int:
+        jnl = self._httpd.journal  # type: ignore[attr-defined]
+        return jnl.seq if jnl is not None else 0
+
     def start(self) -> int:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -244,6 +401,60 @@ class RendezvousServer:
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        jnl = self._httpd.journal  # type: ignore[attr-defined]
+        if jnl is not None:
+            jnl.close()
+
+    # -- HA control plane ------------------------------------------------
+
+    def put_local(self, key: str, value: bytes):
+        """Driver-side direct put (no HTTP round-trip to ourselves):
+        how the elastic driver journals its control record."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            jnl = self._httpd.journal  # type: ignore[attr-defined]
+            if jnl is not None:
+                jnl.record_put(key, value)
+            else:
+                self._httpd.store[key] = value  # type: ignore
+
+    def promote(self, new_term: int):
+        """Take leadership at ``new_term``: unfence, stop following,
+        journal the term bump so it survives OUR crash too."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.term = max(  # type: ignore[attr-defined]
+                self._httpd.term, int(new_term))  # type: ignore
+            self._httpd.fenced = False   # type: ignore[attr-defined]
+            self._httpd.follower = False  # type: ignore[attr-defined]
+            jnl = self._httpd.journal  # type: ignore[attr-defined]
+            if jnl is not None:
+                jnl.record_term(self._httpd.term)  # type: ignore
+            term = self._httpd.term  # type: ignore[attr-defined]
+        metrics.gauge("control_leader_term").set(term)
+        LOG.warning("KV server promoted to leader at term %d", term)
+
+    def apply_tail(self, blob: bytes, leader_term: int):
+        """Follower path: journal + apply a leader's replication
+        stream (store updates ride the shared state dict)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            jnl = self._httpd.journal  # type: ignore[attr-defined]
+            if jnl is not None:
+                jnl.apply_frames(blob)
+                self._httpd.term = max(  # type: ignore[attr-defined]
+                    self._httpd.term,  # type: ignore[attr-defined]
+                    jnl.term, int(leader_term))
+
+    def adopt_snapshot(self, kv: Dict[str, bytes], term: int, seq: int):
+        """Follower bootstrap: adopt a leader's full dump (store,
+        term, sequence) and durably snapshot it."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            jnl = self._httpd.journal  # type: ignore[attr-defined]
+            if jnl is not None:
+                jnl.adopt_snapshot(kv, term, seq)
+            else:
+                self._httpd.store.clear()  # type: ignore[attr-defined]
+                self._httpd.store.update(kv)  # type: ignore
+            self._httpd.term = max(  # type: ignore[attr-defined]
+                self._httpd.term, int(term))  # type: ignore
 
     # Test/introspection access.
     def snapshot(self) -> Dict[str, bytes]:
@@ -252,4 +463,124 @@ class RendezvousServer:
 
     def reset(self):
         with self._httpd.lock:  # type: ignore[attr-defined]
-            self._httpd.store.clear()  # type: ignore[attr-defined]
+            jnl = self._httpd.journal  # type: ignore[attr-defined]
+            if jnl is not None:
+                jnl.record_reset()
+            else:
+                self._httpd.store.clear()  # type: ignore[attr-defined]
+
+
+class StandbyServer:
+    """Warm standby for a rendezvous KV leader: a follower
+    :class:`RendezvousServer` (journaled, write-fenced) plus a tail
+    thread that bootstraps from the leader's ``/control/dump`` and
+    then replicates its journal stream over the HMAC'd HTTP plane.
+    When the leader stays unreachable past the lease
+    (``HOROVOD_CONTROL_LEASE_SECS``) the standby promotes itself with
+    a bumped term — from then on the old leader's writes are fenced
+    (409) by term comparison wherever they land."""
+
+    def __init__(self, leader_addr: str, journal_dir: str,
+                 secret: Optional[str] = None,
+                 host: str = "0.0.0.0", port: int = 0,
+                 lease: Optional[float] = None):
+        from . import journal as _journal
+        self.leader_addr = leader_addr
+        self.secret = secret
+        self.server = RendezvousServer(host=host, port=port,
+                                       secret=secret,
+                                       journal_dir=journal_dir,
+                                       follower=True)
+        self._lease = (lease if lease is not None
+                       else _journal.lease_secs())
+        self._leader_term = 1
+        self._bootstrapped = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def promoted(self) -> bool:
+        return not self.server.follower
+
+    def _leader_get(self, path: str) -> "tuple[bytes, Dict[str, str]]":
+        """One unretried GET against the leader (the poll cadence is
+        the retry policy); returns (body, headers)."""
+        import urllib.request
+        url = "http://" + self.leader_addr + path
+        headers = {}
+        if self.secret:
+            headers[SECRET_HEADER] = compute_digest(
+                self.secret, path.encode())
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.read(), dict(resp.headers)
+
+    def _poll_once(self) -> bool:
+        """One replication poll; True on success."""
+        if faultline.site("kv.standby.partition"):
+            LOG.warning("standby replication poll dropped (faultline "
+                        "kv.standby.partition)")
+            return False
+        try:
+            if not self._bootstrapped:
+                body, _hdrs = self._leader_get("/control/dump")
+                doc = json.loads(body.decode())
+                self.server.adopt_snapshot(
+                    {k: base64.b64decode(v.encode("ascii"))
+                     for k, v in doc["kv"].items()},
+                    int(doc["term"]), int(doc["seq"]))
+                self._leader_term = max(self._leader_term,
+                                        int(doc["term"]))
+                self._bootstrapped = True
+            tail, hdrs = self._leader_get(
+                "/control/journal?since=%d" % self.server.seq)
+            leader_term = int(hdrs.get(TERM_HEADER, "1"))
+            self._leader_term = max(self._leader_term, leader_term)
+            if tail:
+                self.server.apply_tail(tail, leader_term)
+            return True
+        except Exception as exc:  # noqa: BLE001 — liveness signal
+            LOG.debug("standby poll of leader %s failed: %s",
+                      self.leader_addr, exc)
+            return False
+
+    def _run(self):
+        from .http_client import jittered
+        last_ok = time.monotonic()
+        interval = max(0.05, self._lease / 4.0)
+        while not self._stop.is_set():
+            if self._poll_once():
+                last_ok = time.monotonic()
+            elif (time.monotonic() - last_ok > self._lease
+                  and not self.promoted):
+                new_term = max(self._leader_term,
+                               self.server.term) + 1
+                LOG.warning(
+                    "leader %s unreachable for %.1fs (lease %.1fs): "
+                    "standby taking over at term %d",
+                    self.leader_addr,
+                    time.monotonic() - last_ok, self._lease, new_term)
+                self.server.promote(new_term)
+                metrics.counter("control_failovers_total").inc()
+                metrics.event("control_failover",
+                              old_leader=self.leader_addr,
+                              term=new_term)
+            if self.promoted:
+                return  # leaders do not tail anyone
+            self._stop.wait(jittered(interval))
+
+    def start(self) -> int:
+        port = self.server.start()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return port
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.server.stop()

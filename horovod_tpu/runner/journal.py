@@ -1,0 +1,392 @@
+"""Control-plane write-ahead journal: durable rendezvous KV + driver state.
+
+The rendezvous KV server (runner/http_server.py) and the elastic
+driver's slot bookkeeping were the last in-memory singletons in the
+robustness story — a driver-host crash killed the world even though
+every worker and spill blob outlived it.  With
+``HOROVOD_CONTROL_JOURNAL_DIR`` set, every KV mutation is appended to a
+write-ahead log before it is acknowledged, and the full store is
+periodically snapshotted, both in the r10 spill wire format
+(MAGIC + seq u64 + len u64 + crc32 + payload, shared framing from
+common/atomicio.py with this plane's own MAGIC):
+
+* ``wal-<first_seq>.walseg`` — append-only segments of framed JSON
+  records, fsynced per append.  Record ops: ``put``/``del``/``reset``
+  (store mutations, values base64), ``term`` (leadership changes).
+* ``snap-<seq>.snap`` — atomic whole-store snapshots
+  (``{"term": t, "kv": {key: b64}}``), written every
+  ``SNAPSHOT_EVERY`` records; the newest ``KEEP_SNAPSHOTS`` are kept
+  and fully-covered segments are deleted (keep-last-K compaction).
+
+Replay loads the newest VALID snapshot (corrupt-newest falls back down
+the chain, exactly like spill restore) and applies every journal
+record with a newer sequence.  Torn or corrupt records — injectable
+via the ``kv.journal.torn`` fault site — are skipped loudly
+(``kv_journal_skipped_records_total``) with a resync to the next magic
+boundary, never silently trusted.
+
+The journal directory holds the launcher secret (inside the driver's
+control record) and is created mode 0700 — treat it like a credential
+store, not like scratch space.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import atomicio, faultline, metrics
+from ..common.envutil import env_float
+
+LOG = logging.getLogger("horovod_tpu.runner.journal")
+
+MAGIC = b"HVDKVWAL1\n"
+_SEG_PREFIX = "wal-"
+_SEG_SUFFIX = ".walseg"
+_SNAP_PREFIX = "snap-"
+_SNAP_SUFFIX = ".snap"
+
+# Snapshot cadence and retained history.  Keep-last-K is a constant,
+# not an env: the chain only needs depth for corrupt-newest fallback,
+# and the segments between snapshots bound disk growth regardless.
+SNAPSHOT_EVERY = 256
+KEEP_SNAPSHOTS = 3
+
+# KV key under which the elastic driver journals its own bookkeeping
+# (epoch, assignments, worker addresses, blacklist, secret) so a
+# restarted driver can adopt the old world instead of re-forming it.
+CONTROL_KEY = "/__control__/driver"
+
+
+def control_journal_dir(tenant: Optional[str] = None) -> Optional[str]:
+    """The control-plane journal directory
+    (``HOROVOD_CONTROL_JOURNAL_DIR``); None disables journaling
+    entirely.  Like spill_dir, a multi-tenant pod gives each tenant its
+    own ``tenant-<id>`` subdirectory (explicit ``tenant`` argument wins
+    over ``HOROVOD_TENANT_ID``) so one tenant's control history can
+    never be adopted by another's driver."""
+    base = os.environ.get("HOROVOD_CONTROL_JOURNAL_DIR") or None
+    if base is None:
+        return None
+    if tenant is None:
+        tenant = os.environ.get("HOROVOD_TENANT_ID")
+    if tenant:
+        return os.path.join(base, "tenant-%s" % tenant)
+    return base
+
+
+def lease_secs() -> float:
+    """Leader lease (``HOROVOD_CONTROL_LEASE_SECS``, default 5 s,
+    floor 0.1): a warm standby that cannot reach the active KV server
+    for this long promotes itself with a bumped term."""
+    return env_float("HOROVOD_CONTROL_LEASE_SECS", 5.0, minimum=0.1)
+
+
+def recovery_deadline() -> float:
+    """Driver-adoption budget (``HOROVOD_CONTROL_RECOVERY_DEADLINE``,
+    default 60 s): how long a restarted driver waits for journaled
+    workers to prove liveness (answer a ping / re-register) before
+    giving up on adoption and falling back to ordinary world
+    re-formation (where the r2 elastic deadline governs)."""
+    return env_float("HOROVOD_CONTROL_RECOVERY_DEADLINE", 60.0,
+                     minimum=0.0)
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode("ascii")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode("ascii"))
+
+
+def _apply_op(op: Dict, kv: Dict[str, bytes], term: int) -> int:
+    """Apply one journal record to (kv, term); returns the new term."""
+    kind = op.get("op")
+    if kind == "put":
+        kv[op["k"]] = _unb64(op["v"])
+    elif kind == "del":
+        kv.pop(op["k"], None)
+    elif kind == "reset":
+        kv.clear()
+    elif kind == "term":
+        term = max(term, int(op["term"]))
+    return term
+
+
+def parse_frames(blob: bytes,
+                 on_skip: Optional[Callable[[str], None]] = None
+                 ) -> List[Tuple[int, bytes, Dict]]:
+    """Parse a byte stream of concatenated journal frames into
+    ``(seq, frame_bytes, op)`` triples.  A torn or corrupt record is
+    skipped loudly (``on_skip`` + metrics) and parsing resyncs at the
+    next MAGIC boundary — one bad record costs itself, not the tail of
+    the segment."""
+    out: List[Tuple[int, bytes, Dict]] = []
+    head_len = len(MAGIC) + atomicio.HEADER.size
+    pos = 0
+
+    def skip(reason: str, resync_from: int):
+        metrics.counter("kv_journal_skipped_records_total").inc()
+        metrics.event("kv_journal_skip", reason=reason)
+        if on_skip:
+            on_skip(reason)
+        return blob.find(MAGIC, resync_from)
+
+    while 0 <= pos < len(blob):
+        if not blob.startswith(MAGIC, pos):
+            pos = skip("bad magic at offset %d" % pos, pos + 1)
+            continue
+        if pos + head_len > len(blob):
+            pos = skip("truncated header at offset %d" % pos, pos + 1)
+            continue
+        seq, payload_len, _crc = atomicio.HEADER.unpack(
+            blob[pos + len(MAGIC):pos + head_len])
+        end = pos + head_len + payload_len
+        frame_bytes = blob[pos:end]
+        try:
+            _seq, payload = atomicio.unframe(MAGIC, frame_bytes)
+            op = json.loads(payload.decode())
+        except (atomicio.RecordCorrupt, ValueError) as exc:
+            pos = skip("record seq=%d at offset %d: %s"
+                       % (seq, pos, exc), pos + 1)
+            continue
+        out.append((seq, frame_bytes, op))
+        pos = end
+    return out
+
+
+def _list(d: str, prefix: str, suffix: str) -> List[Tuple[int, str]]:
+    """(seq, path), ascending by seq, for journal files of one kind."""
+    out = []
+    if not os.path.isdir(d):
+        return out
+    for name in os.listdir(d):
+        if not (name.startswith(prefix) and name.endswith(suffix)):
+            continue
+        try:
+            seq = int(name[len(prefix):-len(suffix)])
+        except ValueError:
+            continue
+        out.append((seq, os.path.join(d, name)))
+    out.sort()
+    return out
+
+
+def replay(d: str) -> Tuple[Dict[str, bytes], int, int]:
+    """Reconstruct ``(kv, term, last_seq)`` from a journal directory:
+    newest valid snapshot (fallback chain on corruption) + every
+    journal record with a newer sequence."""
+    kv: Dict[str, bytes] = {}
+    term, snap_seq = 0, 0
+    for seq, path in reversed(_list(d, _SNAP_PREFIX, _SNAP_SUFFIX)):
+        try:
+            with open(path, "rb") as f:
+                file_seq, payload = atomicio.unframe(MAGIC, f.read())
+            doc = json.loads(payload.decode())
+            kv = {k: _unb64(v) for k, v in doc["kv"].items()}
+            term, snap_seq = int(doc["term"]), file_seq
+            break
+        except (OSError, atomicio.RecordCorrupt, ValueError, KeyError) as exc:
+            metrics.counter("kv_journal_skipped_records_total").inc()
+            LOG.warning("skipping corrupt control snapshot %s (%s); "
+                        "falling back to the previous one", path, exc)
+    last_seq = snap_seq
+    for _first, path in _list(d, _SEG_PREFIX, _SEG_SUFFIX):
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            LOG.warning("unreadable journal segment %s: %s", path, exc)
+            continue
+        for seq, _frame, op in parse_frames(
+                blob, on_skip=lambda r, p=path: LOG.warning(
+                    "skipping corrupt control-journal record in %s: %s",
+                    p, r)):
+            if seq <= snap_seq:
+                continue
+            term = _apply_op(op, kv, term)
+            last_seq = max(last_seq, seq)
+    return kv, term, last_seq
+
+
+def peek_control_record(d: Optional[str]) -> Optional[Dict]:
+    """The driver's journaled control record (parsed JSON), or None
+    when there is no journal / no record — the restarted driver's
+    adoption probe, read without taking ownership of the journal."""
+    if not d or not os.path.isdir(d):
+        return None
+    kv, _term, _seq = replay(d)
+    blob = kv.get(CONTROL_KEY)
+    if blob is None:
+        return None
+    try:
+        return json.loads(blob.decode())
+    except ValueError as exc:
+        LOG.warning("journaled control record is unparseable (%s); "
+                    "ignoring it", exc)
+        return None
+
+
+class ControlJournal:
+    """One process's handle on a journal directory: replays on open,
+    appends framed records with per-record fsync, snapshots + compacts
+    on cadence.  Not thread-safe by itself — the KV server serializes
+    calls under its store lock."""
+
+    def __init__(self, d: str):
+        self.dir = d
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        try:
+            os.chmod(d, 0o700)  # pre-existing dir: tighten anyway
+        except OSError:
+            pass
+        self.state, self.term, self.seq = replay(d)
+        self._since_snapshot = 0
+        self._seg_fd = None
+        self._open_segment(self.seq + 1)
+
+    def _open_segment(self, first_seq: int):
+        if self._seg_fd is not None:
+            try:
+                self._seg_fd.close()
+            except OSError:
+                pass
+        path = os.path.join(self.dir, "%s%020d%s"
+                            % (_SEG_PREFIX, first_seq, _SEG_SUFFIX))
+        self._seg_fd = open(path, "ab")
+
+    def append(self, op: Dict) -> int:
+        """Journal one record (fsync before return) and apply it to
+        the in-memory replayed state; returns its sequence number."""
+        seq = self.seq + 1
+        blob = atomicio.frame(MAGIC, seq, json.dumps(
+            op, sort_keys=True).encode())
+        if faultline.site("kv.journal.torn"):
+            # Injected torn append: the record lands truncated
+            # mid-payload — the shape a power loss mid-fsync leaves.
+            blob = blob[:len(MAGIC) + atomicio.HEADER.size
+                        + max(1, (len(blob) - len(MAGIC)
+                                  - atomicio.HEADER.size) // 2)]
+            LOG.warning("control-journal record seq=%d torn "
+                        "(faultline kv.journal.torn)", seq)
+        self._seg_fd.write(blob)
+        self._seg_fd.flush()
+        os.fsync(self._seg_fd.fileno())
+        metrics.counter("kv_journal_bytes_total").inc(len(blob))
+        self.seq = seq
+        self.term = _apply_op(op, self.state, self.term)
+        self._since_snapshot += 1
+        if self._since_snapshot >= SNAPSHOT_EVERY:
+            self.snapshot()
+        return seq
+
+    def record_put(self, key: str, value: bytes) -> int:
+        return self.append({"op": "put", "k": key, "v": _b64(value)})
+
+    def record_delete(self, key: str) -> int:
+        return self.append({"op": "del", "k": key})
+
+    def record_reset(self) -> int:
+        return self.append({"op": "reset"})
+
+    def record_term(self, term: int) -> int:
+        return self.append({"op": "term", "term": int(term)})
+
+    def snapshot(self):
+        """Atomic whole-store snapshot at the current sequence, then
+        keep-last-K compaction: old snapshots beyond ``KEEP_SNAPSHOTS``
+        and segments fully covered by the oldest retained snapshot are
+        deleted, and appends roll into a fresh segment."""
+        doc = {"term": self.term,
+               "kv": {k: _b64(v) for k, v in self.state.items()}}
+        blob = atomicio.frame(MAGIC, self.seq,
+                              json.dumps(doc, sort_keys=True).encode())
+        atomicio.write_atomic(
+            self.dir, "%s%020d%s" % (_SNAP_PREFIX, self.seq,
+                                     _SNAP_SUFFIX), blob)
+        self._since_snapshot = 0
+        snaps = _list(self.dir, _SNAP_PREFIX, _SNAP_SUFFIX)
+        for _seq, path in snaps[:-KEEP_SNAPSHOTS]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        retained = snaps[-KEEP_SNAPSHOTS:]
+        oldest_kept = retained[0][0] if retained else 0
+        # A segment is droppable when every record in it is at or
+        # below the oldest retained snapshot — i.e. the NEXT segment
+        # starts at or below oldest_kept + 1.
+        segs = _list(self.dir, _SEG_PREFIX, _SEG_SUFFIX)
+        for i, (first, path) in enumerate(segs):
+            nxt = segs[i + 1][0] if i + 1 < len(segs) else self.seq + 1
+            if nxt <= oldest_kept + 1:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        atomicio.sweep_tmp(self.dir)
+        self._open_segment(self.seq + 1)
+
+    def adopt_snapshot(self, kv: Dict[str, bytes], term: int, seq: int):
+        """Standby bootstrap: adopt a leader's full dump as our own
+        durable snapshot, so the subsequent journal tail (whose
+        records carry the leader's sequence numbers) lands on the same
+        baseline.  ``max`` semantics keep anything newer we already
+        hold (a restarted standby must not move backwards).  The
+        in-place clear/update matters: the KV server's store IS this
+        dict object."""
+        self.state.clear()
+        self.state.update(kv)
+        self.term = max(self.term, int(term))
+        self.seq = max(self.seq, int(seq))
+        self.snapshot()
+
+    def tail_since(self, since_seq: int) -> bytes:
+        """Concatenated frames of every on-disk record newer than
+        ``since_seq`` — the standby's replication feed (served over
+        ``GET /control/journal?since=N``)."""
+        out = []
+        for _first, path in _list(self.dir, _SEG_PREFIX, _SEG_SUFFIX):
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue
+            for seq, frame_bytes, _op in parse_frames(blob):
+                if seq > since_seq:
+                    out.append(frame_bytes)
+        return b"".join(out)
+
+    def apply_frames(self, blob: bytes) -> List[Dict]:
+        """Apply a leader's tail stream: each record newer than our
+        own sequence is journaled verbatim (preserving the leader's
+        sequence numbers) and applied; already-seen records are
+        skipped.  Returns the ops applied, in order."""
+        applied = []
+        for seq, frame_bytes, op in parse_frames(blob):
+            if seq <= self.seq:
+                continue
+            self._seg_fd.write(frame_bytes)
+            self._seg_fd.flush()
+            os.fsync(self._seg_fd.fileno())
+            metrics.counter("kv_journal_bytes_total").inc(
+                len(frame_bytes))
+            self.seq = seq
+            self.term = _apply_op(op, self.state, self.term)
+            self._since_snapshot += 1
+            applied.append(op)
+        if self._since_snapshot >= SNAPSHOT_EVERY:
+            self.snapshot()
+        return applied
+
+    def close(self):
+        if self._seg_fd is not None:
+            try:
+                self._seg_fd.close()
+            except OSError:
+                pass
+            self._seg_fd = None

@@ -1,0 +1,95 @@
+"""Standalone rendezvous KV server / warm standby launcher.
+
+The embedded driver KV (elastic/driver.py) dies with the driver
+process; running the KV out-of-process with this module decouples the
+control plane's lifetime from the driver's::
+
+    # the active leader, journaled
+    python -m horovod_tpu.runner.kv_server --port 18888 \
+        --journal-dir /durable/kv-a
+
+    # a warm standby tailing it (promotes on lease expiry)
+    python -m horovod_tpu.runner.kv_server --port 18889 \
+        --journal-dir /durable/kv-b --standby-of 127.0.0.1:18888
+
+Workers and the driver reach whichever is alive via
+``HOROVOD_RENDEZVOUS_ENDPOINTS=host:18888,host:18889`` — the client
+rotates on transient-exhaustion and 409 fences (runner/http_client.py).
+
+Auth: ``--secret-env`` names the env var holding the launcher secret
+(default ``HOROVOD_SECRET_KEY``); unset/empty runs the plane
+unauthenticated (harness-internal networks only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from . import journal
+from .http_server import RendezvousServer, StandbyServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runner.kv_server",
+        description="standalone rendezvous KV server / warm standby")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--journal-dir", default=None,
+                        help="write-ahead journal directory (default: "
+                             "HOROVOD_CONTROL_JOURNAL_DIR)")
+    parser.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                        help="run as a warm standby tailing this "
+                             "leader; promotes on lease expiry")
+    parser.add_argument("--secret-env", default="HOROVOD_SECRET_KEY",
+                        help="env var holding the HMAC secret "
+                             "(empty value = auth disabled)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    secret = os.environ.get(args.secret_env) or None
+    journal_dir = args.journal_dir or journal.control_journal_dir()
+
+    if args.standby_of:
+        if not journal_dir:
+            parser.error("--standby-of requires a journal dir "
+                         "(--journal-dir or "
+                         "HOROVOD_CONTROL_JOURNAL_DIR)")
+        node = StandbyServer(args.standby_of, journal_dir,
+                             secret=secret, host=args.host,
+                             port=args.port)
+        port = node.start()
+        role = "standby"
+        term = node.server.term
+    else:
+        node = RendezvousServer(host=args.host, port=args.port,
+                                secret=secret, journal_dir=journal_dir)
+        port = node.start()
+        role = "leader"
+        term = node.term
+
+    # Parseable liveness line for launch tooling and the HA e2e.
+    print("KV_SERVER LISTENING port=%d role=%s term=%d journal=%s"
+          % (port, role, term, journal_dir or "-"), flush=True)
+
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    done.wait()
+    node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

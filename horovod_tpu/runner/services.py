@@ -50,6 +50,14 @@ def _recv_exact(sock, n: int) -> bytes:
     return buf
 
 
+class _ReusableTCPServer(socketserver.ThreadingTCPServer):
+    # A crash-restarted driver must be able to rebind its journaled
+    # port while the dead process's sockets linger in TIME_WAIT —
+    # without this, crash adoption (elastic/driver.py) could never
+    # come back on the address its workers still hold.
+    allow_reuse_address = True
+
+
 class MessageServer:
     """Threaded TCP server dispatching pickled requests to a handler."""
 
@@ -74,7 +82,7 @@ class MessageServer:
 
         self.handler = handler
         self.secret = secret
-        self._server = socketserver.ThreadingTCPServer(
+        self._server = _ReusableTCPServer(
             (host, port), _Handler, bind_and_activate=True)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -92,6 +100,66 @@ class MessageServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class AddressTable:
+    """Generation-tracked endpoint table for the notification plane
+    (keyed by slot): the fix for stale-entry shadowing after a
+    failover.  A worker that reattaches re-registers from a NEW port;
+    a live :meth:`register` always wins (it carries a fresh
+    generation and evicts any other key still claiming the same
+    address), while :meth:`restore` — the crash-adopted driver seeding
+    journaled addresses — never overwrites an entry a live
+    registration already refreshed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Any, Tuple[Tuple[str, int], int]] = {}
+        self._gen = 0
+
+    def register(self, key: Any, addr: Tuple[str, int]):
+        """A live registration: newest always wins, and any OTHER key
+        still mapped to this exact address is purged (the old owner's
+        socket is gone — keeping it would misroute notifications)."""
+        with self._lock:
+            self._gen += 1
+            stale = [k for k, (a, _g) in self._entries.items()
+                     if a == addr and k != key]
+            for k in stale:
+                del self._entries[k]
+            self._entries[key] = (addr, self._gen)
+
+    def restore(self, key: Any, addr: Tuple[str, int]):
+        """Seed a journaled address at generation 0: useful until the
+        worker re-registers, at which point the live entry shadows it
+        (never the other way around)."""
+        with self._lock:
+            self._entries.setdefault(key, (addr, 0))
+
+    def get(self, key: Any) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            entry = self._entries.get(key)
+            return entry[0] if entry else None
+
+    def purge(self, key: Any):
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def items(self):
+        with self._lock:
+            return [(k, a) for k, (a, _g) in self._entries.items()]
+
+    def snapshot(self) -> Dict[Any, Tuple[str, int]]:
+        with self._lock:
+            return {k: a for k, (a, _g) in self._entries.items()}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._entries
 
 
 def send_message(addr: Tuple[str, int], secret: str, obj: Any,

@@ -1,0 +1,412 @@
+"""HA control plane unit tests: write-ahead journal roundtrips,
+torn/corrupt record recovery, snapshot compaction + fallback, leader
+term fencing (split-brain), client endpoint failover, and warm-standby
+promotion (docs/elastic.md §Control-plane HA)."""
+
+import base64
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.common import atomicio, faultline, metrics
+from horovod_tpu.runner import journal
+from horovod_tpu.runner.http_client import RendezvousClient
+from horovod_tpu.runner.http_server import (RendezvousServer,
+                                            SECRET_HEADER, StandbyServer,
+                                            TERM_HEADER, compute_digest)
+from horovod_tpu.runner.services import AddressTable
+
+SECRET = "unit-secret"
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_FAULT", raising=False)
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_ENDPOINTS", raising=False)
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+def _fast_rpc(monkeypatch, retries="1", backoff="0.01", deadline="3"):
+    monkeypatch.setenv("HOROVOD_RPC_MAX_RETRIES", retries)
+    monkeypatch.setenv("HOROVOD_RPC_RETRY_BACKOFF", backoff)
+    monkeypatch.setenv("HOROVOD_RPC_DEADLINE", deadline)
+
+
+def _dead_port() -> int:
+    """A port with nothing listening (refused = transient, fast)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- journal roundtrips ----------------------------------------------------
+
+def test_journal_roundtrip(tmp_path):
+    d = str(tmp_path / "jnl")
+    j = journal.ControlJournal(d)
+    j.record_put("/a", b"1")
+    j.record_put("/b", b"2")
+    j.record_delete("/a")
+    j.record_term(7)
+    j.close()
+
+    kv, term, seq = journal.replay(d)
+    assert kv == {"/b": b"2"}
+    assert term == 7
+    assert seq == 4
+
+    # Reopening resumes at the replayed sequence; appends continue it.
+    j2 = journal.ControlJournal(d)
+    assert (j2.state, j2.term, j2.seq) == (kv, 7, 4)
+    assert j2.record_put("/c", b"3") == 5
+    j2.close()
+    kv2, _term2, seq2 = journal.replay(d)
+    assert kv2 == {"/b": b"2", "/c": b"3"} and seq2 == 5
+
+
+def test_snapshot_compaction_keeps_last_k(tmp_path, monkeypatch):
+    monkeypatch.setattr(journal, "SNAPSHOT_EVERY", 4)
+    d = str(tmp_path / "jnl")
+    j = journal.ControlJournal(d)
+    for i in range(20):
+        j.record_put("/k%d" % i, b"v%d" % i)
+    j.close()
+
+    snaps = [n for n in os.listdir(d) if n.endswith(".snap")]
+    segs = [n for n in os.listdir(d) if n.endswith(".walseg")]
+    assert len(snaps) == journal.KEEP_SNAPSHOTS
+    # Segments fully covered by the oldest retained snapshot are gone:
+    # with snapshots every 4 records, at most a few live segments stay.
+    assert len(segs) <= journal.KEEP_SNAPSHOTS + 1
+    kv, _term, seq = journal.replay(d)
+    assert seq == 20
+    assert kv == {"/k%d" % i: b"v%d" % i for i in range(20)}
+
+
+def test_parse_frames_resyncs_after_torn_record():
+    f1 = atomicio.frame(journal.MAGIC, 1, json.dumps(
+        {"op": "put", "k": "/a", "v": journal._b64(b"x")}).encode())
+    f2 = atomicio.frame(journal.MAGIC, 2, json.dumps(
+        {"op": "put", "k": "/b", "v": journal._b64(b"y")}).encode())
+    f3 = atomicio.frame(journal.MAGIC, 3, json.dumps(
+        {"op": "put", "k": "/c", "v": journal._b64(b"z")}).encode())
+    torn = f2[:len(f2) - 7]  # mid-payload truncation
+    skips = []
+    before = metrics.series_sum("kv_journal_skipped_records_total")
+    out = journal.parse_frames(f1 + torn + f3, on_skip=skips.append)
+    assert [seq for seq, _f, _op in out] == [1, 3]
+    assert skips  # loud
+    assert metrics.series_sum("kv_journal_skipped_records_total") > before
+
+
+def test_corrupt_crc_record_skipped_on_replay(tmp_path):
+    d = str(tmp_path / "jnl")
+    j = journal.ControlJournal(d)
+    j.record_put("/a", b"aaaa")
+    j.record_put("/b", b"bbbb")
+    j.close()
+    seg = [os.path.join(d, n) for n in os.listdir(d)
+           if n.endswith(".walseg")][0]
+    blob = bytearray(open(seg, "rb").read())
+    # Flip one payload byte of the FIRST record (its CRC now fails);
+    # the second record must survive the resync.
+    blob[len(journal.MAGIC) + atomicio.HEADER.size + 2] ^= 0xFF
+    open(seg, "wb").write(bytes(blob))
+
+    before = metrics.series_sum("kv_journal_skipped_records_total")
+    kv, _term, seq = journal.replay(d)
+    assert "/a" not in kv and kv["/b"] == b"bbbb"
+    assert seq == 2
+    assert metrics.series_sum("kv_journal_skipped_records_total") > before
+
+
+def test_journal_torn_write_fault_site(tmp_path, monkeypatch):
+    # CI fault-smoke runs this node id: an injected torn append (the
+    # power-loss-mid-fsync shape) costs exactly that record on replay.
+    d = str(tmp_path / "jnl")
+    monkeypatch.setenv("HVD_TPU_FAULT", "kv.journal.torn:drop@times=1")
+    faultline.reset()
+    j = journal.ControlJournal(d)
+    j.record_put("/lost", b"torn-away")
+    j.record_put("/kept", b"ok")
+    j.close()
+    faultline.reset()
+
+    kv, _term, seq = journal.replay(d)
+    assert "/lost" not in kv
+    assert kv["/kept"] == b"ok"
+    assert seq == 2
+
+
+def test_snapshot_chain_falls_back_past_corrupt_newest(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setattr(journal, "SNAPSHOT_EVERY", 3)
+    d = str(tmp_path / "jnl")
+    j = journal.ControlJournal(d)
+    for i in range(9):  # three snapshots
+        j.record_put("/k%d" % i, b"v")
+    j.close()
+    snaps = sorted(n for n in os.listdir(d) if n.endswith(".snap"))
+    assert len(snaps) >= 2
+    # Corrupt the NEWEST snapshot: replay must fall back to the
+    # previous one and re-apply the journal tail past it.
+    open(os.path.join(d, snaps[-1]), "wb").write(b"garbage")
+    kv, _term, seq = journal.replay(d)
+    assert seq == 9
+    assert set(kv) == {"/k%d" % i for i in range(9)}
+
+
+# -- term fencing (split-brain) --------------------------------------------
+
+def test_old_term_leader_fences_and_rejects_writes(tmp_path, monkeypatch):
+    # Tiny deadline: a full-cycle 409 is retried (leaderless-window
+    # ride-out) until the rpc deadline, and here it should raise fast.
+    _fast_rpc(monkeypatch, retries="0", deadline="0.3")
+    srv = RendezvousServer(host="127.0.0.1", secret=SECRET,
+                           journal_dir=str(tmp_path / "jnl"))
+    port = srv.start()
+    addr = "127.0.0.1:%d" % port
+    try:
+        old = RendezvousClient(addr, SECRET)
+        old.put("seed", "1")
+        assert srv.term == 1 and not srv.fenced
+
+        # A client that has seen a newer leader presents its term: the
+        # stale leader fences itself and 409s — and the write is LOST
+        # to this server, not silently forked.
+        newer = RendezvousClient(addr, SECRET)
+        newer._term = 2
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            newer.put("fork", "evil")
+        assert exc_info.value.code == 409
+        assert srv.fenced
+        assert "/fork" not in srv.snapshot()
+
+        # Fencing is sticky: even a termless client is rejected now.
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            old.put("late", "2")
+        assert exc_info.value.code == 409
+        # ... and the 409 response taught it the fenced server's term.
+        assert old._term >= 1
+    finally:
+        srv.stop()
+
+
+def test_client_rotates_to_live_leader_and_adopts_term(tmp_path,
+                                                       monkeypatch):
+    _fast_rpc(monkeypatch, retries="0")
+    leader = RendezvousServer(host="127.0.0.1", secret=SECRET,
+                              journal_dir=str(tmp_path / "jnl"))
+    port = leader.start()
+    leader.promote(3)
+    dead = _dead_port()
+    try:
+        # First endpoint dead (transient exhaustion) -> rotate to the
+        # live leader, pin it, and adopt its advertised term.
+        cli = RendezvousClient("127.0.0.1:%d" % dead, SECRET,
+                               endpoints=["127.0.0.1:%d" % port])
+        cli.put("k", "v")
+        assert cli.get("k") == "v"
+        assert cli._term == 3
+        assert cli._active == 1  # pinned past the dead endpoint
+    finally:
+        leader.stop()
+
+
+def test_kv_server_die_drop_absorbed_by_retry(monkeypatch):
+    # kv.server.die:drop = one synthetic 503; the client's transient
+    # retry rides it out against the SAME endpoint.
+    _fast_rpc(monkeypatch, retries="2")
+    srv = RendezvousServer(host="127.0.0.1", secret=SECRET)
+    port = srv.start()
+    try:
+        monkeypatch.setenv("HVD_TPU_FAULT", "kv.server.die:drop@times=1")
+        faultline.reset()
+        cli = RendezvousClient("127.0.0.1:%d" % port, SECRET)
+        cli.put("k", "v")
+        assert cli.get("k") == "v"
+    finally:
+        faultline.reset()
+        srv.stop()
+
+
+def test_get_blocking_rides_out_mid_poll_failover(monkeypatch):
+    # The satellite-1 regression: get_blocking must re-resolve its
+    # endpoint per poll iteration, not once at entry.  Entry resolves
+    # while only the doomed endpoint answers; the key appears on the
+    # OTHER endpoint after the first has died.
+    _fast_rpc(monkeypatch, retries="0", deadline="1")
+    a = RendezvousServer(host="127.0.0.1", secret=SECRET)
+    b = RendezvousServer(host="127.0.0.1", secret=SECRET)
+    pa, pb = a.start(), b.start()
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_ENDPOINTS",
+                       "127.0.0.1:%d" % pb)
+    cli = RendezvousClient("127.0.0.1:%d" % pa, SECRET)
+
+    def _fail_over():
+        time.sleep(0.4)
+        a.stop()
+        b.put_local("/ready", b"yes")
+
+    t = threading.Thread(target=_fail_over, daemon=True)
+    t.start()
+    try:
+        assert cli.get_blocking("ready", timeout=15.0) == "yes"
+    finally:
+        t.join()
+        b.stop()
+
+
+# -- warm standby ----------------------------------------------------------
+
+def test_standby_tails_and_promotes_on_lease_expiry(tmp_path):
+    leader = RendezvousServer(host="127.0.0.1", secret=SECRET,
+                              journal_dir=str(tmp_path / "leader"))
+    lport = leader.start()
+    leader.put_local("/a", b"1")
+    standby = StandbyServer("127.0.0.1:%d" % lport,
+                            str(tmp_path / "standby"), secret=SECRET,
+                            host="127.0.0.1", lease=0.6)
+    failovers_before = metrics.series_sum("control_failovers_total")
+    standby.start()
+    try:
+        # Bootstrap (dump) + tail replication of a post-bootstrap write.
+        deadline = time.monotonic() + 10
+        while standby.server.seq < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        leader.put_local("/b", b"2")
+        while (standby.server.snapshot().get("/b") != b"2"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        pre_kill = leader.snapshot()
+        assert standby.server.snapshot() == pre_kill
+        assert not standby.promoted and standby.server.follower
+
+        # Kill the leader: lease expiry promotes the standby with a
+        # bumped term; its store is bitwise the pre-kill leader's.
+        leader.stop()
+        while not standby.promoted and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert standby.promoted
+        assert standby.server.term == 2
+        assert standby.server.snapshot() == pre_kill
+        assert metrics.series_sum("control_failovers_total") \
+            > failovers_before
+
+        # The promoted standby serves writes under its own term.
+        cli = RendezvousClient("127.0.0.1:%d" % standby.port, SECRET)
+        cli.put("after", "failover")
+        assert cli._term == 2
+    finally:
+        standby.stop()
+
+
+def test_standby_partition_fault_site_drives_promotion(tmp_path,
+                                                       monkeypatch):
+    _fast_rpc(monkeypatch, retries="0", deadline="0.3")
+    # Unbounded kv.standby.partition:drop = every poll lost: the lease
+    # expires against a perfectly healthy leader and the standby
+    # promotes — the split-brain HALF the term fence then contains.
+    leader = RendezvousServer(host="127.0.0.1", secret=SECRET,
+                              journal_dir=str(tmp_path / "leader"))
+    lport = leader.start()
+    monkeypatch.setenv("HVD_TPU_FAULT", "kv.standby.partition:drop")
+    faultline.reset()
+    standby = StandbyServer("127.0.0.1:%d" % lport,
+                            str(tmp_path / "standby"), secret=SECRET,
+                            host="127.0.0.1", lease=0.4)
+    standby.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not standby.promoted and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert standby.promoted and standby.server.term >= 2
+        # A client that learned the standby's term fences the old
+        # leader on first contact: split brain lasts one request.
+        cli = RendezvousClient("127.0.0.1:%d" % standby.port, SECRET)
+        cli.put("x", "1")
+        assert cli._term >= 2
+        stale = RendezvousClient("127.0.0.1:%d" % lport, SECRET)
+        stale._term = cli._term
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            stale.put("y", "2")
+        assert exc_info.value.code == 409
+        assert leader.fenced
+        assert "/y" not in leader.snapshot()
+    finally:
+        faultline.reset()
+        standby.stop()
+        leader.stop()
+
+
+# -- control endpoints -----------------------------------------------------
+
+def test_control_status_and_dump_roundtrip(tmp_path):
+    srv = RendezvousServer(host="127.0.0.1", secret=SECRET,
+                           journal_dir=str(tmp_path / "jnl"))
+    port = srv.start()
+    try:
+        srv.put_local("/k", b"\x00\x01binary")
+        base = "http://127.0.0.1:%d" % port
+
+        def authed_get(path):
+            req = urllib.request.Request(base + path, headers={
+                SECRET_HEADER: compute_digest(SECRET, path.encode())})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.read(), dict(resp.headers)
+
+        body, hdrs = authed_get("/control/status")
+        doc = json.loads(body.decode())
+        assert doc == {"term": 1, "seq": 1, "fenced": False,
+                       "role": "leader"}
+        assert hdrs[TERM_HEADER] == "1"
+
+        body, _hdrs = authed_get("/control/dump")
+        dump = json.loads(body.decode())
+        assert base64.b64decode(dump["kv"]["/k"]) == b"\x00\x01binary"
+
+        # Unauthenticated probes are refused (the dump carries state).
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/control/dump", timeout=5)
+        assert exc_info.value.code == 403
+    finally:
+        srv.stop()
+
+
+# -- notification address table --------------------------------------------
+
+def test_address_table_register_wins_over_restore():
+    t = AddressTable()
+    t.restore(("h", 0), ("10.0.0.1", 1111))     # journal seed
+    t.register(("h", 0), ("10.0.0.1", 2222))    # live re-registration
+    assert t.get(("h", 0)) == ("10.0.0.1", 2222)
+    # restore never overwrites a live entry...
+    t.restore(("h", 0), ("10.0.0.1", 1111))
+    assert t.get(("h", 0)) == ("10.0.0.1", 2222)
+    # ...and two registrations for the same slot: latest wins.
+    t.register(("h", 0), ("10.0.0.1", 3333))
+    assert t.get(("h", 0)) == ("10.0.0.1", 3333)
+    assert len(t) == 1
+
+
+def test_address_table_evicts_stale_claim_on_same_address():
+    # Reattach-after-failover: the address a dead slot held is reused
+    # by a new registration — the stale entry must not shadow it.
+    t = AddressTable()
+    t.register(("h", 0), ("10.0.0.1", 5000))
+    t.register(("h", 1), ("10.0.0.1", 5000))  # same socket, new owner
+    assert t.get(("h", 1)) == ("10.0.0.1", 5000)
+    assert t.get(("h", 0)) is None
+    assert ("h", 0) not in t
+    t.purge(("h", 1))
+    assert len(t) == 0

@@ -394,8 +394,9 @@ def test_replica_buddies_prefer_other_hosts(monkeypatch):
     d = _make_driver(FixedHosts({"a": 2, "b": 1}))
     try:
         d._target = [("a", 0), ("a", 1), ("b", 0)]
-        d._worker_addrs = {("a", 0): ("a", 1), ("a", 1): ("a", 2),
-                           ("b", 0): ("b", 3)}
+        for slot, addr in [(("a", 0), ("a", 1)), (("a", 1), ("a", 2)),
+                           (("b", 0), ("b", 3))]:
+            d._worker_addrs.register(slot, addr)
         resp = d._handle({"kind": "replicate", "host": "a", "slot": 0,
                           "commit_id": 5, "replicas": 1, "blob": b"x"})
         assert resp["delivered"] == 1
@@ -1579,3 +1580,274 @@ def test_shard_spill_n_to_m_restore(tmp_path):
     # shard each, reader 2 owns none in the 2→3 case).
     assert all(v < total for v in streamed.values()), (streamed, total)
     assert sum(streamed.values()) >= total, (streamed, total)
+
+
+# -- HA control plane: KV failover + driver crash adoption (ISSUE 17) ------
+
+HA_KV_WORKER = """
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.runner.http_client import RendezvousClient
+
+hvd.init()
+state = elastic.ObjectState(batch=0)
+
+@elastic.run
+def train(state):
+    # External HA KV pair via HOROVOD_RENDEZVOUS_ENDPOINTS (no addr,
+    # no secret: the out-of-process kv_server runs unauthenticated).
+    cli = RendezvousClient()
+    while state.batch < 20:
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                      name="b%d" % state.batch)
+        state.batch += 1
+        state.commit()
+        print("STEP rank=%d batch=%d" % (hvd.rank(), state.batch),
+              flush=True)
+        if state.batch == 10:
+            # Park mid-run on the HA KV: the leader is SIGKILLed while
+            # every worker polls this key, so finishing at all proves
+            # get_blocking re-resolves its endpoint per iteration.
+            cli.put("step10/%d" % hvd.rank(), "here")
+            cli.get_blocking("go2", timeout=120.0)
+    print("DONE rank=%d size=%d batch=%d"
+          % (hvd.rank(), hvd.size(), state.batch), flush=True)
+
+train(state)
+"""
+
+
+def _start_kv_server(env, args):
+    """Spawn ``python -m horovod_tpu.runner.kv_server`` and parse its
+    liveness line; returns (proc, port)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.kv_server"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    line = ""
+    for line in iter(proc.stdout.readline, ""):
+        if "KV_SERVER LISTENING" in line:
+            break
+    assert "KV_SERVER LISTENING" in line, line
+    port = int(line.split("port=")[1].split()[0])
+    # Drain further output so the pipe never fills.
+    threading.Thread(target=lambda: [None for _ in
+                                     iter(proc.stdout.readline, "")],
+                     daemon=True).start()
+    return proc, port
+
+
+def _control_get(port, path):
+    import json
+    import urllib.request
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+@pytest.mark.slow
+def test_control_plane_failover_e2e(tmp_path):
+    """ISSUE 17 headline: SIGKILL the active KV server while a 2-proc
+    elastic run is parked on it mid-training.  The warm standby takes
+    over within the lease at a bumped term, every worker fails over
+    to it mid-poll, NO training step is lost (each rank runs batches
+    1..20 exactly once), no blacklist churn, and the recovered store
+    is bitwise-identical to the pre-kill leader snapshot."""
+    import signal
+
+    kv_env = _env()
+    kv_env.pop("HOROVOD_SECRET_KEY", None)
+    kv_env["HOROVOD_CONTROL_LEASE_SECS"] = "1.0"
+    leader_proc, lport = _start_kv_server(
+        kv_env, ["--host", "127.0.0.1", "--journal-dir",
+                 str(tmp_path / "kv-a")])
+    standby_proc, sport = _start_kv_server(
+        kv_env, ["--host", "127.0.0.1", "--journal-dir",
+                 str(tmp_path / "kv-b"),
+                 "--standby-of", "127.0.0.1:%d" % lport])
+
+    script = tmp_path / "train.py"
+    script.write_text(HA_KV_WORKER)
+    env = _env()
+    env["HOROVOD_RENDEZVOUS_ENDPOINTS"] = \
+        "127.0.0.1:%d,127.0.0.1:%d" % (lport, sport)
+    run = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "2",
+         sys.executable, str(script)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        from horovod_tpu.runner.http_client import RendezvousClient
+        cli = RendezvousClient(
+            endpoints=["127.0.0.1:%d" % lport, "127.0.0.1:%d" % sport])
+        # Phase 1 done: both ranks at batch 10, parked on "go2".
+        cli.get_blocking("step10/0", timeout=scaled_timeout(180))
+        cli.get_blocking("step10/1", timeout=scaled_timeout(180))
+        pre_kill = _control_get(lport, "/control/dump")
+        # Wait for full replication, then SIGKILL the leader.
+        deadline = time.monotonic() + scaled_timeout(30)
+        while time.monotonic() < deadline:
+            if _control_get(sport, "/control/dump")["kv"] \
+                    == pre_kill["kv"]:
+                break
+            time.sleep(0.1)
+        leader_proc.send_signal(signal.SIGKILL)
+        leader_proc.wait(timeout=10)
+        # Standby promotes within the lease, at a bumped term ...
+        deadline = time.monotonic() + scaled_timeout(30)
+        status = {}
+        while time.monotonic() < deadline:
+            status = _control_get(sport, "/control/status")
+            if status["role"] == "leader":
+                break
+            time.sleep(0.1)
+        assert status.get("role") == "leader", status
+        assert status["term"] >= 2, status
+        # ... with the recovered store bitwise-identical to the
+        # pre-kill leader snapshot.
+        post = _control_get(sport, "/control/dump")
+        assert post["kv"] == pre_kill["kv"]
+        assert post["seq"] >= pre_kill["seq"]
+        # Release phase 2 through the NEW leader (the client rotates
+        # past the dead one).
+        cli.put("go2", "now")
+        out, err = run.communicate(timeout=scaled_timeout(240))
+        assert run.returncode == 0, out + err
+        # Zero lost steps: each rank ran batches 1..20 exactly once
+        # (a re-rendezvous/rollback would repeat a batch number).
+        for r in range(2):
+            # The runner prefixes forwarded worker lines with
+            # "[host:slot]<stdout>", so match by substring.
+            batches = [int(line.split("batch=")[1])
+                       for line in out.splitlines()
+                       if "STEP rank=%d " % r in line]
+            assert batches == list(range(1, 21)), (r, batches)
+            assert "DONE rank=%d size=2 batch=20" % r in out, out + err
+        # ... and no blacklist churn: the failover was invisible to
+        # the membership plane.
+        assert "blacklisting host" not in err, err
+    finally:
+        for p in (run, leader_proc, standby_proc):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+def test_driver_adoption_restores_world(tmp_path, monkeypatch):
+    """Driver crash adoption: a restarted driver pointed at the same
+    control journal reconstructs secret/epoch/assignments/blacklist,
+    reattaches the still-live workers WITHOUT a world re-formation
+    (epoch preserved, no respawn), and books their clean finishes via
+    the `finished` notice (no proc handle exists to reap)."""
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner import journal as control_journal
+    from horovod_tpu.runner.services import MessageServer
+
+    jdir = str(tmp_path / "ctl")
+    slots = [("127.0.0.1", 0), ("127.0.0.1", 1)]
+
+    d1 = ElasticDriver(["true"], FixedHosts({"127.0.0.1": 2}),
+                       min_np=2, max_np=2, journal_dir=jdir)
+    secret, msg_port = d1._secret, d1._server.port
+
+    # Fake live workers: notification services that answer pings with
+    # the journaled secret (what a real WorkerNotificationManager runs).
+    fakes = [MessageServer(lambda req: {"ok": True}, secret)
+             for _ in slots]
+    addrs = {}
+    for slot, f in zip(slots, fakes):
+        addrs[slot] = ("127.0.0.1", f.start())
+
+    # Publish a world by hand (no real spawns), journal it, crash.
+    with d1._lock:
+        d1._epoch = 3
+        d1._target = list(slots)
+        d1._assignments = {s: {"rank": i} for i, s in enumerate(slots)}
+        d1._published = True
+        d1._port_base = 29600
+    for slot, addr in addrs.items():
+        d1._worker_addrs.register(slot, addr)
+    d1._registry.record_failure("10.9.9.9")  # journaled blacklist
+    d1._journal_control()
+    _close_driver(d1)
+    d1._kv._httpd.journal.close()
+
+    # The restarted driver adopts: journaled secret + message port
+    # (workers hold both), old epoch, restored blacklist, external
+    # (no-proc-handle) worker bookkeeping.
+    monkeypatch.setenv("HOROVOD_CONTROL_RECOVERY_DEADLINE", "15")
+    d2 = ElasticDriver(["true"], FixedHosts({"127.0.0.1": 2}),
+                       min_np=2, max_np=2, journal_dir=jdir)
+    try:
+        assert d2._secret == secret
+        assert d2._server.port == msg_port
+        assert d2._adopt_rec is not None
+        assert d2._try_adopt()
+        assert d2._epoch == 3 and d2._published
+        assert d2._target == slots
+        assert set(d2._external) == set(slots)
+        assert d2._registry.is_blacklisted("10.9.9.9")
+        assert d2._assignments[slots[1]]["rank"] == 1
+
+        # Clean finishes arrive as `finished` notices; the run is then
+        # complete with rc=0 and the epoch never bumped.
+        for slot in slots:
+            resp = d2._handle({"kind": "finished", "host": slot[0],
+                               "slot": slot[1], "commit_id": 7})
+            assert resp == {"ok": True}
+        assert not d2._external
+        assert d2._check_procs() is True
+        assert d2._rc == 0 and d2._epoch == 3
+    finally:
+        _close_driver(d2)
+        d2._kv._httpd.journal.close()
+        for f in fakes:
+            f.stop()
+
+
+def test_driver_adoption_fails_loudly_when_workers_gone(tmp_path,
+                                                        monkeypatch):
+    """Past HOROVOD_CONTROL_RECOVERY_DEADLINE with a journaled worker
+    unreachable, adoption aborts (control_adopt_failed) and the driver
+    falls back to ordinary world formation — it must NOT adopt a
+    half-dead world silently."""
+    from horovod_tpu.common import metrics
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    jdir = str(tmp_path / "ctl")
+    d1 = ElasticDriver(["true"], FixedHosts({"127.0.0.1": 1}),
+                       min_np=1, max_np=1, journal_dir=jdir)
+    with d1._lock:
+        d1._epoch = 2
+        d1._target = [("127.0.0.1", 0)]
+        d1._assignments = {("127.0.0.1", 0): {"rank": 0}}
+        d1._published = True
+    # A dead notification address: nothing listens there anymore.
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    d1._worker_addrs.register(("127.0.0.1", 0),
+                              ("127.0.0.1", dead_port))
+    d1._journal_control()
+    _close_driver(d1)
+    d1._kv._httpd.journal.close()
+
+    monkeypatch.setenv("HOROVOD_CONTROL_RECOVERY_DEADLINE", "0.5")
+    d2 = ElasticDriver(["true"], FixedHosts({"127.0.0.1": 1}),
+                       min_np=1, max_np=1, journal_dir=jdir)
+    try:
+        t0 = time.monotonic()
+        assert d2._try_adopt() is False
+        assert time.monotonic() - t0 < 10.0
+        assert not d2._published and d2._epoch == 0
+        # The stale journaled address was purged: re-formation starts
+        # from a clean notification table.
+        assert d2._worker_addrs.get(("127.0.0.1", 0)) is None
+    finally:
+        _close_driver(d2)
+        d2._kv._httpd.journal.close()
