@@ -237,6 +237,17 @@ def lever_attribution(jax, jnp, on_accel, peak):
         lev["plan"] = plancache.describe()
     except Exception as exc:  # noqa: BLE001 - attribution is optional
         print("plan attribution degraded: %s" % exc, file=sys.stderr)
+    try:
+        # Self-healing data-plane attribution (ISSUE 18): the deadline /
+        # retry / degradation knobs plus the live evidence (retries
+        # absorbed, routes demoted, deadlines expired) — so a BENCH
+        # delta under flaky DCN is attributable to degraded routing
+        # rather than a codec or plan shift.
+        from horovod_tpu.common import resilience as _resilience
+        lev["resilience"] = _resilience.describe()
+    except Exception as exc:  # noqa: BLE001 - attribution is optional
+        print("resilience attribution degraded: %s" % exc,
+              file=sys.stderr)
     return lev
 
 

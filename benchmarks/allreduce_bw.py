@@ -130,6 +130,16 @@ def main():
                          "uses the WIRE itemsize so reported GB/s "
                          "stays NCCL-convention-comparable across "
                          "codecs")
+    ap.add_argument("--fault", default=None, metavar="SITE:SPEC",
+                    help="resilience A/B: arm HVD_TPU_FAULT with this "
+                         "spec before init (e.g. "
+                         "'mh.leg.drop:drop@times=2' for retry-under-"
+                         "flake GB/s, an unbounded drop for degraded "
+                         "hier->flat GB/s) and self-attribute the run "
+                         "with a levers.resilience JSON line (retries "
+                         "absorbed, routes demoted, failure ledger) so "
+                         "the A/B delta is attributable to the fault, "
+                         "not trusted from the printed math")
     args = ap.parse_args()
     if args.op != "allreduce" and not args.eager:
         ap.error("--op %s requires --eager (the jit path and the async "
@@ -139,6 +149,18 @@ def main():
         ap.error("--compression requires --eager/--eager-async "
                  "(the codec lives on the eager multihost hier "
                  "leg; the raw jit path has no compression seam)")
+    if args.fault and not (args.eager or args.eager_async):
+        ap.error("--fault requires --eager/--eager-async (the "
+                 "mh.leg.* / mh.deadline.* seams live on the eager "
+                 "multihost data plane)")
+    if args.fault:
+        # Pre-init export, like --compression: faultline parses the
+        # spec at hvd.init() and rejects malformed/misplaced actions
+        # (e.g. drop at a non-skip site) loudly at parse time.
+        import os
+        prior = os.environ.get("HVD_TPU_FAULT")
+        os.environ["HVD_TPU_FAULT"] = (
+            prior + "," + args.fault if prior else args.fault)
     # Export unconditionally: --compression none must OVERRIDE a
     # pre-set HOROVOD_CROSS_HOST_COMPRESSION (a stale env from the A/B
     # recipe would otherwise silently compress the baseline leg while
@@ -444,6 +466,19 @@ def run_eager(args):
                 codec=resolved_codec)),
             "compression_ratio": series("mh_compression_ratio", op=op,
                                         codec=resolved_codec),
+        }))
+    if args.fault and hvd.rank() == 0:
+        # Self-attribution for the resilience A/B: the engine's own
+        # evidence of what the armed fault did to this run — retries
+        # absorbed, (op, size_class) routes demoted hier->flat,
+        # deadlines expired, failures by reason — so a GB/s delta vs
+        # the clean leg is attributable to the injected fault.
+        from horovod_tpu.common import resilience
+
+        print(json.dumps({
+            "metric": "resilience_levers",
+            "fault": args.fault,
+            "levers": {"resilience": resilience.describe()},
         }))
     hvd.shutdown()
 

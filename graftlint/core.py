@@ -737,6 +737,11 @@ class LintConfig:
         # and the plan-staleness ratio are read by the elastic
         # driver's observe loop, pre-Config by design.
         "horovod_tpu/common/skew.py",
+        # Self-healing data plane (ISSUE 18): deadlines, leg retry and
+        # degraded-routing knobs are read inside the dispatch/watchdog
+        # paths, pre-Config by design (the guard must govern the very
+        # first collective).
+        "horovod_tpu/common/resilience.py",
         "horovod_tpu/utils/timeline.py",
         "horovod_tpu/elastic/spill.py",
         # Sharded durable commits (ISSUE 15): the shard-spill gate and

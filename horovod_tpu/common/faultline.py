@@ -90,6 +90,29 @@ SITES: Dict[str, str] = {
         "multihost engine, executor drain loop: a negotiated record "
         "was popped but not yet dispatched (drop = negotiated-but-"
         "never-dispatched member, the watchdog scenario)",
+    "mh.leg.drop":
+        "data-plane leg guard, resilience.run_hier_leg: one attempt of "
+        "a hier cross-host leg (drop = the attempt fails with a "
+        "synthetic transport fault before dispatch, exercising the "
+        "retry/backoff path; a drop without @times proves retry "
+        "exhaustion -> flat fallback -> demotion streaks)",
+    "mh.leg.delay":
+        "data-plane leg guard, resilience.run_hier_leg: latency "
+        "injection at the top of each hier leg attempt (delay = a "
+        "slow-but-healthy DCN leg; the leg must complete with a "
+        "bounded latency hit and no retry)",
+    "mh.leg.corrupt":
+        "data-plane leg guard, resilience.run_hier_leg: the wire-"
+        "integrity verify of a quantized hier leg (drop = the observed "
+        "CRC32 diverges from the staged one, a simulated in-flight bit "
+        "flip; the guard must re-stage exactly once, then escalate "
+        "loudly — never absorb silently)",
+    "mh.deadline.wedge":
+        "multihost engine, MultihostEngine._execute: after the group "
+        "is deadline-stamped and watched, before dispatch (drop = the "
+        "dispatch is withheld so the group wedges until its "
+        "per-collective deadline expires -> error-complete -> poison "
+        "-> elastic restore, never a stall-inspector abort)",
     "hvd.shutdown.pre_barrier":
         "common/multihost.py shutdown_jax_distributed: before the "
         "synchronized teardown barrier",
@@ -198,6 +221,9 @@ ACTIONS = ("delay", "drop", "die", "wedge")
 # no-op this module exists to forbid.
 DROP_SITES = frozenset({
     "mh.drain.record",
+    "mh.leg.drop",
+    "mh.leg.corrupt",
+    "mh.deadline.wedge",
     "elastic.rendezvous.poll",
     "runner.rpc.request",
     "elastic.discovery.run",

@@ -104,6 +104,29 @@ NAMES: Dict[str, Tuple[str, str]] = {
         "gauge", "payload-to-wire byte ratio of the most recent "
                  "compressed cross-host collective, labeled op + "
                  "codec (4.0 = int8 from f32, incl. scale overhead)"),
+    # -- self-healing data plane (common/resilience.py) --
+    "mh_collective_failures_total": (
+        "counter", "negotiated groups that error-completed, labeled "
+                   "op + reason (deadline|transport|corrupt|error) — "
+                   "the failure-side complement of "
+                   "mh_collective_seconds, which only records clean "
+                   "completions"),
+    "mh_leg_retries_total": (
+        "counter", "hier cross-host leg attempts repeated by the "
+                   "data-plane guard (transient transport faults and "
+                   "the single wire-integrity re-stage), labeled op + "
+                   "size_class"),
+    "mh_degraded_routes": (
+        "gauge", "1 while an (op, size_class) hier route is demoted "
+                 "to the flat plane after sustained leg failures, 0 "
+                 "after the re-promotion probe clears it (rank-0 KV "
+                 "verdict; every member reports its adopted view)"),
+    "collective_deadline_expired_total": (
+        "counter", "negotiated groups error-completed because they "
+                   "outlived their per-collective deadline "
+                   "(HOROVOD_COLLECTIVE_TIMEOUT_SECS + per-GiB "
+                   "scaling), labeled op — each expiry poisons the "
+                   "engine so elastic restores instead of hanging"),
     # -- collective-plan cache (persistent autotuned plans) --
     "plan_cache_hits_total": (
         "counter", "persisted collective-plan blobs successfully "

@@ -1,0 +1,617 @@
+"""Self-healing collective data plane: deadlines, DCN-leg retry,
+degraded routing, wire integrity.
+
+The control plane is crash-survivable (journaled KV + failover) and
+the elastic plane absorbs process loss, but a collective that hangs or
+hits a flaky cross-host leg had no governance of its own: the coarse
+stall inspector warns about NEGOTIATION stalls, and the execution
+watchdog only fires when the whole pipeline is starved.  This module
+gives every in-flight collective an end-to-end contract:
+
+* **Per-collective deadlines** — each negotiated group carries an
+  absolute deadline (:func:`collective_deadline`, scaled by payload
+  size).  Expiry error-completes the group and poisons the multihost
+  engine through the existing fail-fast path, so the worker raises
+  ``HorovodInternalError`` (a :class:`CollectiveDeadlineExceeded`) and
+  the elastic restore-from-spill loop recovers the world instead of
+  hanging until a coarse abort.  The deadline message deliberately
+  never matches the stall inspector's abort text: elastic's
+  ``_is_stall_abort`` must route deadline expiry to RESTORE, not
+  drain.
+
+* **DCN-leg transient retry** — the hier cross-host legs run through
+  :func:`run_hier_leg`, which classifies transport faults
+  (:func:`is_transient_leg`, the control-plane ``is_transient`` shape)
+  and retries with exponential backoff + full jitter under the group
+  deadline.  A bounded flake costs latency, not the job.
+
+* **Degraded routing with re-promotion** — sustained leg failures
+  (``HOROVOD_LEG_DEMOTE_THRESHOLD`` consecutive retry exhaustions)
+  demote that (op, size_class) hier→flat.  The demotion is
+  SPMD-uniform: rank 0 decides from its streak evidence and publishes
+  the verdict history through the rendezvous KV
+  (:func:`check_degraded_routes`, the plan-staleness record protocol);
+  members adopt at the same check index or raise.  A time-eligible
+  probe re-promotes the class when the leg heals, so a transient sick
+  link is not a permanent bandwidth loss.
+
+* **Wire integrity** — quantized cross-host legs checksum
+  (CRC32) their host-staged payload across the staging window and
+  verify after dispatch.  A mismatch is a counted, injectable fault
+  (``mh.leg.corrupt``) that triggers exactly one re-stage retry and
+  then escalates loudly — never silent gradient corruption.  Honest
+  scope: the on-device wire rows cannot be host-checksummed without a
+  device round-trip that would halve throughput, so the CRC guards the
+  host staging window; the injected fault certifies the full
+  detect→retry→escalate machinery.
+
+**Retry boundary.**  Compiled XLA dispatch is asynchronous: the guard
+retries failures that surface synchronously (staging, dispatch, and
+every injected fault).  A program that fails after dispatch surfaces
+at completion and escalates through the engine's error path, counted
+in ``mh_collective_failures_total`` — retrying it would require
+re-staging donated buffers that no longer exist.
+
+**SPMD note.**  A retry-exhausted member falls back to the flat plane
+for THAT group while a healthy peer may still run hier — divergent
+programs, a distributed hang.  That divergence is bounded by the group
+deadline (expiry poisons and elastic restores), and the fault shapes
+this plane absorbs (config-driven codec faults, injected sites, a
+down DCN link every member shares) exhaust identically on every
+member.  Persistent ROUTING only ever changes through the rank-0 KV
+verdict, never from rank-local judgement.
+"""
+
+from __future__ import annotations
+
+import binascii
+import logging
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import faultline, metrics
+from .envutil import env_float, env_int
+
+LOG = logging.getLogger("horovod_tpu.resilience")
+
+SCHEMA_VERSION = 1
+
+# Rendezvous-KV key carrying rank 0's degraded-route verdict history,
+# per topology fingerprint (the plan-staleness record protocol).
+_DEGRADED_KEY = "resilience/degraded/v%d/%s"
+
+# Per-sleep cap on the leg retry backoff (the group deadline bounds the
+# total anyway) — mirrors the control-plane RPC cap.
+_BACKOFF_CAP_S = 5.0
+
+_GIB = float(1 << 30)
+
+
+class LegTransportError(RuntimeError):
+    """A cross-host leg transport fault (injected or classified)."""
+
+
+class WireIntegrityError(RuntimeError):
+    """Checksum mismatch over a staged cross-host wire payload."""
+
+
+class LegDegraded(RuntimeError):
+    """Control-flow escalation: a hier leg exhausted its retry budget
+    and degraded routing is enabled — the caller must run THIS group on
+    the flat plane.  Never crosses the engine boundary."""
+
+    def __init__(self, op: str, size_class: str,
+                 cause: BaseException):
+        super().__init__(
+            "hier %s[%s] leg exhausted its transient-retry budget: %s"
+            % (op, size_class, cause))
+        self.op = op
+        self.size_class = size_class
+        self.cause = cause
+
+
+# -- knobs (the ONE read point each; env-default-conflict discipline) -------
+
+def collective_timeout_secs() -> float:
+    """Base per-collective deadline in seconds
+    (``HOROVOD_COLLECTIVE_TIMEOUT_SECS``, default 0 = no deadline).
+    Mirrored into the native core as
+    ``StallInspector::kDefaultCollectiveTimeoutSecs`` so python-less
+    tcp-core worlds enforce the same bound."""
+    return env_float("HOROVOD_COLLECTIVE_TIMEOUT_SECS", 0.0,
+                     minimum=0.0)
+
+
+def collective_timeout_per_gib() -> float:
+    """Extra deadline seconds granted per GiB of group payload
+    (``HOROVOD_COLLECTIVE_TIMEOUT_PER_GIB``, default 30) — a 4 GiB
+    fused group legitimately outlives a 4 KiB one, so the deadline
+    scales with the size class instead of punishing big tensors."""
+    return env_float("HOROVOD_COLLECTIVE_TIMEOUT_PER_GIB", 30.0,
+                     minimum=0.0)
+
+
+def collective_deadline(nbytes: int) -> float:
+    """Deadline (seconds) governing one negotiated group of ``nbytes``
+    total payload; 0.0 when the deadline plane is off."""
+    base = collective_timeout_secs()
+    if base <= 0:
+        return 0.0
+    return base + collective_timeout_per_gib() * (
+        max(int(nbytes), 0) / _GIB)
+
+
+def leg_retry_config() -> Tuple[int, float]:
+    """(max_retries, initial_backoff_s) for one hier cross-host leg:
+    ``HOROVOD_LEG_MAX_RETRIES`` (default 2 retries after the first
+    attempt) and ``HOROVOD_LEG_RETRY_BACKOFF`` (default 0.05 s,
+    doubled per failure with full jitter, capped at 5 s per sleep and
+    bounded overall by the group deadline)."""
+    return (env_int("HOROVOD_LEG_MAX_RETRIES", 2, minimum=0),
+            env_float("HOROVOD_LEG_RETRY_BACKOFF", 0.05, minimum=0.0))
+
+
+def leg_demote_threshold() -> int:
+    """Consecutive retry-EXHAUSTIONS (not individual flakes) of one
+    (op, size_class) hier leg before rank 0 demotes the class to the
+    flat plane (``HOROVOD_LEG_DEMOTE_THRESHOLD``, default 3)."""
+    return env_int("HOROVOD_LEG_DEMOTE_THRESHOLD", 3, minimum=1)
+
+
+def leg_reprobe_secs() -> float:
+    """Seconds a demoted class stays flat before the re-promotion
+    probe clears it (``HOROVOD_LEG_REPROBE_SECS``, default 30; 0
+    disables re-promotion — a demotion then lasts the process
+    lifetime)."""
+    return env_float("HOROVOD_LEG_REPROBE_SECS", 30.0, minimum=0.0)
+
+
+def degrade_enabled() -> bool:
+    """Whether retry exhaustion falls back to the flat plane and feeds
+    the demotion machinery (``HOROVOD_DATA_PLANE_DEGRADE``, default
+    on; 0/false/off disables — exhaustion then escalates the transport
+    error to the engine's fail-fast path)."""
+    raw = (os.environ.get("HOROVOD_DATA_PLANE_DEGRADE") or "1")
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def wire_integrity_enabled() -> bool:
+    """Whether quantized cross-host legs checksum their host-staged
+    payload (``HOROVOD_WIRE_INTEGRITY``, default on)."""
+    raw = (os.environ.get("HOROVOD_WIRE_INTEGRITY") or "1")
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def check_every_commits() -> int:
+    """Cadence (in ``State.commit`` calls) of the SPMD degraded-route
+    check (``HOROVOD_DATA_PLANE_CHECK_EVERY``, default 0 = the commit
+    hook is off and :func:`check_degraded_routes` runs only where the
+    application calls it — the ``tune_collective_plans`` opt-in
+    contract, because every member must reach the check at the same
+    index)."""
+    return env_int("HOROVOD_DATA_PLANE_CHECK_EVERY", 0, minimum=0)
+
+
+# -- group deadline (engine executor -> leg guard) --------------------------
+
+_tls = threading.local()
+
+
+def set_group_deadline(deadline_at: Optional[float]):
+    """Stamp the absolute (monotonic) deadline of the group this
+    thread is dispatching; the leg guard bounds its retries by it.
+    Thread-local on purpose: two executors may dispatch through one
+    shared mesh object, and instance state would cross their groups."""
+    _tls.deadline_at = deadline_at
+
+
+def group_deadline() -> Optional[float]:
+    return getattr(_tls, "deadline_at", None)
+
+
+# -- fault classification ---------------------------------------------------
+
+# Message fragments marking a transport-shaped runtime failure: the
+# distributed runtime surfaces DCN faults as XlaRuntimeError text, not
+# typed exceptions.
+_TRANSIENT_PATTERNS = (
+    "deadline exceeded", "deadline_exceeded",
+    "unavailable", "connection reset", "connection refused",
+    "connection aborted", "failed to connect", "socket closed",
+    "broken pipe", "transient",
+)
+
+
+def is_transient_leg(exc: BaseException) -> bool:
+    """Whether a cross-host leg failure is worth retrying.
+
+    Transient: the injected :class:`LegTransportError`, connection
+    resets/timeouts, and runtime errors whose text carries a
+    transport-shaped marker (the distributed runtime reports DCN
+    faults as ``XlaRuntimeError`` text).  Fatal: integrity mismatches
+    (their one-retry policy is handled separately), shape/dtype
+    programming errors, and everything else — retrying those repeats a
+    deterministic failure under the group deadline."""
+    if isinstance(exc, WireIntegrityError):
+        return False
+    if isinstance(exc, LegTransportError):
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, (TypeError, ValueError)):
+        return False
+    msg = str(exc).lower()
+    return any(p in msg for p in _TRANSIENT_PATTERNS)
+
+
+def failure_reason(exc: BaseException) -> str:
+    """Label bucket for ``mh_collective_failures_total{reason=}``:
+    deadline | corrupt | transport | error."""
+    if ("deadline" in type(exc).__name__.lower()
+            or "collective deadline exceeded" in str(exc).lower()):
+        return "deadline"
+    if isinstance(exc, WireIntegrityError):
+        return "corrupt"
+    if isinstance(exc, LegTransportError) or is_transient_leg(exc):
+        return "transport"
+    return "error"
+
+
+def _jittered(seconds: float) -> float:
+    """Full jitter over [0.5x, 1.5x) — N members retrying a shared
+    flake must not re-converge on the wire in lockstep."""
+    return seconds * (0.5 + random.random())
+
+
+# -- wire integrity ---------------------------------------------------------
+
+def wire_checksum(*arrays) -> int:
+    """CRC32 over the raw bytes of host-staged payload arrays (wire
+    source rows + scales).  Host numpy only — device arrays must never
+    bounce through here (the host-bounce ban)."""
+    crc = 0
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        crc = binascii.crc32(a.view(np.uint8).reshape(-1), crc)
+    return crc & 0xFFFFFFFF
+
+
+# -- leg health / degraded-route state --------------------------------------
+
+class _DataPlaneState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (op, size_class) -> consecutive retry-EXHAUSTION count; one
+        # absorbed flake resets it (the discovery-streak shape).
+        self.streak: Dict[Tuple[str, str], int] = {}
+        # (op, size_class) -> monotonic stamp of the local demotion
+        # apply.  Read lock-free by the dispatch hot path (dict
+        # membership is GIL-atomic); mutated only at the SPMD check.
+        self.demoted: Dict[Tuple[str, str], float] = {}
+        # SPMD record-protocol state (mirrors plan staleness): every
+        # member bumps seq per check; rank 0's verdict history is
+        # applied by prefix.
+        self.seq = 0
+        self.applied = 0
+        self.entries: List[dict] = []
+        self.warned_no_kv = False
+        self.commits = 0
+
+
+_state = _DataPlaneState()
+
+
+def reset():
+    """Drop all data-plane resilience state (tests, and re-init after
+    shutdown — a reformed world restarts the check sequence)."""
+    global _state
+    _state = _DataPlaneState()
+
+
+def note_leg_success(op: str, cls: str):
+    with _state.lock:
+        _state.streak.pop((op, cls), None)
+
+
+def note_leg_failure(op: str, cls: str) -> int:
+    """Record one retry EXHAUSTION for a hier leg; returns the new
+    consecutive-failure streak (rank 0's demotion evidence)."""
+    with _state.lock:
+        n = _state.streak.get((op, cls), 0) + 1
+        _state.streak[(op, cls)] = n
+    return n
+
+
+def demoted(op: str, cls: str) -> bool:  # graftlint: hot-path
+    """Whether (op, cls) is currently demoted to the flat plane.
+    Lock-free: normally an empty-dict miss on the dispatch hot path."""
+    return (op, cls) in _state.demoted
+
+
+def demoted_routes() -> List[Tuple[str, str]]:
+    with _state.lock:
+        return sorted(_state.demoted)
+
+
+# -- the leg guard ----------------------------------------------------------
+
+def run_hier_leg(op: str, size_class: str, run: Callable,
+                 payloads: Sequence = (), quantized: bool = False):
+    """Run one hier cross-host leg (stage + dispatch closure) under
+    the data-plane guard: injection sites, wire integrity, transient
+    retry with backoff under the group deadline, and streak feeding.
+
+    ``run`` must be safe to call again after a synchronous failure
+    (each attempt re-stages from the caller's payload).  On retry
+    exhaustion raises :class:`LegDegraded` (degrade enabled) or the
+    last transport error; non-transient failures propagate unchanged.
+    """
+    retries, backoff = leg_retry_config()
+    deadline_at = group_deadline()
+    check = (quantized and wire_integrity_enabled()
+             and len(payloads) > 0
+             and all(isinstance(p, np.ndarray) for p in payloads))
+    transport_failures = 0
+    integrity_retried = False
+    while True:
+        try:
+            # Latency injection: a slow-but-healthy leg (the delay
+            # action sleeps inside site()).
+            faultline.site("mh.leg.delay")
+            if faultline.site("mh.leg.drop"):
+                raise LegTransportError(
+                    "injected cross-host leg transport fault "
+                    "(faultline mh.leg.drop) in %s[%s]"
+                    % (op, size_class))
+            pre = wire_checksum(*payloads) if check else None
+            out = run()
+            if check:
+                post = wire_checksum(*payloads)
+                if faultline.site("mh.leg.corrupt"):
+                    # Simulated in-flight bit flip: the observed wire
+                    # checksum diverges from the staged one.
+                    post ^= 0x1
+                if post != pre:
+                    raise WireIntegrityError(
+                        "wire checksum mismatch on hier %s[%s] leg "
+                        "(staged crc32 %08x, observed %08x): the "
+                        "staged payload changed across the dispatch "
+                        "window" % (op, size_class, pre, post))
+            note_leg_success(op, size_class)
+            return out
+        except WireIntegrityError as exc:
+            if integrity_retried:
+                # Exactly one re-stage retry, then loud escalation:
+                # a silently-absorbed persistent corruption is the
+                # failure mode this plane exists to forbid.
+                note_leg_failure(op, size_class)
+                LOG.error("%s", exc)
+                raise
+            integrity_retried = True
+            metrics.counter("mh_leg_retries_total", op=op,
+                            size_class=size_class).inc()
+            metrics.event("mh_leg_retry", op=op, size_class=size_class,
+                          cause="integrity", error=str(exc))
+            LOG.warning("hier %s[%s] wire integrity failure; "
+                        "re-staging once: %s", op, size_class, exc)
+            continue
+        except LegDegraded:
+            raise
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not is_transient_leg(exc):
+                raise
+            transport_failures += 1
+            now = time.monotonic()
+            out_of_time = deadline_at is not None and now >= deadline_at
+            if transport_failures > retries or out_of_time:
+                streak = note_leg_failure(op, size_class)
+                metrics.event(
+                    "mh_leg_exhausted", op=op, size_class=size_class,
+                    failures=transport_failures, streak=streak,
+                    error=str(exc))
+                LOG.warning(
+                    "hier %s[%s] leg failed %d time(s), budget spent "
+                    "(retries=%d, deadline%s): %s", op, size_class,
+                    transport_failures, retries,
+                    " exceeded" if out_of_time else " ok", exc)
+                if degrade_enabled():
+                    raise LegDegraded(op, size_class, exc) from exc
+                raise
+            metrics.counter("mh_leg_retries_total", op=op,
+                            size_class=size_class).inc()
+            sleep = min(backoff * (2 ** (transport_failures - 1)),
+                        _BACKOFF_CAP_S)
+            sleep = _jittered(sleep)
+            if deadline_at is not None:
+                sleep = min(sleep, max(0.0, deadline_at - now))
+            LOG.warning("hier %s[%s] transient leg failure %d/%d (%s);"
+                        " retrying in %.3fs", op, size_class,
+                        transport_failures, retries, exc, sleep)
+            time.sleep(sleep)
+
+
+# -- SPMD-uniform demotion / re-promotion -----------------------------------
+
+def _apply_route(plane, entry: dict):
+    """Apply one rank-0 route verdict on this member: the local
+    demoted map is the authoritative routing override (consulted by
+    ``_route`` ahead of the controller) and the PlanController's
+    invalidate/pin keeps the plan plane's view consistent."""
+    op, cls = entry["op"], entry["size_class"]
+    key = (op, cls)
+    if entry.get("action") == "demote":
+        with _state.lock:
+            _state.demoted[key] = time.monotonic()
+            _state.streak.pop(key, None)
+        if plane is not None and plane.controller is not None:
+            plane.controller.invalidate(op, cls)
+            plane.controller.pin(op, cls,
+                                 {"path": "flat", "codec": "none"})
+        metrics.gauge("mh_degraded_routes", op=op,
+                      size_class=cls).set(1)
+        metrics.event("mh_route_demoted", scope="member",
+                      rank=getattr(plane, "rank", None), **entry)
+        LOG.warning(
+            "hier route (%s, %s) DEMOTED to the flat plane after %s "
+            "consecutive leg exhaustions; the re-promotion probe "
+            "re-tries hier after %.0fs", op, cls,
+            entry.get("streak", "?"), leg_reprobe_secs())
+    else:
+        with _state.lock:
+            _state.demoted.pop(key, None)
+            _state.streak.pop(key, None)
+        if plane is not None and plane.controller is not None:
+            # invalidate drops the flat pin too: the next dispatch
+            # re-resolves by the default gate and re-tries hier.
+            plane.controller.invalidate(op, cls)
+        metrics.gauge("mh_degraded_routes", op=op,
+                      size_class=cls).set(0)
+        metrics.event("mh_route_promoted", scope="member",
+                      rank=getattr(plane, "rank", None), **entry)
+        LOG.warning(
+            "hier route (%s, %s) RE-PROMOTED: the demotion window "
+            "elapsed, the next dispatch probes the hier leg again "
+            "(a still-sick leg re-trips the demotion)", op, cls)
+
+
+def check_degraded_routes(timeout: float = 60.0) -> Optional[dict]:  # graftlint: spmd-uniform -- rank-0-decide -> KV-adopt: only rank 0's failure streaks and re-probe clock ever produce a route verdict; the verdict history is published under the fingerprint key with an apply_at seq, every member blocks for a record covering ITS OWN seq and applies exactly the verdicts with apply_at <= that seq, so all members flip the same routes at the same check index (between checks, routing is untouched everywhere).  KV-less multi-member worlds return None before any state mutates.
+    """SPMD degraded-route check — demote sick hier legs, re-promote
+    healed ones.  EVERY member calls this at the same point in its
+    step sequence (the ``check_plan_staleness`` contract; each check
+    is one KV round-trip).
+
+    Rank 0 turns its consecutive-exhaustion streaks into ``demote``
+    verdicts (threshold ``HOROVOD_LEG_DEMOTE_THRESHOLD``) and its
+    re-probe clock into ``promote`` verdicts
+    (``HOROVOD_LEG_REPROBE_SECS`` after the demotion), publishes the
+    stamped history through the rendezvous KV, and members adopt it by
+    prefix — per-class routing must never diverge (the divergent-XLA
+    hang class).  Returns the last verdict applied this check, or
+    None.  Multi-member worlds without a KV observe nothing (warned
+    once); a member that cannot reach rank 0's record raises rather
+    than guess."""
+    if not degrade_enabled():
+        return None
+    from ..utils import plancache
+    plane = plancache.world_plane()
+    st = _state
+    size = (plane.size or 1) if plane is not None else 1
+    rank = plane.rank if plane is not None else None
+    kv = plane.kv if plane is not None else None
+    multi = size > 1
+    if multi and kv is None:
+        if not st.warned_no_kv:
+            st.warned_no_kv = True
+            LOG.warning(
+                "degraded-route check skipped: multi-member world "
+                "with no rendezvous KV to agree through (set "
+                "HOROVOD_RENDEZVOUS_ADDR) — rank-local demotion would "
+                "diverge per-class routing")
+        return None
+    fingerprint = (plane.fingerprint if plane is not None
+                   and plane.fingerprint else "local")
+    st.seq += 1
+    key = _DEGRADED_KEY % (SCHEMA_VERSION, fingerprint)
+    if rank in (None, 0):
+        now = time.monotonic()
+        thresh = leg_demote_threshold()
+        reprobe = leg_reprobe_secs()
+        with st.lock:
+            trips = [(k, n) for k, n in sorted(st.streak.items())
+                     if n >= thresh and k not in st.demoted]
+            promos = [k for k, at in sorted(st.demoted.items())
+                      if reprobe > 0 and now - at >= reprobe]
+        for (op, cls), n in trips:
+            st.entries.append({"action": "demote", "op": op,
+                               "size_class": cls, "streak": n,
+                               "apply_at": st.seq})
+        for op, cls in promos:
+            st.entries.append({"action": "promote", "op": op,
+                               "size_class": cls,
+                               "apply_at": st.seq})
+        if multi:
+            kv.put_json(key, {"seq": st.seq, "routes": st.entries})
+        visible = st.entries
+    else:
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = kv.get_json(key)
+            if isinstance(rec, dict) and rec.get("seq", 0) >= st.seq:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "degraded-route check: rank 0 never published "
+                    "check #%d for %s — members must adopt rank 0's "
+                    "route verdict or not at all (the divergent-"
+                    "routing hang class)" % (st.seq, fingerprint))
+            time.sleep(0.05)
+        visible = [e for e in rec.get("routes", ())
+                   if e.get("apply_at", 0) <= st.seq]
+    fresh = visible[st.applied:]
+    for entry in fresh:
+        _apply_route(plane, entry)
+    st.applied = len(visible)
+    return dict(fresh[-1]) if fresh else None
+
+
+def maybe_check_at_commit():
+    """Opt-in commit-cadence hook (``State.commit`` calls this):
+    every ``HOROVOD_DATA_PLANE_CHECK_EVERY``-th commit runs the SPMD
+    degraded-route check.  Count-based on purpose — commits are
+    SPMD-synchronized points, so the cadence cannot drift across
+    members the way a time cadence would.  Default off (0)."""
+    every = check_every_commits()
+    if every <= 0:
+        return None
+    st = _state
+    with st.lock:
+        st.commits += 1
+        due = st.commits % every == 0
+    return check_degraded_routes() if due else None
+
+
+# -- attribution ------------------------------------------------------------
+
+def _series_total(model: dict, name: str, label: Optional[str] = None
+                  ) -> Dict[str, float]:
+    """Sum a counter family's series values from a metrics snapshot,
+    grouped by ``label`` (or under "total")."""
+    fam = model.get(name) or {}
+    out: Dict[str, float] = {}
+    for row in fam.get("series", []):
+        group = (row.get("labels", {}).get(label, "?") if label
+                 else "total")
+        out[group] = out.get(group, 0.0) + float(row.get("value", 0.0))
+    return out
+
+
+def describe() -> dict:
+    """Self-attribution block for the bench ``levers.resilience``
+    section and the driver's ``/skew`` view: the active knobs plus the
+    live retry/degradation/failure evidence."""
+    snap = metrics.snapshot()
+    retries = _series_total(snap, "mh_leg_retries_total")
+    failures = _series_total(snap, "mh_collective_failures_total",
+                             "reason")
+    expired = _series_total(snap, "collective_deadline_expired_total")
+    max_retries, backoff = leg_retry_config()
+    return {
+        "deadline_secs": collective_timeout_secs(),
+        "deadline_per_gib": collective_timeout_per_gib(),
+        "leg_max_retries": max_retries,
+        "leg_retry_backoff": backoff,
+        "demote_threshold": leg_demote_threshold(),
+        "reprobe_secs": leg_reprobe_secs(),
+        "degrade_enabled": degrade_enabled(),
+        "wire_integrity": wire_integrity_enabled(),
+        "demoted_routes": [{"op": op, "size_class": cls}
+                           for op, cls in demoted_routes()],
+        "leg_retries_total": retries.get("total", 0.0),
+        "deadline_expired_total": expired.get("total", 0.0),
+        "failures_by_reason": failures,
+    }

@@ -462,9 +462,44 @@ class SkewObservatory:
                 fired.append((detection, rw.meta))
             self._scores = scores
             self._observe_plan(models)
+            self._resilience = self._observe_resilience(models)
         for detection, meta in fired:
             self._fire(detection, meta)
         return [d for d, _meta in fired]
+
+    def _observe_resilience(self, models) -> dict:
+        """Fleet roll-up of the self-healing data plane's evidence:
+        failed groups by reason, absorbed leg retries, expired
+        deadlines, and every route any member reports demoted — the
+        ``/skew`` view of r18's data-plane resilience layer."""
+        failures: Dict[str, float] = {}
+        retries = expired = 0.0
+        degraded = set()
+        for _label, _meta, model in models:
+            fam = model.get("mh_collective_failures_total") or {}
+            for row in fam.get("series", ()):
+                reason = row.get("labels", {}).get("reason", "?")
+                failures[reason] = (failures.get(reason, 0.0)
+                                    + float(row.get("value", 0.0)))
+            fam = model.get("mh_leg_retries_total") or {}
+            for row in fam.get("series", ()):
+                retries += float(row.get("value", 0.0))
+            fam = model.get("collective_deadline_expired_total") or {}
+            for row in fam.get("series", ()):
+                expired += float(row.get("value", 0.0))
+            fam = model.get("mh_degraded_routes") or {}
+            for row in fam.get("series", ()):
+                if row.get("value"):
+                    lab = row.get("labels", {})
+                    degraded.add((lab.get("op", "?"),
+                                  lab.get("size_class", "?")))
+        return {
+            "failures_by_reason": failures,
+            "leg_retries_total": retries,
+            "deadline_expired_total": expired,
+            "degraded_routes": [{"op": o, "size_class": c}
+                                for o, c in sorted(degraded)],
+        }
 
     def _observe_plan(self, models) -> Optional[dict]:
         """Fleet per-class latency into the staleness tracker; a trip
@@ -564,4 +599,5 @@ class SkewObservatory:
                     "staleness_ratio": self.plan.ratio,
                     "classes": self.plan.describe(),
                 },
+                "resilience": getattr(self, "_resilience", {}),
             }

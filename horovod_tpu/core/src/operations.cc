@@ -60,6 +60,12 @@ Status CoreState::Initialize(int rank, int size,
   bool stall_off = EnvBool("HVD_TPU_STALL_CHECK_DISABLE",
                            "HOROVOD_STALL_CHECK_DISABLE", false);
   stall_.Configure(stall_warn, stall_kill, !stall_off);
+  // Per-collective deadline mirror (common/resilience.py): python-less
+  // tcp-core worlds enforce the same bound the multihost watchdog does.
+  stall_.ConfigureDeadline(EnvDouble(
+      "HVD_TPU_COLLECTIVE_TIMEOUT_SECS",
+      "HOROVOD_COLLECTIVE_TIMEOUT_SECS",
+      StallInspector::kDefaultCollectiveTimeoutSecs));
   const char* tl = EnvStr("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE");
   if (tl)
     timeline_.Initialize(std::string(tl) + "." + std::to_string(rank),
@@ -469,7 +475,13 @@ void CoreState::BackgroundLoop() {
     if (resp.cycle_time_ms > 0) cycle_time_ms_ = resp.cycle_time_ms;
 
     if (rank_ == 0 && stall_.Check()) {
-      Status abort = Status::Aborted("stall shutdown threshold exceeded");
+      // Deadline expiry carries a DISTINCT message on purpose:
+      // elastic keys on the stall phrase to pick drain vs restore,
+      // and an expired collective must RESTORE from spill.
+      Status abort = stall_.LastDeadlineFatal()
+          ? Status::Aborted("collective deadline exceeded "
+                            "(HOROVOD_COLLECTIVE_TIMEOUT_SECS)")
+          : Status::Aborted("stall shutdown threshold exceeded");
       queue_.AbortAll(abort);
     }
 

@@ -9,10 +9,13 @@ namespace hvdtpu {
 // live in stall_inspector.h next to their Python mirrors.
 constexpr double StallInspector::kDefaultWarningSecs;
 constexpr double StallInspector::kDefaultShutdownSecs;
+constexpr double StallInspector::kDefaultCollectiveTimeoutSecs;
 
 void StallInspector::RecordRankReady(const std::string& tensor, int rank,
                                      int world) {
-  if (!enabled_) return;
+  // Pending tracking also feeds the per-collective deadline, which
+  // must work with the stall warning plane disabled.
+  if (!enabled_ && collective_timeout_secs_ <= 0) return;
   auto it = pending_.find(tensor);
   if (it == pending_.end()) {
     PendingInfo info;
@@ -29,13 +32,26 @@ void StallInspector::RecordDone(const std::string& tensor) {
 }
 
 bool StallInspector::Check(std::vector<std::string>* report) {
-  if (!enabled_) return false;
+  last_deadline_fatal_ = false;
+  if (!enabled_ && collective_timeout_secs_ <= 0) return false;
   auto now = std::chrono::steady_clock::now();
   bool fatal = false;
   for (auto& kv : pending_) {
     double age = std::chrono::duration<double>(
         now - kv.second.first_seen).count();
-    if (age < warning_secs_) continue;
+    if (collective_timeout_secs_ > 0 && age >= collective_timeout_secs_) {
+      std::string line =
+          "Collective deadline exceeded: tensor '" + kv.first +
+          "' pending " + std::to_string(static_cast<int>(age)) +
+          "s past HOROVOD_COLLECTIVE_TIMEOUT_SECS (" +
+          std::to_string(static_cast<int>(collective_timeout_secs_)) +
+          "s); aborting the group so elastic recovery can restore.";
+      LOG_WARNING << line;
+      if (report) report->push_back(line);
+      fatal = true;
+      last_deadline_fatal_ = true;
+    }
+    if (!enabled_ || age < warning_secs_) continue;
     double since_warn = std::chrono::duration<double>(
         now - kv.second.last_warn).count();
     if (kv.second.last_warn.time_since_epoch().count() == 0 ||

@@ -24,12 +24,26 @@ class StallInspector {
   // planes MUST agree on when that happens.
   static constexpr double kDefaultWarningSecs = 60.0;
   static constexpr double kDefaultShutdownSecs = 0.0;
+  // Per-collective deadline (HOROVOD_COLLECTIVE_TIMEOUT_SECS),
+  // mirrored by common/resilience.py collective_timeout_secs(): 0 =
+  // off.  Unlike the stall shutdown (a drain-shaped abort), deadline
+  // expiry must surface with a DISTINCT abort message so the elastic
+  // loop restores from spill instead of draining.
+  static constexpr double kDefaultCollectiveTimeoutSecs = 0.0;
 
   void Configure(double warning_secs, double shutdown_secs, bool enabled) {
     warning_secs_ = warning_secs;
     shutdown_secs_ = shutdown_secs;
     enabled_ = enabled && warning_secs > 0;
   }
+
+  void ConfigureDeadline(double collective_timeout_secs) {
+    collective_timeout_secs_ = collective_timeout_secs;
+  }
+
+  // Whether the most recent fatal Check() was a DEADLINE expiry (vs
+  // the stall shutdown threshold) — picks the abort message.
+  bool LastDeadlineFatal() const { return last_deadline_fatal_; }
 
   // Coordinator side: a rank reported this tensor ready.
   void RecordRankReady(const std::string& tensor, int rank, int world);
@@ -47,7 +61,9 @@ class StallInspector {
   };
   double warning_secs_ = kDefaultWarningSecs;
   double shutdown_secs_ = kDefaultShutdownSecs;
+  double collective_timeout_secs_ = kDefaultCollectiveTimeoutSecs;
   bool enabled_ = true;
+  bool last_deadline_fatal_ = false;
   std::unordered_map<std::string, PendingInfo> pending_;
 };
 

@@ -1143,10 +1143,32 @@ class ElasticDriver:
     def _skew_tick(self):
         """One observe pass: pull worker snapshots, feed the
         observatory (scores + sustained-detection + the configured
-        action + plan-staleness tracking)."""
+        action + plan-staleness tracking + the data-plane resilience
+        roll-up)."""
         models = self._pull_worker_snapshots()
-        if models:
-            self._observatory.observe(models)
+        if not models:
+            return
+        self._observatory.observe(models)
+        # Operator visibility for the self-healing data plane: a route
+        # demotion is a fleet-level bandwidth event (hier -> flat), so
+        # the driver logs each CHANGE of the demoted set loudly — the
+        # steady state stays quiet, /skew carries the live view.
+        res = getattr(self._observatory, "_resilience", None) or {}
+        demoted = tuple(sorted(
+            (d["op"], d["size_class"])
+            for d in res.get("degraded_routes", ())))
+        if demoted != getattr(self, "_degraded_seen", ()):
+            if demoted:
+                LOG.warning(
+                    "fleet reports degraded collective routes "
+                    "(hier -> flat): %s; failures by reason: %s",
+                    ["%s/%s" % d for d in demoted],
+                    res.get("failures_by_reason", {}))
+            elif getattr(self, "_degraded_seen", ()):
+                LOG.warning(
+                    "fleet degraded collective routes cleared "
+                    "(re-promotion probe succeeded)")
+            self._degraded_seen = demoted
 
     def _skew_loop(self):
         # Cadence: a few samples per detection window, bounded so a

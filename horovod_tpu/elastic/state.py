@@ -86,6 +86,12 @@ class State:
         self._commit_id += 1
         self.save()
         self._persist()
+        # Opt-in SPMD degraded-route check (HOROVOD_DATA_PLANE_CHECK_
+        # EVERY commits): commits are the natural synchronized point —
+        # every member reaches the same commit count, so the rank-0
+        # route verdict is adopted at the same index everywhere.
+        from ..common import resilience
+        resilience.maybe_check_at_commit()
         self.check_drain()
         self.check_host_updates()
 

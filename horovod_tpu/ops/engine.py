@@ -52,6 +52,17 @@ class HorovodInternalError(RuntimeError):
     """A collective failed (reference parity: surfaces to elastic mode)."""
 
 
+class CollectiveDeadlineExceeded(HorovodInternalError):
+    """A negotiated group outlived its per-collective deadline
+    (HOROVOD_COLLECTIVE_TIMEOUT_SECS) and was error-completed.
+
+    A HorovodInternalError subclass on purpose: elastic's run() loop
+    must treat deadline expiry as a recoverable fault and restore from
+    the last committed spill.  Its message must never contain the
+    stall inspector's abort text ("stall shutdown threshold") — that
+    phrase routes elastic to the DRAIN exit instead of restore."""
+
+
 class CollectiveHandle:
     """Async completion handle (reference: torch handle_manager.cc idea)."""
 

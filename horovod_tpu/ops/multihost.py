@@ -40,11 +40,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..common import faultline, metrics
+from ..common import faultline, metrics, resilience
 from ..common.config import Config
 from ..utils.timeline import Timeline
 from . import xla_ops
-from .engine import CollectiveHandle, HorovodInternalError
+from .engine import (CollectiveDeadlineExceeded, CollectiveHandle,
+                     HorovodInternalError)
 from .xla_ops import (ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM,
                       alltoall_chunk_reduce, product_allreduce)
 
@@ -474,10 +475,45 @@ class GlobalMeshCollectives:
         gate with the codec left to ``_wire_codec``.  Every member
         resolves identically — the plan is shared via the cache blob /
         KV adoption — so negotiated programs never diverge."""
+        cls = _pow2_class(nbytes)
         hier = self._hier_eligible(nbytes)
-        if self._plan_ctl is None:
-            return hier, True
-        return self._plan_ctl.route(op, _pow2_class(nbytes), hier)
+        if self._plan_ctl is not None:
+            hier, codec_on = self._plan_ctl.route(op, cls, hier)
+        else:
+            codec_on = True
+        # The resilience demotion map is authoritative over every
+        # other gate: a demoted class is flat on EVERY member (the
+        # map only ever changes through the rank-0 KV verdict), even
+        # if a stale plan entry or env pin still says hier.
+        if hier and resilience.demoted(op, cls):
+            return False, codec_on
+        return hier, codec_on
+
+    def _guarded(self, op: str, nbytes: int, run_hier, run_flat,
+                 payloads=(), codec=None):  # graftlint: hot-path
+        """Run a hier leg under the data-plane guard
+        (:func:`resilience.run_hier_leg`: injection sites, wire
+        integrity, transient retry under the group deadline), falling
+        back to the flat program for THIS group on retry exhaustion.
+
+        The fallback is rank-local by design: the fault shapes the
+        guard absorbs exhaust identically on every member (shared DCN
+        link, config-driven codec faults, symmetric injection), and a
+        genuinely asymmetric exhaustion diverges the programs only
+        until the group deadline poisons the engine and elastic
+        restores.  Persistent routing never changes here — only the
+        rank-0 KV verdict in ``check_degraded_routes`` demotes a
+        class."""
+        cls = _pow2_class(nbytes)
+        try:
+            return resilience.run_hier_leg(
+                op, cls, run_hier, payloads=payloads,
+                quantized=codec is not None and codec.kind == "quant")
+        except resilience.LegDegraded as exc:
+            LOG.warning(
+                "multihost %s[%s]: hier leg degraded (%s); this group "
+                "falls back to the flat plane", op, cls, exc.cause)
+            return run_flat()
 
     def _stage_hier(self, segments, total: int, chunk: int, np_dtype):
         """Stage ``segments`` as this process's (1, k, chunk) slab of a
@@ -698,6 +734,28 @@ class GlobalMeshCollectives:
         if len(lengths) == 1 and red_op != ADASUM:
             hier, codec_on = self._route(
                 "allreduce", lengths[0] * np.dtype(dtype).itemsize)
+        def run_flat() -> List:
+            key = ("fused_allreduce", tuple(lengths),
+                   str(np.dtype(dtype)), red_op, float(prescale),
+                   float(postscale))
+            size = self.size
+
+            def build():
+                def fn(*xs):
+                    return tuple(
+                        self._reduce_block(x.reshape(-1), red_op,
+                                           prescale, postscale, size)
+                        for x in xs)
+                from jax.sharding import PartitionSpec as P
+                return self._collective_jit(fn, len(lengths), P())
+
+            _count_path("allreduce",
+                        sum(lengths) * np.dtype(dtype).itemsize, False)
+            staged = [self._stage(p, (n,), dtype)
+                      for p, n in zip(payloads, lengths)]
+            outs = self._compiled(key, build, staged, notify)(*staged)
+            return [self._replicated(o) for o in outs]
+
         if hier:
             # Multi-chip hierarchical path: every local chip moves 1/k
             # of the bytes cross-host instead of chip 0 moving all of
@@ -711,29 +769,14 @@ class GlobalMeshCollectives:
                         codec,
                         self._wire_nbytes(codec, lengths[0])
                         if codec else None)
-            return [self._hier_allreduce(
-                payloads[0], lengths[0], dtype, red_op, prescale,
-                postscale, notify, codec,
-                names[0] if names else None)]
-        key = ("fused_allreduce", tuple(lengths), str(np.dtype(dtype)),
-               red_op, float(prescale), float(postscale))
-        size = self.size
-
-        def build():
-            def fn(*xs):
-                return tuple(
-                    self._reduce_block(x.reshape(-1), red_op, prescale,
-                                       postscale, size)
-                    for x in xs)
-            from jax.sharding import PartitionSpec as P
-            return self._collective_jit(fn, len(lengths), P())
-
-        _count_path("allreduce",
-                    sum(lengths) * np.dtype(dtype).itemsize, False)
-        staged = [self._stage(p, (n,), dtype)
-                  for p, n in zip(payloads, lengths)]
-        outs = self._compiled(key, build, staged, notify)(*staged)
-        return [self._replicated(o) for o in outs]
+            return self._guarded(
+                "allreduce", lengths[0] * np.dtype(dtype).itemsize,
+                lambda: [self._hier_allreduce(
+                    payloads[0], lengths[0], dtype, red_op, prescale,
+                    postscale, notify, codec,
+                    names[0] if names else None)],
+                run_flat, payloads=(payloads[0],), codec=codec)
+        return run_flat()
 
     def _hier_allreduce(self, p, n: int, dtype, red_op, prescale,
                         postscale, notify=None, codec=None,
@@ -945,12 +988,8 @@ class GlobalMeshCollectives:
         bucket = _size_class(n, wire.itemsize)
         hier, codec_on = self._route("broadcast", n * wire.itemsize)
         codec = self._wire_codec(wire) if hier and codec_on else None
-        _count_path("broadcast", n * wire.itemsize, hier, codec,
-                    self._wire_nbytes(codec, n) if codec else None)
-        if hier:
-            out = self._hier_broadcast(local, n, bucket, wire, root_idx,
-                                       notify, codec)
-        else:
+
+        def run_flat():
             key = ("broadcast", str(wire), int(bucket), int(root_idx))
 
             def build():
@@ -964,8 +1003,22 @@ class GlobalMeshCollectives:
 
             staged = self._stage_flat_padded([(local, 0, n)], n, bucket,
                                              wire)
-            out = self._replicated(
+            return self._replicated(
                 self._compiled(key, build, (staged,), notify)(staged))
+
+        if hier:
+            _count_path("broadcast", n * wire.itemsize, True, codec,
+                        self._wire_nbytes(codec, n) if codec else None)
+            out = self._guarded(
+                "broadcast", n * wire.itemsize,
+                lambda: self._hier_broadcast(local, n, bucket, wire,
+                                             root_idx, notify, codec),
+                run_flat,
+                payloads=((local,) if self.my_idx == root_idx else ()),
+                codec=codec)
+        else:
+            _count_path("broadcast", n * wire.itemsize, False)
+            out = run_flat()
         out = (out[:n].reshape(shape) if out.shape[0] > n
                else out.reshape(shape))
         return out.astype(jnp.bool_) if is_bool else out
@@ -1085,12 +1138,8 @@ class GlobalMeshCollectives:
         my_len = lens[self.my_idx]
         hier, codec_on = self._route("allgather", bucket * dtype.itemsize)
         codec = self._wire_codec(dtype) if hier and codec_on else None
-        _count_path("allgather", my_len * dtype.itemsize, hier, codec,
-                    self._wire_nbytes(codec, my_len) if codec else None)
-        if hier:
-            g = self._hier_allgather(local, my_len, bucket, dtype,
-                                     notify, codec)
-        else:
+
+        def run_flat():
             key = ("allgather", str(dtype), int(bucket))
 
             def build():
@@ -1101,8 +1150,26 @@ class GlobalMeshCollectives:
 
             staged = self._stage_flat_padded([(local, 0, my_len)],
                                              my_len, bucket, dtype)
-            g = self._replicated(
+            return self._replicated(
                 self._compiled(key, build, (staged,), notify)(staged))
+
+        if hier:
+            _count_path("allgather", my_len * dtype.itemsize, True,
+                        codec,
+                        self._wire_nbytes(codec, my_len)
+                        if codec else None)
+            # Both planes' outputs slice identically: flat g is
+            # [size, bucket], hier g is [size, k*chunk >= bucket], and
+            # the valid-segment slice below reads lens[m] <= bucket
+            # rows either way — so a degraded fallback is transparent.
+            g = self._guarded(
+                "allgather", bucket * dtype.itemsize,
+                lambda: self._hier_allgather(local, my_len, bucket,
+                                             dtype, notify, codec),
+                run_flat, payloads=(local,), codec=codec)
+        else:
+            _count_path("allgather", my_len * dtype.itemsize, False)
+            g = run_flat()
         parts = [g[m, :lens[m]].reshape((rows[m],) + trailing)
                  for m in range(size) if rows[m]]
         return (jnp.concatenate(parts, axis=0) if len(parts) > 1
@@ -1206,16 +1273,8 @@ class GlobalMeshCollectives:
         hier, codec_on = self._route("alltoall",
                                      size * block * dtype.itemsize)
         codec = self._wire_codec(dtype) if hier and codec_on else None
-        _count_path("alltoall",
-                    int(offs[-1]) * telems * dtype.itemsize, hier,
-                    codec,
-                    self._wire_nbytes(codec, int(offs[-1]) * telems)
-                    if codec else None)
-        if hier:
-            w, stride = self._hier_alltoall(local, sm, offs, telems,
-                                            block, dtype, notify, codec)
-        else:
-            stride = block
+
+        def run_flat():
             key = ("alltoall", str(dtype), int(block))
 
             def build():
@@ -1238,8 +1297,28 @@ class GlobalMeshCollectives:
                     segments.append((None, 0, block - seg_elems))
             staged = self._stage_flat_padded(segments, size * block,
                                              size * block, dtype)
-            w = self._my_row(
-                self._compiled(key, build, (staged,), notify)(staged))
+            return self._my_row(
+                self._compiled(key, build, (staged,), notify)(staged)), block
+
+        if hier:
+            _count_path("alltoall",
+                        int(offs[-1]) * telems * dtype.itemsize, True,
+                        codec,
+                        self._wire_nbytes(codec, int(offs[-1]) * telems)
+                        if codec else None)
+            # stride differs per plane (flat = block, hier = k*ceil),
+            # so each closure returns its own (row, stride) pair and
+            # the valid-rows slice below works either way.
+            w, stride = self._guarded(
+                "alltoall", size * block * dtype.itemsize,
+                lambda: self._hier_alltoall(local, sm, offs, telems,
+                                            block, dtype, notify,
+                                            codec),
+                run_flat, payloads=(local,), codec=codec)
+        else:
+            _count_path("alltoall",
+                        int(offs[-1]) * telems * dtype.itemsize, False)
+            w, stride = run_flat()
         parts = [w[j * stride:j * stride + recv_splits[j] * telems]
                  .reshape((recv_splits[j],) + trailing)
                  for j in range(size) if recv_splits[j]]
@@ -1359,58 +1438,71 @@ class GlobalMeshCollectives:
                                          size * seg * dtype.itemsize)
         codec = (self._wire_codec(dtype, red_op) if hier and codec_on
                  else None)
-        _count_path("reducescatter", d0 * telems * dtype.itemsize, hier,
-                    codec,
-                    self._wire_nbytes(codec, d0 * telems)
-                    if codec else None)
+        my_n = rows[my_idx] * telems
+
+        def run_flat():
+            key = ("reducescatter", str(dtype), int(seg), red_op)
+
+            def build():
+                def fn(x):
+                    y = x[0]  # [size*seg]
+                    if red_op in (SUM, AVERAGE):
+                        w = jax.lax.psum_scatter(
+                            y, "proc", scatter_dimension=0, tiled=True)
+                        if red_op == AVERAGE:
+                            # Divides by the full member count (core
+                            # reducescatter semantics; join cannot reach
+                            # this op).
+                            w = (w / size).astype(w.dtype) if \
+                                jnp.issubdtype(w.dtype, jnp.floating) \
+                                else w // size
+                    elif red_op in (MIN, MAX, PRODUCT):
+                        # One all_to_all + local reduce: 1x payload bytes
+                        # (the full-reduce-then-slice fallback moved N x).
+                        w = alltoall_chunk_reduce(y, "proc", size, red_op)
+                    else:
+                        r = self._reduce_block(y, red_op, 1.0, 1.0, size)
+                        w = jax.lax.slice_in_dim(
+                            r, my_idx * seg, (my_idx + 1) * seg)
+                    return w[None]  # [1, seg]
+                from jax.sharding import PartitionSpec as P
+                return self._collective_jit(fn, 1, P("proc"))
+
+            segments = []
+            for m in range(size):
+                n_m = rows[m] * telems
+                segments.append((local, int(offs[m]) * telems, n_m))
+                if n_m < seg:
+                    segments.append((None, 0, seg - n_m))
+            staged = self._stage_flat_padded(segments, size * seg,
+                                             size * seg, dtype)
+            out = self._my_row(
+                self._compiled(key, build, (staged,), notify)(staged))
+            return out[:my_n].reshape((rows[my_idx],) + trailing)
+
         if hier:
             # Adasum (and any other whole-vector combine) stays on the
             # one-device plane: per-chunk combines would change the
             # math — the ``_hier_allreduce`` exclusion.
-            out = self._hier_reducescatter(local, rows, offs, telems,
-                                           seg, dtype, red_op, notify,
-                                           codec, name)
-            my_n = rows[my_idx] * telems
-            return out[:my_n].reshape((rows[my_idx],) + trailing)
-        key = ("reducescatter", str(dtype), int(seg), red_op)
+            _count_path("reducescatter", d0 * telems * dtype.itemsize,
+                        True, codec,
+                        self._wire_nbytes(codec, d0 * telems)
+                        if codec else None)
 
-        def build():
-            def fn(x):
-                y = x[0]  # [size*seg]
-                if red_op in (SUM, AVERAGE):
-                    w = jax.lax.psum_scatter(
-                        y, "proc", scatter_dimension=0, tiled=True)
-                    if red_op == AVERAGE:
-                        # Divides by the full member count (core
-                        # reducescatter semantics; join cannot reach
-                        # this op).
-                        w = (w / size).astype(w.dtype) if \
-                            jnp.issubdtype(w.dtype, jnp.floating) \
-                            else w // size
-                elif red_op in (MIN, MAX, PRODUCT):
-                    # One all_to_all + local reduce: 1x payload bytes
-                    # (the full-reduce-then-slice fallback moved N x).
-                    w = alltoall_chunk_reduce(y, "proc", size, red_op)
-                else:
-                    r = self._reduce_block(y, red_op, 1.0, 1.0, size)
-                    w = jax.lax.slice_in_dim(
-                        r, my_idx * seg, (my_idx + 1) * seg)
-                return w[None]  # [1, seg]
-            from jax.sharding import PartitionSpec as P
-            return self._collective_jit(fn, 1, P("proc"))
+            def run_hier():
+                out = self._hier_reducescatter(local, rows, offs,
+                                               telems, seg, dtype,
+                                               red_op, notify, codec,
+                                               name)
+                return out[:my_n].reshape((rows[my_idx],) + trailing)
 
-        segments = []
-        for m in range(size):
-            n_m = rows[m] * telems
-            segments.append((local, int(offs[m]) * telems, n_m))
-            if n_m < seg:
-                segments.append((None, 0, seg - n_m))
-        staged = self._stage_flat_padded(segments, size * seg,
-                                         size * seg, dtype)
-        out = self._my_row(
-            self._compiled(key, build, (staged,), notify)(staged))
-        my_n = rows[my_idx] * telems
-        return out[:my_n].reshape((rows[my_idx],) + trailing)
+            return self._guarded("reducescatter",
+                                 size * seg * dtype.itemsize, run_hier,
+                                 run_flat, payloads=(local,),
+                                 codec=codec)
+        _count_path("reducescatter", d0 * telems * dtype.itemsize,
+                    False)
+        return run_flat()
 
     def _hier_reducescatter(self, p, rows, offs, telems: int, seg: int,
                             np_dtype, red_op, notify=None, codec=None,
@@ -1594,7 +1686,12 @@ class MultihostEngine:
                                     0.0))
         self._exec_timeout = max(float(getattr(
             config, "device_exec_timeout_secs", 0.0)), 0.0)
-        if self._exec_warn > 0 or self._exec_timeout > 0:
+        # Per-collective deadlines ride the same watchdog thread: when
+        # the deadline plane is on, the thread must run even with the
+        # warning/timeout knobs off.
+        self._deadline_enabled = resilience.collective_timeout_secs() > 0
+        if (self._exec_warn > 0 or self._exec_timeout > 0
+                or self._deadline_enabled):
             self._watchdog = threading.Thread(
                 target=self._watchdog_loop,
                 name="hvd-tpu-multihost-watchdog", daemon=True)
@@ -1743,7 +1840,8 @@ class MultihostEngine:
 
     # -- execution-phase watchdog ------------------------------------------
 
-    def _watch_register(self, g, names, taken, entries) -> int:
+    def _watch_register(self, g, names, taken, entries,
+                        deadline_secs: float = 0.0) -> int:
         with self._watch_lock:
             wid = self._watch_seq
             self._watch_seq += 1
@@ -1751,6 +1849,11 @@ class MultihostEngine:
                 "g": g, "names": names, "taken": taken,
                 "entries": entries, "start": time.monotonic(),
                 "warned": False,
+                # Per-collective deadline (0 = none): absolute bound on
+                # this record's watched age.  The clock restarts at
+                # compile end (_watch_compile), so a legitimate cold
+                # compile is never charged against the deadline.
+                "deadline_secs": max(float(deadline_secs), 0.0),
             }
         return wid
 
@@ -1795,6 +1898,7 @@ class MultihostEngine:
                          if w not in self._killed_wids]
                 idle = now - self._last_progress
             fired = False
+            expired = []
             for wid, rec in items:
                 if rec.get("compiling"):
                     # THIS record's own dispatch is mid-compile (local
@@ -1821,6 +1925,19 @@ class MultihostEngine:
                 if (self._exec_timeout and age > self._exec_timeout
                         and idle > self._exec_timeout):
                     fired = True
+                # Per-collective deadline: an ABSOLUTE bound on this
+                # record alone — no idle gate, no strikes.  Unlike the
+                # starvation watchdog, the deadline is a per-group
+                # contract: other groups completing does not make THIS
+                # group less wedged, and the operator sized the bound
+                # for the size class (per-GiB scaling) on purpose.
+                dl = rec.get("deadline_secs") or 0.0
+                if dl > 0 and age > dl:
+                    expired.append(rec)
+            if expired:
+                strikes = 0
+                self._deadline_fire(expired)
+                continue
             # Poisoning the engine is irreversible, so demand the
             # starved condition on consecutive ticks: a single tick can
             # straddle the instant a slow-but-healthy program completes
@@ -1829,6 +1946,33 @@ class MultihostEngine:
             if strikes >= 2:
                 strikes = 0
                 self._watchdog_fire()
+
+    def _deadline_fire(self, expired):
+        """Per-collective deadline expiry: count + journal each
+        expired group, then error-complete everything outstanding and
+        poison the engine through the fail-fast path.  The worker's
+        pending handles raise :class:`CollectiveDeadlineExceeded` (a
+        ``HorovodInternalError``), which the elastic recovery loop
+        treats as restorable — its message must never contain the
+        stall inspector's abort text, which would route elastic to the
+        drain exit instead of restore-from-spill."""
+        for rec in expired:
+            g = rec["g"]
+            metrics.counter("collective_deadline_expired_total",
+                            op=g["op_type"]).inc()
+            metrics.event("collective_deadline_expired",
+                          op=g["op_type"], names=list(rec["names"]),
+                          deadline_secs=rec.get("deadline_secs"),
+                          size_class=g.get("_metrics_class"))
+        self._poison(lambda records: CollectiveDeadlineExceeded(
+            "collective deadline exceeded: negotiated group(s) %s "
+            "outlived their per-collective deadline "
+            "(HOROVOD_COLLECTIVE_TIMEOUT_SECS, size-class scaled); "
+            "error-completing outstanding handles and poisoning the "
+            "engine so the elastic recovery loop restores from the "
+            "last committed spill" % sorted(
+                {rec["g"]["op_type"] + str(rec["names"])
+                 for rec in records.values()})))
 
     def _watchdog_fire(self):
         """Fail every outstanding handle and poison the engine: the
@@ -1912,7 +2056,11 @@ class MultihostEngine:
         # dispatch call — never parked on the shared mesh object, where
         # a second executor would cross callbacks (graftlint
         # dispatch-scoped).
-        wid = self._watch_register(g, names, taken, entries)
+        group_bytes = sum(
+            int(arr.nbytes) for _, arr in taken if arr is not None)
+        deadline_secs = resilience.collective_deadline(group_bytes)
+        wid = self._watch_register(g, names, taken, entries,
+                                   deadline_secs)
         notify = lambda phase: self._watch_compile(wid, phase)  # noqa: E731
         # One negotiated group = one engine cycle in this mode; the
         # group id correlates the timeline span, the metrics gauge and
@@ -1921,13 +2069,27 @@ class MultihostEngine:
         gid = self._group_seq
         self._m_cycles.inc()
         self._m_last_group.set(gid)
-        group_bytes = sum(
-            int(arr.nbytes) for _, arr in taken if arr is not None)
         if g["op_type"] == "allreduce" and len(entries) > 1:
             self._m_bytes_fused.inc(group_bytes)
             self._m_tensors_fused.inc(len(entries))
         g["_metrics_t0"] = time.monotonic()
         g["_metrics_class"] = _pow2_class(group_bytes)
+        if faultline.site("mh.deadline.wedge"):
+            # The group is registered and deadline-stamped but its
+            # dispatch is withheld: the exact shape of a member whose
+            # program never starts.  The watchdog's deadline check must
+            # expire it -> error-complete -> poison -> elastic restore.
+            LOG.error(
+                "faultline: withholding dispatch of negotiated %s "
+                "group %s (mh.deadline.wedge); the group stays watched "
+                "until its per-collective deadline expires",
+                g["op_type"], names)
+            return
+        # The leg guard bounds its retries by this group's absolute
+        # deadline (thread-local: two executors may share one mesh).
+        resilience.set_group_deadline(
+            time.monotonic() + deadline_secs if deadline_secs > 0
+            else None)
         try:
             # Per-tensor timeline span (reference: the EXEC_* phases the
             # native executors record) + an xprof TraceAnnotation so the
@@ -1944,6 +2106,8 @@ class MultihostEngine:
             if not self._watch_clear(wid):
                 self._complete_error(g, names, taken, entries, exc)
             return
+        finally:
+            resilience.set_group_deadline(None)
         with self._lock:
             route_q = needs_host or self._host_inflight > 0
             if route_q:
@@ -2081,6 +2245,11 @@ class MultihostEngine:
     def _complete_error(self, g, names, taken, entries, exc):
         self.timeline.activity_end_all(names)
         LOG.error("multihost %s failed: %s", g["op_type"], exc)
+        # The failure-side complement of mh_collective_seconds (which
+        # only records clean completions): every error-completed group
+        # is visible in the fleet merge, bucketed by why it died.
+        metrics.counter("mh_collective_failures_total", op=g["op_type"],
+                        reason=resilience.failure_reason(exc)).inc()
         for (py, _), e in zip(taken, entries):
             if e["handle"] >= 0:
                 self.core.external_done(e["handle"], ok=False,

@@ -443,6 +443,20 @@ def reset():
     """Drop all plane state (tests, and re-init after shutdown)."""
     global _plane
     _plane = _PlanPlane()
+    # The resilience plane rides the same world identity (rank / KV /
+    # fingerprint); a plane reset means that identity is gone, so its
+    # demotion state and SPMD check sequence must restart with it.
+    from ..common import resilience
+    resilience.reset()
+
+
+def world_plane() -> _PlanPlane:
+    """The live plan plane: world identity (rank, size, fingerprint),
+    the rendezvous KV handle, and the active :class:`PlanController`.
+    The data-plane resilience layer (common/resilience.py) reads this
+    to publish/adopt SPMD-uniform degraded-route verdicts through the
+    same KV record protocol as plan staleness."""
+    return _plane
 
 
 def _env_pins_gate() -> bool:

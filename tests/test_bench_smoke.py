@@ -62,6 +62,15 @@ def test_bench_emits_one_valid_json_line():
         serving["autoscale"]["down_qdepth"]
     assert set(serving["plan_warm_start"]) == {
         "enabled", "source", "hits"}
+    # ISSUE 18 self-healing data-plane attribution: the deadline /
+    # retry / degradation knobs plus the live failure evidence.
+    res = lev["resilience"]
+    for key in ("deadline_secs", "leg_max_retries", "demote_threshold",
+                "reprobe_secs", "degrade_enabled", "wire_integrity",
+                "demoted_routes", "leg_retries_total",
+                "deadline_expired_total", "failures_by_reason"):
+        assert key in res, key
+    assert res["demoted_routes"] == []  # a clean bench run stays hier
 
 
 def test_allreduce_bw_amortization_math():
@@ -89,6 +98,43 @@ def test_allreduce_bw_amortization_math():
     assert bus_bytes("reducescatter", 4, 100) == 3 / 4 * 100
     assert bus_bytes("alltoall", 4, 100) == 3 / 4 * 100
     assert bus_bytes("broadcast", 4, 100) == 3 / 4 * 100
+
+
+def test_allreduce_bw_fault_leg_self_attributes():
+    # The resilience A/B leg: --fault arms HVD_TPU_FAULT pre-init (the
+    # parse-time registration of the new mh.leg.* drop sites is part of
+    # what this proves) and the run ends with a self-attributing
+    # resilience_levers JSON line.  The in-process CPU world has no
+    # cross-host leg, so the armed fault must parse cleanly and the
+    # run stay healthy — the evidence block shows zero demotions.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HVD_TPU_FAULT", None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "allreduce_bw.py"),
+         "--eager", "--cpu-devices", "2", "--sizes-mb", "0.25",
+         "--iters", "2", "--warmup", "1",
+         "--fault", "mh.leg.drop:drop@times=1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    lev = [r for r in recs if r.get("metric") == "resilience_levers"]
+    assert len(lev) == 1, recs
+    assert lev[0]["fault"] == "mh.leg.drop:drop@times=1"
+    res = lev[0]["levers"]["resilience"]
+    for key in ("deadline_secs", "deadline_per_gib", "leg_max_retries",
+                "leg_retry_backoff", "demote_threshold", "reprobe_secs",
+                "degrade_enabled", "wire_integrity", "demoted_routes",
+                "leg_retries_total", "deadline_expired_total",
+                "failures_by_reason"):
+        assert key in res, key
+    assert res["demoted_routes"] == []
+    # the bandwidth records themselves still printed (the A/B numbers)
+    assert [r for r in recs
+            if r.get("metric") == "allreduce_bus_bandwidth"], recs
 
 
 def test_flash_roofline_smoke_schema():

@@ -959,6 +959,73 @@ train(state)
     assert "DONE rank=0 size=1 batch=6" in proc.stdout, proc.stdout
 
 
+@pytest.mark.slow
+def test_elastic_multihost_deadline_expiry_restores_from_commit(tmp_path):
+    """ISSUE 18 acceptance: elastic x multihost x per-collective
+    deadline, integrated.  At batch 2 every worker arms
+    ``mh.deadline.wedge`` (once per process): the next negotiated group
+    is registered and deadline-stamped but its dispatch is withheld —
+    a program that never starts.  The 8 s deadline must expire it, the
+    engine poisons with the RESTORE-shaped CollectiveDeadlineExceeded
+    (never the drain-shaped stall text), and the elastic loop restores
+    every worker from the last commit IN-PROCESS: the world stays size
+    2, training resumes at batch 2, and the final total proves zero
+    committed steps were lost or double-counted."""
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_COMMON + """
+ARMED = {"done": False}
+
+@elastic.run
+def train(state):
+    while state.batch < 6:
+        if state.batch == 2 and not ARMED["done"]:
+            # Same SPMD point on every rank; the process-global flag
+            # keeps the post-restore replay of batch 2 from re-arming.
+            ARMED["done"] = True
+            os.environ["HVD_TPU_FAULT"] = "mh.deadline.wedge:drop@times=1"
+            from horovod_tpu.common import faultline
+            faultline.reset()
+        try:
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                name="b%d" % state.batch)
+        except Exception as exc:
+            assert "stall shutdown threshold" not in str(exc), exc
+            if "deadline" in str(exc):
+                print("DEADLINE_SEEN rank=%d batch=%d"
+                      % (hvd.rank(), state.batch), flush=True)
+            raise
+        state.total += float(np.asarray(out)[0])
+        state.batch += 1
+        state.commit()
+    print("DONE rank=%d size=%d batch=%d total=%.1f"
+          % (hvd.rank(), hvd.size(), state.batch, state.total),
+          flush=True)
+
+train(state)
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "--multihost",
+         "-H", "127.0.0.1:1,127.0.0.2:1", "--min-np", "1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=scaled_timeout(600),
+        env=dict(_env(), **{
+            "HOROVOD_COLLECTIVE_TIMEOUT_SECS": "8",
+        }), cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Both workers hit the expiry (the wedge armed on every rank) ...
+    assert "DEADLINE_SEEN rank=0 batch=2" in proc.stdout, proc.stdout
+    assert "DEADLINE_SEEN rank=1 batch=2" in proc.stdout, proc.stdout
+    # ... and BOTH survived the restore: same processes, full-size
+    # world, resumed from the batch-2 commit with an exact total
+    # (2.0 per batch x 6 batches — nothing lost, nothing replayed).
+    assert "DONE rank=0 size=2 batch=6 total=12.0" in proc.stdout, \
+        proc.stdout
+    assert "DONE rank=1 size=2 batch=6 total=12.0" in proc.stdout, \
+        proc.stdout
+    # The drain-shaped abort never fired anywhere in the world.
+    assert "stall shutdown threshold" not in proc.stdout + proc.stderr
+
+
 def test_tpu_discovery_preemption_resizes_world(tmp_path):
     """A preemption notice appears on the fake TPU metadata server
     mid-run: the driver drops the host from the slice view, the doomed

@@ -279,6 +279,72 @@ def test_drain_drop_injection_trips_watchdog():
     assert rc1 != 0 and "FAULT_OK" not in out1, (rc1, out1)
 
 
+RESILIENCE_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "utils",
+    "multihost_resilience_worker.py")
+
+
+def test_leg_drop_bounded_is_absorbed_by_retry():
+    # ISSUE 18 acceptance: a BOUNDED transport flake on rank 1's hier
+    # leg (mh.leg.drop:drop@times=2) is absorbed by the transient-retry
+    # budget — every group completes with the CORRECT value on every
+    # rank, the victim's retry counter shows exactly the injected
+    # count, and nothing was demoted.  The worker asserts the evidence
+    # in-process (resilience.describe() + the path counters).
+    _assert_ok(_spawn_multihost(2, local_devices=2, extra_env={
+        "HVD_TPU_FAULT": "mh.leg.drop:drop@times=2@rank=1",
+        "HOROVOD_LEG_RETRY_BACKOFF": "0.01",
+        "TEST_SCENARIO": "leg_flake",
+    }, worker=RESILIENCE_WORKER), marker="RESILIENCE_OK")
+
+
+@pytest.mark.slow
+def test_leg_drop_sustained_demotes_then_repromotes():
+    # ISSUE 18 acceptance: a SUSTAINED leg fault (unbounded drop, every
+    # rank) exhausts the retry budget twice, rank 0's KV verdict
+    # demotes (allreduce, 131072) hier->flat SPMD-uniformly, a demoted
+    # dispatch routes flat with no new retries, and after the fault is
+    # disarmed the 1 s re-probe window re-promotes the class — the
+    # final dispatch rides hier again.  The SPMD verdict needs a
+    # rendezvous KV, so the test runs one in-process.
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1", secret="s")
+    port = server.start()
+    try:
+        _assert_ok(_spawn_multihost(2, local_devices=2, extra_env={
+            "HVD_TPU_FAULT": "mh.leg.drop:drop",
+            "HOROVOD_LEG_MAX_RETRIES": "1",
+            "HOROVOD_LEG_RETRY_BACKOFF": "0.01",
+            "HOROVOD_LEG_DEMOTE_THRESHOLD": "2",
+            "HOROVOD_LEG_REPROBE_SECS": "1",
+            "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1:%d" % port,
+            "HOROVOD_SECRET_KEY": "s",
+            "TEST_SCENARIO": "leg_demote",
+        }, worker=RESILIENCE_WORKER), marker="RESILIENCE_OK")
+    finally:
+        server.stop()
+
+
+def test_deadline_wedge_expires_loudly_with_restore_shaped_error():
+    # ISSUE 18 acceptance: mh.deadline.wedge withholds the dispatch of
+    # a negotiated, deadline-stamped group on every rank — the exact
+    # shape of a program that never starts.  The per-collective
+    # deadline (4 s) must expire it: every rank fails LOUDLY with the
+    # deadline-shaped HorovodInternalError, and the message must NOT
+    # be the stall inspector's drain-shaped abort text (elastic keys on
+    # that phrase to pick drain vs restore-from-spill).
+    outs = _spawn_multihost(2, local_devices=1, extra_env={
+        "HVD_TPU_FAULT": "mh.deadline.wedge:drop@times=1",
+        "HOROVOD_COLLECTIVE_TIMEOUT_SECS": "4",
+    }, worker=FAULT_WORKER)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 3, "rank %d should fail loudly (rc=%d):\n%s\n%s" \
+            % (rank, rc, out, err)
+        assert "FAULT_LOUD %d" % rank in out, out
+        assert "collective deadline exceeded" in out, out
+        assert "stall shutdown threshold" not in out + err, out + err
+
+
 def test_init_detects_preinitialized_runtime(monkeypatch):
     # A pre-initialized JAX backend makes jax.distributed.initialize a
     # silent no-op: every rank would train alone while believing it is

@@ -263,6 +263,50 @@ def test_env_pins_suppress_plan_and_pinning():
     assert metrics.series_sum("plan_apply_total", source="cache") == 0
 
 
+def test_invalidate_under_concurrent_route_hammer():
+    # ISSUE 18: the resilience demotion path calls invalidate() + pin()
+    # from the SPMD check while dispatch threads race route() on the
+    # same class (the memoized lock-free fast path).  No resolution may
+    # tear (every answer is a well-formed pair from SOME consistent
+    # state) and the settled answer must match the last verdict.
+    ctl = _controller()
+    stop = threading.Event()
+    errors = []
+
+    def dispatcher():
+        while not stop.is_set():
+            try:
+                hier, codec_on = ctl.route("allreduce", "20", True)
+                # flat pin -> (False, False); re-resolved cached entry
+                # or default -> hier with codec.  Nothing else exists.
+                assert (hier, codec_on) in ((False, False),
+                                            (True, True)), \
+                    (hier, codec_on)
+            except Exception as exc:  # noqa: BLE001 — the assertion
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=dispatcher) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            # demote: drop the entry, pin flat (the _apply_route pair)
+            ctl.invalidate("allreduce", "20")
+            ctl.pin("allreduce", "20", {"path": "flat", "codec": "none"})
+            # promote: invalidate drops the pin, route re-resolves
+            ctl.invalidate("allreduce", "20")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors[0]
+    assert ctl.route("allreduce", "20", True) == (True, True)
+    ctl.invalidate("allreduce", "20")
+    ctl.pin("allreduce", "20", {"path": "flat", "codec": "none"})
+    assert ctl.route("allreduce", "20", True) == (False, False)
+
+
 def test_route_hier_unavailable_world_never_routes_hier():
     ctl = _controller(hier_available=False)
     use_hier, _ = ctl.route("allreduce", "20", False)

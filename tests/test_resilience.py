@@ -1,0 +1,405 @@
+"""Self-healing collective data plane units (ISSUE 18): deadline
+scaling, transient-vs-fatal leg classification, bounded retry under
+injected flakes, CRC retry-then-escalate, streak-driven demotion /
+re-promotion, and the SPMD-uniform rank-0 KV verdict protocol."""
+
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import faultline, metrics, resilience
+from horovod_tpu.utils import plancache
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("HVD_TPU_FAULT", "HOROVOD_COLLECTIVE_TIMEOUT_SECS",
+                "HOROVOD_COLLECTIVE_TIMEOUT_PER_GIB",
+                "HOROVOD_LEG_MAX_RETRIES", "HOROVOD_LEG_RETRY_BACKOFF",
+                "HOROVOD_LEG_DEMOTE_THRESHOLD",
+                "HOROVOD_LEG_REPROBE_SECS",
+                "HOROVOD_DATA_PLANE_DEGRADE", "HOROVOD_WIRE_INTEGRITY",
+                "HOROVOD_DATA_PLANE_CHECK_EVERY"):
+        monkeypatch.delenv(var, raising=False)
+    # Fast retries for every test that exhausts a budget.
+    monkeypatch.setenv("HOROVOD_LEG_RETRY_BACKOFF", "0.001")
+    faultline.reset()
+    metrics.reset()
+    resilience.reset()
+    plancache.reset()
+    yield
+    faultline.reset()
+    metrics.reset()
+    resilience.reset()
+    plancache.reset()
+
+
+# -- deadlines --------------------------------------------------------------
+
+def test_deadline_off_by_default():
+    assert resilience.collective_timeout_secs() == 0.0
+    assert resilience.collective_deadline(1 << 30) == 0.0
+
+
+def test_deadline_scales_with_size_class(monkeypatch):
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_TIMEOUT_SECS", "10")
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_TIMEOUT_PER_GIB", "30")
+    assert resilience.collective_deadline(0) == 10.0
+    assert resilience.collective_deadline(1 << 30) == 40.0
+    assert resilience.collective_deadline(1 << 29) == 25.0
+
+
+def test_group_deadline_is_thread_local():
+    resilience.set_group_deadline(123.0)
+    seen = []
+
+    def other():
+        seen.append(resilience.group_deadline())
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen == [None]
+    assert resilience.group_deadline() == 123.0
+    resilience.set_group_deadline(None)
+
+
+# -- classification ---------------------------------------------------------
+
+@pytest.mark.parametrize("exc,transient", [
+    (resilience.LegTransportError("x"), True),
+    (ConnectionResetError("peer reset"), True),
+    (TimeoutError("t"), True),
+    (RuntimeError("UNAVAILABLE: connection reset by peer"), True),
+    (RuntimeError("DEADLINE_EXCEEDED while awaiting DCN send"), True),
+    (resilience.WireIntegrityError("crc"), False),
+    (ValueError("bad shape"), False),
+    (TypeError("bad dtype"), False),
+    (RuntimeError("INVALID_ARGUMENT: dimension mismatch"), False),
+])
+def test_is_transient_leg(exc, transient):
+    assert resilience.is_transient_leg(exc) is transient
+
+
+def test_failure_reason_buckets():
+    from horovod_tpu.ops.engine import CollectiveDeadlineExceeded
+    assert resilience.failure_reason(
+        CollectiveDeadlineExceeded("collective deadline exceeded: g"))\
+        == "deadline"
+    assert resilience.failure_reason(
+        resilience.WireIntegrityError("crc")) == "corrupt"
+    assert resilience.failure_reason(
+        resilience.LegTransportError("drop")) == "transport"
+    assert resilience.failure_reason(
+        RuntimeError("connection refused")) == "transport"
+    assert resilience.failure_reason(ValueError("shape")) == "error"
+
+
+# -- the leg guard ----------------------------------------------------------
+
+def _arm(spec, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULT", spec)
+    faultline.reset()
+
+
+def test_bounded_flake_is_absorbed(monkeypatch):
+    # Two injected drops, default budget of two retries: the leg
+    # succeeds, the retries are counted, and the streak stays clean.
+    _arm("mh.leg.drop:drop@times=2", monkeypatch)
+    calls = []
+    out = resilience.run_hier_leg(
+        "allreduce", "20", lambda: calls.append(1) or "ok")
+    assert out == "ok"
+    assert len(calls) == 1  # the first two attempts dropped pre-stage
+    assert metrics.series_sum("mh_leg_retries_total",
+                              op="allreduce") == 2
+    assert resilience._state.streak == {}
+
+
+def test_retry_exhaustion_raises_leg_degraded(monkeypatch):
+    _arm("mh.leg.drop:drop", monkeypatch)  # unbounded
+    with pytest.raises(resilience.LegDegraded) as ei:
+        resilience.run_hier_leg("allreduce", "20", lambda: "never")
+    assert ei.value.op == "allreduce"
+    assert ei.value.size_class == "20"
+    assert isinstance(ei.value.cause, resilience.LegTransportError)
+    # 1 first attempt + 2 retries failed -> one exhaustion streak.
+    assert resilience._state.streak == {("allreduce", "20"): 1}
+    assert metrics.series_sum("mh_leg_retries_total",
+                              op="allreduce") == 2
+
+
+def test_degrade_disabled_escalates_transport_error(monkeypatch):
+    monkeypatch.setenv("HOROVOD_DATA_PLANE_DEGRADE", "0")
+    _arm("mh.leg.drop:drop", monkeypatch)
+    with pytest.raises(resilience.LegTransportError):
+        resilience.run_hier_leg("allreduce", "20", lambda: "never")
+
+
+def test_fatal_error_never_retries(monkeypatch):
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("dimension mismatch")
+
+    with pytest.raises(ValueError):
+        resilience.run_hier_leg("allreduce", "20", boom)
+    assert len(calls) == 1
+    assert metrics.series_sum("mh_leg_retries_total") == 0
+
+
+def test_group_deadline_bounds_retries(monkeypatch):
+    # Plenty of retry budget, but the group deadline has already
+    # passed: the first transient failure exhausts immediately.
+    monkeypatch.setenv("HOROVOD_LEG_MAX_RETRIES", "50")
+    _arm("mh.leg.drop:drop", monkeypatch)
+    resilience.set_group_deadline(time.monotonic() - 1.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(resilience.LegDegraded):
+            resilience.run_hier_leg("allreduce", "20", lambda: "never")
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        resilience.set_group_deadline(None)
+
+
+def test_success_resets_streak(monkeypatch):
+    _arm("mh.leg.drop:drop@times=3", monkeypatch)  # 1 attempt + 2
+    with pytest.raises(resilience.LegDegraded):
+        resilience.run_hier_leg("allreduce", "20", lambda: "never")
+    assert resilience._state.streak == {("allreduce", "20"): 1}
+    resilience.run_hier_leg("allreduce", "20", lambda: "ok")
+    assert resilience._state.streak == {}
+
+
+# -- wire integrity ---------------------------------------------------------
+
+def test_crc_mismatch_retries_once_then_succeeds(monkeypatch):
+    _arm("mh.leg.corrupt:drop@times=1", monkeypatch)
+    payload = np.arange(16, dtype=np.int8)
+    calls = []
+    out = resilience.run_hier_leg(
+        "allreduce", "20", lambda: calls.append(1) or "ok",
+        payloads=(payload,), quantized=True)
+    assert out == "ok"
+    assert len(calls) == 2  # corrupted attempt + the clean re-stage
+    assert metrics.series_sum("mh_leg_retries_total") == 1
+    assert resilience._state.streak == {}
+
+
+def test_crc_mismatch_escalates_after_one_retry(monkeypatch):
+    _arm("mh.leg.corrupt:drop", monkeypatch)  # persistent corruption
+    payload = np.arange(16, dtype=np.int8)
+    with pytest.raises(resilience.WireIntegrityError):
+        resilience.run_hier_leg("allreduce", "20", lambda: "ok",
+                                payloads=(payload,), quantized=True)
+    assert metrics.series_sum("mh_leg_retries_total") == 1
+    assert resilience._state.streak == {("allreduce", "20"): 1}
+
+
+def test_crc_detects_real_payload_mutation():
+    # No injection: the staged payload actually changing across the
+    # dispatch window is the real defect the checksum exists to catch.
+    payload = np.arange(16, dtype=np.int8)
+
+    def mutate():
+        payload[0] += 1
+        return "ok"
+
+    with pytest.raises(resilience.WireIntegrityError):
+        resilience.run_hier_leg("allreduce", "20", mutate,
+                                payloads=(payload,), quantized=True)
+
+
+def test_crc_skipped_when_integrity_disabled(monkeypatch):
+    monkeypatch.setenv("HOROVOD_WIRE_INTEGRITY", "0")
+    _arm("mh.leg.corrupt:drop", monkeypatch)
+    payload = np.arange(16, dtype=np.int8)
+    assert resilience.run_hier_leg(
+        "allreduce", "20", lambda: "ok",
+        payloads=(payload,), quantized=True) == "ok"
+
+
+def test_wire_checksum_is_order_and_content_sensitive():
+    a = np.arange(8, dtype=np.float32)
+    b = np.arange(8, dtype=np.float32) * 2
+    assert resilience.wire_checksum(a, b) != \
+        resilience.wire_checksum(b, a)
+    c = a.copy()
+    assert resilience.wire_checksum(a) == resilience.wire_checksum(c)
+    c[3] = -1
+    assert resilience.wire_checksum(a) != resilience.wire_checksum(c)
+
+
+# -- demotion / re-promotion (local world) ----------------------------------
+
+def _exhaust(n, monkeypatch, op="allreduce", cls="20"):
+    _arm("mh.leg.drop:drop", monkeypatch)
+    for _ in range(n):
+        with pytest.raises(resilience.LegDegraded):
+            resilience.run_hier_leg(op, cls, lambda: "never")
+    monkeypatch.delenv("HVD_TPU_FAULT")
+    faultline.reset()
+
+
+def test_local_world_demotes_and_reprobes(monkeypatch):
+    monkeypatch.setenv("HOROVOD_LEG_DEMOTE_THRESHOLD", "3")
+    _exhaust(3, monkeypatch)
+    verdict = resilience.check_degraded_routes()
+    assert verdict == {"action": "demote", "op": "allreduce",
+                       "size_class": "20", "streak": 3, "apply_at": 1}
+    assert resilience.demoted("allreduce", "20")
+    assert metrics.series_sum("mh_degraded_routes",
+                              op="allreduce") == 1
+    # Below-threshold streaks never demote.
+    assert resilience.check_degraded_routes() is None
+    # Re-promotion: age the demotion past the probe window.
+    monkeypatch.setenv("HOROVOD_LEG_REPROBE_SECS", "0.01")
+    with resilience._state.lock:
+        resilience._state.demoted[("allreduce", "20")] -= 1.0
+    verdict = resilience.check_degraded_routes()
+    assert verdict["action"] == "promote"
+    assert not resilience.demoted("allreduce", "20")
+    assert metrics.series_sum("mh_degraded_routes",
+                              op="allreduce") == 0
+
+
+def test_reprobe_zero_means_permanent_demotion(monkeypatch):
+    monkeypatch.setenv("HOROVOD_LEG_DEMOTE_THRESHOLD", "1")
+    monkeypatch.setenv("HOROVOD_LEG_REPROBE_SECS", "0")
+    _exhaust(1, monkeypatch)
+    assert resilience.check_degraded_routes()["action"] == "demote"
+    with resilience._state.lock:
+        resilience._state.demoted[("allreduce", "20")] -= 3600.0
+    assert resilience.check_degraded_routes() is None
+    assert resilience.demoted("allreduce", "20")
+
+
+def test_demotion_pins_controller_flat(monkeypatch):
+    # The plan plane and the resilience override must agree: demotion
+    # pins (op, cls) flat in the controller, promotion drops the pin.
+    monkeypatch.setenv("HOROVOD_LEG_DEMOTE_THRESHOLD", "1")
+    plane = plancache.world_plane()
+    plane.controller = plancache.PlanController(
+        "fp-test", {"schema": plancache.SCHEMA_VERSION,
+                    "fingerprint": "fp-test", "plans": {}},
+        "cache", "none", hier_available=True, env_pinned=False)
+    _exhaust(1, monkeypatch)
+    assert resilience.check_degraded_routes()["action"] == "demote"
+    assert plane.controller.route("allreduce", "20", True) == \
+        (False, False)
+    monkeypatch.setenv("HOROVOD_LEG_REPROBE_SECS", "0.01")
+    with resilience._state.lock:
+        resilience._state.demoted[("allreduce", "20")] -= 1.0
+    assert resilience.check_degraded_routes()["action"] == "promote"
+    assert plane.controller.route("allreduce", "20", True) == \
+        (True, True)
+
+
+def test_degrade_disabled_skips_check(monkeypatch):
+    monkeypatch.setenv("HOROVOD_DATA_PLANE_DEGRADE", "off")
+    assert resilience.check_degraded_routes() is None
+
+
+# -- SPMD-uniform verdict adoption (fake KV) --------------------------------
+
+class _FakeKV:
+    def __init__(self):
+        self.store = {}
+
+    def put_json(self, key, obj):
+        import json
+        self.store[key] = json.dumps(obj)
+
+    def get_json(self, key):
+        import json
+        v = self.store.get(key)
+        return json.loads(v) if v is not None else None
+
+
+def test_spmd_members_adopt_rank0_verdict(monkeypatch):
+    monkeypatch.setenv("HOROVOD_LEG_DEMOTE_THRESHOLD", "1")
+    kv = _FakeKV()
+    plane = plancache.world_plane()
+    plane.kv, plane.size, plane.fingerprint = kv, 2, "fp-spmd"
+    # rank 0: a tripped streak publishes the demote verdict.
+    plane.rank = 0
+    _exhaust(1, monkeypatch)
+    assert resilience.check_degraded_routes()["action"] == "demote"
+    assert resilience.demoted("allreduce", "20")
+    # member (fresh process state, same world identity): adopts the
+    # SAME verdict at ITS check #1 without any local failure evidence.
+    resilience.reset()
+    plane.rank = 1
+    assert not resilience.demoted("allreduce", "20")
+    verdict = resilience.check_degraded_routes(timeout=1.0)
+    assert verdict == {"action": "demote", "op": "allreduce",
+                       "size_class": "20", "streak": 1, "apply_at": 1}
+    assert resilience.demoted("allreduce", "20")
+    # Next member check: the verdict is applied exactly once.
+    kv.put_json(resilience._DEGRADED_KEY
+                % (resilience.SCHEMA_VERSION, "fp-spmd"),
+                {"seq": 2, "routes": [verdict]})
+    assert resilience.check_degraded_routes(timeout=1.0) is None
+
+
+def test_spmd_member_without_record_raises(monkeypatch):
+    plane = plancache.world_plane()
+    plane.kv, plane.size, plane.rank = _FakeKV(), 2, 1
+    plane.fingerprint = "fp-spmd"
+    with pytest.raises(RuntimeError, match="never published"):
+        resilience.check_degraded_routes(timeout=0.15)
+
+
+def test_spmd_no_kv_observes_nothing(monkeypatch, caplog):
+    monkeypatch.setenv("HOROVOD_LEG_DEMOTE_THRESHOLD", "1")
+    plane = plancache.world_plane()
+    plane.size, plane.rank, plane.kv = 2, 0, None
+    _exhaust(1, monkeypatch)
+    with caplog.at_level(logging.WARNING, "horovod_tpu.resilience"):
+        assert resilience.check_degraded_routes() is None
+        assert resilience.check_degraded_routes() is None
+    assert caplog.text.count("no rendezvous KV") == 1  # warned once
+    assert not resilience.demoted("allreduce", "20")
+
+
+# -- commit-cadence hook ----------------------------------------------------
+
+def test_commit_hook_off_by_default(monkeypatch):
+    calls = []
+    monkeypatch.setattr(resilience, "check_degraded_routes",
+                        lambda timeout=60.0: calls.append(1))
+    for _ in range(5):
+        resilience.maybe_check_at_commit()
+    assert calls == []
+
+
+def test_commit_hook_cadence(monkeypatch):
+    monkeypatch.setenv("HOROVOD_DATA_PLANE_CHECK_EVERY", "3")
+    calls = []
+    monkeypatch.setattr(resilience, "check_degraded_routes",
+                        lambda timeout=60.0: calls.append(1) or None)
+    for _ in range(7):
+        resilience.maybe_check_at_commit()
+    assert len(calls) == 2  # commits 3 and 6
+
+
+# -- attribution ------------------------------------------------------------
+
+def test_describe_reports_knobs_and_evidence(monkeypatch):
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_TIMEOUT_SECS", "12")
+    monkeypatch.setenv("HOROVOD_LEG_DEMOTE_THRESHOLD", "1")
+    _exhaust(1, monkeypatch)
+    resilience.check_degraded_routes()
+    metrics.counter("mh_collective_failures_total", op="allreduce",
+                    reason="transport").inc()
+    d = resilience.describe()
+    assert d["deadline_secs"] == 12.0
+    assert d["demoted_routes"] == [
+        {"op": "allreduce", "size_class": "20"}]
+    assert d["leg_retries_total"] == 2.0
+    assert d["failures_by_reason"] == {"transport": 1.0}

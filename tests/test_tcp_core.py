@@ -55,6 +55,19 @@ def test_tcp_error_propagation():
     _assert_ok(_spawn_world(2, "error"))
 
 
+def test_tcp_collective_deadline_distinct_abort():
+    # ISSUE 18 C++ mirror: HOROVOD_COLLECTIVE_TIMEOUT_SECS bounds a
+    # negotiation-phase hang in the native core too (python-less
+    # worlds).  A tensor only rank 0 submits must error-complete after
+    # the deadline with "collective deadline exceeded" — a message
+    # DISTINCT from the stall inspector's drain-shaped abort, because
+    # elastic routes the two differently (restore vs drain).
+    outs = _spawn_world(2, "deadline", extra_env={
+        "HOROVOD_COLLECTIVE_TIMEOUT_SECS": "2",
+    })
+    assert_world_ok(outs, marker="DEADLINE_OK")
+
+
 def test_tcp_timeline_written(tmp_path):
     tl = str(tmp_path / "tl.json")
     _assert_ok(_spawn_world(2, "cache", extra_env={"HOROVOD_TIMELINE": tl}))

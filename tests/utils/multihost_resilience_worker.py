@@ -1,0 +1,151 @@
+"""Worker for the self-healing data-plane e2e tests (ISSUE 18): the
+hier cross-host legs run under the resilience guard, and this worker
+certifies the two live behaviours a unit test cannot:
+
+``TEST_SCENARIO=leg_flake`` — the spawning test arms a BOUNDED drop
+(``mh.leg.drop:drop@times=2@rank=1``): rank 1's first hier dispatch
+eats two injected transport faults, retries them under the backoff
+budget, and the group still completes with the CORRECT value on every
+rank.  Evidence asserted in-process: the victim's retry counter grew
+by exactly the injected count, nobody recorded a collective failure,
+and no route was demoted — a bounded flake costs latency, never the
+job and never the topology.
+
+``TEST_SCENARIO=leg_demote`` — an UNBOUNDED drop on every rank with a
+demote threshold of 2: two consecutive retry exhaustions degrade each
+group to the flat plane (values stay correct), the SPMD
+``check_degraded_routes`` call demotes the (op, size_class) through
+rank 0's KV verdict on ALL ranks, a demoted dispatch routes flat with
+zero new retries, and after the fault is disarmed the re-probe window
+(HOROVOD_LEG_REPROBE_SECS=1) re-promotes the class — the final
+dispatch runs hier again.  Needs a rendezvous KV: the spawning test
+runs a RendezvousServer in-process and passes
+HOROVOD_RENDEZVOUS_ADDR/HOROVOD_SECRET_KEY.
+"""
+
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("TEST_LOCAL_DEVICES", "2")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import faultline, metrics, resilience
+
+# 32768 f32 = 128 KiB: past the 64 KiB hier threshold, so every
+# dispatch engages the proc x local plane (and the resilience guard).
+BIG_N = 32768
+CLS = str(BIG_N * 4)  # pow2 class of the payload bytes (already a pow2)
+
+
+def _path_counts():
+    """{path: total} from mh_collective_path_total for allreduce."""
+    fam = metrics.snapshot().get("mh_collective_path_total") or {}
+    out = {}
+    for row in fam.get("series", []):
+        labels = row.get("labels", {})
+        if labels.get("op") != "allreduce":
+            continue
+        path = labels.get("path", "?")
+        out[path] = out.get(path, 0.0) + float(row.get("value", 0.0))
+    return out
+
+
+def _verified_allreduce(r, n, name):
+    out = hvd.allreduce(np.full((BIG_N,), float(r + 1), np.float32),
+                        op=hvd.Sum, name=name)
+    np.testing.assert_allclose(np.asarray(out),
+                               float(sum(range(1, n + 1))))
+
+
+def run_leg_flake():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    for i in range(4):
+        _verified_allreduce(r, n, "flake%d" % i)
+    desc = resilience.describe()
+    if r == 1:
+        # The victim absorbed exactly the two injected faults.
+        assert desc["leg_retries_total"] == 2.0, desc
+    else:
+        assert desc["leg_retries_total"] == 0.0, desc
+    # Absorbed flakes are not failures and never demote a route.
+    assert desc["failures_by_reason"] == {}, desc
+    assert desc["demoted_routes"] == [], desc
+    # Every group rode the hier plane (the retries happened IN it).
+    assert _path_counts().get("hier", 0) >= 4, _path_counts()
+    hvd.shutdown()
+    print("RESILIENCE_OK %d" % r, flush=True)
+
+
+def run_leg_demote():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+
+    # Phase 1: the armed unbounded drop exhausts the retry budget on
+    # every group; each degrades to the flat plane with correct values.
+    _verified_allreduce(r, n, "demote0")
+    _verified_allreduce(r, n, "demote1")
+    desc = resilience.describe()
+    assert desc["demoted_routes"] == [], desc  # no rank-local demotion
+    counts = _path_counts()
+    assert counts.get("flat", 0) >= 2, counts  # degraded fallbacks ran
+
+    # Phase 2: the SPMD check — rank 0's streak (2 >= threshold 2)
+    # becomes a KV verdict every member adopts at the same index.
+    verdict = resilience.check_degraded_routes(timeout=60.0)
+    assert verdict is not None and verdict["action"] == "demote", verdict
+    assert (verdict["op"], verdict["size_class"]) == ("allreduce", CLS), \
+        verdict
+    assert resilience.demoted("allreduce", CLS)
+    assert resilience.describe()["demoted_routes"] == [
+        {"op": "allreduce", "size_class": CLS}]
+
+    # Phase 3: a demoted dispatch routes flat at the gate — no hier
+    # attempt, so no new retries even with the fault still armed.
+    retries_before = resilience.describe()["leg_retries_total"]
+    hier_before = _path_counts().get("hier", 0)
+    _verified_allreduce(r, n, "demoted_flat")
+    assert resilience.describe()["leg_retries_total"] == retries_before
+    assert _path_counts().get("hier", 0) == hier_before
+
+    # Phase 4: heal the leg (every rank disarms at the same point),
+    # wait out the re-probe window, and check again: rank 0's probe
+    # clock re-promotes the class through the same KV protocol.
+    del os.environ["HVD_TPU_FAULT"]
+    faultline.reset()
+    time.sleep(1.2)  # > HOROVOD_LEG_REPROBE_SECS=1
+    verdict = resilience.check_degraded_routes(timeout=60.0)
+    assert verdict is not None and verdict["action"] == "promote", verdict
+    assert not resilience.demoted("allreduce", CLS)
+
+    # Phase 5: the re-promoted class rides hier again, healthily.
+    _verified_allreduce(r, n, "promoted")
+    assert _path_counts().get("hier", 0) == hier_before + 1, \
+        _path_counts()
+    hvd.shutdown()
+    print("RESILIENCE_OK %d" % r, flush=True)
+
+
+def main():
+    scenario = os.environ.get("TEST_SCENARIO", "leg_flake")
+    if scenario == "leg_demote":
+        run_leg_demote()
+    else:
+        run_leg_flake()
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,8 @@ def main():
             run_join(core, rank, size)
         if scenario == "error":
             run_error(core, rank, size)
+        if scenario == "deadline":
+            run_deadline(core, rank, size)
     finally:
         core.shutdown()
 
@@ -284,6 +286,30 @@ def run_join(core, rank, size):
     # Everyone joins after its own work; join returns the last rank.
     last = core.join()
     assert 0 <= last < size
+
+
+def run_deadline(core, rank, size):
+    # A collective rank 0 submits but rank 1+ withholds: the native
+    # core's per-collective deadline (HOROVOD_COLLECTIVE_TIMEOUT_SECS,
+    # the C++ mirror of common/resilience.py) must error-complete it
+    # with the RESTORE-shaped message — never the drain-shaped stall
+    # text elastic keys on, and never a hang.
+    import time
+    budget = float(os.environ.get("HOROVOD_COLLECTIVE_TIMEOUT_SECS", "2"))
+    if rank == 0:
+        h = core.allreduce_async(np.ones(4, np.float32), "dl")
+        try:
+            h.wait(timeout=60)
+            raise AssertionError("deadline should have expired")
+        except HorovodInternalError as e:
+            msg = str(e)
+            assert "collective deadline exceeded" in msg, msg
+            assert "stall shutdown threshold" not in msg, msg
+    else:
+        # Stay alive past rank 0's expiry so the world's teardown is
+        # orderly (a dead peer would be a different failure mode).
+        time.sleep(budget + 2.0)
+    print("DEADLINE_OK %d" % rank, flush=True)
 
 
 def run_error(core, rank, size):
