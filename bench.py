@@ -248,6 +248,16 @@ def lever_attribution(jax, jnp, on_accel, peak):
     except Exception as exc:  # noqa: BLE001 - attribution is optional
         print("resilience attribution degraded: %s" % exc,
               file=sys.stderr)
+    try:
+        # Steady-state fast-path attribution (ISSUE 19): frozen-cycle /
+        # thaw counters plus per-plane freezer state — so a BENCH delta
+        # is attributable to skipped negotiation (or to a thaw storm)
+        # rather than a plan or codec shift.
+        from horovod_tpu.ops import fastpath as _fastpath
+        lev["fastpath"] = _fastpath.describe()
+    except Exception as exc:  # noqa: BLE001 - attribution is optional
+        print("fastpath attribution degraded: %s" % exc,
+              file=sys.stderr)
     return lev
 
 

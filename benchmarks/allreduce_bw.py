@@ -130,6 +130,17 @@ def main():
                          "uses the WIRE itemsize so reported GB/s "
                          "stays NCCL-convention-comparable across "
                          "codecs")
+    ap.add_argument("--fast-path", default=None, choices=["on", "off"],
+                    help="steady-state fast path A/B (exports "
+                         "HOROVOD_FAST_PATH before init): after "
+                         "HOROVOD_FAST_PATH_WARM_CYCLES identical "
+                         "cycles the engine freezes the negotiated "
+                         "schedule and dispatches straight off it.  "
+                         "Each size reports negotiation cycles vs "
+                         "frozen (negotiation-skipped) cycles and the "
+                         "steady-state cycle time from the live "
+                         "metrics; the run self-attributes with a "
+                         "levers.fastpath JSON line")
     ap.add_argument("--fault", default=None, metavar="SITE:SPEC",
                     help="resilience A/B: arm HVD_TPU_FAULT with this "
                          "spec before init (e.g. "
@@ -153,6 +164,17 @@ def main():
         ap.error("--fault requires --eager/--eager-async (the "
                  "mh.leg.* / mh.deadline.* seams live on the eager "
                  "multihost data plane)")
+    if args.fast_path and not (args.eager or args.eager_async):
+        ap.error("--fast-path requires --eager/--eager-async (the "
+                 "frozen-schedule seam lives on the negotiating "
+                 "engines; the raw jit path never negotiates)")
+    if args.fast_path:
+        # Pre-init export, like --compression: an explicit off leg must
+        # OVERRIDE ambient HOROVOD_FAST_PATH so the A/B baseline really
+        # negotiates every cycle.
+        import os
+        os.environ["HOROVOD_FAST_PATH"] = (
+            "1" if args.fast_path == "on" else "0")
     if args.fault:
         # Pre-init export, like --compression: faultline parses the
         # spec at hvd.init() and rejects malformed/misplaced actions
@@ -385,6 +407,20 @@ def run_eager(args):
                     float(np.asarray(y).reshape(-1)[0])  # fetch barrier
                 return time.perf_counter() - t0
 
+        def _fp_counters():
+            # Live-metrics reading of the fast path's effect: counts of
+            # negotiated vs frozen (negotiation-skipped) cycles plus the
+            # engine_cycle_seconds running (sum, count) — per-size
+            # deltas of these are the A/B evidence, not printed math.
+            from horovod_tpu.common.metrics import series_sum, snapshot
+            s = c = 0.0
+            fam = snapshot().get("engine_cycle_seconds") or {}
+            for row in fam.get("series", ()):
+                s += float(row.get("sum", 0.0))
+                c += float(row.get("count", 0.0))
+            return (series_sum("engine_cycles_total"),
+                    series_sum("fastpath_frozen_cycles_total"), s, c)
+
         def _compressed_count():
             # Engagement observed from the engine's own counter, not a
             # re-derivation of its per-op gate bytes (padding /
@@ -395,9 +431,11 @@ def run_eager(args):
             return series_sum("mh_compressed_collectives_total", op=op)
 
         cc_before = _compressed_count()
+        fp0 = _fp_counters() if args.fast_path else None
         timed(args.warmup)
         engaged = _compressed_count() > cc_before
         per_op, opw, resolvable = measure_per_op(timed, args.iters)
+        fp1 = _fp_counters() if args.fast_path else None
         payload_bytes = elems * dtype.itemsize
         # Wire bytes at the engine's accounting: the bus-bytes
         # convention uses the WIRE itemsize when the codec engaged on
@@ -424,6 +462,19 @@ def run_eager(args):
             rec["compression_engaged"] = codec_obj is not None
             rec["wire_bytes"] = int(wire_bytes)
             rec["payload_bytes"] = int(payload_bytes)
+        if args.fast_path:
+            # This size's window from the engine's own counters: frozen
+            # cycles ARE skipped negotiations (the two counters are
+            # disjoint by design), and the steady-state cycle time is
+            # the mean over negotiation cycles that still ran.
+            d_cyc = fp1[0] - fp0[0]
+            d_frozen = fp1[1] - fp0[1]
+            d_sum, d_cnt = fp1[2] - fp0[2], fp1[3] - fp0[3]
+            rec["fast_path"] = args.fast_path
+            rec["negotiation_cycles"] = int(d_cyc)
+            rec["negotiation_cycles_skipped"] = int(d_frozen)
+            rec["cycle_time_us"] = (round(d_sum / d_cnt * 1e6, 2)
+                                    if d_cnt else None)
         if not resolvable:
             rec["note"] = ("below timer resolution even amortized "
                            "over %d ops/window" % opw)
@@ -466,6 +517,18 @@ def run_eager(args):
                 codec=resolved_codec)),
             "compression_ratio": series("mh_compression_ratio", op=op,
                                         codec=resolved_codec),
+        }))
+    if args.fast_path and hvd.rank() == 0:
+        # Self-attribution for the fast-path A/B: the engine's own
+        # frozen/thaw evidence (per-plane freezer state, thaw reasons,
+        # core idle rounds skipped) so a latency delta vs the off leg
+        # is attributable to skipped negotiation, not printed math.
+        from horovod_tpu.ops import fastpath
+
+        print(json.dumps({
+            "metric": "fastpath_levers",
+            "fast_path": args.fast_path,
+            "levers": {"fastpath": fastpath.describe()},
         }))
     if args.fault and hvd.rank() == 0:
         # Self-attribution for the resilience A/B: the engine's own

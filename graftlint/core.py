@@ -762,6 +762,12 @@ class LintConfig:
         # autoscale policy are read pre-Config by design.
         "horovod_tpu/serving/router.py",
         "horovod_tpu/serving/replica.py",
+        # Steady-state fast path (ISSUE 19): the freezer consumes its
+        # knobs through Config today, but the module sits on the
+        # pre-init bootstrap path (registered thaw hooks fire from
+        # planes that exist before any engine) — any direct env read
+        # it ever grows must be documented like config.py's.
+        "horovod_tpu/ops/fastpath.py",
     )
     # env-drift rule: test-harness modules whose hard env pins must be
     # documented (the spawn harness pinning HOROVOD_CYCLE_TIME=1
@@ -775,6 +781,10 @@ class LintConfig:
     spmd_roots: Sequence[str] = (
         "horovod_tpu/ops/engine.py",
         "horovod_tpu/ops/multihost.py",
+        # Steady-state fast path (ISSUE 19): freeze/thaw verdicts gate
+        # whether a member negotiates at all — divergence here is a
+        # hang, so the rank-taint pass must cover it.
+        "horovod_tpu/ops/fastpath.py",
         "horovod_tpu/utils/plancache.py",
         "horovod_tpu/utils/autotune.py",
         "horovod_tpu/common/process_sets.py",

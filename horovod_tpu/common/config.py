@@ -214,6 +214,23 @@ class Config:
     # (warnings after stall_warning_secs).
     device_exec_timeout_secs: float = 0.0
 
+    # --- steady-state fast path (frozen negotiated schedules) ---
+    # The reference's response_cache.cc idea taken one step further:
+    # after fast_path_warm_cycles identical negotiated cycles (same
+    # tensor multiset, shapes, dtypes, membership) the response
+    # schedule FREEZES and dispatch runs straight off the cached
+    # schedule, skipping request gather/fuse/broadcast entirely.  Any
+    # loud-invalidation source (shape/membership change, plan
+    # staleness trip, degraded-route verdict, collective deadline)
+    # thaws it back to full negotiation.  overlap_buckets carves the
+    # frozen fused payload into that many staging buckets, each
+    # dispatched the instant its last tensor lands so early buckets'
+    # collectives overlap later gradient production (the DDP bucket
+    # overlap lever).
+    fast_path: bool = True
+    fast_path_warm_cycles: int = 10
+    overlap_buckets: int = 4
+
     @staticmethod
     def from_env() -> "Config":
         def opt_int(name):
@@ -268,4 +285,8 @@ class Config:
                 1, _env_int("MAX_INFLIGHT_GROUPS", 4)),
             device_exec_timeout_secs=_env_float(
                 "DEVICE_EXEC_TIMEOUT_SECONDS", 0.0),
+            fast_path=_env_bool("FAST_PATH", True),
+            fast_path_warm_cycles=max(
+                1, _env_int("FAST_PATH_WARM_CYCLES", 10)),
+            overlap_buckets=max(1, _env_int("OVERLAP_BUCKETS", 4)),
         )

@@ -107,6 +107,15 @@ SITES: Dict[str, str] = {
         "CRC32 diverges from the staged one, a simulated in-flight bit "
         "flip; the guard must re-stage exactly once, then escalate "
         "loudly — never absorb silently)",
+    "engine.fastpath.stale_dispatch":
+        "steady-state fast path, the frozen-schedule bucket-dispatch "
+        "seam (CollectiveEngine._fp_stage and MultihostEngine."
+        "_fp_stage): a completed overlap bucket is about to dispatch "
+        "off the frozen schedule (drop = the schedule is treated as "
+        "stale at dispatch time: the engine thaws loudly with "
+        "reason=staleness and pushes the bucket's tensors back "
+        "through full negotiation — values must stay correct and "
+        "nothing may hang)",
     "mh.deadline.wedge":
         "multihost engine, MultihostEngine._execute: after the group "
         "is deadline-stamped and watched, before dispatch (drop = the "
@@ -220,6 +229,7 @@ ACTIONS = ("delay", "drop", "die", "wedge")
 # and the test arming it would pass vacuously — exactly the silent
 # no-op this module exists to forbid.
 DROP_SITES = frozenset({
+    "engine.fastpath.stale_dispatch",
     "mh.drain.record",
     "mh.leg.drop",
     "mh.leg.corrupt",

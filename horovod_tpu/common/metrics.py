@@ -87,6 +87,26 @@ NAMES: Dict[str, Tuple[str, str]] = {
         "gauge", "monotonic id of the newest dispatched collective "
                  "group; the same id tags the group's timeline EXEC "
                  "events (args.group) for cross-plane correlation"),
+    # -- steady-state fast path (frozen negotiated schedules) --
+    "fastpath_frozen_cycles_total": (
+        "counter", "execution cycles dispatched straight off a frozen "
+                   "negotiated schedule, skipping request "
+                   "gather/fuse/broadcast (upstream response_cache.cc "
+                   "parity); disjoint from engine_cycles_total so a "
+                   "cached-schedule dispatch is never double-counted "
+                   "as a negotiation cycle"),
+    "fastpath_thaws_total": (
+        "counter", "frozen schedules invalidated back to full "
+                   "negotiation, labeled reason (shape|membership|"
+                   "staleness|route|deadline); the paired "
+                   "fastpath_thaw event carries the frozen schedule's "
+                   "group id for timeline correlation"),
+    "engine_overlap_bucket_seconds": (
+        "histogram", "per-bucket wall time of a frozen fused cycle "
+                     "(HOROVOD_OVERLAP_BUCKETS contiguous staging "
+                     "buckets, each dispatched the instant its last "
+                     "tensor lands): eager reports dispatch time, "
+                     "multihost dispatch-to-completion"),
     # -- multihost payload plane --
     "mh_collective_seconds": (
         "histogram", "dispatch-to-completion latency of one negotiated "

@@ -444,6 +444,15 @@ def _apply_route(plane, entry: dict):
     invalidate/pin keeps the plan plane's view consistent."""
     op, cls = entry["op"], entry["size_class"]
     key = (op, cls)
+    # A route verdict (either direction) changes how the very next
+    # dispatch should run — a frozen negotiated schedule built over the
+    # old route must thaw BEFORE the controller invalidate, so staged
+    # fast-path work renegotiates onto the new route.  SPMD-safe: route
+    # verdicts are rank-0-decided and KV-adopted on every member.
+    from ..ops import fastpath
+    fastpath.thaw_all(
+        "route", detail="route %s for (%s, %s)"
+        % (entry.get("action", "promote"), op, cls))
     if entry.get("action") == "demote":
         with _state.lock:
             _state.demoted[key] = time.monotonic()

@@ -156,6 +156,16 @@ def load_library():
         lib.hvd_tcp_autotune_state.restype = None
     except AttributeError:
         pass
+    try:
+        # r22 symbols: steady-state fast path (frozen schedules) — a
+        # stale .so keeps its normal idle cadence; the Python engine
+        # guards the call sites.
+        lib.hvd_tcp_set_fastpath.argtypes = [ctypes.c_int]
+        lib.hvd_tcp_set_fastpath.restype = None
+        lib.hvd_tcp_fastpath_idle_rounds.argtypes = []
+        lib.hvd_tcp_fastpath_idle_rounds.restype = ctypes.c_ulonglong
+    except AttributeError:
+        pass
     lib.hvd_tcp_kernel_tune_record.argtypes = [ctypes.c_int,
                                                ctypes.c_double]
     lib.hvd_tcp_kernel_tune_record.restype = None
@@ -474,6 +484,27 @@ class TcpCore:
         """Report a device-plane allreduce group's (bytes, time-to-
         completion) to rank 0's autotuner (no-op elsewhere)."""
         self._lib.hvd_tcp_autotune_observe(int(nbytes), float(secs))
+
+    def set_fastpath(self, on: bool):
+        """Stretch (on) / restore (off) the background loop's idle
+        negotiation cadence while the engine's frozen schedule makes
+        rounds pointless.  No-op on a stale .so — the fast path still
+        works, the core just keeps polling at normal cycle time."""
+        try:
+            fn = self._lib.hvd_tcp_set_fastpath
+        except AttributeError:  # stale .so: degrade, don't fail
+            return
+        fn(1 if on else 0)
+
+    def fastpath_idle_rounds(self) -> int:
+        """Negotiation rounds the core skipped (stretched) while the
+        fast path was on, for levers.fastpath attribution; 0 on a
+        stale .so."""
+        try:
+            fn = self._lib.hvd_tcp_fastpath_idle_rounds
+        except AttributeError:  # stale .so: degrade, don't fail
+            return 0
+        return int(fn())
 
     def autotune_warm_start(self, fusion_threshold: int,
                             cycle_time_ms: float, converged: bool):

@@ -118,6 +118,19 @@ void hvd_tcp_autotune_observe(unsigned long long bytes, double secs) {
   CoreState::Get().AutotuneObserve(static_cast<uint64_t>(bytes), secs);
 }
 
+// Steady-state fast path: the Python engine holds a frozen negotiated
+// schedule and dispatches without this core — stretch the background
+// loop's idle cadence while on; off wakes the loop immediately.
+void hvd_tcp_set_fastpath(int on) {
+  CoreState::Get().SetFastPath(on != 0);
+}
+
+// Avoided-negotiation-round counter for levers.fastpath attribution.
+unsigned long long hvd_tcp_fastpath_idle_rounds(void) {
+  return static_cast<unsigned long long>(
+      CoreState::Get().FastPathIdleRounds());
+}
+
 // Plan-cache warm start: adopt a persisted tuned operating point —
 // sampling starts there with the warm-up window skipped, a converged
 // plan freezes the tuner.  Meaningful on the rank-0 coordinator (the

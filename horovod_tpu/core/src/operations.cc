@@ -186,6 +186,13 @@ void CoreState::WakeLoop() {
   wake_cv_.notify_one();
 }
 
+void CoreState::SetFastPath(bool on) {
+  bool was = fastpath_.exchange(on);
+  // Thaw: wake the loop out of a stretched pause so the first
+  // renegotiated request is picked up at normal cadence immediately.
+  if (was && !on) WakeLoop();
+}
+
 void CoreState::AutotuneObserve(uint64_t bytes, double secs) {
   // Device-plane completion report (multihost executor): rank 0's
   // tuner scores it exactly like a cycle observation.
@@ -505,10 +512,22 @@ void CoreState::BackgroundLoop() {
     // shutdown request) wakes the loop immediately — the reference
     // pays up to a full HOROVOD_CYCLE_TIME of latency here; a cv wait
     // keeps the idle pacing without taxing every synchronous op.
+    // While the engine's frozen schedule is active (fast path), no
+    // requests will arrive through this loop: stretch the pause (16x,
+    // capped at 250ms) so idle negotiation rounds stop burning CPU and
+    // coordinator traffic, and count every stretched round for the
+    // levers.fastpath attribution.  Enqueues and SetFastPath(false)
+    // still wake the loop instantly, so the stretch never adds latency
+    // to real work.
     {
+      double pause_ms = cycle_time_ms_;
+      if (fastpath_.load()) {
+        pause_ms = std::min(cycle_time_ms_ * 16.0, 250.0);
+        ++fastpath_idle_rounds_;
+      }
       std::unique_lock<std::mutex> lk(wake_mu_);
       wake_cv_.wait_for(
-          lk, std::chrono::duration<double, std::milli>(cycle_time_ms_),
+          lk, std::chrono::duration<double, std::milli>(pause_ms),
           [&] { return enqueue_seq_ != seen_seq; });
     }
   }

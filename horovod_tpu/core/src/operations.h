@@ -79,6 +79,17 @@ class CoreState {
   // meaningless negotiation-cycle timing for external payloads.
   void AutotuneObserve(uint64_t bytes, double secs);
 
+  // Steady-state fast path: while the Python engine dispatches off a
+  // frozen negotiated schedule, no requests reach this loop — stretch
+  // the inter-cycle pause instead of burning empty negotiation rounds
+  // (the avoided rounds are counted for attribution).  Turning the
+  // flag off wakes the loop immediately so the first post-thaw
+  // request pays no stretched-pause latency.
+  void SetFastPath(bool on) EXCLUDES(wake_mu_);
+  uint64_t FastPathIdleRounds() const {
+    return fastpath_idle_rounds_.load();
+  }
+
   uint32_t RegisterProcessSet(const std::vector<int32_t>& ranks) {
     return process_sets_.Register(ranks);
   }
@@ -162,6 +173,13 @@ class CoreState {
   // counter itself must also be readable from sanitizer interceptors
   // whose mutex identity tracking breaks under an embedding host.
   std::atomic<uint64_t> enqueue_seq_ GUARDED_BY(wake_mu_){0};
+
+  // Steady-state fast path (set by the Python engine when its frozen
+  // schedule makes negotiation rounds pointless): plain atomics — the
+  // flag gates only the inter-cycle pause length, never correctness
+  // (an enqueue still wakes the loop through wake_cv_ regardless).
+  std::atomic<bool> fastpath_{false};
+  std::atomic<uint64_t> fastpath_idle_rounds_{0};
 };
 
 }  // namespace hvdtpu

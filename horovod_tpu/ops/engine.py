@@ -34,7 +34,7 @@ from ..common import faultline, metrics
 from ..common.config import Config
 from ..utils.stall_inspector import StallInspector
 from ..utils.timeline import Timeline
-from . import xla_ops
+from . import fastpath, xla_ops
 from .executable_cache import ExecutableCache
 from .xla_ops import MeshCollectives
 
@@ -120,6 +120,17 @@ class _Entry:
         self.joined_idx = tuple(joined_idx)
 
 
+def _fp_slot_sig(e: "_Entry") -> tuple:
+    """One entry's frozen-schedule slot signature.  Names are NOT part
+    of it on purpose: steady-state training loops enqueue the same
+    tensors in the same order every step but often with step-suffixed
+    names, and the reference's response cache keys on shape/type for
+    the same reason.  Position in the cycle is the identity."""
+    return (e.op_type, e.process_set_id, str(e.payload.dtype), e.red_op,
+            float(e.prescale), float(e.postscale), e.joined_idx,
+            tuple(e.payload.shape), int(e.nbytes))
+
+
 def _bucket(n: int) -> int:
     """Pad fused flat length to a power-of-two bucket (>=1024) so compiled
     executables are reused across steps with slightly different groupings —
@@ -155,7 +166,11 @@ class CollectiveEngine:
         # (fused chunk or single op) gets one; the same id tags the
         # group's timeline EXEC events (args.group) and the
         # engine_last_group_id gauge, correlating trace and metrics.
-        self._group_seq = 0  # graftlint: owned-by=hvd-tpu-cycle
+        # Guarded by its own leaf lock since r22: frozen fast-path
+        # buckets dispatch on the CALLER thread, so the cycle thread
+        # no longer owns the sequence.
+        self._gid_lock = threading.Lock()
+        self._group_seq = 0  # graftlint: guarded-by=_gid_lock
         # Fixed unlabeled series resolved ONCE: the enqueue/cycle hot
         # paths must pay only the .inc()/.set() lock round trip, not a
         # per-call name lookup + label-tuple build.
@@ -182,6 +197,25 @@ class CollectiveEngine:
         # zeros to allreduces until every rank has joined.  Ordered so
         # finalize can report the LAST rank to join, like the core.
         self._joined: List[int] = []  # graftlint: guarded-by=_lock
+        # -- steady-state fast path (frozen schedule, ISSUE 19) --
+        # Staging state for the current frozen cycle: callers match
+        # entries against the frozen slots positionally and dispatch
+        # each overlap bucket inline the instant it fills — no cycle-
+        # thread handoff, no cycle-time wait.  _fp_lock is reentrant
+        # (a mismatch thaw flushes from under the staging section) and
+        # is always taken BEFORE _lock/_wake (lock order, never after).
+        self._fp_lock = threading.RLock()
+        self._fp_pending: List[_Entry] = []  # graftlint: guarded-by=_fp_lock
+        self._fp_idx = 0  # graftlint: guarded-by=_fp_lock
+        self._fp_t = 0.0  # graftlint: guarded-by=_fp_lock
+        self._fp = fastpath.ScheduleFreezer(
+            warm_cycles=config.fast_path_warm_cycles,
+            enabled=config.fast_path, spmd=False, plane_name="eager",
+            on_thaw=self._fp_flush, stage_lock=self._fp_lock)
+        fastpath.register(self._fp)
+        self._m_fp_frozen = metrics.counter("fastpath_frozen_cycles_total")
+        self._m_fp_bucket = metrics.histogram(
+            "engine_overlap_bucket_seconds")
         self._thread = threading.Thread(
             target=self._loop, name="hvd-tpu-cycle", daemon=True)
         self._thread.start()
@@ -192,6 +226,10 @@ class CollectiveEngine:
         """Mark world ranks as out of data; their rows of every
         subsequent stacked allreduce payload are zeroed (the reference's
         joined ranks contribute zeros, ``operations.cc`` JoinOp path)."""
+        # A join changes the payload the frozen schedule would
+        # dispatch (zeroed rows): thaw before mutating membership.
+        self._fp.thaw("membership", detail="rank(s) %s joined"
+                      % list(ranks))
         with self._lock:
             for r in ranks:
                 r = int(r)
@@ -240,6 +278,9 @@ class CollectiveEngine:
             return mc
 
     def invalidate_process_set(self, process_set_id: int):
+        self._fp.thaw("membership",
+                      detail="process set %d invalidated"
+                      % process_set_id)
         with self._lock:
             self._collectives.pop(process_set_id, None)
 
@@ -269,6 +310,8 @@ class CollectiveEngine:
         self.timeline.negotiate_start(name, op_type)
         self.stall_inspector.record_enqueue(name)
         self._m_bytes_submitted.inc(nbytes)
+        if self._fp_stage(e):
+            return handle
         with self._wake:
             self._queue.append(e)
             self._wake.notify()
@@ -303,6 +346,123 @@ class CollectiveEngine:
         return self._enqueue(name, _OP_BARRIER, None,
                              process_set_id=process_set_id)
 
+    # -- steady-state fast path (frozen schedule, ISSUE 19) ----------------
+
+    def _fp_profile(self, batch: List[_Entry]):
+        """Freezable profile of one negotiated cycle, or None.  Only
+        pure-allreduce cycles sharing ONE fuse key with no joined
+        ranks freeze: an overlap bucket is a fused dispatch unit, and
+        mixed keys (or a membership transition) cannot fuse."""
+        keys = set()
+        for e in batch:
+            if e.op_type != _OP_ALLREDUCE or e.joined_idx:
+                return None
+            keys.add((e.process_set_id, str(e.payload.dtype), e.red_op,
+                      float(e.prescale), float(e.postscale)))
+            if len(keys) > 1:
+                return None
+        return tuple(_fp_slot_sig(e) for e in batch)
+
+    def _fp_payload(self, batch: List[_Entry], prof) -> dict:
+        """The schedule cached at freeze time: the positional slot
+        signatures plus the overlap-bucket partition (contiguous,
+        balanced by bytes, capped at the fusion threshold)."""
+        ends = fastpath.bucket_ends(
+            [e.nbytes for e in batch], self.config.overlap_buckets,
+            self.config.fusion_threshold_bytes)
+        return {"sig": fastpath.schedule_sig(prof),
+                "slots": list(prof), "ends": ends}
+
+    def _fp_stage(self, e: _Entry) -> bool:  # graftlint: schedule-entry=fastpath -- frozen-schedule bucket dispatch of the eager plane (negotiation skipped)
+        """Frozen-schedule staging (caller thread).  Match ``e``
+        against the next frozen slot; the instant an overlap bucket's
+        last tensor lands, dispatch that bucket INLINE — the XLA
+        dispatch is async, so the caller keeps producing gradients for
+        later buckets while this one's collective runs, and the
+        negotiation queue, cycle thread and cycle-time wait are all
+        skipped.  A mismatch thaws loudly and falls back (returns
+        False: the caller requeues ``e`` on the negotiation path)."""
+        if self._fp.frozen() is None:
+            return False
+        with self._fp_lock:
+            fs = self._fp.frozen()
+            if fs is None:
+                return False
+            slots = fs["slots"]
+            if (self._fp_idx >= len(slots)
+                    or _fp_slot_sig(e) != slots[self._fp_idx]):
+                self._fp.thaw(
+                    "shape", detail="entry %r does not match frozen "
+                    "slot %d" % (e.name, self._fp_idx))
+                return False
+            self.timeline.negotiate_end(e.name)
+            self._fp_pending.append(e)
+            self._fp_t = time.monotonic()
+            self._fp_idx += 1
+            if self._fp_idx not in fs["ends"]:
+                return True
+            if fastpath.stale_dispatch_seam():
+                # Injected stale dispatch: the frozen schedule must
+                # not be trusted — thaw loudly; the flush pushes this
+                # bucket's tensors back through full negotiation
+                # (correct values, no hang).
+                self._fp.thaw(
+                    "staleness", detail="injected stale dispatch "
+                    "(engine.fastpath.stale_dispatch)")
+                return True
+            pending, self._fp_pending = self._fp_pending, []
+            done = self._fp_idx == len(slots)
+            if done:
+                self._fp_idx = 0
+            t0 = time.monotonic()
+            self._execute_fused_allreduce(pending)
+            self._m_fp_bucket.observe(time.monotonic() - t0)
+            if done:
+                # A frozen cycle is counted here, NOT in
+                # engine_cycles_total: exactly one of the two moves
+                # per cycle, and the exec-cache gauges refresh in the
+                # same breath so levers.metrics never reads a cached
+                # dispatch as both a cache hit and a negotiation
+                # cycle.
+                self._m_fp_frozen.inc()
+                hits, misses = self.cache.stats()
+                self._m_cache_hits.set(hits)
+                self._m_cache_misses.set(misses)
+            return True
+
+    def _fp_flush(self, _payload: dict, _reason: str):
+        """Thaw fallback (any thread; runs under _fp_lock via the
+        freezer): staged-but-undispatched entries re-enter the
+        negotiation queue in program order, so their handles resolve
+        through the normal cycle path."""
+        with self._fp_lock:
+            pending, self._fp_pending = self._fp_pending, []
+            self._fp_idx = 0
+        if not pending:
+            return
+        with self._wake:
+            self._queue.extend(pending)
+            self._wake.notify()
+
+    def _fp_idle_check(self):
+        """Safety valve (cycle thread): a frozen cycle staged
+        PARTIALLY and went quiet — the app's per-step entry list
+        shrank without tripping a slot mismatch.  Waiting forever
+        would hang a caller blocked on a staged handle; thaw and
+        negotiate the stragglers instead."""
+        if self._fp.frozen() is None:
+            return
+        with self._fp_lock:
+            stale = bool(self._fp_pending) and (
+                time.monotonic() - self._fp_t
+                > max(0.05, 4 * self.config.cycle_time_ms / 1e3))
+            if stale:
+                self._fp.thaw(
+                    "shape", detail="partial frozen cycle (%d of %d "
+                    "slots) flushed back to negotiation"
+                    % (self._fp_idx,
+                       len((self._fp.frozen() or {}).get("slots", ()))))
+
     # -- background loop ---------------------------------------------------
 
     def _loop(self):
@@ -322,24 +482,26 @@ class CollectiveEngine:
                 if self._shutdown and not self._queue:
                     return
                 batch, self._queue = self._queue, []
+            self._fp_idle_check()
             self._cycle_count += 1
             self.timeline.mark_cycle(self._cycle_count)
             if batch:
                 self._m_cycles.inc()
                 self._m_queue_depth.set(len(batch))
                 t0 = time.monotonic()
-                misses0 = self.cache.misses
+                _, misses0 = self.cache.stats()
                 nbytes = sum(e.nbytes for e in batch)
                 self._run_cycle(batch)
                 self._m_cycle_seconds.observe(time.monotonic() - t0)
-                self._m_cache_hits.set(self.cache.hits)
-                self._m_cache_misses.set(self.cache.misses)
+                hits, misses = self.cache.stats()
+                self._m_cache_hits.set(hits)
+                self._m_cache_misses.set(misses)
                 # A cycle that compiled a new XLA executable measures
                 # the compiler, not communication; feeding it to the
                 # tuner would bias the early GP samples (the reference
                 # resets after HOROVOD_AUTOTUNE_WARMUP for the same
                 # reason).
-                compiled = self.cache.misses != misses0
+                compiled = misses != misses0
                 if self.parameter_manager is not None and not compiled:
                     self.parameter_manager.observe(
                         nbytes, time.monotonic() - t0)
@@ -362,6 +524,17 @@ class CollectiveEngine:
                         plancache.note_tuned(
                             self.parameter_manager.fusion_threshold,
                             self.parameter_manager.cycle_time_ms, True)
+                # Warm counting for the steady-state fast path: one
+                # identical-profile streak long enough freezes the
+                # schedule (single-controller world: the freeze verdict
+                # is trivially SPMD-uniform, no KV round needed).
+                if self._fp.enabled and self._fp.frozen() is None:
+                    prof = self._fp_profile(batch)
+                    if self._fp.observe(prof):
+                        with self._gid_lock:
+                            gid = self._group_seq
+                        self._fp.freeze(
+                            self._fp_payload(batch, prof), gid)
             try:
                 self.stall_inspector.check()
             except Exception as exc:  # StallError -> fail outstanding ops
@@ -404,12 +577,15 @@ class CollectiveEngine:
             self._execute_single(e)
 
     def _next_group(self) -> int:
-        """Next collective-group id (cycle thread only): tags the
-        group's timeline EXEC span and the engine_last_group_id gauge
-        so the trace and metrics planes correlate."""
-        self._group_seq += 1
-        self._m_last_group.set(self._group_seq)
-        return self._group_seq
+        """Next collective-group id (cycle thread OR a caller thread
+        dispatching a frozen bucket): tags the group's timeline EXEC
+        span and the engine_last_group_id gauge so the trace and
+        metrics planes correlate."""
+        with self._gid_lock:
+            self._group_seq += 1
+            gid = self._group_seq
+        self._m_last_group.set(gid)
+        return gid
 
     def _execute_fused_allreduce(self, entries: List[_Entry]):
         names = [e.name for e in entries]
@@ -535,6 +711,11 @@ class CollectiveEngine:
     # -- shutdown ----------------------------------------------------------
 
     def shutdown(self):
+        # Flush any staged frozen work back into the queue FIRST so
+        # the cycle thread drains it before exiting (the world is
+        # ending: membership is the honest reason).
+        self._fp.thaw("membership", detail="engine shutdown")
+        fastpath.unregister(self._fp)
         with self._wake:
             self._shutdown = True
             self._wake.notify()

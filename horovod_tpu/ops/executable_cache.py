@@ -53,6 +53,17 @@ class ExecutableCache:
         self.put(key, built)
         return built
 
+    def stats(self):
+        """Atomic ``(hits, misses)`` snapshot.  The engines' exec-cache
+        gauges and the fast path's frozen-cycle refresh both read
+        through here: a frozen (negotiation-skipping) dispatch still
+        hits this cache, and the gauges must move together with the
+        ``fastpath_frozen_cycles_total`` counter so a cached-schedule
+        cycle is attributed exactly once — as a fast-path cycle with a
+        cache hit, never additionally as a negotiation cycle."""
+        with self._lock:
+            return self.hits, self.misses
+
     def keys(self):
         """Snapshot of cached keys (observability: tests assert the
         packed-bucket paths keep the executable count flat across

@@ -43,7 +43,7 @@ import numpy as np
 from ..common import faultline, metrics, resilience
 from ..common.config import Config
 from ..utils.timeline import Timeline
-from . import xla_ops
+from . import fastpath, xla_ops
 from .engine import (CollectiveDeadlineExceeded, CollectiveHandle,
                      HorovodInternalError)
 from .xla_ops import (ADASUM, AVERAGE, MAX, MIN, PRODUCT, SUM,
@@ -1652,6 +1652,38 @@ class MultihostEngine:
         # and the engine_last_group_id gauge for trace<->metrics
         # correlation.
         self._group_seq = 0  # graftlint: owned-by=hvd-tpu-multihost-exec
+        # -- steady-state fast path (frozen negotiated schedules) ----------
+        # Caller threads stage payloads against the frozen schedule and
+        # hand full buckets to the drain thread via _fp_q, so every
+        # dispatch still flows through _execute (one schedule entry, one
+        # watchdog/deadline registration path).  _fp_lock is the
+        # freezer's stage lock and is ALWAYS taken before self._lock
+        # (the thaw flush re-enqueues through the core under both).
+        self._fp_lock = threading.RLock()
+        # Staged-but-undispatched payloads of the CURRENT bucket only:
+        # (py handle, ndarray, name) in frozen slot order.  A thaw
+        # flush renegotiates exactly these — already-dispatched buckets
+        # are in flight and complete through _finish.
+        self._fp_pending: List[tuple] = []  # graftlint: guarded-by=_fp_lock
+        self._fp_idx = 0  # graftlint: guarded-by=_fp_lock
+        self._fp_t = 0.0  # graftlint: guarded-by=_fp_lock
+        # Synthetic frozen-bucket groups, drained by the exec thread
+        # ahead of negotiated records (queue is thread-safe; unbounded
+        # is fine — depth is capped by the frozen schedule's bucket
+        # count times the caller's own blocking cadence).
+        self._fp_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._fp = fastpath.ScheduleFreezer(
+            warm_cycles=config.fast_path_warm_cycles,
+            enabled=getattr(config, "fast_path", True), spmd=True,
+            plane_name="multihost", on_thaw=self._fp_flush,
+            stage_lock=self._fp_lock)
+        fastpath.register(self._fp)
+        rounds = getattr(core, "fastpath_idle_rounds", None)
+        if rounds is not None:
+            fastpath.set_core_rounds_provider(rounds)
+        self._m_fp_frozen = metrics.counter("fastpath_frozen_cycles_total")
+        self._m_fp_bucket = metrics.histogram(
+            "engine_overlap_bucket_seconds")
         # Fixed unlabeled series resolved once (hot-path discipline);
         # the exec-cache gauges additionally refresh at most 1/s —
         # they only change on a compile, and _finish runs per group.
@@ -1721,6 +1753,11 @@ class MultihostEngine:
             return mc
 
     def invalidate_process_set(self, process_set_id: int):
+        # Membership changed: a frozen schedule negotiated against the
+        # old mesh must never dispatch again (loud thaw, before _lock —
+        # the flush path takes _fp_lock then _lock).
+        self._fp.thaw("membership",
+                      detail="process set %d invalidated" % process_set_id)
         with self._lock:
             self._collectives.pop(process_set_id, None)
 
@@ -1736,6 +1773,9 @@ class MultihostEngine:
         return np.ascontiguousarray(np.asarray(tensor))
 
     def _enqueue(self, name, op_type, arr, **kw) -> CollectiveHandle:
+        fp = self._fp_stage(name, op_type, arr, kw)
+        if fp is not None:
+            return fp
         py = CollectiveHandle(name)
         # Enqueue and park ATOMICALLY w.r.t. the executor's _take: the
         # instant enqueue_external returns, the background thread can
@@ -1796,6 +1836,204 @@ class MultihostEngine:
                              red_op=red_op,
                              process_set_id=process_set_id)
 
+    # -- steady-state fast path (frozen negotiated schedules) --------------
+
+    @staticmethod
+    def _fp_slot_sig(op_type, arr, kw) -> tuple:
+        """Positional slot identity on the enqueue side.  Names carry
+        step suffixes in real training loops, so frozen slots match on
+        what negotiation actually keys on — op, set, dtype, reduction
+        parameters and flat size at position i (the upstream
+        ``response_cache.cc`` keys on shape/type for the same reason)."""
+        return (op_type, int(kw.get("process_set_id", 0)),
+                np.dtype(arr.dtype).name, kw.get("red_op"),
+                float(kw.get("prescale", 1.0)),
+                float(kw.get("postscale", 1.0)), int(arr.size))
+
+    def _fp_profile(self, g: dict):
+        """One negotiated record's schedule profile, or None when the
+        record is not freezable (non-allreduce, error record, or a
+        zero-filled joined entry — membership is mid-change)."""
+        if (g["op_type"] != "allreduce" or g.get("error")
+                or any(e["handle"] < 0 for e in g["entries"])):
+            return None
+        dtype = np.dtype(g["dtype"]).name
+        return tuple(
+            ("allreduce", int(g["process_set_id"]), dtype, g["red_op"],
+             float(g["prescale"]), float(g["postscale"]), int(n))
+            for n in g["aux_sizes"])
+
+    def _fp_payload(self, g: dict, prof) -> dict:
+        lengths = [int(n) for n in g["aux_sizes"]]
+        item = np.dtype(g["dtype"]).itemsize
+        return {
+            "sig": fastpath.schedule_sig(prof),
+            "slots": [tuple(s) for s in prof],
+            "lengths": lengths,
+            "ends": fastpath.bucket_ends(
+                [n * item for n in lengths],
+                getattr(self.config, "overlap_buckets", 4),
+                self.config.fusion_threshold_bytes),
+            "process_set_id": int(g["process_set_id"]),
+            "dtype": g["dtype"],
+            "red_op": g["red_op"],
+            "prescale": g["prescale"],
+            "postscale": g["postscale"],
+        }
+
+    def _fp_cycle(self, g: dict):
+        """Per-negotiated-record fast-path bookkeeping (exec thread,
+        BEFORE the record executes).  A record arriving while frozen
+        means some member kept negotiating — membership/world change;
+        otherwise feed the warm streak and, when it trips, propose the
+        freeze.  The flip happens before record K executes so a caller
+        unblocked by K's handles stages K+1 against the frozen schedule
+        on EVERY rank — rank 0's eligibility gate (every parked payload
+        belongs to this record, i.e. no async caller is straddling the
+        freeze point) is checked at the same record index on all
+        members because records are coordinator-broadcast."""
+        if self._fp.frozen() is not None:
+            self._fp.thaw(
+                "membership",
+                detail="negotiated %s record arrived while frozen"
+                % g["op_type"])
+            return
+        prof = self._fp_profile(g)
+        if not self._fp.observe(prof):
+            return
+        with self._lock:
+            quiesced = (self._failed is None
+                        and len(self._pending) == len(g["entries"]))
+        if self._fp.freeze(self._fp_payload(g, prof),
+                           self._group_seq + 1, ok=quiesced):
+            self._fp_core_set(True)
+
+    def _fp_stage(self, name, op_type, arr, kw):
+        """Caller-thread staging against the frozen schedule.  Returns
+        a handle when the payload was staged (negotiation skipped), or
+        None to fall through to full negotiation — including right
+        after a loud shape thaw, whose flush has already renegotiated
+        the staged prefix in program order."""
+        if self._fp.frozen() is None:
+            return None
+        with self._fp_lock:
+            fs = self._fp.frozen()
+            if fs is None:
+                return None
+            i = self._fp_idx
+            sig = self._fp_slot_sig(op_type, arr, kw)
+            if i >= len(fs["slots"]) or tuple(fs["slots"][i]) != sig:
+                self._fp.thaw(
+                    "shape",
+                    detail="staged %s %r does not match frozen slot %d"
+                    % (op_type, name, i))
+                return None
+            py = CollectiveHandle(name)
+            self._fp_pending.append((py, arr, name))
+            self._fp_t = time.monotonic()
+            self._fp_idx = i + 1
+            self._m_bytes_submitted.inc(int(arr.nbytes))
+            if self._fp_idx in fs["ends"]:
+                if fastpath.stale_dispatch_seam():
+                    # Injected stale frozen dispatch: thaw loudly and
+                    # push the staged bucket back through full
+                    # negotiation (the flush) — values stay correct,
+                    # nothing hangs.
+                    self._fp.thaw(
+                        "staleness",
+                        detail="injected stale dispatch "
+                        "(engine.fastpath.stale_dispatch)")
+                    return py
+                start = self._fp_idx - len(self._fp_pending)
+                bucket, self._fp_pending = self._fp_pending, []
+                done = self._fp_idx >= len(fs["slots"])
+                if done:
+                    self._fp_idx = 0
+                self._fp_q.put(self._fp_group(fs, bucket, start, done))
+            return py
+
+    def _fp_group(self, fs: dict, bucket, start: int, done: bool) -> dict:
+        """Synthesize one frozen overlap bucket as a negotiated-group
+        dict so dispatch reuses _execute verbatim (same watchdog,
+        deadline, pipeline window and completion paths).  handle=-2
+        marks entries with no core-side record to complete."""
+        end = start + len(bucket)
+        return {
+            "op_type": "allreduce",
+            "process_set_id": fs["process_set_id"],
+            "dtype": fs["dtype"],
+            "red_op": fs["red_op"],
+            "prescale": fs["prescale"],
+            "postscale": fs["postscale"],
+            "aux_sizes": list(fs["lengths"][start:end]),
+            "entries": [{"name": n, "handle": -2} for _, _, n in bucket],
+            "_fp": True,
+            "_fp_taken": [(py, arr) for py, arr, _ in bucket],
+            "_fp_done": done,
+            "_fp_t0": time.monotonic(),
+        }
+
+    def _fp_flush(self, fs: dict, reason: str):
+        """Thaw flush (called under _fp_lock, inside the thaw — the
+        re-entrant acquire below keeps the guard explicit): push the
+        staged-but-undispatched bucket back through full negotiation in
+        program order so every staged handle still resolves with
+        correct values.  On a poisoned engine the handles error out
+        instead — never silently dropped."""
+        with self._fp_lock:
+            bucket, self._fp_pending = self._fp_pending, []
+            self._fp_idx = 0
+        self._fp_core_set(False)
+        if not bucket:
+            return
+        LOG.warning(
+            "fast path: renegotiating %d staged tensor(s) after %s thaw",
+            len(bucket), reason)
+        for py, arr, name in bucket:
+            with self._lock:
+                if self._failed is not None:
+                    if not py.poll():
+                        py._set_error(HorovodInternalError(
+                            "multihost engine disabled after watchdog "
+                            "failure: %s" % self._failed))
+                    continue
+                ch = self.core.enqueue_external(
+                    name, "allreduce", tuple(arr.shape),
+                    np.dtype(arr.dtype), red_op=fs["red_op"],
+                    process_set_id=fs["process_set_id"],
+                    prescale=fs["prescale"], postscale=fs["postscale"])
+                self._pending[ch._h] = (py, arr)
+                self._m_queue_depth.set(len(self._pending))
+
+    def _fp_core_set(self, on: bool):
+        """Tell the native core to stretch its idle negotiation cadence
+        while frozen (no requests will arrive); tolerate a stale .so
+        without the export — the fast path works without it, the core
+        just keeps polling at the normal cycle time."""
+        set_fp = getattr(self.core, "set_fastpath", None)
+        if set_fp is None:
+            return
+        try:
+            set_fp(bool(on))
+        except Exception:  # noqa: BLE001 - optional, stale .so
+            pass
+
+    def _fp_idle_check(self):
+        """Partial-cycle safety valve (exec thread, every drain tick):
+        an app that stops enqueuing mid-bucket would otherwise park
+        staged handles forever — after ~4 cycle times of staging
+        silence, thaw loudly and renegotiate the staged prefix."""
+        with self._fp_lock:
+            if not self._fp_pending:
+                return
+            age = time.monotonic() - self._fp_t
+            limit = max(0.05, 4.0 * self.config.cycle_time_ms / 1000.0)
+            if age > limit:
+                self._fp.thaw(
+                    "shape",
+                    detail="partial frozen cycle: %d staged tensor(s) "
+                    "idle for %.2fs" % (len(self._fp_pending), age))
+
     # -- executor ----------------------------------------------------------
 
     def _loop(self):
@@ -1806,6 +2044,19 @@ class MultihostEngine:
         # latency.
         wait_ms = max(int(self.config.cycle_time_ms), 1)
         while not self._shutdown:
+            # Frozen overlap buckets dispatch ahead of negotiated
+            # records: a staged bucket is already schedule-certain and
+            # every record behind it (if any) postdates the freeze.
+            try:
+                while True:
+                    g = self._fp_q.get_nowait()
+                    try:
+                        self._execute(g)
+                    except Exception as exc:  # noqa: BLE001 - keep draining
+                        LOG.error("multihost executor (frozen): %s", exc)
+            except queue_mod.Empty:
+                pass
+            self._fp_idle_check()
             rec = self.core.wait_negotiated(wait_ms)
             if rec is None:
                 # A stopped control plane (negotiation failure / peer
@@ -1828,7 +2079,16 @@ class MultihostEngine:
                 LOG.error("faultline: dropping negotiated record")
                 continue
             try:
-                self._execute(parse_negotiated_record(rec))
+                g = parse_negotiated_record(rec)
+                try:
+                    # Freeze coordination failing (KV timeout) must not
+                    # strand the record: execute it regardless so its
+                    # handles resolve; the world simply stays thawed.
+                    self._fp_cycle(g)
+                except Exception as exc:  # noqa: BLE001
+                    LOG.error(
+                        "fast-path freeze coordination failed: %s", exc)
+                self._execute(g)
             except Exception as exc:  # noqa: BLE001 - keep draining
                 LOG.error("multihost executor: %s", exc)
 
@@ -1956,6 +2216,13 @@ class MultihostEngine:
         treats as restorable — its message must never contain the
         stall inspector's abort text, which would route elastic to the
         drain exit instead of restore-from-spill."""
+        # Thaw BEFORE poisoning: the flush re-enqueues any staged
+        # frozen bucket through the core while _failed is still unset,
+        # so those handles land in the pending map and are swept into
+        # the same loud deadline error as everything else — no hang,
+        # and the next (recovered) engine starts from full negotiation.
+        fastpath.thaw_all(
+            "deadline", detail="per-collective deadline expired")
         for rec in expired:
             g = rec["g"]
             metrics.counter("collective_deadline_expired_total",
@@ -2027,8 +2294,14 @@ class MultihostEngine:
         to pop and dispatch group N+1 while N's program runs on
         device."""
         entries = g["entries"]
-        taken = [self._take(e["handle"]) if e["handle"] >= 0
-                 else (None, None) for e in entries]
+        if g.get("_fp"):
+            # Frozen overlap bucket: payloads were staged caller-side,
+            # nothing is parked in the core or the pending map
+            # (handle=-2 entries skip core completion in _finish too).
+            taken = g.pop("_fp_taken")
+        else:
+            taken = [self._take(e["handle"]) if e["handle"] >= 0
+                     else (None, None) for e in entries]
         names = [e["name"] for e in entries]
         if g.get("error"):
             # Fail-fast record: the core refused to zero-fill a
@@ -2067,7 +2340,15 @@ class MultihostEngine:
         # (below, via g) the completion-latency histogram.
         self._group_seq += 1
         gid = self._group_seq
-        self._m_cycles.inc()
+        if g.get("_fp"):
+            # A frozen schedule's buckets are one logical cycle: count
+            # it ONCE (on the final bucket) and as a fast-path cycle,
+            # never additionally as a negotiation cycle — levers.metrics
+            # must attribute each cycle to exactly one path.
+            if g.get("_fp_done"):
+                self._m_fp_frozen.inc()
+        else:
+            self._m_cycles.inc()
         self._m_last_group.set(gid)
         if g["op_type"] == "allreduce" and len(entries) > 1:
             self._m_bytes_fused.inc(group_bytes)
@@ -2229,6 +2510,12 @@ class MultihostEngine:
                 "mh_collective_seconds", op=g["op_type"],
                 size_class=g.get("_metrics_class", "0")).observe(
                     time.monotonic() - t0)
+            fp_t0 = g.get("_fp_t0")
+            if fp_t0 is not None:
+                # Per-bucket staging-to-completion latency of the
+                # frozen fast path (the eager plane reports dispatch
+                # time; here completion is the meaningful bound).
+                self._m_fp_bucket.observe(time.monotonic() - fp_t0)
             now = time.monotonic()
             if now - self._cache_gauge_t >= 1.0:
                 # Benign race on the throttle stamp (worst case one
@@ -2355,6 +2642,11 @@ class MultihostEngine:
     # -- shutdown ----------------------------------------------------------
 
     def shutdown(self):
+        # Thaw first (flush re-parks staged payloads in the pending
+        # map, swept into "engine shut down" errors below), and drop
+        # out of the thaw_all registry before the drain thread dies.
+        self._fp.thaw("membership", detail="engine shutdown")
+        fastpath.unregister(self._fp)
         self._shutdown = True
         self._thread.join(timeout=10.0)
         # Stop the completion thread with a sentinel AFTER the queued

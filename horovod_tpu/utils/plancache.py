@@ -377,6 +377,17 @@ class PlanController:
             self._seen.pop(key, None)
             self._counted = {k for k in self._counted if k[0] != key}
             self._memo.clear()  # the drop changes future resolutions
+        if had:
+            # A stale plan verdict also invalidates any frozen
+            # negotiated schedule built over it (SPMD-safe: this runs
+            # on every member at the same point, per the contract
+            # above).  Lazy import — plancache must not pull the ops
+            # package at module load.
+            from ..ops import fastpath
+            fastpath.thaw_all(
+                "staleness",
+                detail="plan %s/%s invalidated by staleness verdict"
+                % (op, cls))
         return had
 
     def pin(self, op: str, cls: str, entry: dict) -> bool:

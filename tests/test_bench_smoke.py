@@ -71,6 +71,13 @@ def test_bench_emits_one_valid_json_line():
                 "deadline_expired_total", "failures_by_reason"):
         assert key in res, key
     assert res["demoted_routes"] == []  # a clean bench run stays hier
+    # ISSUE 19 steady-state fast-path attribution: frozen/thaw counters
+    # + per-plane freezer state (additive key; present even when no
+    # engine ran, degraded to counters-only).
+    fp = lev["fastpath"]
+    for key in ("frozen_cycles_total", "thaws_total", "thaws_by_reason",
+                "planes"):
+        assert key in fp, key
 
 
 def test_allreduce_bw_amortization_math():
@@ -135,6 +142,54 @@ def test_allreduce_bw_fault_leg_self_attributes():
     # the bandwidth records themselves still printed (the A/B numbers)
     assert [r for r in recs
             if r.get("metric") == "allreduce_bus_bandwidth"], recs
+
+
+def test_allreduce_bw_fast_path_leg_self_attributes():
+    # The fast-path A/B leg: --fast-path on exports HOROVOD_FAST_PATH
+    # pre-init, the warm streak trips on the in-process engine, and the
+    # run ends with a self-attributing fastpath_levers JSON line whose
+    # frozen-cycle count (negotiations skipped) is the A/B evidence.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_FAST_PATH_WARM_CYCLES"] = "3"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "allreduce_bw.py"),
+         "--eager", "--cpu-devices", "2", "--sizes-mb", "0.25",
+         "--iters", "4", "--warmup", "2", "--fast-path", "on"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    bw = [r for r in recs
+          if r.get("metric") == "allreduce_bus_bandwidth"]
+    assert bw, recs
+    # per-size live-metrics reporting rode along
+    for key in ("negotiation_cycles", "negotiation_cycles_skipped",
+                "cycle_time_us"):
+        assert key in bw[0], key
+    lev = [r for r in recs if r.get("metric") == "fastpath_levers"]
+    assert len(lev) == 1, recs
+    fp = lev[0]["levers"]["fastpath"]
+    assert fp["frozen_cycles_total"] > 0, fp  # negotiations skipped
+    assert fp["planes"]["eager"]["enabled"] is True
+    # the off leg must really negotiate every cycle
+    env["HOROVOD_FAST_PATH"] = "1"  # ambient on; the flag must win
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "allreduce_bw.py"),
+         "--eager", "--cpu-devices", "2", "--sizes-mb", "0.25",
+         "--iters", "2", "--warmup", "1", "--fast-path", "off"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    lev = [r for r in recs if r.get("metric") == "fastpath_levers"]
+    assert len(lev) == 1, recs
+    assert lev[0]["levers"]["fastpath"]["frozen_cycles_total"] == 0
+    assert lev[0]["levers"]["fastpath"]["planes"]["eager"]["enabled"] \
+        is False
 
 
 def test_flash_roofline_smoke_schema():

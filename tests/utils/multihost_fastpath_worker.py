@@ -1,0 +1,199 @@
+"""Worker for the steady-state fast-path e2e tests (ISSUE 19): the
+multihost engine freezes a negotiated schedule after
+HOROVOD_FAST_PATH_WARM_CYCLES identical cycles (rank 0's verdict
+adopted through the rendezvous KV), dispatches from the cache, and —
+the part a unit test cannot certify — every loud-invalidation source
+thaws it back to full negotiation with CORRECT values and NO hang on
+every rank.  All scenarios need a rendezvous KV (the spawning test
+runs a RendezvousServer in-process): a KV-less multi-member world
+never freezes by design.
+
+``TEST_SCENARIO=fp_shape`` — warm, freeze, then submit a tensor whose
+shape does not match the frozen slot: the stage path thaws loudly
+(reason=shape), the mismatching tensor renegotiates to the right
+value, and the engine re-freezes on the new shape.
+
+``TEST_SCENARIO=fp_membership`` — the elastic-resize-shaped membership
+change: warm and freeze, then ``hvd.remove_process_set`` actuates the
+same engine invalidation a resize does — the frozen schedule thaws
+(reason=membership) before the engine touches its pending map.
+
+``TEST_SCENARIO=fp_stale`` — injection-certified stale dispatch: the
+spawning test arms ``engine.fastpath.stale_dispatch:drop@times=1``;
+the first frozen bucket dispatch hits the site, thaws
+(reason=staleness), and the staged tensor is flushed back through
+full negotiation — correct value, no hang, then re-freezes once the
+site is disarmed.
+
+``TEST_SCENARIO=fp_route`` — the r21 degraded-route verdict: an
+unbounded leg drop degrades every hier group to the flat plane while
+the schedule freezes anyway (routing is orthogonal to the negotiated
+profile); the SPMD ``check_degraded_routes`` demote verdict thaws
+(reason=route) on every member BEFORE the plan invalidate, and the
+next dispatch renegotiates onto the demoted flat route.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("TEST_LOCAL_DEVICES", "2")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import faultline, metrics, resilience
+from horovod_tpu.ops import fastpath
+
+WARM = int(os.environ.get("HOROVOD_FAST_PATH_WARM_CYCLES", "3"))
+N = 4096            # 16 KiB f32: below the hier threshold, fast cycles
+BIG_N = 32768       # 128 KiB: past the hier threshold (fp_route)
+CLS = str(BIG_N * 4)
+
+
+def _plane():
+    return fastpath.describe()["planes"]["multihost"]
+
+
+def _thaws(reason):
+    return metrics.series_sum("fastpath_thaws_total", reason=reason)
+
+
+def _frozen_total():
+    return metrics.series_sum("fastpath_frozen_cycles_total")
+
+
+def _cycles_total():
+    return metrics.series_sum("engine_cycles_total")
+
+
+def _ar(r, n, name, elems=N):
+    out = hvd.allreduce(np.full((elems,), float(r + 1), np.float32),
+                        op=hvd.Sum, name=name)
+    np.testing.assert_allclose(np.asarray(out),
+                               float(sum(range(1, n + 1))))
+
+
+def _warm_freeze(r, n, tag, elems=N):
+    """Run the warm streak; the freeze verdict lands (rank 0 through
+    the KV) before the tripping record executes, so the engine is
+    frozen the moment the last warm allreduce returns."""
+    for i in range(WARM):
+        _ar(r, n, "%s.%d" % (tag, i), elems)
+    assert _plane()["frozen"] is True, _plane()
+
+
+def run_fp_shape():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    _warm_freeze(r, n, "warm")
+
+    # Steady state: frozen dispatches move the frozen counter, never
+    # the negotiation-cycle counter (satellite f: no double counting).
+    cyc0, fr0 = _cycles_total(), _frozen_total()
+    _ar(r, n, "steady.0")
+    _ar(r, n, "steady.1")
+    assert _frozen_total() - fr0 == 2, (fr0, _frozen_total())
+    assert _cycles_total() == cyc0, (cyc0, _cycles_total())
+
+    # A shape change thaws loudly and still reduces correctly.
+    th0 = _thaws("shape")
+    _ar(r, n, "shape.change", elems=2 * N)
+    assert _thaws("shape") == th0 + 1, _thaws("shape")
+    assert _plane()["frozen"] is False, _plane()
+
+    # The engine re-freezes on the NEW shape (warm streak restarted
+    # by the mismatching cycle itself, so WARM more trips it).
+    _warm_freeze(r, n, "rewarm", elems=2 * N)
+    hvd.shutdown()
+    print("FASTPATH_OK %d" % r, flush=True)
+
+
+def run_fp_membership():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    ps = hvd.add_process_set([0])  # registered SPMD on every rank
+    _warm_freeze(r, n, "warm")
+
+    # The resize-shaped membership actuation: removing a process set
+    # invalidates it on the engine, which must thaw FIRST.
+    th0 = _thaws("membership")
+    assert hvd.remove_process_set(ps)
+    assert _thaws("membership") == th0 + 1, _thaws("membership")
+    assert _plane()["frozen"] is False, _plane()
+
+    # The world keeps reducing correctly and re-freezes.
+    _warm_freeze(r, n, "rewarm")
+    _ar(r, n, "steady.post")
+    hvd.shutdown()
+    print("FASTPATH_OK %d" % r, flush=True)
+
+
+def run_fp_stale():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+    _warm_freeze(r, n, "warm")
+
+    # The armed drop@times=1 fires at the first frozen bucket end:
+    # thaw(staleness) + flush back through negotiation — the caller's
+    # handle still resolves to the correct sum (no hang).
+    th0 = _thaws("staleness")
+    _ar(r, n, "stale.inject")
+    assert _thaws("staleness") == th0 + 1, _thaws("staleness")
+    assert _plane()["frozen"] is False, _plane()
+
+    # Disarm at the same point on every rank; the engine re-warms.
+    del os.environ["HVD_TPU_FAULT"]
+    faultline.reset()
+    _warm_freeze(r, n, "rewarm")
+    _ar(r, n, "steady.post")
+    hvd.shutdown()
+    print("FASTPATH_OK %d" % r, flush=True)
+
+
+def run_fp_route():
+    hvd.init(controller="multihost")
+    r, n = hvd.rank(), hvd.size()
+
+    # Hier-eligible payloads under an unbounded leg drop: every group
+    # degrades to the flat plane (values stay correct) while the
+    # negotiated profile — and therefore the freeze — is unaffected.
+    _warm_freeze(r, n, "warm", elems=BIG_N)
+
+    # The SPMD demote verdict (rank 0 streak >= threshold, adopted
+    # through the KV) must thaw the frozen schedule on EVERY member.
+    th0 = _thaws("route")
+    verdict = resilience.check_degraded_routes(timeout=60.0)
+    assert verdict is not None and verdict["action"] == "demote", verdict
+    assert (verdict["op"], verdict["size_class"]) == ("allreduce", CLS), \
+        verdict
+    assert _thaws("route") == th0 + 1, _thaws("route")
+    assert _plane()["frozen"] is False, _plane()
+
+    # Post-thaw dispatches renegotiate onto the demoted flat route
+    # with the fault still armed — correct values, no hier attempt.
+    _ar(r, n, "steady.post", elems=BIG_N)
+    hvd.shutdown()
+    print("FASTPATH_OK %d" % r, flush=True)
+
+
+def main():
+    scenario = os.environ.get("TEST_SCENARIO", "fp_shape")
+    run = {"fp_shape": run_fp_shape,
+           "fp_membership": run_fp_membership,
+           "fp_stale": run_fp_stale,
+           "fp_route": run_fp_route}[scenario]
+    run()
+
+
+if __name__ == "__main__":
+    main()
